@@ -1,0 +1,118 @@
+"""Benchmark harness.
+
+Runs the reference workload — the 20-epoch MNIST training defined by
+/root/reference/example.py:41-43 (batch 100, lr 5e-4, sigmoid MLP,
+11 000 sync steps = 20 global passes; SURVEY.md §6/§7 on epoch
+semantics) — on the current JAX backend and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "s", "vs_baseline": N}
+
+``vs_baseline`` is CPU_BASELINE_S / value: how many times faster than
+the measured single-host CPU baseline of this same framework (the
+reference publishes no numbers, SURVEY.md §6; the baseline is measured
+reproducibly here with --cpu-baseline and recorded in BASELINE.md).
+Values > 1 beat the baseline.
+
+Usage:
+    python bench.py                 # full 20-epoch run, one JSON line
+    python bench.py --epochs 2      # shorter run, extrapolated to 20
+    python bench.py --cpu-baseline  # re-measure the CPU baseline number
+    python bench.py --all-configs   # BASELINE.json's five configs (table to stderr)
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import sys
+
+# Measured on this image's CPU (1 core), full 20-epoch reference workload,
+# seed 1, synthetic MNIST; see BASELINE.md "Measured" table.
+CPU_BASELINE_S = 8.76
+CPU_BASELINE_ACC = 0.2356
+
+
+def _run(cfg):
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        res = run(cfg)
+    return res, buf.getvalue()
+
+
+def bench_config(name: str, cfg, epochs_full: int = 20):
+    res, _ = _run(cfg)
+    scale = epochs_full / cfg.training_epochs
+    wall = res["total_time_s"] * scale
+    return {
+        "config": name,
+        "wall_clock_20ep_s": wall,
+        "examples_per_sec": res["examples_per_sec"],
+        "examples_per_sec_per_chip": res["examples_per_sec"] / max(res["devices"], 1),
+        "test_accuracy": res["test_accuracy"],
+        "final_cost": res["final_cost"],
+        "devices": res["devices"],
+        "dataset": res["dataset_source"],
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=20)
+    p.add_argument("--cpu-baseline", action="store_true")
+    p.add_argument("--all-configs", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.cpu_baseline:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from distributed_tensorflow_example_tpu.config import Config
+
+    base = Config(summaries=False, training_epochs=args.epochs)
+
+    if args.all_configs:
+        # BASELINE.json's five configs (SURVEY.md §6). Configs 1-3's
+        # ps/worker topologies map per SURVEY.md §7: async -> local-SGD
+        # analog or summed-replica sync; sync -> the psum step.
+        import jax
+
+        n = len(jax.devices())
+        dp3 = min(3, n)
+        configs = [
+            ("1ps1worker_async", base.replace(data_parallel=1)),
+            ("1ps3workers_async", base.replace(
+                data_parallel=dp3, batch_size=102, grad_reduce="sum")),
+            ("syncreplicas_3workers", base.replace(
+                data_parallel=dp3, batch_size=102, grad_reduce="mean")),
+            ("deeper_relu_adam", base.replace(
+                hidden_sizes=(256, 128), activation="relu", optimizer="adam",
+                learning_rate=0.001)),
+            ("8way_dp", base.replace(
+                data_parallel=min(8, n), batch_size=104)),
+        ]
+        rows = [bench_config(name, cfg, epochs_full=20) for name, cfg in configs]
+        for r in rows:
+            print(json.dumps(r), file=sys.stderr)
+        headline = next(r for r in rows if r["config"] == "8way_dp")
+        wall = headline["wall_clock_20ep_s"]
+    else:
+        r = bench_config("reference_default", base, epochs_full=20)
+        print(json.dumps(r), file=sys.stderr)
+        wall = r["wall_clock_20ep_s"]
+
+    print(json.dumps({
+        "metric": "mnist_20epoch_wall_clock",
+        "value": round(wall, 3),
+        "unit": "s",
+        "vs_baseline": round(CPU_BASELINE_S / wall, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,31 @@
+"""TPU-native distributed training framework.
+
+A brand-new JAX/XLA/pjit framework with the capabilities of
+``springle/distributed-tensorflow-example`` (reference: a TF 1.2
+parameter-server MNIST example, /root/reference/example.py) rebuilt
+TPU-first:
+
+- the parameter server's per-step param-pull / grad-push over gRPC
+  (reference example.py:55-57, 111) becomes a single ``lax.psum``
+  allreduce over the ICI data-parallel mesh, compiled into the step;
+- both the live async path (example.py:101, 111) and the commented
+  ``SyncReplicasOptimizer`` path (example.py:102-110) map to the same
+  synchronous SPMD step (see SURVEY.md §7), with an optional local-SGD
+  mode (``--sync_period > 1``) reproducing async staleness semantics
+  TPU-natively;
+- the ``--job_name/--task_index`` CLI (example.py:30-32) is preserved
+  and maps to ``jax.distributed`` process identity.
+
+Layout:
+    config      flag system (reference example.py:29-44 equivalents)
+    cluster     process bootstrap / chief helpers (example.py:34-38, 132-138)
+    data        MNIST pipeline (example.py:46-48, 157)
+    models      MLP model zoo (example.py:74-90)
+    ops         losses, metrics, Pallas kernels (example.py:92-96, 118-121)
+    parallel    mesh, shardings, SPMD train step (example.py:54-57, 98-116)
+    train       optimizers, train state, driver loop (example.py:132-181)
+    utils       TensorBoard event writer, checkpointing, timers
+                (example.py:123-128, 145-146, 163)
+"""
+
+__version__ = "0.1.0"

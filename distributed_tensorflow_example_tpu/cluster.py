@@ -1,0 +1,84 @@
+"""Cluster / runtime bootstrap.
+
+Reference parity: the reference builds a ``tf.train.ClusterSpec`` over
+hardcoded ``host:2222`` endpoints and starts an in-process gRPC
+``tf.train.Server`` per task (/root/reference/example.py:22-38); the
+parameter-server role then blocks in ``server.join()`` (example.py:50-51)
+while workers wait for a ready session via ``tf.train.Supervisor``
+(example.py:132-138).
+
+TPU-native design (SURVEY.md L1): there is no role split — SPMD makes
+every process a worker. ``jax.distributed.initialize`` provides the
+coordination service (the coordinator address plays the spirit of the
+ps endpoint), and chief-ness is simply ``jax.process_index() == 0``,
+replacing ``Supervisor(is_chief=...)``. A startup barrier replaces
+``prepare_or_wait_for_session``; parameter broadcast is unnecessary
+because every process runs the identical seeded init (deterministic and
+barrier-free, SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .config import Config
+
+
+def bootstrap(cfg: Config) -> None:
+    """Initialize the distributed runtime from flags.
+
+    Maps the reference CLI onto ``jax.distributed``:
+      - ``--coordinator_address`` ≈ the ps endpoint ``pc-01:2222``
+        (example.py:23) — but serves only bootstrap, never tensors;
+      - ``--task_index`` ≈ the reference's task index (example.py:31-32),
+        reused as the process id;
+      - ``--job_name=ps`` is absorbed: the ps role is eliminated
+        (SURVEY.md §7). We print the explanation once for operators
+        porting run scripts from the reference.
+    """
+    if cfg.job_name == "ps":
+        print(
+            "NOTE: --job_name=ps maps to a no-op under SPMD: parameters are "
+            "device-resident and gradient exchange is a compiled psum "
+            "allreduce, so there is no parameter-server role. This process "
+            "will participate as a regular worker."
+        )
+    if cfg.coordinator_address and cfg.num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=cfg.coordinator_address,
+            num_processes=cfg.num_processes,
+            process_id=cfg.task_index,
+        )
+
+
+def enable_compilation_cache(cfg: Config) -> None:
+    """Persistent XLA compile cache (the analog of the reference reusing
+    its built graph across sess.run calls — here across *processes*).
+
+    First compile of the fused training program costs tens of seconds
+    through a remote-compile path; warm runs load the serialized
+    executable in ~ms. "auto" keeps the cache next to the repo so bench
+    and CLI runs share it.
+    """
+    path = cfg.compilation_cache
+    if not path:
+        return
+    if path == "auto":
+        import os
+
+        path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                            ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def is_chief() -> bool:
+    """Replaces ``Supervisor(is_chief=(task_index == 0))`` (example.py:132)."""
+    return jax.process_index() == 0
+
+
+def shutdown() -> None:
+    """Replaces ``sv.stop()`` (example.py:181)."""
+    if jax.process_count() > 1:
+        jax.distributed.shutdown()

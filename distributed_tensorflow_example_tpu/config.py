@@ -1,0 +1,182 @@
+"""Flag/config system.
+
+Reference parity: the reference exposes exactly two flags,
+``--job_name`` ("Either 'ps' or 'worker'") and ``--task_index``
+(/root/reference/example.py:30-32), and hardcodes everything else:
+cluster hosts (example.py:23-26), ``batch_size=100``,
+``learning_rate=0.0005``, ``training_epochs=20``,
+``logs_path="/tmp/mnist/1"`` (example.py:41-44), print ``frequency=100``
+(example.py:137) and graph seed 1 (example.py:74).
+
+Here every hardcoded constant is promoted to a flag with the reference
+value as its default, and the two reference flags keep their names.
+``--job_name=ps`` is accepted and explained away: SPMD has no parameter
+server role (SURVEY.md §7) — every process is a worker.
+
+Extensions required by BASELINE.json config 4: ``--hidden_sizes``,
+``--activation``, ``--optimizer`` make the deeper ReLU+Adam variant a
+flag change, not a code change.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Complete run configuration. Defaults replicate the reference."""
+
+    # ---- reference flags (example.py:30-32) ----
+    job_name: str = ""          # "", "ps" or "worker"; informational under SPMD
+    task_index: int = 0         # maps to jax.distributed process_id
+
+    # ---- distributed topology (replaces ClusterSpec, example.py:22-27) ----
+    coordinator_address: str = ""   # e.g. "10.0.0.1:2222"; empty = single process
+    num_processes: int = 1
+
+    # ---- hyperparameters (example.py:41-44) ----
+    batch_size: int = 100           # global batch size
+    learning_rate: float = 0.0005
+    training_epochs: int = 20
+    logs_path: str = "/tmp/mnist/1"
+
+    # ---- training-loop constants (example.py:74, 137) ----
+    seed: int = 1
+    frequency: int = 100            # steps between throughput prints
+
+    # ---- model (example.py:76-90; BASELINE config 4 extensions) ----
+    input_size: int = 784
+    num_classes: int = 10
+    hidden_sizes: tuple[int, ...] = (100,)
+    activation: str = "sigmoid"     # sigmoid | relu | tanh | gelu
+    param_dtype: str = "float32"
+    compute_dtype: str = "float32"  # bfloat16 puts the matmuls on the MXU native dtype
+
+    # ---- loss (example.py:92-96) ----
+    naive_ce: bool = False          # reproduce the reference's unstable log(softmax) CE
+
+    # ---- optimizer (example.py:98-111; BASELINE config 4) ----
+    optimizer: str = "sgd"          # sgd | momentum | adam
+    momentum: float = 0.9
+    adam_b1: float = 0.9
+    adam_b2: float = 0.999
+    adam_eps: float = 1e-8
+
+    # ---- parallelism (SURVEY.md §7; replaces replica_device_setter) ----
+    data_parallel: int = -1         # -1: all devices on the data axis
+    model_parallel: int = 1         # Megatron-style TP over the hidden dim
+    sync_period: int = 1            # 1 = fully synchronous psum every step;
+                                    # K>1 = local SGD, params averaged every K
+                                    # steps (TPU-native async-staleness analog,
+                                    # SURVEY.md §7 semantic mapping)
+    grad_reduce: str = "mean"       # mean | sum over the data axis
+
+    # ---- data (example.py:46-48) ----
+    data_dir: str = "MNIST_data"
+    dataset: str = "auto"           # auto | mnist | synthetic
+    shard_data: bool = True         # reference workers each consume the FULL
+                                    # dataset (example.py:150-157); sharded
+                                    # epochs are the sync-DP equivalent.
+
+    # ---- observability (example.py:123-128, 145-146) ----
+    summaries: bool = True
+    summaries_all_hosts: bool = False   # reference logs on every machine
+                                        # (example.py:145-146); chief-only default
+    profile: bool = False               # jax.profiler trace into logs_path
+    debug_nans: bool = False
+
+    # ---- checkpoint/resume (SURVEY.md §5) ----
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 0       # steps; 0 = only at exit
+    resume: bool = False
+
+    # ---- misc ----
+    eval_batch_size: int = 2000
+    pallas: bool = False            # use the fused Pallas forward kernel
+    fast_loop: bool = True          # device-resident dataset + lax.scan epochs
+                                    # (zero per-step host traffic); falls back
+                                    # to the host-fed loop for async mode and
+                                    # multi-process runs
+    compilation_cache: str = "auto" # persistent XLA compile cache dir;
+                                    # "auto" = <repo>/.jax_cache, "" = off
+
+    @property
+    def is_chief(self) -> bool:
+        import jax
+
+        return jax.process_index() == 0
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+def _parse_hidden(s: str) -> tuple[int, ...]:
+    return tuple(int(x) for x in s.replace(",", " ").split())
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="distributed_tensorflow_example_tpu",
+        description="TPU-native data-parallel MNIST training "
+        "(capability parity with springle/distributed-tensorflow-example)",
+    )
+    d = Config()
+    p.add_argument("--job_name", type=str, default=d.job_name,
+                   help="Either 'ps' or 'worker' (reference parity; SPMD has no "
+                        "ps role — 'ps' is accepted and absorbed)")
+    p.add_argument("--task_index", type=int, default=d.task_index,
+                   help="Index of task within the job (maps to process id)")
+    p.add_argument("--coordinator_address", type=str, default=d.coordinator_address)
+    p.add_argument("--num_processes", type=int, default=d.num_processes)
+    p.add_argument("--batch_size", type=int, default=d.batch_size)
+    p.add_argument("--learning_rate", type=float, default=d.learning_rate)
+    p.add_argument("--training_epochs", type=int, default=d.training_epochs)
+    p.add_argument("--logs_path", type=str, default=d.logs_path)
+    p.add_argument("--seed", type=int, default=d.seed)
+    p.add_argument("--frequency", type=int, default=d.frequency)
+    p.add_argument("--input_size", type=int, default=d.input_size)
+    p.add_argument("--num_classes", type=int, default=d.num_classes)
+    p.add_argument("--hidden_sizes", type=_parse_hidden, default=d.hidden_sizes,
+                   metavar="H1,H2,...", help="e.g. 100 or 256,128")
+    p.add_argument("--activation", type=str, default=d.activation,
+                   choices=["sigmoid", "relu", "tanh", "gelu"])
+    p.add_argument("--param_dtype", type=str, default=d.param_dtype)
+    p.add_argument("--compute_dtype", type=str, default=d.compute_dtype)
+    p.add_argument("--naive_ce", action="store_true")
+    p.add_argument("--optimizer", type=str, default=d.optimizer,
+                   choices=["sgd", "momentum", "adam"])
+    p.add_argument("--momentum", type=float, default=d.momentum)
+    p.add_argument("--adam_b1", type=float, default=d.adam_b1)
+    p.add_argument("--adam_b2", type=float, default=d.adam_b2)
+    p.add_argument("--adam_eps", type=float, default=d.adam_eps)
+    p.add_argument("--data_parallel", type=int, default=d.data_parallel)
+    p.add_argument("--model_parallel", type=int, default=d.model_parallel)
+    p.add_argument("--sync_period", type=int, default=d.sync_period)
+    p.add_argument("--grad_reduce", type=str, default=d.grad_reduce,
+                   choices=["mean", "sum"])
+    p.add_argument("--data_dir", type=str, default=d.data_dir)
+    p.add_argument("--dataset", type=str, default=d.dataset,
+                   choices=["auto", "mnist", "synthetic"])
+    p.add_argument("--no_shard_data", dest="shard_data", action="store_false")
+    p.add_argument("--no_summaries", dest="summaries", action="store_false")
+    p.add_argument("--summaries_all_hosts", action="store_true")
+    p.add_argument("--profile", action="store_true")
+    p.add_argument("--debug_nans", action="store_true")
+    p.add_argument("--checkpoint_dir", type=str, default=d.checkpoint_dir)
+    p.add_argument("--checkpoint_every", type=int, default=d.checkpoint_every)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--eval_batch_size", type=int, default=d.eval_batch_size)
+    p.add_argument("--pallas", action="store_true")
+    p.add_argument("--no_fast_loop", dest="fast_loop", action="store_false")
+    p.add_argument("--compilation_cache", type=str, default=d.compilation_cache)
+    return p
+
+
+def parse_config(argv: Sequence[str] | None = None) -> Config:
+    ns = build_parser().parse_args(argv)
+    kw = vars(ns)
+    kw["hidden_sizes"] = tuple(kw["hidden_sizes"])
+    return Config(**kw)

@@ -1,0 +1,3 @@
+from .mnist import Dataset, DataSplit, load_datasets, EpochIterator
+
+__all__ = ["Dataset", "DataSplit", "load_datasets", "EpochIterator"]

@@ -1,0 +1,288 @@
+"""MNIST input pipeline.
+
+Reference parity: the reference calls
+``input_data.read_data_sets('MNIST_data', one_hot=True)``
+(/root/reference/example.py:47-48) from the long-gone
+``tensorflow.examples.tutorials.mnist`` package, then iterates
+``mnist.train.next_batch(batch_size)`` (example.py:157) over
+``mnist.train.num_examples`` (= 55 000; example.py:153) and evaluates on
+``mnist.test.images/labels`` (10 000 examples; example.py:177).
+
+This module is a from-scratch replacement:
+
+- **IDX parser** for the four standard MNIST files (``*-images-idx3-ubyte``
+  / ``*-labels-idx1-ubyte``, optionally ``.gz``), validated against the
+  IDX magic numbers (0x00000803 images / 0x00000801 labels);
+- the TF tutorial's exact split semantics: the 60 000-example train file
+  becomes 55 000 train + 5 000 validation;
+- a **deterministic synthetic MNIST** fallback for air-gapped machines
+  (no network egress): procedurally rendered digit glyphs with jitter and
+  noise, same shapes/dtypes/split sizes, so every code path (train, eval,
+  bench) runs end-to-end offline;
+- an **epoch iterator** mirroring ``next_batch`` (shuffled each epoch,
+  seeded) with optional per-process sharding. Note the reference does
+  *not* shard: each of its 3 async workers consumes all 20 full epochs
+  (example.py:150-157); ``shard=False`` reproduces that, ``shard=True``
+  is the sync-DP equivalent (SURVEY.md §7 hard part 3).
+
+Native path: when the compiled helper library is available
+(``native/libdtx.so``), IDX decoding and batch gather run in C++
+(see ``distributed_tensorflow_example_tpu.native``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import gzip
+import os
+import struct
+from typing import Iterator, Tuple
+
+import numpy as np
+
+IMAGE_MAGIC = 0x00000803
+LABEL_MAGIC = 0x00000801
+
+TRAIN_IMAGES = "train-images-idx3-ubyte"
+TRAIN_LABELS = "train-labels-idx1-ubyte"
+TEST_IMAGES = "t10k-images-idx3-ubyte"
+TEST_LABELS = "t10k-labels-idx1-ubyte"
+
+VALIDATION_SIZE = 5000  # TF tutorial split: 60k -> 55k train + 5k validation
+
+
+def _open_maybe_gz(path: str):
+    if os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", "rb")
+    return open(path, "rb")
+
+
+def parse_idx_images(data: bytes) -> np.ndarray:
+    """Parse an IDX3 image file into uint8 [N, rows, cols]."""
+    if len(data) < 16:
+        raise ValueError(f"IDX image file too short ({len(data)} bytes); bad magic/header")
+    magic, n, rows, cols = struct.unpack(">IIII", data[:16])
+    if magic != IMAGE_MAGIC:
+        raise ValueError(f"bad IDX image magic 0x{magic:08x}, want 0x{IMAGE_MAGIC:08x}")
+    arr = np.frombuffer(data, dtype=np.uint8, count=n * rows * cols, offset=16)
+    return arr.reshape(n, rows, cols)
+
+
+def parse_idx_labels(data: bytes) -> np.ndarray:
+    """Parse an IDX1 label file into uint8 [N]."""
+    if len(data) < 8:
+        raise ValueError(f"IDX label file too short ({len(data)} bytes); bad magic/header")
+    magic, n = struct.unpack(">II", data[:8])
+    if magic != LABEL_MAGIC:
+        raise ValueError(f"bad IDX label magic 0x{magic:08x}, want 0x{LABEL_MAGIC:08x}")
+    return np.frombuffer(data, dtype=np.uint8, count=n, offset=8)
+
+
+def one_hot(labels: np.ndarray, num_classes: int = 10) -> np.ndarray:
+    out = np.zeros((labels.shape[0], num_classes), dtype=np.float32)
+    out[np.arange(labels.shape[0]), labels] = 1.0
+    return out
+
+
+@dataclasses.dataclass
+class DataSplit:
+    """One split: flattened float32 images in [0,1] and one-hot labels.
+
+    Mirrors the ``mnist.train`` / ``mnist.test`` objects the reference
+    uses (example.py:153, 157, 177).
+    """
+
+    images: np.ndarray  # [N, 784] float32 in [0, 1]
+    labels: np.ndarray  # [N, 10] float32 one-hot
+
+    @property
+    def num_examples(self) -> int:
+        return self.images.shape[0]
+
+
+@dataclasses.dataclass
+class Dataset:
+    train: DataSplit
+    validation: DataSplit
+    test: DataSplit
+    source: str  # "mnist" or "synthetic"
+
+
+# ---------------------------------------------------------------------------
+# Synthetic fallback (offline-deterministic)
+# ---------------------------------------------------------------------------
+
+# 5x7 bitmap glyphs for digits 0-9 (classic dot-matrix font), row-major.
+_GLYPHS = {
+    0: ["01110", "10001", "10011", "10101", "11001", "10001", "01110"],
+    1: ["00100", "01100", "00100", "00100", "00100", "00100", "01110"],
+    2: ["01110", "10001", "00001", "00010", "00100", "01000", "11111"],
+    3: ["11111", "00010", "00100", "00010", "00001", "10001", "01110"],
+    4: ["00010", "00110", "01010", "10010", "11111", "00010", "00010"],
+    5: ["11111", "10000", "11110", "00001", "00001", "10001", "01110"],
+    6: ["00110", "01000", "10000", "11110", "10001", "10001", "01110"],
+    7: ["11111", "00001", "00010", "00100", "01000", "01000", "01000"],
+    8: ["01110", "10001", "10001", "01110", "10001", "10001", "01110"],
+    9: ["01110", "10001", "10001", "01111", "00001", "00010", "01100"],
+}
+
+
+def _glyph_array(digit: int) -> np.ndarray:
+    g = _GLYPHS[digit]
+    return np.array([[int(c) for c in row] for row in g], dtype=np.float32)
+
+
+def synthesize_split(n: int, seed: int) -> DataSplit:
+    """Deterministic MNIST-like data: upscaled glyphs + jitter + noise.
+
+    Learnable by the reference MLP to high accuracy, which is what the
+    end-to-end and bench paths need; statistically it is NOT MNIST and
+    accuracy numbers on it are labelled as synthetic (Dataset.source).
+    """
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=n).astype(np.uint8)
+    images = np.zeros((n, 28, 28), dtype=np.float32)
+    # Upscale 5x7 -> 15x21 (3x), place with +-3 px jitter around center.
+    glyphs = {d: np.kron(_glyph_array(d), np.ones((3, 3), np.float32)) for d in range(10)}
+    gh, gw = 21, 15
+    for i in range(n):
+        gy = 3 + rng.randint(-3, 4)
+        gx = 6 + rng.randint(-3, 4)
+        intensity = 0.6 + 0.4 * rng.rand()
+        images[i, gy : gy + gh, gx : gx + gw] = glyphs[labels[i]] * intensity
+    images += rng.normal(0.0, 0.08, size=images.shape).astype(np.float32)
+    np.clip(images, 0.0, 1.0, out=images)
+    return DataSplit(images=images.reshape(n, 784), labels=one_hot(labels))
+
+
+def synthesize_dataset(seed: int = 0) -> Dataset:
+    return Dataset(
+        train=synthesize_split(55000, seed=seed + 1),
+        validation=synthesize_split(5000, seed=seed + 2),
+        test=synthesize_split(10000, seed=seed + 3),
+        source="synthetic",
+    )
+
+
+# ---------------------------------------------------------------------------
+# Real MNIST from IDX files on disk
+# ---------------------------------------------------------------------------
+
+
+def load_idx_dataset(data_dir: str) -> Dataset:
+    def read(name: str) -> bytes:
+        with _open_maybe_gz(os.path.join(data_dir, name)) as f:
+            return f.read()
+
+    train_images = parse_idx_images(read(TRAIN_IMAGES))
+    train_labels = parse_idx_labels(read(TRAIN_LABELS))
+    test_images = parse_idx_images(read(TEST_IMAGES))
+    test_labels = parse_idx_labels(read(TEST_LABELS))
+
+    def to_split(imgs: np.ndarray, lbls: np.ndarray) -> DataSplit:
+        flat = imgs.reshape(imgs.shape[0], -1).astype(np.float32) / 255.0
+        return DataSplit(images=flat, labels=one_hot(lbls))
+
+    # TF tutorial split semantics: first VALIDATION_SIZE examples held out.
+    return Dataset(
+        train=to_split(train_images[VALIDATION_SIZE:], train_labels[VALIDATION_SIZE:]),
+        validation=to_split(train_images[:VALIDATION_SIZE], train_labels[:VALIDATION_SIZE]),
+        test=to_split(test_images, test_labels),
+        source="mnist",
+    )
+
+
+def idx_files_present(data_dir: str) -> bool:
+    return all(
+        os.path.exists(os.path.join(data_dir, n))
+        or os.path.exists(os.path.join(data_dir, n + ".gz"))
+        for n in (TRAIN_IMAGES, TRAIN_LABELS, TEST_IMAGES, TEST_LABELS)
+    )
+
+
+def load_datasets(data_dir: str = "MNIST_data", dataset: str = "auto", seed: int = 0) -> Dataset:
+    """Replacement for ``input_data.read_data_sets`` (example.py:47-48).
+
+    ``auto`` uses real IDX files when present in ``data_dir``, otherwise
+    the deterministic synthetic fallback (this machine has no network
+    egress, so there is no download path; drop the 4 standard IDX files
+    into ``data_dir`` to train on real MNIST).
+    """
+    if dataset in ("mnist", "auto") and idx_files_present(data_dir):
+        return load_idx_dataset(data_dir)
+    if dataset == "mnist":
+        raise FileNotFoundError(
+            f"MNIST IDX files not found in {data_dir!r}: need "
+            f"{TRAIN_IMAGES}, {TRAIN_LABELS}, {TEST_IMAGES}, {TEST_LABELS} "
+            f"(optionally .gz)"
+        )
+    return synthesize_dataset(seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# Epoch iterator (next_batch equivalent)
+# ---------------------------------------------------------------------------
+
+
+class EpochIterator:
+    """Shuffled mini-batch iterator, the ``next_batch`` analog.
+
+    The reference's ``mnist.train.next_batch(100)`` (example.py:157)
+    shuffles once per epoch and walks the permutation. This iterator does
+    the same, seeded for determinism, with optional per-process sharding:
+    process ``p`` of ``P`` sees the permutation's slice ``p::P`` so one
+    "epoch" across all processes is exactly one global pass (SURVEY.md §7
+    hard part 3). With ``shard=False`` every process walks the full
+    permutation — the reference's actual (unsharded) behavior.
+
+    Batches are gathered through the native C++ helper when available
+    (index-gather is host-side memcpy work, off the interpreter).
+    """
+
+    def __init__(
+        self,
+        split: DataSplit,
+        batch_size: int,
+        seed: int = 1,
+        shard: bool = True,
+        process_index: int = 0,
+        process_count: int = 1,
+        drop_remainder: bool = True,
+    ):
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        self.split = split
+        self.batch_size = batch_size
+        self.shard = shard
+        self.process_index = process_index
+        self.process_count = process_count
+        self.drop_remainder = drop_remainder
+        self._rng = np.random.RandomState(seed)
+        self._epoch = 0
+
+    def _local_examples(self) -> int:
+        n = self.split.num_examples
+        if self.shard:
+            n = n // self.process_count + (
+                1 if self.process_index < n % self.process_count else 0
+            )
+        return n
+
+    @property
+    def batches_per_epoch(self) -> int:
+        """Reference: ``int(mnist.train.num_examples / batch_size)`` (example.py:153)."""
+        n = self._local_examples()
+        if self.drop_remainder:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def epoch(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        perm = self._rng.permutation(self.split.num_examples)
+        self._epoch += 1
+        if self.shard and self.process_count > 1:
+            perm = perm[self.process_index :: self.process_count]
+        from ..native import gather_batch  # lazy: avoids import cycle at module load
+
+        for b in range(self.batches_per_epoch):
+            idx = perm[b * self.batch_size : (b + 1) * self.batch_size]
+            yield gather_batch(self.split.images, self.split.labels, idx)

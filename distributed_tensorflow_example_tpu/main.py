@@ -1,0 +1,27 @@
+"""CLI entry point.
+
+Reference parity: ``python example.py --job_name={ps,worker}
+--task_index=N`` (/root/reference/example.py:6-11, 29-32). Same flags
+here — ``python -m distributed_tensorflow_example_tpu.main
+--job_name=worker --task_index=0`` — plus every formerly-hardcoded
+constant as a flag (config.py). Under SPMD there is no ps role
+(SURVEY.md §7): ``--job_name=ps`` participates as a worker after
+printing the mapping explanation.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .config import parse_config
+from .train.loop import run
+
+
+def main(argv=None) -> int:
+    cfg = parse_config(argv)
+    run(cfg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+from .mlp import MLPSpec, init, apply, num_params
+
+__all__ = ["MLPSpec", "init", "apply", "num_params"]

@@ -1,0 +1,120 @@
+"""MLP model family.
+
+Reference parity: the reference builds, under a ps-placement scope, a
+2-layer sigmoid MLP with seed-1 standard-normal weights and zero biases
+(/root/reference/example.py:74-90):
+
+    W1 ~ N(0,1) [784,100]; b1 = 0 [100]      (example.py:76, 81)
+    W2 ~ N(0,1) [100,10];  b2 = 0 [10]       (example.py:77, 82)
+    z2 = x@W1 + b1; a2 = sigmoid(z2)         (example.py:87-88)
+    z3 = a2@W2 + b2; y = softmax(z3)         (example.py:89-90)
+
+TPU-native design (SURVEY.md L3): a pure-function pytree model —
+``init(key, spec)`` returns the parameter pytree, ``apply(spec, params,
+x)`` returns *logits* (z3). Softmax is deliberately NOT applied in the
+forward: the loss works on logits in log-sum-exp form (the reference's
+``log(softmax)`` is numerically unstable, SURVEY.md §2 quirks), and the
+accuracy argmax is softmax-invariant. ``--naive_ce`` reproduces the
+reference arithmetic from the same logits for parity runs.
+
+BASELINE.json config 4 ("deeper MLP, 2 hidden, ReLU") is the same code
+with ``hidden_sizes=(h1, h2), activation='relu'`` — depth, widths and
+activation are spec fields, not new code.
+
+Sharding (SURVEY.md L2): parameters carry no placement here; the
+parallel layer assigns ``NamedSharding``s — replicated for pure DP, or
+Megatron-style split over the hidden axis when ``model_parallel > 1``
+(W1 column-sharded, W2 row-sharded; see parallel/step.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, jnp.ndarray]
+
+_ACTIVATIONS = {
+    "sigmoid": jax.nn.sigmoid,
+    "relu": jax.nn.relu,
+    "tanh": jnp.tanh,
+    "gelu": jax.nn.gelu,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPSpec:
+    input_size: int = 784
+    hidden_sizes: tuple[int, ...] = (100,)
+    num_classes: int = 10
+    activation: str = "sigmoid"
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @property
+    def layer_sizes(self) -> tuple[int, ...]:
+        return (self.input_size, *self.hidden_sizes, self.num_classes)
+
+    @property
+    def num_layers(self) -> int:
+        return len(self.hidden_sizes) + 1
+
+
+def init(key: jax.Array, spec: MLPSpec) -> Params:
+    """Seeded init: W ~ N(0,1), b = 0, matching example.py:74-82.
+
+    The reference seeds the graph with ``tf.set_random_seed(1)``
+    (example.py:74); callers pass ``jax.random.PRNGKey(seed)``. Standard
+    normal (stddev 1) init is unusual by modern standards but is the
+    reference's exact choice (``tf.random_normal`` defaults).
+    """
+    sizes = spec.layer_sizes
+    params: Params = {}
+    keys = jax.random.split(key, spec.num_layers)
+    for i in range(spec.num_layers):
+        params[f"W{i + 1}"] = jax.random.normal(
+            keys[i], (sizes[i], sizes[i + 1]), dtype=spec.param_dtype
+        )
+        params[f"b{i + 1}"] = jnp.zeros((sizes[i + 1],), dtype=spec.param_dtype)
+    return params
+
+
+def apply(
+    spec: MLPSpec,
+    params: Params,
+    x: jnp.ndarray,
+    styles: tuple[str, ...] | None = None,
+    model_axis: str | None = None,
+) -> jnp.ndarray:
+    """Forward pass to logits (example.py:87-89; softmax left to the loss).
+
+    Runs in ``compute_dtype`` (bfloat16 hits the MXU's native input
+    width); params stay in ``param_dtype``. The whole chain fuses into
+    one XLA computation — matmuls on the MXU, elementwise fused in.
+
+    ``styles`` (from parallel.mesh.layer_styles) makes the same code
+    tensor-parallel inside shard_map: a 'row'-split layer's partial
+    matmul is psum'd over ``model_axis`` before the bias. With the
+    default (None / all-'rep') this is the plain replicated forward.
+    """
+    act = _ACTIVATIONS[spec.activation]
+    h = x.astype(spec.compute_dtype)
+    L = spec.num_layers
+    for i in range(1, L + 1):
+        w = params[f"W{i}"].astype(spec.compute_dtype)
+        b = params[f"b{i}"].astype(spec.compute_dtype)
+        if styles is not None and styles[i - 1] == "row":
+            h = jax.lax.psum(h @ w, model_axis) + b
+        else:
+            h = h @ w + b
+        if i < L:
+            h = act(h)
+    return h.astype(jnp.float32)
+
+
+def num_params(spec: MLPSpec) -> int:
+    sizes = spec.layer_sizes
+    return sum(sizes[i] * sizes[i + 1] + sizes[i + 1] for i in range(spec.num_layers))

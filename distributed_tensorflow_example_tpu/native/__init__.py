@@ -1,0 +1,161 @@
+"""ctypes bindings for the native host-runtime library.
+
+The TPU compute path is XLA/Pallas (that stack's native surface); this
+module covers the *host-side* native work the reference delegated to
+TensorFlow's C++ runtime (SURVEY.md §2b): IDX pixel decode, mini-batch
+gather (behind ``next_batch``, /root/reference/example.py:157), and
+CRC32C for TFRecord-framed TensorBoard event files (example.py:146).
+
+The shared library is built lazily with ``g++`` on first use and cached
+next to the source. Every function has a numpy fallback so the framework
+runs (slower) even without a toolchain; ``DTX_NO_NATIVE=1`` forces the
+fallback (used by tests to compare both paths).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+_SRC = os.path.join(_SRC_DIR, "dtx_native.cpp")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libdtx.so")
+
+_lock = threading.Lock()
+_lib: "ctypes.CDLL | None" = None
+_load_attempted = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _LIB_PATH, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        return False
+
+
+def _load() -> "ctypes.CDLL | None":
+    global _lib, _load_attempted
+    if os.environ.get("DTX_NO_NATIVE"):
+        return None
+    with _lock:
+        if _load_attempted:
+            return _lib
+        _load_attempted = True
+        stale = not os.path.exists(_LIB_PATH) or (
+            os.path.exists(_SRC) and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
+        )
+        if stale and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.dtx_crc32c.restype = ctypes.c_uint32
+        lib.dtx_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.dtx_u8_to_f32_scaled.restype = None
+        lib.dtx_u8_to_f32_scaled.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t, ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.dtx_gather_batch.restype = None
+        lib.dtx_gather_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# CRC32C
+# ---------------------------------------------------------------------------
+
+_CRC_TABLE = None
+
+
+def _py_crc32c(data: bytes) -> int:
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (0x82F63B78 ^ (c >> 1)) if c & 1 else (c >> 1)
+            table.append(c)
+        _CRC_TABLE = table
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def crc32c(data: bytes) -> int:
+    lib = _load()
+    if lib is not None:
+        return lib.dtx_crc32c(data, len(data))
+    return _py_crc32c(data)
+
+
+def masked_crc32c(data: bytes) -> int:
+    """TFRecord CRC masking (the RecordWriter convention)."""
+    crc = crc32c(data)
+    return ((((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF)
+
+
+# ---------------------------------------------------------------------------
+# IDX pixel decode
+# ---------------------------------------------------------------------------
+
+
+def u8_to_f32_scaled(arr: np.ndarray) -> np.ndarray:
+    """uint8 pixels -> float32 in [0,1] (the normalize in example.py:47-48)."""
+    arr = np.ascontiguousarray(arr, dtype=np.uint8)
+    lib = _load()
+    if lib is None:
+        return arr.astype(np.float32) / 255.0
+    out = np.empty(arr.shape, dtype=np.float32)
+    lib.dtx_u8_to_f32_scaled(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        arr.size,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Batch gather
+# ---------------------------------------------------------------------------
+
+
+def gather_batch(images: np.ndarray, labels: np.ndarray, idx: np.ndarray):
+    """(images[idx], labels[idx]) — the copy behind next_batch (example.py:157).
+
+    ctypes releases the GIL during the call, so a Python-thread prefetcher
+    wrapping this gather overlaps with the train loop for real.
+    """
+    lib = _load()
+    if lib is None:
+        return images[idx], labels[idx]
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    n = idx.shape[0]
+    out_img = np.empty((n, images.shape[1]), dtype=np.float32)
+    out_lbl = np.empty((n, labels.shape[1]), dtype=np.float32)
+    fp = ctypes.POINTER(ctypes.c_float)
+    lib.dtx_gather_batch(
+        images.ctypes.data_as(fp), labels.ctypes.data_as(fp),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        images.shape[1], labels.shape[1],
+        out_img.ctypes.data_as(fp), out_lbl.ctypes.data_as(fp),
+    )
+    return out_img, out_lbl

@@ -1,0 +1,4 @@
+from .losses import cross_entropy, stable_cross_entropy, naive_cross_entropy
+from .metrics import accuracy
+
+__all__ = ["cross_entropy", "stable_cross_entropy", "naive_cross_entropy", "accuracy"]

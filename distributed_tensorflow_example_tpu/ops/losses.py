@@ -1,0 +1,42 @@
+"""Cross-entropy losses.
+
+Reference parity: the reference computes
+``mean(-sum(y_ * log(softmax(z3)), axis=1))``
+(/root/reference/example.py:92-96 over the softmax from :90) — the
+numerically *unstable* form: ``log(softmax)`` with no clamping NaNs when
+any softmax output underflows to 0 (SURVEY.md §2 quirks).
+
+``stable_cross_entropy`` is the default: the same quantity computed from
+logits in log-sum-exp form, safe for all logit magnitudes.
+``naive_cross_entropy`` reproduces the reference arithmetic exactly
+(softmax then log) behind the ``--naive_ce`` flag for parity runs.
+Both take logits so the forward pass is shared.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def stable_cross_entropy(logits: jnp.ndarray, labels_onehot: jnp.ndarray) -> jnp.ndarray:
+    """mean over batch of -sum(y_ * log_softmax(logits)) — stable form."""
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.sum(labels_onehot * log_probs, axis=-1))
+
+
+def naive_cross_entropy(logits: jnp.ndarray, labels_onehot: jnp.ndarray) -> jnp.ndarray:
+    """The reference's exact arithmetic (example.py:95-96): log(softmax(z)).
+
+    Kept for parity experiments; NaNs for large logits, by design.
+    """
+    probs = jax.nn.softmax(logits, axis=-1)
+    return -jnp.mean(jnp.sum(labels_onehot * jnp.log(probs), axis=-1))
+
+
+def cross_entropy(
+    logits: jnp.ndarray, labels_onehot: jnp.ndarray, naive: bool = False
+) -> jnp.ndarray:
+    if naive:
+        return naive_cross_entropy(logits, labels_onehot)
+    return stable_cross_entropy(logits, labels_onehot)

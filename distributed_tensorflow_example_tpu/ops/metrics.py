@@ -1,0 +1,16 @@
+"""Metrics.
+
+Reference parity: ``mean(cast(equal(argmax(y,1), argmax(y_,1)), float))``
+(/root/reference/example.py:118-121). Computed from logits — argmax is
+softmax-invariant, so this matches the reference's accuracy over
+softmax outputs exactly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def accuracy(logits: jnp.ndarray, labels_onehot: jnp.ndarray) -> jnp.ndarray:
+    correct = jnp.argmax(logits, axis=-1) == jnp.argmax(labels_onehot, axis=-1)
+    return jnp.mean(correct.astype(jnp.float32))

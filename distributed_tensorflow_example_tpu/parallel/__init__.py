@@ -1,0 +1,22 @@
+from .mesh import build_mesh, param_pspecs, state_pspecs, place_state
+from .step import (
+    build_train_step,
+    build_eval_step,
+    build_local_train_step,
+    build_param_sync,
+    stack_state,
+    unstack_params,
+)
+
+__all__ = [
+    "build_mesh",
+    "param_pspecs",
+    "state_pspecs",
+    "place_state",
+    "build_train_step",
+    "build_eval_step",
+    "build_local_train_step",
+    "build_param_sync",
+    "stack_state",
+    "unstack_params",
+]

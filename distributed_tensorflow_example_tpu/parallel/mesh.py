@@ -1,0 +1,126 @@
+"""Device mesh and sharding policy.
+
+Reference parity: the reference's placement policy is
+``tf.train.replica_device_setter(worker_device=..., cluster=cluster)``
+(/root/reference/example.py:55-57) — between-graph replication that pins
+every ``tf.Variable`` to the parameter server and compute to the local
+worker, making each training step a param-pull/grad-push over gRPC
+(SURVEY.md §3.3: three network crossings per step).
+
+TPU-native design (SURVEY.md L2): a named ``jax.sharding.Mesh`` over
+the chips with axes ``('data', 'model')`` replaces the cluster spec's
+job/task topology. Placement becomes declarative ``PartitionSpec``s:
+
+- pure data parallelism (the reference's one real strategy, SURVEY.md
+  §2c): params replicated ``P()``, batch split ``P('data')`` — gradient
+  exchange compiles to one psum allreduce over ICI;
+- optional Megatron-style tensor parallelism over the MLP hidden dim
+  (``--model_parallel > 1``): odd layers column-split ``P(None,
+  'model')``, even layers row-split ``P('model', None)`` with a psum
+  after the row-split matmul. Absent from the reference (SURVEY.md §2c)
+  but a config change here, not a rewrite — the mesh layer is built so
+  absent strategies have a natural slot.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import AxisType, Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.mlp import MLPSpec
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def build_mesh(data_parallel: int = -1, model_parallel: int = 1, devices=None) -> Mesh:
+    """Build the ('data', 'model') mesh; replaces ClusterSpec (example.py:22-27).
+
+    ``data_parallel == -1`` takes every device not used by the model
+    axis. Axis order puts 'model' innermost so TP collectives ride the
+    fastest ICI links on real slices.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if model_parallel < 1 or n % model_parallel:
+        raise ValueError(f"model_parallel={model_parallel} must divide device count {n}")
+    dp = n // model_parallel if data_parallel == -1 else data_parallel
+    if dp * model_parallel > n:
+        raise ValueError(
+            f"mesh {dp}x{model_parallel} needs {dp * model_parallel} devices, have {n}"
+        )
+    devices = devices[: dp * model_parallel]
+    import numpy as np
+
+    dev_array = np.array(devices).reshape(dp, model_parallel)
+    return Mesh(
+        dev_array, (DATA_AXIS, MODEL_AXIS), axis_types=(AxisType.Auto, AxisType.Auto)
+    )
+
+
+def layer_styles(spec: MLPSpec, model_parallel: int) -> list[str]:
+    """Per-layer TP style: 'col' (column-split), 'row' (row-split + psum),
+    or 'rep' (replicated). Layers alternate col/row so activations only
+    need one psum per pair; the final layer stays replicated when the
+    alternation would leave the logits sharded."""
+    styles = []
+    for i in range(1, spec.num_layers + 1):
+        if model_parallel == 1:
+            styles.append("rep")
+        elif i % 2 == 1:
+            # Column-split shards the layer's output dim; keep logits replicated.
+            styles.append("rep" if i == spec.num_layers else "col")
+        else:
+            styles.append("row")
+    # validate divisibility for the sharded dims
+    sizes = spec.layer_sizes
+    for i, st in enumerate(styles, start=1):
+        if st == "col" and sizes[i] % model_parallel:
+            raise ValueError(
+                f"layer {i} output dim {sizes[i]} not divisible by model_parallel={model_parallel}"
+            )
+        if st == "row" and sizes[i - 1] % model_parallel:
+            raise ValueError(
+                f"layer {i} input dim {sizes[i - 1]} not divisible by model_parallel={model_parallel}"
+            )
+    return styles
+
+
+def param_pspecs(spec: MLPSpec, model_parallel: int = 1) -> Dict[str, P]:
+    """PartitionSpecs for the param pytree — the replica_device_setter analog."""
+    out: Dict[str, P] = {}
+    for i, st in enumerate(layer_styles(spec, model_parallel), start=1):
+        if st == "col":
+            out[f"W{i}"] = P(None, MODEL_AXIS)
+            out[f"b{i}"] = P(MODEL_AXIS)
+        elif st == "row":
+            out[f"W{i}"] = P(MODEL_AXIS, None)
+            out[f"b{i}"] = P()
+        else:
+            out[f"W{i}"] = P()
+            out[f"b{i}"] = P()
+    return out
+
+
+def state_pspecs(spec: MLPSpec, optimizer, model_parallel: int = 1):
+    """Spec tree matching a TrainState pytree."""
+    from ..train.state import TrainState
+
+    pp = param_pspecs(spec, model_parallel)
+    return TrainState(step=P(), params=pp, opt_state=optimizer.state_pspecs(pp))
+
+
+def shardings_for(mesh: Mesh, pspec_tree: Any):
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        pspec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def place_state(state, mesh: Mesh, pspec_tree):
+    """Put the state on the mesh with its shardings (one-time, at init;
+    afterwards the donated jit'd step keeps buffers in place)."""
+    return jax.device_put(state, shardings_for(mesh, pspec_tree))

@@ -1,0 +1,249 @@
+"""SPMD train/eval steps.
+
+Reference parity: one reference training step is
+``sess.run([train_op, cross_entropy, summary_op, global_step])``
+(/root/reference/example.py:160-162) — the TF graph executor pulls all
+parameters from the ps, runs fwd/bwd on the worker, pushes gradients
+back, and the ps applies SGD without locking (async, example.py:101,
+111) or behind the SyncReplicasOptimizer barrier (sync, commented,
+example.py:102-110). Three gRPC crossings and a full parameter copy
+each way, every step (SURVEY.md §3.3).
+
+TPU-native design (SURVEY.md §7): both reference paths compile to ONE
+XLA executable per step — forward, backward, cross-replica gradient
+reduction, and the optimizer update fused, with the reduction riding
+the ICI as a single psum. Two flavors:
+
+- **sync** (`build_train_step`): the SyncReplicasOptimizer semantics.
+  Per-shard fwd/bwd on the local batch slice; gradients of the (data-)
+  replicated params are automatically psum'd across the 'data' axis by
+  shard_map's transpose; ``grad_reduce='mean'`` rescales by 1/dp so an
+  N-device batch-B step is bitwise the 1-device batch-B step (the §4
+  psum-equivalence guarantee), while ``'sum'`` keeps the summed-replica
+  gradient — the effective-LR analog of N async workers each applying
+  their local gradient (SURVEY.md §7 hard part 1).
+
+- **async analog** (`build_local_train_step` + `build_param_sync`):
+  the reference's HOGWILD-style path (example.py:101,111) has no shared
+  mutable server under SPMD; its TPU-native equivalent is **local SGD**:
+  every data shard keeps a *divergent* copy of the params (stacked along
+  a leading mesh-sharded axis) and applies its own gradients locally,
+  reconciled by parameter averaging every ``--sync_period`` steps.
+  K=1 collapses to sync; growing K dials in the gradient staleness the
+  async reference exhibits.
+
+Tensor parallelism (absent in the reference, SURVEY.md §2c) composes
+orthogonally: layers marked 'col'/'row' by mesh.layer_styles shard the
+hidden dim Megatron-style with one psum after each row-split matmul.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..models import mlp
+from ..ops import losses, metrics
+from ..train.state import TrainState
+from . import mesh as mesh_lib
+from .mesh import DATA_AXIS, MODEL_AXIS
+
+
+def forward_local(spec: mlp.MLPSpec, params, x, styles, use_pallas: bool = False):
+    """Per-shard forward to (replicated) logits; TP-aware (example.py:87-89)."""
+    if use_pallas and all(s == "rep" for s in styles):
+        from ..ops import pallas_fused
+
+        return pallas_fused.mlp_forward(spec, params, x)
+    return mlp.apply(spec, params, x, styles=styles, model_axis=MODEL_AXIS)
+
+
+def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas):
+    logits = forward_local(spec, params, x, styles, use_pallas)
+    cost = losses.cross_entropy(logits, y, naive=naive)
+    acc = metrics.accuracy(logits, y)
+    return cost, acc
+
+
+def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer) -> Callable:
+    """The per-shard synchronous step body (state, x, y) -> (state, cost,
+    acc) — shared by the host-fed step (build_train_step) and the
+    device-resident scan paths (parallel/epoch.py) so both train with
+    identical semantics."""
+
+    def body(state: TrainState, x, y):
+        def loss_fn(p):
+            return _loss_and_acc(spec, p, x, y, styles, cfg.naive_ce, cfg.pallas)
+
+        (cost, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        # shard_map's transpose has already psum'd grads over 'data'
+        # (params are data-unvarying); rescale for mean semantics.
+        if cfg.grad_reduce == "mean" and dp > 1:
+            grads = jax.tree.map(lambda g: g / dp, grads)
+        new_params, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        cost = jax.lax.pmean(cost, DATA_AXIS)
+        acc = jax.lax.pmean(acc, DATA_AXIS)
+        return TrainState(state.step + 1, new_params, new_opt), cost, acc
+
+    return body
+
+
+def build_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer) -> Callable:
+    """Synchronous SPMD step: (state, x, y) -> (state, cost, acc).
+
+    The returned callable is jit'd with the state donated — params never
+    leave the devices (the inverse of the reference's per-step parameter
+    round-trip, SURVEY.md §3.3).
+    """
+    dp = mesh.shape[DATA_AXIS]
+    mp = mesh.shape[MODEL_AXIS]
+    styles = mesh_lib.layer_styles(spec, mp)
+    sspecs = mesh_lib.state_pspecs(spec, optimizer, mp)
+    shard_step = make_sync_step_body(cfg, spec, styles, dp, optimizer)
+
+    fn = jax.shard_map(
+        shard_step,
+        mesh=mesh,
+        in_specs=(sspecs, P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(sspecs, P(), P()),
+    )
+    return jax.jit(fn, donate_argnums=0)
+
+
+def build_eval_step(cfg, mesh, spec: mlp.MLPSpec) -> Callable:
+    """(params, x, y, mask) -> correct-prediction count (example.py:118-121).
+
+    Masked so the eval set can be zero-padded to a multiple of the data
+    axis; chunked callers sum counts exactly.
+    """
+    mp = mesh.shape[MODEL_AXIS]
+    styles = mesh_lib.layer_styles(spec, mp)
+    pp = mesh_lib.param_pspecs(spec, mp)
+
+    def shard_eval(params, x, y, mask):
+        logits = forward_local(spec, params, x, styles, cfg.pallas)
+        correct = (jnp.argmax(logits, -1) == jnp.argmax(y, -1)).astype(jnp.float32)
+        return jax.lax.psum(jnp.sum(correct * mask), DATA_AXIS)
+
+    fn = jax.shard_map(
+        shard_eval,
+        mesh=mesh,
+        in_specs=(pp, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(),
+    )
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# Async analog: local SGD over divergent per-shard replicas
+# ---------------------------------------------------------------------------
+
+
+def stack_state(state: TrainState, dp: int) -> TrainState:
+    """Replicate params/opt into a [dp, ...] leading axis (one divergent
+    copy per data shard — the analog of each async worker's view)."""
+    stack = lambda a: jnp.repeat(jnp.asarray(a)[None], dp, axis=0)
+    return TrainState(
+        step=state.step,
+        params=jax.tree.map(stack, state.params),
+        opt_state=jax.tree.map(stack, state.opt_state),
+    )
+
+
+def _stacked_specs(state: TrainState) -> TrainState:
+    """Spec tree for a stacked state: every array leaf P('data'), step P()."""
+    return TrainState(
+        step=P(),
+        params=jax.tree.map(lambda _: P(DATA_AXIS), state.params),
+        opt_state=jax.tree.map(lambda _: P(DATA_AXIS), state.opt_state),
+    )
+
+
+def build_local_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer, state_template):
+    """Async-analog step: each data shard updates its own param copy.
+
+    No cross-shard collective at all — the reference's unlocked
+    ps-apply (example.py:101, 111) with staleness made explicit.
+    Requires model_parallel == 1 (the reference has no TP to compose
+    with its async path either).
+    """
+    if mesh.shape[MODEL_AXIS] != 1:
+        raise ValueError("local-SGD (async) mode requires model_parallel=1")
+    styles = mesh_lib.layer_styles(spec, 1)
+    sspecs = _stacked_specs(state_template)
+
+    def shard_step(state: TrainState, x, y):
+        local_p = jax.tree.map(lambda a: a[0], state.params)
+        local_o = jax.tree.map(lambda a: a[0], state.opt_state)
+
+        def loss_fn(p):
+            return _loss_and_acc(spec, p, x, y, styles, cfg.naive_ce, cfg.pallas)
+
+        (cost, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(local_p)
+        new_p, new_o = optimizer.update(grads, local_o, local_p)
+        cost = jax.lax.pmean(cost, DATA_AXIS)
+        acc = jax.lax.pmean(acc, DATA_AXIS)
+        return (
+            TrainState(
+                state.step + 1,
+                jax.tree.map(lambda a: a[None], new_p),
+                jax.tree.map(lambda a: a[None], new_o),
+            ),
+            cost,
+            acc,
+        )
+
+    fn = jax.shard_map(
+        shard_step,
+        mesh=mesh,
+        in_specs=(sspecs, P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(sspecs, P(), P()),
+    )
+    return jax.jit(fn, donate_argnums=0)
+
+
+def build_param_sync(mesh, state_template) -> Callable:
+    """Average divergent replicas — the --sync_period reconciliation.
+
+    Float leaves are averaged across the data axis (the model-averaging
+    step of local SGD); integer leaves (e.g. Adam's count) are identical
+    across shards by construction and pass through.
+    """
+    sspecs = _stacked_specs(state_template)
+
+    def avg(a):
+        if jnp.issubdtype(a.dtype, jnp.integer):
+            return a
+        return jax.lax.pmean(a, DATA_AXIS)
+
+    def shard_sync(state: TrainState):
+        return TrainState(
+            step=state.step,
+            params=jax.tree.map(avg, state.params),
+            opt_state=jax.tree.map(avg, state.opt_state),
+        )
+
+    fn = jax.shard_map(shard_sync, mesh=mesh, in_specs=(sspecs,), out_specs=sspecs)
+    return jax.jit(fn, donate_argnums=0)
+
+
+def build_unstack_params(mesh, state_template) -> Callable:
+    """Consensus (mean) params from a stacked state, replicated — for
+    eval and checkpointing in async mode."""
+    sspecs = _stacked_specs(state_template)
+    pspecs_out = jax.tree.map(lambda _: P(), state_template.params)
+
+    def shard_mean(state: TrainState):
+        return jax.tree.map(
+            lambda a: jax.lax.pmean(a[0], DATA_AXIS), state.params
+        )
+
+    fn = jax.shard_map(shard_mean, mesh=mesh, in_specs=(sspecs,), out_specs=pspecs_out)
+    return jax.jit(fn)
+
+
+def unstack_params(mesh, state: TrainState):
+    return build_unstack_params(mesh, state)(state)

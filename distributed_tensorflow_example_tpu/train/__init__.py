@@ -1,0 +1,4 @@
+from .optim import Optimizer, make_optimizer
+from .state import TrainState
+
+__all__ = ["Optimizer", "make_optimizer", "TrainState"]

@@ -1,0 +1,350 @@
+"""Training driver.
+
+Reference parity: the reference's driver (/root/reference/example.py:
+132-182) is a ``Supervisor``-managed session running 20 epochs x 550
+batches, fetching ``[train_op, cross_entropy, summary_op, global_step]``
+per step (example.py:160-162), writing a summary every step
+(example.py:163), printing Step/Epoch/Batch/Cost/AvgTime every
+``frequency=100`` steps and at epoch end (example.py:166-174), then the
+full-test-set accuracy, total wall-clock and final cost
+(example.py:177-179) and "done" (example.py:182). Stdout format is
+replicated byte-for-byte modulo values (SURVEY.md §4 golden test).
+
+TPU-native design (SURVEY.md L7): no session, no supervisor — chief is
+``jax.process_index() == 0``, init is deterministic seeded init on every
+process (barrier-free, SURVEY.md §3.2). Two execution paths:
+
+- **fast path** (default, single-process sync): the dataset lives in
+  HBM and each epoch is ONE compiled ``lax.scan`` over its steps
+  (parallel/epoch.py) — zero per-step host traffic; per-step cost/acc
+  arrays come back once per epoch and reproduce the reference's
+  per-step summaries and per-100-step prints exactly;
+- **host path** (async local-SGD mode, multi-process, or
+  ``--no_fast_loop``): a host loop feeding one batch per step — still
+  one donated jit'd SPMD step, with a bounded dispatch queue.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+from .. import cluster
+from ..config import Config
+from ..data import EpochIterator, load_datasets
+from ..models.mlp import MLPSpec
+from ..parallel import epoch as epoch_lib
+from ..parallel import mesh as mesh_lib
+from ..parallel import step as step_lib
+from ..utils import checkpoint as ckpt_lib
+from ..utils.summary import SummaryWriter
+from .optim import make_optimizer
+from .state import create_train_state
+
+
+def make_spec(cfg: Config) -> MLPSpec:
+    import jax.numpy as jnp
+
+    return MLPSpec(
+        input_size=cfg.input_size,
+        hidden_sizes=tuple(cfg.hidden_sizes),
+        num_classes=cfg.num_classes,
+        activation=cfg.activation,
+        param_dtype=jnp.dtype(cfg.param_dtype),
+        compute_dtype=jnp.dtype(cfg.compute_dtype),
+    )
+
+
+def _global_batch(cfg: Config, dp: int) -> int:
+    """Round the global batch up to a multiple of the data axis."""
+    b = cfg.batch_size
+    if b % dp:
+        b = ((b + dp - 1) // dp) * dp
+        print(f"NOTE: batch_size {cfg.batch_size} rounded up to {b} "
+              f"(must divide data-parallel degree {dp})")
+    return b
+
+
+def _print_window(step: int, epoch: int, batch_i: int, batch_count: int,
+                  cost: float, elapsed_time: float, frequency: int) -> None:
+    """The reference's throughput print, byte-for-byte (example.py:169-173)."""
+    print("Step: %d," % (step + 1),
+          " Epoch: %2d," % (epoch + 1),
+          " Batch: %3d of %3d," % (batch_i + 1, batch_count),
+          " Cost: %.4f," % cost,
+          " AvgTime: %3.2fms" % float(elapsed_time * 1000 / frequency))
+
+
+def _eval_accuracy(eval_step, params, images, labels, dp: int, chunk: int) -> float:
+    """Full-test-set accuracy (example.py:177), zero-padded to the mesh."""
+    n = images.shape[0]
+    chunk = max(dp, (min(chunk, n) // dp) * dp)
+    correct = 0.0
+    for off in range(0, n, chunk):
+        x = images[off : off + chunk]
+        y = labels[off : off + chunk]
+        valid = x.shape[0]
+        if valid < chunk:
+            pad = chunk - valid
+            x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+            y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
+        mask = (np.arange(chunk) < valid).astype(np.float32)
+        correct += float(eval_step(params, x, y, mask))
+    return correct / n
+
+
+def run(cfg: Config) -> Dict[str, Any]:
+    """Train per the config; returns the metrics the reference prints."""
+    cluster.bootstrap(cfg)
+    cluster.enable_compilation_cache(cfg)
+    if cfg.debug_nans:
+        jax.config.update("jax_debug_nans", True)
+
+    proc_idx = jax.process_index()
+    proc_cnt = jax.process_count()
+    chief = proc_idx == 0
+
+    dataset = load_datasets(cfg.data_dir, cfg.dataset, seed=0)
+    mesh = mesh_lib.build_mesh(cfg.data_parallel, cfg.model_parallel)
+    dp = mesh.shape[mesh_lib.DATA_AXIS]
+    spec = make_spec(cfg)
+    optimizer = make_optimizer(cfg)
+
+    global_batch = _global_batch(cfg, dp)
+    async_mode = cfg.sync_period > 1
+    fast = (
+        cfg.fast_loop and not async_mode and proc_cnt == 1
+        and (cfg.shard_data or dp == 1)
+    )
+
+    # init_op equivalent (example.py:129, 74): identical seeded init on
+    # every process — deterministic, no chief broadcast needed.
+    state = create_train_state(jax.random.PRNGKey(cfg.seed), spec, optimizer)
+
+    if async_mode:
+        state = step_lib.stack_state(state, dp)
+        train_step = step_lib.build_local_train_step(cfg, mesh, spec, optimizer, state)
+        param_sync = step_lib.build_param_sync(mesh, state)
+        get_params = step_lib.build_unstack_params(mesh, state)
+        sspecs = step_lib._stacked_specs(state)
+    else:
+        train_step = None if fast else step_lib.build_train_step(cfg, mesh, spec, optimizer)
+        param_sync = None
+        get_params = None
+        sspecs = mesh_lib.state_pspecs(spec, optimizer, cfg.model_parallel)
+    state = mesh_lib.place_state(state, mesh, sspecs)
+    print("Variables initialized ...")  # example.py:130
+
+    start_epoch = 0
+    if cfg.resume and cfg.checkpoint_dir:
+        path = ckpt_lib.latest_checkpoint(cfg.checkpoint_dir)
+        if path:
+            state, _, start_epoch = ckpt_lib.restore_checkpoint(path, state)
+            state = mesh_lib.place_state(state, mesh, sspecs)
+            print(f"Resumed from {path} at epoch {start_epoch}")
+
+    writer = None
+    if cfg.summaries and (chief or cfg.summaries_all_hosts):
+        writer = SummaryWriter(cfg.logs_path)  # example.py:145-146
+
+    if cfg.profile and chief:
+        jax.profiler.start_trace(cfg.logs_path + "/profile")
+
+    # global_step parity: the reference's global_step counts every
+    # worker's update (≈3x per round under 3 async workers, SURVEY.md
+    # §3.3); in local-SGD mode each of the dp shards applies one update
+    # per round, so the printed step advances by dp per round.
+    step_scale = dp if async_mode else 1
+
+    begin_time = time.time()       # example.py:136
+    frequency = cfg.frequency      # example.py:137
+    cost = float("nan")
+    examples_seen = 0
+
+    ckpt_enabled = bool(cfg.checkpoint_dir and cfg.checkpoint_every and chief)
+    last_ckpt_step = 0
+
+    def maybe_checkpoint(resume_epoch: int) -> None:
+        """Save when a checkpoint_every boundary has been crossed since
+        the last save. ``resume_epoch`` is the epoch --resume should
+        restart from (the epoch after a completed one; the current epoch
+        for a mid-epoch save, which re-runs its partial work)."""
+        nonlocal last_ckpt_step
+        if not ckpt_enabled:
+            return
+        step = int(state.step)
+        if step // cfg.checkpoint_every > last_ckpt_step // cfg.checkpoint_every:
+            ckpt_lib.save_checkpoint(cfg.checkpoint_dir, state, step, resume_epoch)
+            last_ckpt_step = step
+
+    if fast:
+        img_d, lbl_d, batch_count = epoch_lib.shard_dataset(
+            mesh, dataset.train.images, dataset.train.labels, global_batch
+        )
+        fast_eval = epoch_lib.build_fast_eval(
+            cfg, mesh, spec, dataset.test.images, dataset.test.labels
+        )
+        shuffle_key = jax.random.PRNGKey(cfg.seed + 0x5EED)
+
+        def emit_epoch(epoch: int, costs: np.ndarray, accs: np.ndarray,
+                       avg_step_s: float) -> float:
+            nonlocal examples_seen
+            examples_seen += batch_count * global_batch
+            if writer is not None:
+                base_step = epoch * batch_count
+                for i in range(batch_count):
+                    writer.add_scalars(
+                        base_step + i + 1, {"cost": float(costs[i]),
+                                            "accuracy": float(accs[i])}
+                    )
+            count = 0
+            last = float("nan")
+            for i in range(batch_count):
+                count += 1
+                if count % frequency == 0 or i + 1 == batch_count:
+                    last = float(costs[i])
+                    step = epoch * batch_count + i + 1
+                    _print_window(step, epoch, i, batch_count, last,
+                                  count * avg_step_s, frequency)
+                    count = 0
+            return last
+
+        n_ep = cfg.training_epochs - start_epoch
+        if cfg.checkpoint_every == 0 and n_ep > 0:
+            # the whole run as one device program
+            runner = epoch_lib.build_run_to_completion(
+                cfg, mesh, spec, optimizer, batch_count, n_ep
+            )
+            t0 = time.time()
+            state, costs2d, accs2d = runner(
+                state, img_d, lbl_d, shuffle_key, start_epoch
+            )
+            costs2d = np.asarray(costs2d)
+            accs2d = np.asarray(accs2d)
+            avg_step_s = (time.time() - t0) / (n_ep * batch_count)
+            for e_off in range(n_ep):
+                cost = emit_epoch(start_epoch + e_off, costs2d[e_off],
+                                  accs2d[e_off], avg_step_s)
+        else:
+            epoch_runner = epoch_lib.build_epoch_runner(
+                cfg, mesh, spec, optimizer, batch_count
+            )
+            for epoch in range(start_epoch, cfg.training_epochs):
+                t0 = time.time()
+                state, costs, accs = epoch_runner(
+                    state, img_d, lbl_d, shuffle_key, epoch
+                )
+                costs = np.asarray(costs)
+                accs = np.asarray(accs)
+                avg_step_s = (time.time() - t0) / batch_count
+                cost = emit_epoch(epoch, costs, accs, avg_step_s)
+                maybe_checkpoint(epoch + 1)
+    else:
+        local_batch = global_batch // proc_cnt
+        iterator = EpochIterator(
+            dataset.train,
+            batch_size=local_batch,
+            seed=cfg.seed,
+            shard=cfg.shard_data,
+            process_index=proc_idx,
+            process_count=proc_cnt,
+        )
+        # Bound the async dispatch queue. On TPU a deep window keeps the
+        # pipeline full; on the CPU backend (tests: 8 virtual devices on
+        # few cores) concurrent in-flight programs can starve the
+        # collective rendezvous, so dispatch is serialized there.
+        window = 1 if jax.default_backend() == "cpu" else 32
+        inflight: list = []
+        # Multi-process: every process holds only its local batch slice;
+        # assemble the global array explicitly (a bare numpy arg would be
+        # treated as the full global batch on every process).
+        batch_sharding = None
+        if proc_cnt > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            batch_sharding = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
+        start_time = time.time()  # example.py:149
+        for epoch in range(start_epoch, cfg.training_epochs):
+            batch_count = iterator.batches_per_epoch  # example.py:153
+            count = 0
+            for i, (batch_x, batch_y) in enumerate(iterator.epoch()):
+                if batch_sharding is not None:
+                    batch_x = jax.make_array_from_process_local_data(
+                        batch_sharding, batch_x
+                    )
+                    batch_y = jax.make_array_from_process_local_data(
+                        batch_sharding, batch_y
+                    )
+                state, cost_dev, acc_dev = train_step(state, batch_x, batch_y)
+                if async_mode and int(state.step) % cfg.sync_period == 0:
+                    state = param_sync(state)
+                examples_seen += global_batch
+                inflight.append(cost_dev)
+                if len(inflight) > window:
+                    inflight.pop(0).block_until_ready()
+                if writer is not None:
+                    # the reference writes cost+accuracy every step
+                    # (example.py:163)
+                    cost = float(cost_dev)
+                    writer.add_scalars(
+                        int(state.step) * step_scale,
+                        {"cost": cost, "accuracy": float(acc_dev)},
+                    )
+                count += 1
+                if count % frequency == 0 or i + 1 == batch_count:
+                    cost = float(cost_dev)
+                    step = int(state.step) * step_scale
+                    elapsed_time = time.time() - start_time  # example.py:167
+                    start_time = time.time()
+                    _print_window(step, epoch, i, batch_count, cost,
+                                  elapsed_time, frequency)
+                    count = 0
+                maybe_checkpoint(epoch)
+
+    if cfg.profile and chief:
+        jax.profiler.stop_trace()
+
+    # Final eval (example.py:177-179): chief-only in spirit; every
+    # process computes (cheap, collective-free divergence is impossible
+    # under SPMD) but only chief prints.
+    params = get_params(state) if async_mode else state.params
+    if fast:
+        test_acc = fast_eval(params)
+    else:
+        eval_step = step_lib.build_eval_step(cfg, mesh, spec)
+        test_acc = _eval_accuracy(
+            eval_step, params, dataset.test.images, dataset.test.labels, dp,
+            chunk=max(cfg.eval_batch_size, dp),
+        )
+    total_time = time.time() - begin_time
+    cost = float(cost)
+    if chief:
+        print("Test-Accuracy: %2.2f" % test_acc)          # example.py:177
+        print("Total Time: %3.2fs" % float(total_time))   # example.py:178
+        print("Final Cost: %.4f" % cost)                  # example.py:179
+
+    if cfg.checkpoint_dir and chief:
+        ckpt_lib.save_checkpoint(
+            cfg.checkpoint_dir, state, int(state.step), cfg.training_epochs
+        )
+    if writer is not None:
+        writer.close()
+
+    if chief:
+        print("done")  # example.py:182
+
+    return {
+        "test_accuracy": test_acc,
+        "total_time_s": total_time,
+        "final_cost": cost,
+        "steps": int(state.step),
+        "examples_seen": examples_seen,
+        "examples_per_sec": examples_seen / total_time if total_time > 0 else 0.0,
+        "dataset_source": dataset.source,
+        "devices": dp * mesh.shape[mesh_lib.MODEL_AXIS],
+        "global_batch": global_batch,
+        "fast_loop": fast,
+    }

@@ -1,0 +1,119 @@
+"""Optimizers.
+
+Reference parity: the reference uses
+``tf.train.GradientDescentOptimizer(0.0005)``
+(/root/reference/example.py:98-101, applied at :111), with the commented
+``SyncReplicasOptimizer`` wrapper (example.py:102-110) for the sync
+path; BASELINE.json config 4 adds ``AdamOptimizer``.
+
+TPU-native design (SURVEY.md L5): optimizers are pure pytree transforms
+— ``init(params) -> opt_state`` and ``update(grads, opt_state, params)
+-> (new_params, new_opt_state)`` — compiled into the same XLA program as
+the forward/backward. There is no ``SyncReplicasOptimizer`` equivalent
+class: cross-replica aggregation is a ``lax.pmean/psum`` on the
+gradients *before* ``update`` (parallel/step.py), which is exactly the
+accumulate-then-apply semantics the TF wrapper implemented with queues
+and locks (example.py:103-108), minus the queues and locks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class Optimizer:
+    """A pure (init, update) pair; update returns new params and state.
+
+    ``state_pspecs`` maps a param-PartitionSpec pytree onto the matching
+    spec tree for ``opt_state`` (the slots shadow the param shapes, so
+    under tensor parallelism they shard the same way — the parallel
+    layer uses this to build shard_map in/out specs).
+    """
+
+    name: str
+    init: Callable[[PyTree], PyTree]
+    update: Callable[[PyTree, PyTree, PyTree], Tuple[PyTree, PyTree]]
+    state_pspecs: Callable[[PyTree], PyTree]
+
+
+def sgd(learning_rate: float) -> Optimizer:
+    """Plain SGD — ``GradientDescentOptimizer`` (example.py:101)."""
+
+    def init(params):
+        return ()
+
+    def update(grads, opt_state, params):
+        new_params = jax.tree.map(lambda p, g: p - learning_rate * g, params, grads)
+        return new_params, opt_state
+
+    return Optimizer("sgd", init, update, lambda pspecs: ())
+
+
+def momentum(learning_rate: float, beta: float = 0.9) -> Optimizer:
+    """Heavy-ball momentum (``tf.train.MomentumOptimizer`` analog)."""
+
+    def init(params):
+        return {"m": jax.tree.map(jnp.zeros_like, params)}
+
+    def update(grads, opt_state, params):
+        m = jax.tree.map(lambda m_, g: beta * m_ + g, opt_state["m"], grads)
+        new_params = jax.tree.map(lambda p, m_: p - learning_rate * m_, params, m)
+        return new_params, {"m": m}
+
+    return Optimizer("momentum", init, update, lambda pspecs: {"m": pspecs})
+
+
+def adam(
+    learning_rate: float,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+) -> Optimizer:
+    """Adam — ``tf.train.AdamOptimizer`` (BASELINE.json config 4).
+
+    TF's AdamOptimizer uses the efficient formulation
+    ``lr_t = lr * sqrt(1-b2^t) / (1-b1^t)`` with eps outside the
+    bias correction; replicated here for parity.
+    """
+
+    def init(params):
+        return {
+            "count": jnp.zeros((), jnp.int32),
+            "mu": jax.tree.map(jnp.zeros_like, params),
+            "nu": jax.tree.map(jnp.zeros_like, params),
+        }
+
+    def update(grads, opt_state, params):
+        count = opt_state["count"] + 1
+        t = count.astype(jnp.float32)
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, opt_state["mu"], grads)
+        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g, opt_state["nu"], grads)
+        lr_t = learning_rate * jnp.sqrt(1 - b2**t) / (1 - b1**t)
+        new_params = jax.tree.map(
+            lambda p, m, v: p - lr_t * m / (jnp.sqrt(v) + eps), params, mu, nu
+        )
+        return new_params, {"count": count, "mu": mu, "nu": nu}
+
+    def state_pspecs(pspecs):
+        from jax.sharding import PartitionSpec
+
+        return {"count": PartitionSpec(), "mu": pspecs, "nu": pspecs}
+
+    return Optimizer("adam", init, update, state_pspecs)
+
+
+def make_optimizer(cfg) -> Optimizer:
+    if cfg.optimizer == "sgd":
+        return sgd(cfg.learning_rate)
+    if cfg.optimizer == "momentum":
+        return momentum(cfg.learning_rate, cfg.momentum)
+    if cfg.optimizer == "adam":
+        return adam(cfg.learning_rate, cfg.adam_b1, cfg.adam_b2, cfg.adam_eps)
+    raise ValueError(f"unknown optimizer {cfg.optimizer!r}")

@@ -1,0 +1,41 @@
+"""Train state.
+
+Reference parity: the reference's mutable training state is five
+``tf.Variable``s living on the parameter server — ``global_step``
+(/root/reference/example.py:60-64) and ``W1, W2, b1, b2``
+(example.py:76-82), placed there by ``replica_device_setter``
+(example.py:55-57) and mutated over gRPC each step.
+
+TPU-native design (SURVEY.md L6): the state is an immutable pytree
+carried through the jit'd step function — device-resident, donated
+buffer-to-buffer each step, no server. ``global_step`` is a replicated
+scalar counter incremented inside the compiled step (the analog of
+``minimize(..., global_step=global_step)``, example.py:111).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    step: jnp.ndarray          # global_step (example.py:60-64); int32 scalar
+    params: Any                # the W/b pytree (example.py:76-82)
+    opt_state: Any             # optimizer slots (TF kept these on the ps too)
+
+
+def create_train_state(key: jax.Array, spec, optimizer) -> TrainState:
+    """``init_op`` equivalent (example.py:129): build the full state pytree."""
+    from ..models import mlp
+
+    params = mlp.init(key, spec)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=optimizer.init(params),
+    )

@@ -1,0 +1,10 @@
+from .summary import SummaryWriter, read_event_file
+from .checkpoint import save_checkpoint, restore_checkpoint, latest_checkpoint
+
+__all__ = [
+    "SummaryWriter",
+    "read_event_file",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "latest_checkpoint",
+]

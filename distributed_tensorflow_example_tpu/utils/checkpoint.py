@@ -1,0 +1,84 @@
+"""Checkpoint / resume.
+
+Reference parity: the reference configures **no** checkpointing — its
+``Supervisor`` is built without a logdir so the default saver is
+inactive, and no ``tf.train.Saver`` exists (/root/reference/example.py:
+132-134; SURVEY.md §5). Its only restart resilience is the parameters
+surviving on the parameter server across worker restarts.
+
+SPMD removes that implicit resilience (a lost process kills the step),
+so this module supplies the explicit recovery story (SURVEY.md §5):
+the chief saves the full train-state pytree + step + epoch every
+``--checkpoint_every`` steps and at exit; ``--resume`` restores and
+continues. Format: a single ``.npz`` holding each leaf under its
+tree-path name — readable anywhere numpy is.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Tuple
+
+import jax
+import numpy as np
+
+
+def _flatten(tree: Any):
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = {}
+    for path, leaf in leaves_with_paths:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        out[key] = np.asarray(leaf)
+    return out
+
+
+def save_checkpoint(ckpt_dir: str, state: Any, step: int, epoch: int) -> str:
+    """Atomic save: write tmp, rename. Returns the checkpoint path."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = os.path.join(ckpt_dir, f"ckpt-{step:08d}.npz")
+    tmp = path + ".tmp.npz"
+    payload = _flatten(state)
+    payload["__step__"] = np.asarray(step, np.int64)
+    payload["__epoch__"] = np.asarray(epoch, np.int64)
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, path)
+    return path
+
+
+def latest_checkpoint(ckpt_dir: str) -> str | None:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    best = None
+    for name in os.listdir(ckpt_dir):
+        m = re.fullmatch(r"ckpt-(\d+)\.npz", name)
+        if m and (best is None or int(m.group(1)) > best[0]):
+            best = (int(m.group(1)), name)
+    return os.path.join(ckpt_dir, best[1]) if best else None
+
+
+def restore_checkpoint(path: str, state_template: Any) -> Tuple[Any, int, int]:
+    """Restore into the template's tree structure; returns (state, step, epoch).
+
+    Leaves are matched by tree path, so the checkpoint survives
+    refactors that keep param names stable (W1/b1/..., SURVEY.md §5).
+    """
+    with np.load(path) as z:
+        data = {k: z[k] for k in z.files}
+    step = int(data.pop("__step__"))
+    epoch = int(data.pop("__epoch__"))
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(state_template)
+    new_leaves = []
+    for path_, leaf in leaves_with_paths:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path_)
+        if key not in data:
+            raise KeyError(f"checkpoint {path} missing leaf {key!r}")
+        arr = data[key]
+        if arr.shape != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"checkpoint leaf {key!r} shape {arr.shape} != expected {np.shape(leaf)}"
+            )
+        new_leaves.append(arr.astype(np.asarray(leaf).dtype))
+    state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    return state, step, epoch

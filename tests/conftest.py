@@ -1,0 +1,27 @@
+"""Test harness: 8 virtual CPU devices (SURVEY.md §4 "distributed
+without a cluster") — the TPU-native analog of a fake backend.
+
+Must run before any backend initialization: XLA_FLAGS gains the forced
+host device count, and jax_platforms is pinned to cpu via config (an
+env var is not enough here: the TPU plugin in this image forces
+jax_platforms at interpreter start, so we override it the same way).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs

@@ -1,0 +1,61 @@
+"""Checkpoint round-trip tests (SURVEY.md §5 checkpoint/resume)."""
+
+import jax
+import numpy as np
+
+from distributed_tensorflow_example_tpu.config import Config
+from distributed_tensorflow_example_tpu.models.mlp import MLPSpec
+from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+from distributed_tensorflow_example_tpu.train.state import create_train_state
+from distributed_tensorflow_example_tpu.utils import checkpoint as C
+
+SPEC = MLPSpec(input_size=8, hidden_sizes=(6,), num_classes=3)
+
+
+def test_roundtrip_sgd(tmp_path):
+    opt = make_optimizer(Config(optimizer="sgd"))
+    state = create_train_state(jax.random.PRNGKey(3), SPEC, opt)
+    path = C.save_checkpoint(str(tmp_path), state, step=42, epoch=2)
+    restored, step, epoch = C.restore_checkpoint(path, state)
+    assert (step, epoch) == (42, 2)
+    for k in state.params:
+        np.testing.assert_array_equal(
+            np.asarray(state.params[k]), np.asarray(restored.params[k])
+        )
+
+
+def test_roundtrip_adam_opt_state(tmp_path):
+    opt = make_optimizer(Config(optimizer="adam"))
+    state = create_train_state(jax.random.PRNGKey(3), SPEC, opt)
+    # make opt state non-trivial
+    g = jax.tree.map(lambda p: p * 0.01, state.params)
+    new_p, new_o = opt.update(g, state.opt_state, state.params)
+    state = state.replace(params=new_p, opt_state=new_o)
+    path = C.save_checkpoint(str(tmp_path), state, step=1, epoch=0)
+    restored, _, _ = C.restore_checkpoint(path, state)
+    np.testing.assert_array_equal(
+        np.asarray(state.opt_state["mu"]["W1"]), np.asarray(restored.opt_state["mu"]["W1"])
+    )
+    assert int(restored.opt_state["count"]) == 1
+
+
+def test_latest_checkpoint_picks_highest(tmp_path):
+    opt = make_optimizer(Config())
+    state = create_train_state(jax.random.PRNGKey(0), SPEC, opt)
+    C.save_checkpoint(str(tmp_path), state, step=10, epoch=0)
+    p2 = C.save_checkpoint(str(tmp_path), state, step=200, epoch=3)
+    assert C.latest_checkpoint(str(tmp_path)) == p2
+    assert C.latest_checkpoint(str(tmp_path / "nope")) is None
+
+
+def test_shape_mismatch_rejected(tmp_path):
+    opt = make_optimizer(Config())
+    state = create_train_state(jax.random.PRNGKey(0), SPEC, opt)
+    path = C.save_checkpoint(str(tmp_path), state, step=1, epoch=0)
+    other = create_train_state(
+        jax.random.PRNGKey(0), MLPSpec(input_size=9, hidden_sizes=(6,), num_classes=3), opt
+    )
+    import pytest
+
+    with pytest.raises((ValueError, KeyError)):
+        C.restore_checkpoint(path, other)

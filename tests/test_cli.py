@@ -1,0 +1,46 @@
+"""Flag-system tests: reference defaults and flag-name parity
+(example.py:29-44; SURVEY.md §5 config)."""
+
+from distributed_tensorflow_example_tpu.config import parse_config
+
+
+def test_reference_defaults():
+    cfg = parse_config([])
+    # example.py:41-44
+    assert cfg.batch_size == 100
+    assert cfg.learning_rate == 0.0005
+    assert cfg.training_epochs == 20
+    assert cfg.logs_path == "/tmp/mnist/1"
+    # example.py:74, 137
+    assert cfg.seed == 1
+    assert cfg.frequency == 100
+    # example.py:30-32
+    assert cfg.job_name == ""
+    assert cfg.task_index == 0
+    # model defaults (example.py:76-90)
+    assert cfg.hidden_sizes == (100,)
+    assert cfg.activation == "sigmoid"
+    assert cfg.optimizer == "sgd"
+
+
+def test_reference_flag_names_accepted():
+    cfg = parse_config(["--job_name=worker", "--task_index=2"])
+    assert cfg.job_name == "worker"
+    assert cfg.task_index == 2
+    cfg = parse_config(["--job_name=ps", "--task_index=0"])
+    assert cfg.job_name == "ps"
+
+
+def test_extension_flags():
+    cfg = parse_config([
+        "--hidden_sizes=256,128", "--activation=relu", "--optimizer=adam",
+        "--model_parallel=2", "--sync_period=5", "--grad_reduce=sum",
+        "--naive_ce", "--pallas",
+    ])
+    assert cfg.hidden_sizes == (256, 128)
+    assert cfg.activation == "relu"
+    assert cfg.optimizer == "adam"
+    assert cfg.model_parallel == 2
+    assert cfg.sync_period == 5
+    assert cfg.grad_reduce == "sum"
+    assert cfg.naive_ce and cfg.pallas

@@ -1,0 +1,50 @@
+"""Loss tests: stable-vs-naive CE agreement in safe regimes; the naive
+form's instability is real and the stable form survives it (SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_example_tpu.ops import losses, metrics
+
+
+def _np_ce(logits, y):
+    z = logits - logits.max(axis=1, keepdims=True)
+    p = np.exp(z) / np.exp(z).sum(axis=1, keepdims=True)
+    return float(np.mean(-np.sum(y * np.log(p), axis=1)))
+
+
+def test_stable_matches_numpy():
+    rng = np.random.RandomState(0)
+    logits = rng.randn(8, 10).astype(np.float32) * 3
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]
+    got = float(losses.stable_cross_entropy(jnp.asarray(logits), jnp.asarray(y)))
+    assert abs(got - _np_ce(logits, y)) < 1e-5
+
+
+def test_naive_matches_stable_in_safe_regime():
+    rng = np.random.RandomState(1)
+    logits = rng.randn(16, 10).astype(np.float32)  # small logits: both fine
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 16)]
+    a = float(losses.stable_cross_entropy(jnp.asarray(logits), jnp.asarray(y)))
+    b = float(losses.naive_cross_entropy(jnp.asarray(logits), jnp.asarray(y)))
+    assert abs(a - b) < 1e-5
+
+
+def test_naive_is_unstable_stable_is_not():
+    """The reference's log(softmax) NaNs/infs on large logits
+    (example.py:95-96, SURVEY.md §2 quirks) — the rebuilt default must not."""
+    logits = np.zeros((2, 10), np.float32)
+    logits[:, 0] = 200.0  # softmax underflows to exactly 0 elsewhere
+    y = np.zeros((2, 10), np.float32)
+    y[:, 1] = 1.0  # true class has prob 0 -> log(0)
+    naive = float(losses.naive_cross_entropy(jnp.asarray(logits), jnp.asarray(y)))
+    stable = float(losses.stable_cross_entropy(jnp.asarray(logits), jnp.asarray(y)))
+    assert not np.isfinite(naive)
+    assert np.isfinite(stable) and abs(stable - 200.0) < 1e-3
+
+
+def test_accuracy_oracle():
+    logits = np.array([[1, 2, 0], [5, 1, 1], [0, 0, 3], [1, 9, 2]], np.float32)
+    y = np.eye(3, dtype=np.float32)[[1, 0, 2, 0]]  # 3 of 4 correct
+    got = float(metrics.accuracy(jnp.asarray(logits), jnp.asarray(y)))
+    assert abs(got - 0.75) < 1e-6

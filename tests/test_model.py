@@ -1,0 +1,64 @@
+"""Model tests: init shapes/dtypes, forward vs numpy oracle (SURVEY.md §4)."""
+
+import jax
+import numpy as np
+
+from distributed_tensorflow_example_tpu.models import mlp
+
+
+def _np_forward(params, x, activation="sigmoid"):
+    def sigmoid(z):
+        return 1.0 / (1.0 + np.exp(-z))
+
+    acts = {"sigmoid": sigmoid, "relu": lambda z: np.maximum(z, 0)}
+    a = acts[activation]
+    h = x
+    L = len([k for k in params if k.startswith("W")])
+    for i in range(1, L + 1):
+        h = h @ np.asarray(params[f"W{i}"]) + np.asarray(params[f"b{i}"])
+        if i < L:
+            h = a(h)
+    return h
+
+
+def test_init_shapes_reference_parity():
+    """Reference shapes: W1 [784,100], W2 [100,10], b1 [100], b2 [10]
+    (example.py:76-82)."""
+    spec = mlp.MLPSpec()
+    params = mlp.init(jax.random.PRNGKey(1), spec)
+    assert params["W1"].shape == (784, 100)
+    assert params["W2"].shape == (100, 10)
+    assert params["b1"].shape == (100,)
+    assert params["b2"].shape == (10,)
+    assert all(np.asarray(v).dtype == np.float32 for v in params.values())
+    # stddev-1 normal init (tf.random_normal default, example.py:76)
+    assert 0.9 < np.asarray(params["W1"]).std() < 1.1
+    assert np.asarray(params["b1"]).sum() == 0.0
+    assert mlp.num_params(spec) == 784 * 100 + 100 + 100 * 10 + 10  # 79510
+
+
+def test_init_deterministic():
+    spec = mlp.MLPSpec()
+    p1 = mlp.init(jax.random.PRNGKey(1), spec)
+    p2 = mlp.init(jax.random.PRNGKey(1), spec)
+    for k in p1:
+        np.testing.assert_array_equal(np.asarray(p1[k]), np.asarray(p2[k]))
+
+
+def test_forward_matches_numpy_oracle():
+    spec = mlp.MLPSpec(input_size=12, hidden_sizes=(7,), num_classes=4)
+    params = mlp.init(jax.random.PRNGKey(0), spec)
+    x = np.random.RandomState(0).randn(5, 12).astype(np.float32)
+    got = np.asarray(mlp.apply(spec, params, x))
+    want = _np_forward(params, x)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_forward_deep_relu():
+    spec = mlp.MLPSpec(input_size=6, hidden_sizes=(8, 5), num_classes=3,
+                       activation="relu")
+    params = mlp.init(jax.random.PRNGKey(2), spec)
+    x = np.random.RandomState(1).randn(4, 6).astype(np.float32)
+    got = np.asarray(mlp.apply(spec, params, x))
+    want = _np_forward(params, x, activation="relu")
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)

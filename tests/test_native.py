@@ -1,0 +1,26 @@
+"""Native C++ helper tests: native and numpy fallback paths agree."""
+
+import numpy as np
+
+from distributed_tensorflow_example_tpu import native
+
+
+def test_gather_batch_matches_fallback():
+    rng = np.random.RandomState(0)
+    images = rng.rand(50, 12).astype(np.float32)
+    labels = rng.rand(50, 4).astype(np.float32)
+    idx = rng.permutation(50)[:16].astype(np.int64)
+    gi, gl = native.gather_batch(images, labels, idx)
+    np.testing.assert_array_equal(gi, images[idx])
+    np.testing.assert_array_equal(gl, labels[idx])
+
+
+def test_u8_to_f32_scaled():
+    arr = np.arange(256, dtype=np.uint8).reshape(16, 16)
+    out = native.u8_to_f32_scaled(arr)
+    np.testing.assert_allclose(out, arr.astype(np.float32) / 255.0, rtol=1e-6)
+    assert out.dtype == np.float32
+
+
+def test_native_availability_is_boolean():
+    assert native.native_available() in (True, False)

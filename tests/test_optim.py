@@ -1,0 +1,60 @@
+"""Optimizer math tests (SURVEY.md §4: SGD update math; Adam parity
+with the TF formulation)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tensorflow_example_tpu.train import optim
+
+
+def _tree(vals):
+    return {k: jnp.asarray(v, jnp.float32) for k, v in vals.items()}
+
+
+def test_sgd_update():
+    """p <- p - lr*g: GradientDescentOptimizer semantics (example.py:101)."""
+    opt = optim.sgd(0.5)
+    params = _tree({"w": [1.0, 2.0]})
+    grads = _tree({"w": [0.2, -0.4]})
+    s = opt.init(params)
+    new_p, s = opt.update(grads, s, params)
+    np.testing.assert_allclose(np.asarray(new_p["w"]), [0.9, 2.2], rtol=1e-6)
+
+
+def test_momentum_update():
+    opt = optim.momentum(0.1, beta=0.5)
+    params = _tree({"w": [0.0]})
+    g = _tree({"w": [1.0]})
+    s = opt.init(params)
+    p, s = opt.update(g, s, params)       # m=1,   p=-0.1
+    p, s = opt.update(g, s, p)            # m=1.5, p=-0.25
+    np.testing.assert_allclose(np.asarray(p["w"]), [-0.25], rtol=1e-6)
+
+
+def test_adam_matches_numpy_reference():
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    opt = optim.adam(lr, b1, b2, eps)
+    rng = np.random.RandomState(0)
+    p_np = rng.randn(5).astype(np.float32)
+    params = {"w": jnp.asarray(p_np)}
+    s = opt.init(params)
+    m = np.zeros(5); v = np.zeros(5)
+    for t in range(1, 4):
+        g_np = rng.randn(5).astype(np.float32)
+        params, s = opt.update({"w": jnp.asarray(g_np)}, s, params)
+        m = b1 * m + (1 - b1) * g_np
+        v = b2 * v + (1 - b2) * g_np**2
+        lr_t = lr * np.sqrt(1 - b2**t) / (1 - b1**t)
+        p_np = p_np - lr_t * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(np.asarray(params["w"]), p_np, rtol=1e-5, atol=1e-6)
+
+
+def test_state_pspecs_structure():
+    from jax.sharding import PartitionSpec as P
+
+    pp = {"W1": P(None, "model"), "b1": P("model")}
+    assert optim.sgd(0.1).state_pspecs(pp) == ()
+    assert optim.momentum(0.1).state_pspecs(pp) == {"m": pp}
+    adam_specs = optim.adam(0.1).state_pspecs(pp)
+    assert adam_specs["count"] == P()
+    assert adam_specs["mu"] == pp and adam_specs["nu"] == pp

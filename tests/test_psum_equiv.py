@@ -1,0 +1,160 @@
+"""THE distributed-semantics tests (SURVEY.md §4): on 8 virtual CPU
+devices, the sharded SPMD step must reproduce the single-device step
+bitwise-close — the sync-DP guarantee the reference never verified
+(its sync path was commented out and stale, README.md:3).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.config import Config
+from distributed_tensorflow_example_tpu.models.mlp import MLPSpec
+from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+from distributed_tensorflow_example_tpu.parallel import step as step_lib
+from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+SPEC = MLPSpec(input_size=16, hidden_sizes=(8,), num_classes=4)
+DEEP = MLPSpec(input_size=16, hidden_sizes=(8, 6), num_classes=4, activation="relu")
+
+
+def _data(batch, spec, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(batch, spec.input_size).astype(np.float32)
+    y = np.eye(spec.num_classes, dtype=np.float32)[
+        rng.randint(0, spec.num_classes, batch)
+    ]
+    return x, y
+
+
+def _run_steps(cfg, spec, dp, mp, n_steps=3, seed=0):
+    mesh = mesh_lib.build_mesh(dp, mp)
+    opt = make_optimizer(cfg)
+    state = create_train_state(jax.random.PRNGKey(1), spec, opt)
+    sspecs = mesh_lib.state_pspecs(spec, opt, mp)
+    state = mesh_lib.place_state(state, mesh, sspecs)
+    step = step_lib.build_train_step(cfg, mesh, spec, opt)
+    for i in range(n_steps):
+        x, y = _data(96, spec, seed=seed + i)
+        state, cost, acc = step(state, x, y)
+    return jax.device_get(state.params), float(cost)
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "adam"])
+def test_dp8_equals_single_device(devices8, opt_name):
+    """8-device batch-96-sharded step == 1-device batch-96 step
+    (identical params after 3 steps) — SURVEY.md §4's psum test."""
+    cfg = Config(optimizer=opt_name, learning_rate=0.05, grad_reduce="mean")
+    p1, c1 = _run_steps(cfg, SPEC, 1, 1)
+    p8, c8 = _run_steps(cfg, SPEC, 8, 1)
+    assert abs(c1 - c8) < 1e-5
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p8[k], rtol=2e-5, atol=2e-6, err_msg=k)
+
+
+def test_tp2_equals_single_device(devices8):
+    """Megatron split over the hidden dim changes nothing numerically."""
+    cfg = Config(learning_rate=0.05)
+    p1, _ = _run_steps(cfg, SPEC, 1, 1)
+    ptp, _ = _run_steps(cfg, SPEC, 4, 2)
+    for k in p1:
+        np.testing.assert_allclose(p1[k], ptp[k], rtol=2e-5, atol=2e-6, err_msg=k)
+
+
+def test_tp2_deep_model(devices8):
+    cfg = Config(learning_rate=0.05, activation="relu")
+    p1, _ = _run_steps(cfg, DEEP, 1, 1)
+    ptp, _ = _run_steps(cfg, DEEP, 2, 2)
+    for k in p1:
+        np.testing.assert_allclose(p1[k], ptp[k], rtol=2e-5, atol=2e-6, err_msg=k)
+
+
+def test_sum_reduce_is_dp_times_mean(devices8):
+    """grad_reduce='sum' applies dp x the mean gradient — the async
+    effective-LR analog (SURVEY.md §7 hard part 1): for plain SGD, one
+    'sum' step == one 'mean' step at dp x the learning rate."""
+    cfg_sum = Config(optimizer="sgd", learning_rate=0.01, grad_reduce="sum")
+    cfg_lr = Config(optimizer="sgd", learning_rate=0.08, grad_reduce="mean")
+    p_sum, _ = _run_steps(cfg_sum, SPEC, 8, 1, n_steps=1)
+    p_lr, _ = _run_steps(cfg_lr, SPEC, 8, 1, n_steps=1)
+    for k in p_sum:
+        np.testing.assert_allclose(p_sum[k], p_lr[k], rtol=2e-5, atol=2e-6, err_msg=k)
+
+
+def test_local_sgd_k1_with_sync_equals_sync_step(devices8):
+    """Local-SGD with sync after every step == the synchronous step (for
+    SGD, averaging params after local updates == averaging gradients)."""
+    cfg = Config(optimizer="sgd", learning_rate=0.05, sync_period=2)
+    spec = SPEC
+    mesh = mesh_lib.build_mesh(8, 1)
+    opt = make_optimizer(cfg)
+    state0 = create_train_state(jax.random.PRNGKey(1), spec, opt)
+
+    # local path: step, sync, every step
+    stacked = step_lib.stack_state(state0, 8)
+    sspecs = step_lib._stacked_specs(stacked)
+    stacked = mesh_lib.place_state(stacked, mesh, sspecs)
+    local_step = step_lib.build_local_train_step(cfg, mesh, spec, opt, stacked)
+    sync = step_lib.build_param_sync(mesh, stacked)
+    get_params = step_lib.build_unstack_params(mesh, stacked)
+    for i in range(2):
+        x, y = _data(96, spec, seed=i)
+        stacked, cost, acc = local_step(stacked, x, y)
+        stacked = sync(stacked)
+    p_local = jax.device_get(get_params(stacked))
+
+    # sync path
+    cfg_sync = Config(optimizer="sgd", learning_rate=0.05, grad_reduce="mean")
+    p_sync, _ = _run_steps(cfg_sync, spec, 8, 1, n_steps=2)
+    for k in p_sync:
+        np.testing.assert_allclose(p_local[k], p_sync[k], rtol=2e-5, atol=2e-6, err_msg=k)
+
+
+def test_local_sgd_diverges_then_reconciles(devices8):
+    """Without sync the replicas drift (the async staleness analog);
+    sync brings them back to a consensus."""
+    cfg = Config(optimizer="sgd", learning_rate=0.1, sync_period=100)
+    mesh = mesh_lib.build_mesh(8, 1)
+    opt = make_optimizer(cfg)
+    state0 = create_train_state(jax.random.PRNGKey(1), SPEC, opt)
+    stacked = step_lib.stack_state(state0, 8)
+    sspecs = step_lib._stacked_specs(stacked)
+    stacked = mesh_lib.place_state(stacked, mesh, sspecs)
+    local_step = step_lib.build_local_train_step(cfg, mesh, SPEC, opt, stacked)
+    for i in range(3):
+        x, y = _data(96, SPEC, seed=i)
+        stacked, _, _ = local_step(stacked, x, y)
+    w1 = np.asarray(jax.device_get(stacked.params["W1"]))
+    drift = np.abs(w1 - w1[0:1]).max()
+    assert drift > 1e-6, "replicas should have diverged without sync"
+    sync = step_lib.build_param_sync(mesh, stacked)
+    synced = sync(stacked)
+    w1s = np.asarray(jax.device_get(synced.params["W1"]))
+    np.testing.assert_allclose(w1s, np.broadcast_to(w1.mean(0), w1s.shape),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_eval_step_masked_padding(devices8):
+    """Eval counts correct predictions exactly under zero-padding."""
+    cfg = Config()
+    mesh = mesh_lib.build_mesh(8, 1)
+    opt = make_optimizer(cfg)
+    state = create_train_state(jax.random.PRNGKey(1), SPEC, opt)
+    sspecs = mesh_lib.state_pspecs(SPEC, opt, 1)
+    state = mesh_lib.place_state(state, mesh, sspecs)
+    eval_step = step_lib.build_eval_step(cfg, mesh, SPEC)
+
+    x, y = _data(40, SPEC, seed=9)
+    # unpadded reference count on one device
+    from distributed_tensorflow_example_tpu.models import mlp as mlp_lib
+
+    logits = np.asarray(mlp_lib.apply(SPEC, jax.device_get(state.params), x))
+    want = int((logits.argmax(1) == y.argmax(1)).sum())
+
+    pad = 48 - 40
+    xp = np.concatenate([x, np.zeros((pad, SPEC.input_size), np.float32)])
+    yp = np.concatenate([y, np.zeros((pad, SPEC.num_classes), np.float32)])
+    mask = (np.arange(48) < 40).astype(np.float32)
+    got = float(eval_step(state.params, xp, yp, mask))
+    assert got == want
