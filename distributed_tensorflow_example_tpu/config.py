@@ -77,6 +77,8 @@ class Config:
     # ---- data (example.py:46-48) ----
     data_dir: str = "MNIST_data"
     dataset: str = "auto"           # auto | mnist | synthetic
+    synthetic_train_size: int = 55000   # synthetic fallback split sizes
+    synthetic_test_size: int = 10000    # (mirror the MNIST split by default)
     shard_data: bool = True         # reference workers each consume the FULL
                                     # dataset (example.py:150-157); sharded
                                     # epochs are the sync-DP equivalent.
@@ -160,6 +162,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data_dir", type=str, default=d.data_dir)
     p.add_argument("--dataset", type=str, default=d.dataset,
                    choices=["auto", "mnist", "synthetic"])
+    p.add_argument("--synthetic_train_size", type=int, default=d.synthetic_train_size)
+    p.add_argument("--synthetic_test_size", type=int, default=d.synthetic_test_size)
     p.add_argument("--no_shard_data", dest="shard_data", action="store_false")
     p.add_argument("--no_summaries", dest="summaries", action="store_false")
     p.add_argument("--summaries_all_hosts", action="store_true")

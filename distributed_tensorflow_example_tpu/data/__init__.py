@@ -1,3 +1,4 @@
 from .mnist import Dataset, DataSplit, load_datasets, EpochIterator
+from .prefetch import Prefetcher
 
-__all__ = ["Dataset", "DataSplit", "load_datasets", "EpochIterator"]
+__all__ = ["Dataset", "DataSplit", "load_datasets", "EpochIterator", "Prefetcher"]

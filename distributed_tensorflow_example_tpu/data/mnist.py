@@ -155,11 +155,13 @@ def synthesize_split(n: int, seed: int) -> DataSplit:
     return DataSplit(images=images.reshape(n, 784), labels=one_hot(labels))
 
 
-def synthesize_dataset(seed: int = 0) -> Dataset:
+def synthesize_dataset(
+    seed: int = 0, train_size: int = 55000, test_size: int = 10000
+) -> Dataset:
     return Dataset(
-        train=synthesize_split(55000, seed=seed + 1),
-        validation=synthesize_split(5000, seed=seed + 2),
-        test=synthesize_split(10000, seed=seed + 3),
+        train=synthesize_split(train_size, seed=seed + 1),
+        validation=synthesize_split(max(train_size // 11, 10), seed=seed + 2),
+        test=synthesize_split(test_size, seed=seed + 3),
         source="synthetic",
     )
 
@@ -200,7 +202,13 @@ def idx_files_present(data_dir: str) -> bool:
     )
 
 
-def load_datasets(data_dir: str = "MNIST_data", dataset: str = "auto", seed: int = 0) -> Dataset:
+def load_datasets(
+    data_dir: str = "MNIST_data",
+    dataset: str = "auto",
+    seed: int = 0,
+    synthetic_train_size: int = 55000,
+    synthetic_test_size: int = 10000,
+) -> Dataset:
     """Replacement for ``input_data.read_data_sets`` (example.py:47-48).
 
     ``auto`` uses real IDX files when present in ``data_dir``, otherwise
@@ -216,7 +224,9 @@ def load_datasets(data_dir: str = "MNIST_data", dataset: str = "auto", seed: int
             f"{TRAIN_IMAGES}, {TRAIN_LABELS}, {TEST_IMAGES}, {TEST_LABELS} "
             f"(optionally .gz)"
         )
-    return synthesize_dataset(seed=seed)
+    return synthesize_dataset(
+        seed=seed, train_size=synthetic_train_size, test_size=synthetic_test_size
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -261,11 +271,14 @@ class EpochIterator:
         self._epoch = 0
 
     def _local_examples(self) -> int:
+        """Per-process example count. When sharded, every process gets
+        exactly floor(N / P): unequal shards would give processes
+        different batches_per_epoch, and under SPMD an extra step on one
+        process is a collective the others never join (deadlock). The
+        remainder (< P examples) is dropped each epoch."""
         n = self.split.num_examples
         if self.shard:
-            n = n // self.process_count + (
-                1 if self.process_index < n % self.process_count else 0
-            )
+            n = n // self.process_count
         return n
 
     @property
@@ -280,7 +293,10 @@ class EpochIterator:
         perm = self._rng.permutation(self.split.num_examples)
         self._epoch += 1
         if self.shard and self.process_count > 1:
+            # strided slice, truncated to the common per-process length
+            # so every process runs the same number of (collective) steps
             perm = perm[self.process_index :: self.process_count]
+            perm = perm[: self._local_examples()]
         from ..native import gather_batch  # lazy: avoids import cycle at module load
 
         for b in range(self.batches_per_epoch):

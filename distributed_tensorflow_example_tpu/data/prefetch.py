@@ -1,0 +1,85 @@
+"""Background batch prefetcher for the host-fed loop.
+
+Reference parity: the reference's input pipeline is fully synchronous —
+``next_batch`` gathers on the host, then ``sess.run`` blocks
+(/root/reference/example.py:157-162); batch prep and training never
+overlap.
+
+Here a daemon thread runs one epoch ahead of the consumer through a
+small bounded queue. The actual gather runs in native C++ via ctypes
+(``native.gather_batch``), which releases the GIL — so prefetch
+genuinely overlaps with the train loop's dispatch work. Used by the
+host path (async local-SGD mode, multi-process); the default fast path
+keeps the whole dataset in HBM and needs no host feeding at all.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Tuple
+
+import numpy as np
+
+_END = object()
+
+
+class Prefetcher:
+    """Wraps an iterable of batches; yields the same batches, produced
+    by a background thread with ``depth`` batches of lookahead."""
+
+    def __init__(self, iterable, depth: int = 2):
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        self._err: list = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._produce, args=(iterable,), daemon=True
+        )
+        self._thread.start()
+
+    def _produce(self, iterable) -> None:
+        try:
+            for item in iterable:
+                # bounded put that notices close(): never blocks forever
+                # holding the iterator's buffers if the consumer bails out
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except BaseException as e:  # surface producer errors to the consumer
+            self._err.append(e)
+        finally:
+            # deliver the sentinel unless closed (a Full queue must not
+            # lose it, or the consumer would block forever)
+            while not self._stop.is_set():
+                try:
+                    self._q.put(_END, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def close(self) -> None:
+        """Stop the producer and release its buffers (safe to call
+        multiple times; called by consumers on early exit)."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        try:
+            while True:
+                item = self._q.get()
+                if item is _END:
+                    if self._err:
+                        raise self._err[0]
+                    return
+                yield item
+        finally:
+            self.close()

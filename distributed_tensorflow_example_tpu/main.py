@@ -11,6 +11,7 @@ printing the mapping explanation.
 
 from __future__ import annotations
 
+import os
 import sys
 
 from .config import parse_config
@@ -18,6 +19,15 @@ from .train.loop import run
 
 
 def main(argv=None) -> int:
+    # Operator platform override (e.g. DTX_PLATFORM=cpu for local runs /
+    # multi-process localhost smoke tests). Needed as a config update,
+    # not an env var: this image's TPU plugin pins jax_platforms via
+    # jax.config at interpreter start, which wins over JAX_PLATFORMS.
+    platform = os.environ.get("DTX_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
     cfg = parse_config(argv)
     run(cfg)
     return 0

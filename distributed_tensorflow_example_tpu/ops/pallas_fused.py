@@ -1,0 +1,218 @@
+"""Fused MLP forward as a Pallas TPU kernel.
+
+Reference parity: the reference's forward is four ops dispatched by the
+TF graph executor — matmul, sigmoid, matmul, (softmax)
+(/root/reference/example.py:87-90), each a separate C++ Eigen kernel
+with HBM round-trips between them on CPU.
+
+TPU-native design: one Pallas kernel computes the whole forward chain
+per batch tile — weights and the tile's activations stay in VMEM, the
+matmuls hit the MXU, the activation function runs on the VPU between
+them with no HBM round-trip. For the reference's 784-100-10 MLP, stock
+XLA already fuses this well (SURVEY.md §2b); the kernel exists to (a)
+own the capability the task calls for, (b) cut dispatch to a single
+fused op for wider/deeper spec variants where XLA's fusion boundaries
+start to matter.
+
+Training support: gradients flow via ``jax.custom_vjp`` — the forward
+runs the Pallas kernel (saving the layer activations as residuals), the
+backward is plain XLA (matmuls on the MXU either way). Enabled with
+``--pallas``; only the pure data-parallel path uses it (TP shards the
+hidden dim, which this kernel does not partition).
+
+On non-TPU backends the kernel runs in Pallas interpret mode so tests
+exercise the same code path on the 8-virtual-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..models import mlp
+
+_BATCH_TILE = 128
+
+# Activations whose derivative is expressible from the saved activation
+# output (the residuals the kernel writes); gelu needs the
+# pre-activation, so its --pallas requests fall back to the XLA forward
+# (parallel/step.py gates on this set).
+SUPPORTED_ACTIVATIONS = ("sigmoid", "tanh", "relu")
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _act(name: str, z):
+    return mlp._ACTIVATIONS[name](z)
+
+
+def _make_kernel(num_layers: int, activation: str):
+    """Kernel over one batch tile: x_ref, W1,b1,...,WL,bL -> logits and
+    per-hidden-layer activations (residuals for the VJP)."""
+
+    def kernel(x_ref, *refs):
+        param_refs = refs[: 2 * num_layers]
+        out_refs = refs[2 * num_layers :]  # logits_ref, h1_ref, ..., h{L-1}_ref
+        h = x_ref[:]
+        for i in range(num_layers):
+            w = param_refs[2 * i][:]
+            b = param_refs[2 * i + 1][:]
+            h = jnp.dot(h, w, preferred_element_type=jnp.float32) + b
+            if i < num_layers - 1:
+                h = _act(activation, h)
+                out_refs[1 + i][:] = h
+        out_refs[0][:] = h
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _forward_pallas(spec: mlp.MLPSpec, params, x):
+    """Run the fused kernel; returns (logits, (h1, ..., h_{L-1}))."""
+    L = spec.num_layers
+    n = x.shape[0]
+    n_pad = max(_BATCH_TILE, ((n + _BATCH_TILE - 1) // _BATCH_TILE) * _BATCH_TILE)
+    xp = jnp.pad(x.astype(jnp.float32), ((0, n_pad - n), (0, 0)))
+
+    flat_params = []
+    for i in range(1, L + 1):
+        flat_params.append(params[f"W{i}"].astype(jnp.float32))
+        flat_params.append(params[f"b{i}"].astype(jnp.float32).reshape(1, -1))
+
+    grid = (n_pad // _BATCH_TILE,)
+    sizes = spec.layer_sizes
+    in_specs = [
+        pl.BlockSpec((_BATCH_TILE, sizes[0]), lambda i: (i, 0)),
+    ]
+    for i in range(1, L + 1):
+        in_specs.append(pl.BlockSpec((sizes[i - 1], sizes[i]), lambda i_: (0, 0)))
+        in_specs.append(pl.BlockSpec((1, sizes[i]), lambda i_: (0, 0)))
+
+    # Under shard_map's varying-axis checking, outputs must declare how
+    # they vary across mesh axes: like the batch input (vma of x). The
+    # kernel's inputs must also agree, so lift the (data-replicated)
+    # params to the batch's vma; the custom-VJP backward reduces the
+    # cotangents back down (_match_vma).
+    try:
+        vma = jax.typeof(xp).vma
+    except (AttributeError, TypeError):
+        vma = None
+    if vma:
+        flat_params = [jax.lax.pvary(p, tuple(sorted(vma))) for p in flat_params]
+    _sds = (
+        (lambda shape: jax.ShapeDtypeStruct(shape, jnp.float32, vma=vma))
+        if vma
+        else (lambda shape: jax.ShapeDtypeStruct(shape, jnp.float32))
+    )
+    out_shapes = [_sds((n_pad, sizes[L]))]
+    out_specs = [pl.BlockSpec((_BATCH_TILE, sizes[L]), lambda i: (i, 0))]
+    for i in range(1, L):
+        out_shapes.append(_sds((n_pad, sizes[i])))
+        out_specs.append(pl.BlockSpec((_BATCH_TILE, sizes[i]), lambda i: (i, 0)))
+
+    if _interpret() and vma:
+        # The HLO interpreter drops vma from its internal loop carries,
+        # so it cannot run under shard_map's varying-axis checking. On
+        # CPU inside shard_map, compute the identical math with XLA ops
+        # — the custom-VJP path (incl. the _match_vma psum reinsertion)
+        # is still exercised; the kernel itself is covered by the
+        # non-shard_map interpret tests and by real-TPU runs.
+        act = mlp._ACTIVATIONS[spec.activation]
+        h = xp
+        outs = [None]
+        for i in range(L):
+            h = h @ flat_params[2 * i] + flat_params[2 * i + 1]
+            if i < L - 1:
+                h = act(h)
+                outs.append(h)
+        outs[0] = h
+    elif _interpret():
+        # Interpret mode (CPU tests), outside shard_map: gridless
+        # full-array call (the interpreter pads oddly with grids).
+        outs = pl.pallas_call(
+            _make_kernel(L, spec.activation),
+            out_shape=out_shapes,
+            interpret=True,
+        )(xp, *flat_params)
+    else:
+        outs = pl.pallas_call(
+            _make_kernel(L, spec.activation),
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shapes,
+        )(xp, *flat_params)
+    logits = outs[0][:n]
+    hiddens = tuple(o[:n] for o in outs[1:])
+    return logits, hiddens
+
+
+def _act_grad(name: str, h):
+    """d(act)/dz expressed in terms of the activation output h (the
+    residual we saved): sigmoid' = h(1-h), tanh' = 1-h^2, relu' = h>0.
+    gelu has no closed form in h — it is excluded by
+    SUPPORTED_ACTIVATIONS and routed to the XLA forward instead."""
+    if name == "sigmoid":
+        return h * (1.0 - h)
+    if name == "tanh":
+        return 1.0 - h * h
+    if name == "relu":
+        return (h > 0).astype(h.dtype)
+    raise NotImplementedError(name)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def mlp_forward(spec: mlp.MLPSpec, params, x):
+    """Drop-in for models.mlp.apply on the data-parallel path."""
+    logits, _ = _forward_pallas(spec, params, x)
+    return logits
+
+
+def _fwd(spec, params, x):
+    logits, hiddens = _forward_pallas(spec, params, x)
+    return logits, (params, x, hiddens)
+
+
+def _match_vma(val, like):
+    """Reduce a cotangent onto its primal's varying-axis set — the psum
+    shard_map's automatic transpose would have inserted (a custom_vjp
+    opts out of that machinery, so we reproduce it): a param replicated
+    across 'data' gets its per-shard cotangents summed over 'data'."""
+    try:
+        cur = jax.typeof(val).vma
+        want = jax.typeof(like).vma
+    except (AttributeError, TypeError):
+        return val
+    extra = tuple(sorted(cur - want))
+    return jax.lax.psum(val, extra) if extra else val
+
+
+def _bwd(spec, res, g):
+    params, x, hiddens = res
+    L = spec.num_layers
+    acts = (x.astype(jnp.float32),) + hiddens  # inputs to layers 1..L
+    dW = {}
+    db = {}
+    delta = g.astype(jnp.float32)  # dL/dz_L
+    for i in range(L, 0, -1):
+        a_in = acts[i - 1]
+        dW[f"W{i}"] = a_in.T @ delta
+        db[f"b{i}"] = jnp.sum(delta, axis=0)
+        if i > 1:
+            da = delta @ params[f"W{i}"].astype(jnp.float32).T
+            delta = da * _act_grad(spec.activation, hiddens[i - 2])
+    dparams = {
+        k: _match_vma(v, params[k]).astype(params[k].dtype)
+        for k, v in {**dW, **db}.items()
+    }
+    dx = (delta @ params["W1"].astype(jnp.float32).T).astype(x.dtype)
+    return dparams, dx
+
+
+mlp_forward.defvjp(_fwd, _bwd)

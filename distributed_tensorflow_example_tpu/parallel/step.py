@@ -53,11 +53,18 @@ from .mesh import DATA_AXIS, MODEL_AXIS
 
 
 def forward_local(spec: mlp.MLPSpec, params, x, styles, use_pallas: bool = False):
-    """Per-shard forward to (replicated) logits; TP-aware (example.py:87-89)."""
+    """Per-shard forward to (replicated) logits; TP-aware (example.py:87-89).
+
+    The fused Pallas kernel handles the pure data-parallel case for
+    activations whose VJP is expressible from the saved activation
+    (pallas_fused.SUPPORTED_ACTIVATIONS); TP shards the hidden dim and
+    gelu's VJP needs the pre-activation, so those fall to the XLA path.
+    """
     if use_pallas and all(s == "rep" for s in styles):
         from ..ops import pallas_fused
 
-        return pallas_fused.mlp_forward(spec, params, x)
+        if spec.activation in pallas_fused.SUPPORTED_ACTIVATIONS:
+            return pallas_fused.mlp_forward(spec, params, x)
     return mlp.apply(spec, params, x, styles=styles, model_axis=MODEL_AXIS)
 
 

@@ -107,7 +107,11 @@ def run(cfg: Config) -> Dict[str, Any]:
     proc_cnt = jax.process_count()
     chief = proc_idx == 0
 
-    dataset = load_datasets(cfg.data_dir, cfg.dataset, seed=0)
+    dataset = load_datasets(
+        cfg.data_dir, cfg.dataset, seed=0,
+        synthetic_train_size=cfg.synthetic_train_size,
+        synthetic_test_size=cfg.synthetic_test_size,
+    )
     mesh = mesh_lib.build_mesh(cfg.data_parallel, cfg.model_parallel)
     dp = mesh.shape[mesh_lib.DATA_AXIS]
     spec = make_spec(cfg)
@@ -164,7 +168,19 @@ def run(cfg: Config) -> Dict[str, Any]:
     cost = float("nan")
     examples_seen = 0
 
-    ckpt_enabled = bool(cfg.checkpoint_dir and cfg.checkpoint_every and chief)
+    def save_state(step: int, resume_epoch: int) -> None:
+        """Write a checkpoint. In multi-process runs state leaves may
+        span non-addressable devices; every process joins the allgather,
+        only the chief writes."""
+        to_save = state
+        if proc_cnt > 1:
+            from jax.experimental import multihost_utils
+
+            to_save = multihost_utils.process_allgather(state, tiled=True)
+        if chief:
+            ckpt_lib.save_checkpoint(cfg.checkpoint_dir, to_save, step, resume_epoch)
+
+    ckpt_enabled = bool(cfg.checkpoint_dir and cfg.checkpoint_every)
     last_ckpt_step = 0
 
     def maybe_checkpoint(resume_epoch: int) -> None:
@@ -177,7 +193,7 @@ def run(cfg: Config) -> Dict[str, Any]:
             return
         step = int(state.step)
         if step // cfg.checkpoint_every > last_ckpt_step // cfg.checkpoint_every:
-            ckpt_lib.save_checkpoint(cfg.checkpoint_dir, state, step, resume_epoch)
+            save_state(step, resume_epoch)
             last_ckpt_step = step
 
     if fast:
@@ -267,42 +283,54 @@ def run(cfg: Config) -> Dict[str, Any]:
 
             batch_sharding = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
         start_time = time.time()  # example.py:149
+        from ..data.prefetch import Prefetcher
+
+        steps_done = start_epoch * iterator.batches_per_epoch
         for epoch in range(start_epoch, cfg.training_epochs):
             batch_count = iterator.batches_per_epoch  # example.py:153
             count = 0
-            for i, (batch_x, batch_y) in enumerate(iterator.epoch()):
-                if batch_sharding is not None:
-                    batch_x = jax.make_array_from_process_local_data(
-                        batch_sharding, batch_x
-                    )
-                    batch_y = jax.make_array_from_process_local_data(
-                        batch_sharding, batch_y
-                    )
-                state, cost_dev, acc_dev = train_step(state, batch_x, batch_y)
-                if async_mode and int(state.step) % cfg.sync_period == 0:
-                    state = param_sync(state)
-                examples_seen += global_batch
-                inflight.append(cost_dev)
-                if len(inflight) > window:
-                    inflight.pop(0).block_until_ready()
-                if writer is not None:
-                    # the reference writes cost+accuracy every step
-                    # (example.py:163)
-                    cost = float(cost_dev)
-                    writer.add_scalars(
-                        int(state.step) * step_scale,
-                        {"cost": cost, "accuracy": float(acc_dev)},
-                    )
-                count += 1
-                if count % frequency == 0 or i + 1 == batch_count:
-                    cost = float(cost_dev)
-                    step = int(state.step) * step_scale
-                    elapsed_time = time.time() - start_time  # example.py:167
-                    start_time = time.time()
-                    _print_window(step, epoch, i, batch_count, cost,
-                                  elapsed_time, frequency)
-                    count = 0
-                maybe_checkpoint(epoch)
+            prefetcher = Prefetcher(iterator.epoch())
+            try:
+                batches = enumerate(prefetcher)
+                for i, (batch_x, batch_y) in batches:
+                    if batch_sharding is not None:
+                        batch_x = jax.make_array_from_process_local_data(
+                            batch_sharding, batch_x
+                        )
+                        batch_y = jax.make_array_from_process_local_data(
+                            batch_sharding, batch_y
+                        )
+                    state, cost_dev, acc_dev = train_step(state, batch_x, batch_y)
+                    steps_done += 1
+                    # host-side step counter: state.step advances 1 per call
+                    # deterministically, and fetching it would force a
+                    # host-device sync every step
+                    if async_mode and steps_done % cfg.sync_period == 0:
+                        state = param_sync(state)
+                    examples_seen += global_batch
+                    inflight.append(cost_dev)
+                    if len(inflight) > window:
+                        inflight.pop(0).block_until_ready()
+                    if writer is not None:
+                        # the reference writes cost+accuracy every step
+                        # (example.py:163)
+                        cost = float(cost_dev)
+                        writer.add_scalars(
+                            steps_done * step_scale,
+                            {"cost": cost, "accuracy": float(acc_dev)},
+                        )
+                    count += 1
+                    if count % frequency == 0 or i + 1 == batch_count:
+                        cost = float(cost_dev)
+                        step = steps_done * step_scale
+                        elapsed_time = time.time() - start_time  # example.py:167
+                        start_time = time.time()
+                        _print_window(step, epoch, i, batch_count, cost,
+                                      elapsed_time, frequency)
+                        count = 0
+                    maybe_checkpoint(epoch)
+            finally:
+                prefetcher.close()
 
     if cfg.profile and chief:
         jax.profiler.stop_trace()
@@ -326,10 +354,8 @@ def run(cfg: Config) -> Dict[str, Any]:
         print("Total Time: %3.2fs" % float(total_time))   # example.py:178
         print("Final Cost: %.4f" % cost)                  # example.py:179
 
-    if cfg.checkpoint_dir and chief:
-        ckpt_lib.save_checkpoint(
-            cfg.checkpoint_dir, state, int(state.step), cfg.training_epochs
-        )
+    if cfg.checkpoint_dir:
+        save_state(int(state.step), cfg.training_epochs)
     if writer is not None:
         writer.close()
 
