@@ -180,4 +180,5 @@ def build_fast_eval(cfg, mesh, spec: mlp.MLPSpec, images: np.ndarray, labels: np
     def evaluate(params) -> float:
         return float(fn(params, img_d, lbl_d, mask_d)) / n
 
+    evaluate.staged = (img_d, lbl_d, mask_d)  # for callers that must block
     return evaluate
