@@ -141,6 +141,115 @@ def build_run_to_completion(
     return run
 
 
+def build_local_run_to_completion(
+    cfg, mesh, spec: mlp.MLPSpec, optimizer, steps_per_epoch: int, num_epochs: int
+) -> Callable:
+    """Local-SGD (async analog) whole-run program: nested scan where the
+    inner body applies per-shard updates with NO collective, and every
+    ``cfg.sync_period`` steps the shards' params/opt-state are averaged
+    (the reconciliation) — all inside one XLA executable.
+
+    Same semantics as the host-fed build_local_train_step +
+    build_param_sync pair (parallel/step.py), which remains the
+    multi-process path; this runner makes the async mode run at device
+    speed on a single host (the reference's 3 async workers were its
+    performance story, example.py:24-26 — this is that story's
+    TPU-native fast path).
+
+    State layout matches stack_state: every params/opt leaf has a
+    leading [dp] axis sharded P('data'); inside the shard_map body the
+    local view is leaf[0].
+    """
+    if mesh.shape[MODEL_AXIS] != 1:
+        raise ValueError("local-SGD (async) mode requires model_parallel=1")
+    dp = mesh.shape[DATA_AXIS]
+    K = max(1, cfg.sync_period)
+    styles = mesh_lib.layer_styles(spec, 1)
+
+    def avg(a):
+        if jnp.issubdtype(a.dtype, jnp.integer):
+            return a
+        return jax.lax.pmean(a, DATA_AXIS)
+
+    def shard_run(state: TrainState, img_u8, lbl, key, epoch_offset):
+        n_local = img_u8.shape[0]
+        b = n_local // steps_per_epoch
+        shard_id = jax.lax.axis_index(DATA_AXIS)
+        shard_key = jax.random.fold_in(key, shard_id)
+
+        def epoch_body(state, epoch_idx):
+            perm = jax.random.permutation(
+                jax.random.fold_in(shard_key, epoch_idx), n_local
+            )
+
+            def body(state, step_idx):
+                idx = jax.lax.dynamic_slice_in_dim(perm, step_idx * b, b)
+                x = jnp.take(img_u8, idx, axis=0).astype(jnp.float32) * (1.0 / 255.0)
+                y = jnp.take(lbl, idx, axis=0)
+                local_p = jax.tree.map(lambda a: a[0], state.params)
+                local_o = jax.tree.map(lambda a: a[0], state.opt_state)
+
+                def loss_fn(p):
+                    from .step import _loss_and_acc
+
+                    return _loss_and_acc(
+                        spec, p, x, y, styles, cfg.naive_ce, cfg.pallas
+                    )
+
+                (cost, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                    local_p
+                )
+                new_p, new_o = optimizer.update(grads, local_o, local_p)
+                new_state = TrainState(
+                    state.step + 1,
+                    jax.tree.map(lambda a: a[None], new_p),
+                    jax.tree.map(lambda a: a[None], new_o),
+                )
+                # reconcile every K-th step (HOGWILD staleness window)
+                do_sync = (new_state.step % K) == 0
+                synced = TrainState(
+                    new_state.step,
+                    jax.tree.map(avg, new_state.params),
+                    jax.tree.map(avg, new_state.opt_state),
+                )
+                new_state = jax.tree.map(
+                    lambda s, u: jnp.where(do_sync, s, u), synced, new_state
+                )
+                cost = jax.lax.pmean(cost, DATA_AXIS)
+                acc = jax.lax.pmean(acc, DATA_AXIS)
+                return new_state, (cost, acc)
+
+            state, (costs, accs) = jax.lax.scan(
+                body, state, jnp.arange(steps_per_epoch, dtype=jnp.int32)
+            )
+            return state, (costs, accs)
+
+        state, (costs, accs) = jax.lax.scan(
+            epoch_body, state,
+            epoch_offset + jnp.arange(num_epochs, dtype=jnp.int32),
+        )
+        return state, costs, accs
+
+    from .step import _stacked_specs
+
+    def build(state_template):
+        sspecs = _stacked_specs(state_template)
+        fn = jax.shard_map(
+            shard_run,
+            mesh=mesh,
+            in_specs=(sspecs, P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+            out_specs=(sspecs, P(), P()),
+        )
+        jitted = jax.jit(fn, donate_argnums=0)
+
+        def run(state, img_u8, lbl, key, epoch_offset: int = 0):
+            return jitted(state, img_u8, lbl, key, jnp.int32(epoch_offset))
+
+        return run
+
+    return build
+
+
 def build_fast_eval(cfg, mesh, spec: mlp.MLPSpec, images: np.ndarray, labels: np.ndarray):
     """Device-resident full-test-set eval (example.py:177): pad once to
     the mesh, upload once (uint8), return a zero-arg callable -> accuracy."""

@@ -120,8 +120,11 @@ def run(cfg: Config) -> Dict[str, Any]:
     global_batch = _global_batch(cfg, dp)
     async_mode = cfg.sync_period > 1
     fast = (
-        cfg.fast_loop and not async_mode and proc_cnt == 1
+        cfg.fast_loop and proc_cnt == 1
         and (cfg.shard_data or dp == 1)
+        # async fast path runs the whole program on-device; periodic
+        # host-side checkpoints need the host loop
+        and not (async_mode and (cfg.checkpoint_every or cfg.model_parallel > 1))
     )
 
     # init_op equivalent (example.py:129, 74): identical seeded init on
@@ -130,8 +133,11 @@ def run(cfg: Config) -> Dict[str, Any]:
 
     if async_mode:
         state = step_lib.stack_state(state, dp)
-        train_step = step_lib.build_local_train_step(cfg, mesh, spec, optimizer, state)
-        param_sync = step_lib.build_param_sync(mesh, state)
+        train_step = (
+            None if fast
+            else step_lib.build_local_train_step(cfg, mesh, spec, optimizer, state)
+        )
+        param_sync = None if fast else step_lib.build_param_sync(mesh, state)
         get_params = step_lib.build_unstack_params(mesh, state)
         sspecs = step_lib._stacked_specs(state)
     else:
@@ -222,8 +228,8 @@ def run(cfg: Config) -> Dict[str, Any]:
                 base_step = epoch * batch_count
                 for i in range(batch_count):
                     writer.add_scalars(
-                        base_step + i + 1, {"cost": float(costs[i]),
-                                            "accuracy": float(accs[i])}
+                        (base_step + i + 1) * step_scale,
+                        {"cost": float(costs[i]), "accuracy": float(accs[i])},
                     )
             count = 0
             last = float("nan")
@@ -231,7 +237,7 @@ def run(cfg: Config) -> Dict[str, Any]:
                 count += 1
                 if count % frequency == 0 or i + 1 == batch_count:
                     last = float(costs[i])
-                    step = epoch * batch_count + i + 1
+                    step = (epoch * batch_count + i + 1) * step_scale
                     _print_window(step, epoch, i, batch_count, last,
                                   count * avg_step_s, frequency)
                     count = 0
@@ -240,9 +246,14 @@ def run(cfg: Config) -> Dict[str, Any]:
         n_ep = cfg.training_epochs - start_epoch
         if cfg.checkpoint_every == 0 and n_ep > 0:
             # the whole run as one device program
-            runner = epoch_lib.build_run_to_completion(
-                cfg, mesh, spec, optimizer, batch_count, n_ep
-            )
+            if async_mode:
+                runner = epoch_lib.build_local_run_to_completion(
+                    cfg, mesh, spec, optimizer, batch_count, n_ep
+                )(state)
+            else:
+                runner = epoch_lib.build_run_to_completion(
+                    cfg, mesh, spec, optimizer, batch_count, n_ep
+                )
             t0 = time.time()
             state, costs2d, accs2d = runner(
                 state, img_d, lbl_d, shuffle_key, start_epoch

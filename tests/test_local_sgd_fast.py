@@ -1,0 +1,59 @@
+"""Fast-path (scanned) local-SGD: the async analog as one device
+program (parallel/epoch.py:build_local_run_to_completion)."""
+
+import jax
+import numpy as np
+
+from distributed_tensorflow_example_tpu.config import Config
+from distributed_tensorflow_example_tpu.models.mlp import MLPSpec
+from distributed_tensorflow_example_tpu.parallel import epoch as epoch_lib
+from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+from distributed_tensorflow_example_tpu.parallel import step as step_lib
+from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+SPEC = MLPSpec(input_size=16, hidden_sizes=(8,), num_classes=4)
+
+
+def _setup(sync_period, spe, epochs, dp=8):
+    cfg = Config(learning_rate=0.2, sync_period=sync_period)
+    mesh = mesh_lib.build_mesh(dp, 1)
+    opt = make_optimizer(cfg)
+    state = step_lib.stack_state(create_train_state(jax.random.PRNGKey(1), SPEC, opt), dp)
+    state = mesh_lib.place_state(state, mesh, step_lib._stacked_specs(state))
+    runner = epoch_lib.build_local_run_to_completion(cfg, mesh, SPEC, opt, spe, epochs)(state)
+    rng = np.random.RandomState(0)
+    n = dp * spe * 4  # local batch 4
+    imgs = rng.rand(n, SPEC.input_size).astype(np.float32)
+    lbls = np.eye(SPEC.num_classes, dtype=np.float32)[rng.randint(0, 4, n)]
+    img_d, lbl_d, spe2 = epoch_lib.shard_dataset(mesh, imgs, lbls, dp * 4)
+    assert spe2 == spe
+    return state, runner, img_d, lbl_d
+
+
+def test_synced_at_period_boundary(devices8):
+    """After K steps (K = sync_period), every shard holds the averaged
+    params — the reconciliation fired on the last scan step."""
+    K = 5
+    state, runner, img_d, lbl_d = _setup(sync_period=K, spe=K, epochs=1)
+    state, costs, accs = runner(state, img_d, lbl_d, jax.random.PRNGKey(3))
+    w = np.asarray(jax.device_get(state.params["W1"]))  # [dp, in, hid]
+    np.testing.assert_allclose(w, np.broadcast_to(w[0], w.shape), rtol=1e-6)
+    assert int(state.step) == K
+    assert np.isfinite(costs).all()
+
+
+def test_diverged_between_syncs(devices8):
+    """One step past the boundary, shards have drifted apart again."""
+    K = 5
+    state, runner, img_d, lbl_d = _setup(sync_period=K, spe=K + 1, epochs=1)
+    state, costs, accs = runner(state, img_d, lbl_d, jax.random.PRNGKey(3))
+    w = np.asarray(jax.device_get(state.params["W1"]))
+    assert np.abs(w - w[0:1]).max() > 1e-7
+
+
+def test_learns(devices8):
+    state, runner, img_d, lbl_d = _setup(sync_period=4, spe=20, epochs=5)
+    state, costs, accs = runner(state, img_d, lbl_d, jax.random.PRNGKey(3))
+    costs = np.asarray(costs)  # [epochs, spe]
+    assert costs[-1].mean() < costs[0].mean()
