@@ -267,7 +267,7 @@ class EpochIterator:
         self.process_index = process_index
         self.process_count = process_count
         self.drop_remainder = drop_remainder
-        self._rng = np.random.RandomState(seed)
+        self._seed = seed
         self._epoch = 0
 
     def _local_examples(self) -> int:
@@ -289,9 +289,20 @@ class EpochIterator:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
 
-    def epoch(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        perm = self._rng.permutation(self.split.num_examples)
-        self._epoch += 1
+    def epoch(
+        self, epoch_index: int | None = None
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """One shuffled pass. The permutation is keyed by ``(seed,
+        epoch_index)`` — not by a stateful RNG stream — so a run resumed
+        at epoch E replays exactly the shuffles an uninterrupted run
+        would have used (the host-path analog of the device path's
+        ``fold_in(epoch)`` keying). ``epoch_index`` defaults to an
+        internal counter for sequential use."""
+        if epoch_index is None:
+            epoch_index = self._epoch
+        rng = np.random.RandomState([self._seed & 0x7FFFFFFF, epoch_index])
+        perm = rng.permutation(self.split.num_examples)
+        self._epoch = epoch_index + 1
         if self.shard and self.process_count > 1:
             # strided slice, truncated to the common per-process length
             # so every process runs the same number of (collective) steps

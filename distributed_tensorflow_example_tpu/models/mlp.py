@@ -91,9 +91,14 @@ def apply(
 ) -> jnp.ndarray:
     """Forward pass to logits (example.py:87-89; softmax left to the loss).
 
-    Runs in ``compute_dtype`` (bfloat16 hits the MXU's native input
-    width); params stay in ``param_dtype``. The whole chain fuses into
-    one XLA computation — matmuls on the MXU, elementwise fused in.
+    Matmuls take ``compute_dtype`` inputs (bfloat16 hits the MXU's
+    native input width) with float32 accumulation
+    (``preferred_element_type``); bias add and activation run in f32,
+    rounded to ``compute_dtype`` at each layer edge. For float32 this is
+    the plain forward; for bfloat16 it keeps the MXU's f32 accumulator
+    precision through the elementwise tail. The fused Pallas kernel
+    (ops.pallas_fused) computes this layer-for-layer identically. The
+    whole chain fuses into one XLA computation.
 
     ``styles`` (from parallel.mesh.layer_styles) makes the same code
     tensor-parallel inside shard_map: a 'row'-split layer's partial
@@ -101,17 +106,18 @@ def apply(
     default (None / all-'rep') this is the plain replicated forward.
     """
     act = _ACTIVATIONS[spec.activation]
-    h = x.astype(spec.compute_dtype)
+    cdt = spec.compute_dtype
+    h = x.astype(cdt)
     L = spec.num_layers
     for i in range(1, L + 1):
-        w = params[f"W{i}"].astype(spec.compute_dtype)
-        b = params[f"b{i}"].astype(spec.compute_dtype)
+        w = params[f"W{i}"].astype(cdt)
+        b = params[f"b{i}"].astype(jnp.float32)
+        acc = jnp.dot(h.astype(cdt), w, preferred_element_type=jnp.float32)
         if styles is not None and styles[i - 1] == "row":
-            h = jax.lax.psum(h @ w, model_axis) + b
-        else:
-            h = h @ w + b
+            acc = jax.lax.psum(acc, model_axis)
+        h = acc + b
         if i < L:
-            h = act(h)
+            h = act(h).astype(cdt)
     return h.astype(jnp.float32)
 
 

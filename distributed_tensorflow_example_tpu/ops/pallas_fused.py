@@ -52,7 +52,22 @@ def _act(name: str, z):
     return mlp._ACTIVATIONS[name](z)
 
 
-def _make_kernel(num_layers: int, activation: str):
+def _layer(h, w, b, activation: str, compute_dtype, last: bool):
+    """One MLP layer, shared by the Pallas kernel body and the XLA
+    fallback (and matching models.mlp.apply layer-for-layer): matmul
+    takes ``compute_dtype`` inputs (bfloat16 is the MXU's native input
+    width), accumulation/bias/activation run in f32 (Mosaic also rejects
+    f32 scalar constants inside bf16 elementwise ops), and the result is
+    rounded to ``compute_dtype`` at the layer edge."""
+    acc = jnp.dot(
+        h.astype(compute_dtype), w, preferred_element_type=jnp.float32
+    ) + b.astype(jnp.float32)
+    if last:
+        return acc  # logits stay f32, as in models.mlp.apply
+    return _act(activation, acc).astype(compute_dtype)
+
+
+def _make_kernel(num_layers: int, activation: str, compute_dtype):
     """Kernel over one batch tile: x_ref, W1,b1,...,WL,bL -> logits and
     per-hidden-layer activations (residuals for the VJP)."""
 
@@ -63,27 +78,28 @@ def _make_kernel(num_layers: int, activation: str):
         for i in range(num_layers):
             w = param_refs[2 * i][:]
             b = param_refs[2 * i + 1][:]
-            h = jnp.dot(h, w, preferred_element_type=jnp.float32) + b
-            if i < num_layers - 1:
-                h = _act(activation, h)
-                out_refs[1 + i][:] = h
-        out_refs[0][:] = h
+            h = _layer(h, w, b, activation, compute_dtype, i == num_layers - 1)
+            out_refs[(1 + i) if i < num_layers - 1 else 0][:] = h
 
     return kernel
 
 
 @functools.partial(jax.jit, static_argnums=0)
 def _forward_pallas(spec: mlp.MLPSpec, params, x):
-    """Run the fused kernel; returns (logits, (h1, ..., h_{L-1}))."""
+    """Run the fused kernel; returns (logits, (h1, ..., h_{L-1})).
+
+    Inputs/params are cast to ``spec.compute_dtype`` (as the XLA forward
+    in models.mlp.apply does); matmul accumulation stays float32."""
     L = spec.num_layers
+    cdt = spec.compute_dtype
     n = x.shape[0]
     n_pad = max(_BATCH_TILE, ((n + _BATCH_TILE - 1) // _BATCH_TILE) * _BATCH_TILE)
-    xp = jnp.pad(x.astype(jnp.float32), ((0, n_pad - n), (0, 0)))
+    xp = jnp.pad(x.astype(cdt), ((0, n_pad - n), (0, 0)))
 
     flat_params = []
     for i in range(1, L + 1):
-        flat_params.append(params[f"W{i}"].astype(jnp.float32))
-        flat_params.append(params[f"b{i}"].astype(jnp.float32).reshape(1, -1))
+        flat_params.append(params[f"W{i}"].astype(cdt))
+        flat_params.append(params[f"b{i}"].astype(cdt).reshape(1, -1))
 
     grid = (n_pad // _BATCH_TILE,)
     sizes = spec.layer_sizes
@@ -104,16 +120,24 @@ def _forward_pallas(spec: mlp.MLPSpec, params, x):
     except (AttributeError, TypeError):
         vma = None
     if vma:
-        flat_params = [jax.lax.pvary(p, tuple(sorted(vma))) for p in flat_params]
+        if hasattr(jax.lax, "pcast"):
+            flat_params = [
+                jax.lax.pcast(p, tuple(sorted(vma)), to="varying")
+                for p in flat_params
+            ]
+        else:  # older JAX
+            flat_params = [jax.lax.pvary(p, tuple(sorted(vma))) for p in flat_params]
     _sds = (
-        (lambda shape: jax.ShapeDtypeStruct(shape, jnp.float32, vma=vma))
+        (lambda shape, dt: jax.ShapeDtypeStruct(shape, dt, vma=vma))
         if vma
-        else (lambda shape: jax.ShapeDtypeStruct(shape, jnp.float32))
+        else (lambda shape, dt: jax.ShapeDtypeStruct(shape, dt))
     )
-    out_shapes = [_sds((n_pad, sizes[L]))]
+    # logits in f32 (the accumulator dtype, as mlp.apply returns them);
+    # hidden residuals in compute_dtype
+    out_shapes = [_sds((n_pad, sizes[L]), jnp.float32)]
     out_specs = [pl.BlockSpec((_BATCH_TILE, sizes[L]), lambda i: (i, 0))]
     for i in range(1, L):
-        out_shapes.append(_sds((n_pad, sizes[i])))
+        out_shapes.append(_sds((n_pad, sizes[i]), cdt))
         out_specs.append(pl.BlockSpec((_BATCH_TILE, sizes[i]), lambda i: (i, 0)))
 
     if _interpret() and vma:
@@ -123,32 +147,34 @@ def _forward_pallas(spec: mlp.MLPSpec, params, x):
         # — the custom-VJP path (incl. the _match_vma psum reinsertion)
         # is still exercised; the kernel itself is covered by the
         # non-shard_map interpret tests and by real-TPU runs.
-        act = mlp._ACTIVATIONS[spec.activation]
         h = xp
         outs = [None]
         for i in range(L):
-            h = h @ flat_params[2 * i] + flat_params[2 * i + 1]
+            h = _layer(
+                h, flat_params[2 * i], flat_params[2 * i + 1],
+                spec.activation, cdt, i == L - 1,
+            )
             if i < L - 1:
-                h = act(h)
                 outs.append(h)
-        outs[0] = h
+            else:
+                outs[0] = h
     elif _interpret():
         # Interpret mode (CPU tests), outside shard_map: gridless
         # full-array call (the interpreter pads oddly with grids).
         outs = pl.pallas_call(
-            _make_kernel(L, spec.activation),
+            _make_kernel(L, spec.activation, cdt),
             out_shape=out_shapes,
             interpret=True,
         )(xp, *flat_params)
     else:
         outs = pl.pallas_call(
-            _make_kernel(L, spec.activation),
+            _make_kernel(L, spec.activation, cdt),
             grid=grid,
             in_specs=in_specs,
             out_specs=out_specs,
             out_shape=out_shapes,
         )(xp, *flat_params)
-    logits = outs[0][:n]
+    logits = outs[0][:n].astype(jnp.float32)
     hiddens = tuple(o[:n] for o in outs[1:])
     return logits, hiddens
 

@@ -8,10 +8,12 @@ through the gRPC runtime (SURVEY.md §3.3). The rebuilt host loop
 per step; this module removes even that:
 
 - the **entire training split lives in HBM** (MNIST is 43 MB as uint8;
-  pixels are stored uint8 and normalized to float32 *inside* the
-  compiled step — 4x less HBM bandwidth than float32 storage and the
-  exact ``/255`` normalization the reference's input pipeline applied
-  on the host, example.py:47-48);
+  pixels are stored uint8 when exactly k/255-representable — real
+  MNIST always is — and normalized to float32 *inside* the compiled
+  step: 4x less HBM bandwidth than float32 storage and the exact
+  ``/255`` normalization the reference's input pipeline applied on the
+  host (example.py:47-48); non-8-bit sources (the synthetic set) stay
+  float32 so fast and host loops train on bit-identical data;
 - each shard of the ('data',) axis holds its slice of the dataset;
 - one ``jax.lax.scan`` runs a whole epoch of steps inside a single
   XLA executable: per-step batch gather (dynamic slice of a device-side
@@ -42,22 +44,40 @@ from .mesh import DATA_AXIS, MODEL_AXIS
 from .step import make_sync_step_body
 
 
+def _pack_images(images: np.ndarray) -> np.ndarray:
+    """uint8-quantize when exact (real MNIST pixels are k/255), else keep
+    float32 — so the fast loop trains on bit-identical data to the host
+    loop for any source (the synthetic set is not 8-bit-representable)."""
+    q = np.round(np.clip(images, 0.0, 1.0) * 255.0).astype(np.uint8)
+    # division, not reciprocal-multiply: matches the IDX loader's `/ 255.0`
+    # bit-for-bit (they differ in the last ulp for some pixel values)
+    if np.array_equal(q.astype(np.float32) / np.float32(255.0), images):
+        return q
+    return images.astype(np.float32)
+
+
+def _normalize(img):
+    """Device-side inverse of _pack_images (dtype is static at trace time)."""
+    if img.dtype == jnp.uint8:
+        return img.astype(jnp.float32) / np.float32(255.0)
+    return img
+
+
 def shard_dataset(mesh, images: np.ndarray, labels: np.ndarray, batch: int):
-    """Place the split on the mesh: images uint8 [N,784] P('data'),
-    labels one-hot float32 [N,C] P('data'). N is trimmed so every shard
-    holds a whole number of batches."""
+    """Place the split on the mesh: images [N,784] P('data') (uint8 when
+    exactly representable, float32 otherwise), labels one-hot float32
+    [N,C] P('data'). N is trimmed so every shard holds a whole number of
+    batches."""
     dp = mesh.shape[DATA_AXIS]
     local_batch = batch // dp
     n = images.shape[0]
     per_shard = (n // dp // local_batch) * local_batch
     n_keep = per_shard * dp
-    img_u8 = np.ascontiguousarray(
-        np.round(np.clip(images[:n_keep], 0.0, 1.0) * 255.0).astype(np.uint8)
-    )
+    img = np.ascontiguousarray(_pack_images(images[:n_keep]))
     lbl = np.ascontiguousarray(labels[:n_keep])
     sh = NamedSharding(mesh, P(DATA_AXIS))
     return (
-        jax.device_put(img_u8, sh),
+        jax.device_put(img, sh),
         jax.device_put(lbl, sh),
         per_shard // local_batch,  # steps per epoch
     )
@@ -111,7 +131,7 @@ def build_run_to_completion(
 
             def body(state, step_idx):
                 idx = jax.lax.dynamic_slice_in_dim(perm, step_idx * b, b)
-                x = jnp.take(img_u8, idx, axis=0).astype(jnp.float32) * (1.0 / 255.0)
+                x = _normalize(jnp.take(img_u8, idx, axis=0))
                 y = jnp.take(lbl, idx, axis=0)
                 state, cost, acc = step_body(state, x, y)
                 return state, (cost, acc)
@@ -169,7 +189,12 @@ def build_local_run_to_completion(
     def avg(a):
         if jnp.issubdtype(a.dtype, jnp.integer):
             return a
-        return jax.lax.pmean(a, DATA_AXIS)
+        m = jax.lax.pmean(a, DATA_AXIS)
+        # pmean's output is axis-invariant; lift it back to varying so the
+        # lax.cond reconcile branch type-matches the identity branch
+        if hasattr(jax.lax, "pcast"):
+            return jax.lax.pcast(m, DATA_AXIS, to="varying")
+        return jax.lax.pvary(m, DATA_AXIS)
 
     def shard_run(state: TrainState, img_u8, lbl, key, epoch_offset):
         n_local = img_u8.shape[0]
@@ -184,7 +209,7 @@ def build_local_run_to_completion(
 
             def body(state, step_idx):
                 idx = jax.lax.dynamic_slice_in_dim(perm, step_idx * b, b)
-                x = jnp.take(img_u8, idx, axis=0).astype(jnp.float32) * (1.0 / 255.0)
+                x = _normalize(jnp.take(img_u8, idx, axis=0))
                 y = jnp.take(lbl, idx, axis=0)
                 local_p = jax.tree.map(lambda a: a[0], state.params)
                 local_o = jax.tree.map(lambda a: a[0], state.opt_state)
@@ -205,16 +230,26 @@ def build_local_run_to_completion(
                     jax.tree.map(lambda a: a[None], new_p),
                     jax.tree.map(lambda a: a[None], new_o),
                 )
-                # reconcile every K-th step (HOGWILD staleness window)
-                do_sync = (new_state.step % K) == 0
-                synced = TrainState(
-                    new_state.step,
-                    jax.tree.map(avg, new_state.params),
-                    jax.tree.map(avg, new_state.opt_state),
-                )
-                new_state = jax.tree.map(
-                    lambda s, u: jnp.where(do_sync, s, u), synced, new_state
-                )
+                # Reconcile every K-th step (HOGWILD staleness window).
+                # lax.cond, not a where-select: the predicate derives from
+                # the replicated step counter (uniform across shards), so
+                # the param-sized pmean allreduce only *executes* on sync
+                # steps — a where-select would pay the full cross-shard
+                # traffic every step, defeating local-SGD's purpose.
+                def reconcile(s):
+                    return TrainState(
+                        s.step,
+                        jax.tree.map(avg, s.params),
+                        jax.tree.map(avg, s.opt_state),
+                    )
+
+                if K == 1:
+                    new_state = reconcile(new_state)
+                else:
+                    do_sync = (new_state.step % K) == 0
+                    new_state = jax.lax.cond(
+                        do_sync, reconcile, lambda s: s, new_state
+                    )
                 cost = jax.lax.pmean(cost, DATA_AXIS)
                 acc = jax.lax.pmean(acc, DATA_AXIS)
                 return new_state, (cost, acc)
@@ -252,7 +287,8 @@ def build_local_run_to_completion(
 
 def build_fast_eval(cfg, mesh, spec: mlp.MLPSpec, images: np.ndarray, labels: np.ndarray):
     """Device-resident full-test-set eval (example.py:177): pad once to
-    the mesh, upload once (uint8), return a zero-arg callable -> accuracy."""
+    the mesh, upload once (uint8 when exact, else float32), return a
+    zero-arg callable -> accuracy."""
     from .step import forward_local
 
     dp = mesh.shape[DATA_AXIS]
@@ -261,18 +297,19 @@ def build_fast_eval(cfg, mesh, spec: mlp.MLPSpec, images: np.ndarray, labels: np
     pp = mesh_lib.param_pspecs(spec, mp)
     n = images.shape[0]
     n_pad = ((n + dp - 1) // dp) * dp
-    img_u8 = np.zeros((n_pad, images.shape[1]), np.uint8)
-    img_u8[:n] = np.round(np.clip(images, 0.0, 1.0) * 255.0).astype(np.uint8)
+    packed = _pack_images(images)
+    img = np.zeros((n_pad, images.shape[1]), packed.dtype)
+    img[:n] = packed
     lbl = np.zeros((n_pad, labels.shape[1]), np.float32)
     lbl[:n] = labels
     mask = (np.arange(n_pad) < n).astype(np.float32)
     sh = NamedSharding(mesh, P(DATA_AXIS))
-    img_d = jax.device_put(img_u8, sh)
+    img_d = jax.device_put(img, sh)
     lbl_d = jax.device_put(lbl, sh)
     mask_d = jax.device_put(mask, sh)
 
-    def shard_eval(params, img_u8, y, m):
-        x = img_u8.astype(jnp.float32) * (1.0 / 255.0)
+    def shard_eval(params, img_packed, y, m):
+        x = _normalize(img_packed)
         logits = forward_local(spec, params, x, styles, cfg.pallas)
         correct = (jnp.argmax(logits, -1) == jnp.argmax(y, -1)).astype(jnp.float32)
         return jax.lax.psum(jnp.sum(correct * m), DATA_AXIS)

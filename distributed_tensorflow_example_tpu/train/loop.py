@@ -309,7 +309,9 @@ def run(cfg: Config) -> Dict[str, Any]:
         for epoch in range(start_epoch, cfg.training_epochs):
             batch_count = iterator.batches_per_epoch  # example.py:153
             count = 0
-            prefetcher = Prefetcher(iterator.epoch())
+            # epoch-keyed shuffle: resume at epoch E replays the same
+            # permutations an uninterrupted run would have used
+            prefetcher = Prefetcher(iterator.epoch(epoch))
             try:
                 batches = enumerate(prefetcher)
                 for i, (batch_x, batch_y) in batches:

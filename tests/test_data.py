@@ -122,6 +122,37 @@ def test_epoch_iterator_sharding_disjoint():
     assert len(union) >= 90  # allow rare float-sum collisions
 
 
+def test_epoch_iterator_resume_replays_same_shuffles():
+    """A resumed run must see the shuffles the uninterrupted run would
+    have (ADVICE r1: permutation keyed by epoch index, not RNG stream)."""
+    split = M.synthesize_split(40, seed=9)
+    full = M.EpochIterator(split, batch_size=10, seed=1, shard=False)
+    epochs_full = [[x.copy() for x, _ in full.epoch(e)] for e in range(3)]
+    resumed = M.EpochIterator(split, batch_size=10, seed=1, shard=False)
+    for got, want in zip(
+        (x for x, _ in resumed.epoch(2)), epochs_full[2]
+    ):
+        np.testing.assert_array_equal(got, want)
+    # and distinct epochs use distinct permutations
+    assert not np.array_equal(epochs_full[0][0], epochs_full[1][0])
+
+
+def test_pack_images_uint8_when_exact_float32_otherwise():
+    """ADVICE r1: fast-loop HBM packing must be lossless for any source."""
+    from distributed_tensorflow_example_tpu.parallel.epoch import _pack_images
+
+    exact = (np.arange(256, dtype=np.float32) / 255.0).reshape(16, 16)
+    packed = _pack_images(exact)
+    assert packed.dtype == np.uint8
+    np.testing.assert_array_equal(
+        packed.astype(np.float32) / np.float32(255.0), exact
+    )
+    synth = M.synthesize_split(8, seed=2).images  # noise: not 8-bit exact
+    packed2 = _pack_images(synth)
+    assert packed2.dtype == np.float32
+    np.testing.assert_array_equal(packed2, synth)
+
+
 def test_epoch_iterator_drop_remainder_false():
     split = M.synthesize_split(53, seed=5)
     it = M.EpochIterator(split, batch_size=10, seed=1, shard=False,
