@@ -298,6 +298,9 @@ class EpochIterator:
         would have used (the host-path analog of the device path's
         ``fold_in(epoch)`` keying). ``epoch_index`` defaults to an
         internal counter for sequential use."""
+        # Eager body: the permutation and counter update happen at the
+        # epoch() call, not at first next() — two un-consumed epoch()
+        # calls must not key the same permutation.
         if epoch_index is None:
             epoch_index = self._epoch
         rng = np.random.RandomState([self._seed & 0x7FFFFFFF, epoch_index])
@@ -308,8 +311,12 @@ class EpochIterator:
             # so every process runs the same number of (collective) steps
             perm = perm[self.process_index :: self.process_count]
             perm = perm[: self._local_examples()]
-        from ..native import gather_batch  # lazy: avoids import cycle at module load
 
-        for b in range(self.batches_per_epoch):
-            idx = perm[b * self.batch_size : (b + 1) * self.batch_size]
-            yield gather_batch(self.split.images, self.split.labels, idx)
+        def _batches():
+            from ..native import gather_batch  # lazy: avoids import cycle
+
+            for b in range(self.batches_per_epoch):
+                idx = perm[b * self.batch_size : (b + 1) * self.batch_size]
+                yield gather_batch(self.split.images, self.split.labels, idx)
+
+        return _batches()

@@ -61,7 +61,7 @@ def _layer(h, w, b, activation: str, compute_dtype, last: bool):
     rounded to ``compute_dtype`` at the layer edge."""
     acc = jnp.dot(
         h.astype(compute_dtype), w, preferred_element_type=jnp.float32
-    ) + b.astype(jnp.float32)
+    ) + b  # bias arrives f32 (never rounded through compute_dtype), as in mlp.apply
     if last:
         return acc  # logits stay f32, as in models.mlp.apply
     return _act(activation, acc).astype(compute_dtype)
@@ -99,7 +99,7 @@ def _forward_pallas(spec: mlp.MLPSpec, params, x):
     flat_params = []
     for i in range(1, L + 1):
         flat_params.append(params[f"W{i}"].astype(cdt))
-        flat_params.append(params[f"b{i}"].astype(cdt).reshape(1, -1))
+        flat_params.append(params[f"b{i}"].astype(jnp.float32).reshape(1, -1))
 
     grid = (n_pad // _BATCH_TILE,)
     sizes = spec.layer_sizes

@@ -1,0 +1,139 @@
+"""Pure-numpy oracle for the reference's exact training math.
+
+Implements, with no JAX/TF dependency, the arithmetic of
+/root/reference/example.py:74-111:
+
+- sigmoid MLP forward ``z2 = x@W1 + b1; a2 = sigmoid(z2);
+  z3 = a2@W2 + b2; y = softmax(z3)`` (example.py:84-90),
+- the naive cross-entropy ``mean(-sum(y_ * log(y), axis=1))``
+  (example.py:92-96 — the numerically unstable published form),
+- its reverse-mode gradients (what ``Optimizer.minimize`` builds at
+  example.py:111),
+- plain SGD with ``learning_rate = 5e-4`` (example.py:42, 98-101).
+
+The oracle pins the framework's *training dynamics* to the reference's
+math: tests/test_oracle.py asserts that the framework configured with
+``--naive_ce --grad_reduce=sum`` reproduces this trajectory step for
+step (loss, accuracy, and final parameters). Initial parameters are
+taken from the framework's seeded init (the reference's TF RNG stream
+is not reproducible outside TF 1.x; what is checkable — and what this
+oracle checks — is that given the same start point the *update rule*
+is the same function).
+
+``step()`` takes the global batch pre-split into ``dp`` equal worker
+chunks and applies the sum of per-chunk mean-gradients, which is:
+
+- ``dp == 1``: exactly the reference's single-worker sequential SGD;
+- ``dp > 1``: the sum-of-replica-gradients aggregation —
+  ``--grad_reduce=sum``'s semantics, the lockstep analog of ``dp``
+  async workers each pushing its own mean-gradient from the same
+  parameter snapshot (example.py:101, 111; SURVEY.md §7).
+
+Generic over depth/width/activation so the oracle also covers the
+deeper-MLP config (BASELINE.json config 4's architecture under SGD).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _sigmoid(z: np.ndarray) -> np.ndarray:
+    # evaluated in float32, matching jax.nn.sigmoid's precision regime
+    return 1.0 / (1.0 + np.exp(-z))
+
+
+_ACTS = {
+    "sigmoid": (_sigmoid, lambda a: a * (1.0 - a)),
+    "tanh": (np.tanh, lambda a: 1.0 - a * a),
+    "relu": (
+        lambda z: np.maximum(z, 0.0),
+        lambda a: (a > 0).astype(a.dtype),
+    ),
+}
+
+
+def softmax(z: np.ndarray) -> np.ndarray:
+    """tf.nn.softmax (example.py:90) subtracts the row max internally;
+    the instability the reference is known for lives in the later
+    ``log`` of an underflowed probability, not here."""
+    e = np.exp(z - z.max(axis=1, keepdims=True))
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def naive_cross_entropy(probs: np.ndarray, y_onehot: np.ndarray) -> float:
+    """mean(-sum(y_ * log(y), axis=1)) — example.py:95-96, verbatim math."""
+    return float(np.mean(-np.sum(y_onehot * np.log(probs), axis=1)))
+
+
+class ReferenceOracle:
+    """Numpy re-derivation of one reference worker's training update."""
+
+    def __init__(self, params: dict, learning_rate: float = 5e-4,
+                 activation: str = "sigmoid"):
+        # params: {"W1","b1",...,"WL","bL"} float32 numpy arrays (copied)
+        self.params = {k: np.array(v, dtype=np.float32) for k, v in params.items()}
+        self.lr = np.float32(learning_rate)
+        self.L = max(int(k[1:]) for k in params if k.startswith("W"))
+        self.act, self.act_grad = _ACTS[activation]
+
+    def forward(self, x: np.ndarray):
+        """Returns (probs, activations): activations[i] is the input to
+        layer i+1 (activations[0] = x), as saved for backprop."""
+        acts = [x.astype(np.float32)]
+        h = acts[0]
+        for i in range(1, self.L + 1):
+            z = h @ self.params[f"W{i}"] + self.params[f"b{i}"]
+            if i < self.L:
+                h = self.act(z)
+                acts.append(h)
+            else:
+                return softmax(z), acts
+
+    def loss(self, x: np.ndarray, y_onehot: np.ndarray) -> float:
+        probs, _ = self.forward(x)
+        return naive_cross_entropy(probs, y_onehot)
+
+    def accuracy(self, x: np.ndarray, y_onehot: np.ndarray) -> float:
+        """mean(argmax(y) == argmax(y_)) — example.py:118-121."""
+        probs, _ = self.forward(x)
+        return float(np.mean(probs.argmax(axis=1) == y_onehot.argmax(axis=1)))
+
+    def grads(self, x: np.ndarray, y_onehot: np.ndarray):
+        """Reverse-mode gradients of the naive CE mean over this batch.
+
+        d(loss)/d(z_L) = (softmax(z_L) - y_) / B for one-hot rows — the
+        closed form TF's autodiff reaches through softmax+log+mean
+        (example.py:90-96, 111).
+        """
+        B = x.shape[0]
+        probs, acts = self.forward(x)
+        delta = (probs - y_onehot).astype(np.float32) / np.float32(B)
+        g = {}
+        for i in range(self.L, 0, -1):
+            g[f"W{i}"] = acts[i - 1].T @ delta
+            g[f"b{i}"] = delta.sum(axis=0)
+            if i > 1:
+                da = delta @ self.params[f"W{i}"].T
+                delta = da * self.act_grad(acts[i - 1])
+        return g
+
+    def step(self, chunks) -> float:
+        """One aggregated update from ``len(chunks)`` worker chunks, each
+        ``(x, y_onehot)``: apply ``sum_k mean-grad(chunk_k)`` with plain
+        SGD (the reference's GradientDescentOptimizer, example.py:98-101,
+        under sum-aggregation; one chunk = the sequential single-worker
+        reference). Returns the mean of the per-chunk losses (what the
+        framework's pmean'd cost reports)."""
+        total = None
+        losses = []
+        for x, y in chunks:
+            probs, _ = self.forward(x)
+            losses.append(naive_cross_entropy(probs, y))
+            g = self.grads(x, y)
+            total = g if total is None else {
+                k: total[k] + g[k] for k in total
+            }
+        for k in self.params:
+            self.params[k] = self.params[k] - self.lr * total[k]
+        return float(np.mean(losses))

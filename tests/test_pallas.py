@@ -63,7 +63,7 @@ def test_forward_matches_xla_bfloat16():
     got = np.asarray(pallas_fused.mlp_forward(spec, params, x))
     assert got.dtype == np.float32
     # identical op sequence; tolerance only covers backend reduction-order
-    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
     # and bf16 really is lower precision than f32 — sanity that the cast
     # path was exercised (bf16 forward differs from the f32 forward)
     f32_spec = mlp.MLPSpec(input_size=16, hidden_sizes=(8,), num_classes=4)
