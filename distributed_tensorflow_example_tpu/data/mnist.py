@@ -211,19 +211,46 @@ def load_datasets(
 ) -> Dataset:
     """Replacement for ``input_data.read_data_sets`` (example.py:47-48).
 
-    ``auto`` uses real IDX files when present in ``data_dir``, otherwise
-    the deterministic synthetic fallback (this machine has no network
-    egress, so there is no download path; drop the 4 standard IDX files
-    into ``data_dir`` to train on real MNIST).
+    ``mnist`` uses real IDX files from ``data_dir``, downloading the
+    four canonical archives (mirror list + SHA-256 verification,
+    data.download) when absent — the reference's read_data_sets
+    behavior. ``auto`` uses real files when already present, otherwise
+    the deterministic synthetic fallback — never touching the network
+    (the right default for air-gapped machines).
     """
     if dataset in ("mnist", "auto") and idx_files_present(data_dir):
         return load_idx_dataset(data_dir)
     if dataset == "mnist":
-        raise FileNotFoundError(
-            f"MNIST IDX files not found in {data_dir!r}: need "
-            f"{TRAIN_IMAGES}, {TRAIN_LABELS}, {TEST_IMAGES}, {TEST_LABELS} "
-            f"(optionally .gz)"
-        )
+        from .download import DownloadError, download_mnist
+
+        # Multi-process: only the chief downloads (data_dir is commonly
+        # shared); everyone barriers, then re-checks the files. A bare
+        # per-process download would hit the mirrors N times over.
+        pidx, pcnt = 0, 1
+        try:
+            import jax
+
+            pidx, pcnt = jax.process_index(), jax.process_count()
+        except Exception:
+            pass  # jax not initialized: single-process semantics
+        err: Exception | None = None
+        if pidx == 0:
+            try:
+                download_mnist(data_dir)
+            except DownloadError as e:
+                err = e
+        if pcnt > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("mnist_download")
+        if not idx_files_present(data_dir):
+            raise FileNotFoundError(
+                f"MNIST IDX files not found in {data_dir!r} and download "
+                f"failed:\n{err}\nDrop {TRAIN_IMAGES}, {TRAIN_LABELS}, "
+                f"{TEST_IMAGES}, {TEST_LABELS} (optionally .gz) into "
+                f"{data_dir!r} to train on real MNIST offline."
+            ) from err
+        return load_idx_dataset(data_dir)
     return synthesize_dataset(
         seed=seed, train_size=synthetic_train_size, test_size=synthetic_test_size
     )

@@ -1,0 +1,175 @@
+"""MNIST download tests against a local http.server fixture — the
+capability of /root/reference/example.py:47-48's read_data_sets
+(download-when-absent) exercised fully offline: mirror fallback,
+SHA-256 rejection of corrupt payloads, atomic/resume-safe writes, and
+the end-to-end --dataset=mnist fetch+parse path."""
+
+import gzip
+import hashlib
+import http.server
+import os
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.data import download as D
+from distributed_tensorflow_example_tpu.data import mnist as M
+
+
+def _tiny_mnist_archives():
+    """Four tiny-but-valid gzipped IDX files (2 train / 2 test images)."""
+    rng = np.random.RandomState(0)
+
+    def images(n):
+        pix = rng.randint(0, 256, size=(n, 28, 28), dtype=np.uint8)
+        return struct.pack(">IIII", M.IMAGE_MAGIC, n, 28, 28) + pix.tobytes()
+
+    def labels(n):
+        lab = rng.randint(0, 10, size=n).astype(np.uint8)
+        return struct.pack(">II", M.LABEL_MAGIC, n) + lab.tobytes()
+
+    return {
+        M.TRAIN_IMAGES + ".gz": gzip.compress(images(8)),
+        M.TRAIN_LABELS + ".gz": gzip.compress(labels(8)),
+        M.TEST_IMAGES + ".gz": gzip.compress(images(4)),
+        M.TEST_LABELS + ".gz": gzip.compress(labels(4)),
+    }
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    files: dict = {}
+    hits: list = []
+
+    def do_GET(self):
+        name = self.path.rsplit("/", 1)[-1]
+        type(self).hits.append(self.path)
+        payload = self.files.get(name)
+        if payload is None:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+@pytest.fixture()
+def http_mirror():
+    """Yields (base_url, files_dict, hits_list); mutate files_dict to
+    change what the mirror serves."""
+    files = _tiny_mnist_archives()
+    handler = type("H", (_Handler,), {"files": files, "hits": []})
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}/mnist/"
+    try:
+        yield base, files, handler.hits
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def _digests(files):
+    return {k: hashlib.sha256(v).hexdigest() for k, v in files.items()}
+
+
+def test_download_fetches_and_verifies(http_mirror, tmp_path):
+    base, files, _ = http_mirror
+    digests = _digests(files)
+    for name, digest in digests.items():
+        path = D.download_file(name, str(tmp_path), mirrors=(base,),
+                               sha256=digest)
+        assert os.path.exists(path)
+        assert D.sha256_file(path) == digest
+    # no temp litter
+    assert not [p for p in os.listdir(tmp_path) if ".tmp-" in p]
+
+
+def test_corrupt_payload_rejected_then_next_mirror_used(http_mirror, tmp_path):
+    base, files, _ = http_mirror
+    name = M.TRAIN_IMAGES + ".gz"
+    good = files[name]
+    digest = hashlib.sha256(good).hexdigest()
+    # first mirror serves a corrupted copy, second the real one
+    bad_files = dict(files)
+    bad_files[name] = good[:-4] + b"XXXX"
+    bad_handler = type("B", (_Handler,), {"files": bad_files, "hits": []})
+    bad_srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), bad_handler)
+    threading.Thread(target=bad_srv.serve_forever, daemon=True).start()
+    bad_base = f"http://127.0.0.1:{bad_srv.server_address[1]}/mnist/"
+    try:
+        path = D.download_file(name, str(tmp_path),
+                               mirrors=(bad_base, base), sha256=digest)
+        assert D.sha256_file(path) == digest
+        assert bad_handler.hits  # corrupt mirror was tried first
+    finally:
+        bad_srv.shutdown()
+        bad_srv.server_close()
+
+
+def test_all_mirrors_bad_raises_with_detail(http_mirror, tmp_path):
+    base, files, _ = http_mirror
+    name = M.TRAIN_LABELS + ".gz"
+    wrong = "0" * 64
+    with pytest.raises(D.DownloadError, match="SHA-256 mismatch"):
+        D.download_file(name, str(tmp_path), mirrors=(base,), sha256=wrong)
+    assert not os.path.exists(tmp_path / name)  # nothing corrupt left behind
+
+
+def test_existing_verified_file_not_refetched(http_mirror, tmp_path):
+    base, files, hits = http_mirror
+    name = M.TEST_LABELS + ".gz"
+    digest = hashlib.sha256(files[name]).hexdigest()
+    D.download_file(name, str(tmp_path), mirrors=(base,), sha256=digest)
+    n_hits = len(hits)
+    D.download_file(name, str(tmp_path), mirrors=(base,), sha256=digest)
+    assert len(hits) == n_hits  # second call was a local no-op
+
+
+def test_stale_temp_file_does_not_break_download(http_mirror, tmp_path):
+    """A killed previous run's temp file is ignored/overwritten."""
+    base, files, _ = http_mirror
+    name = M.TEST_IMAGES + ".gz"
+    digest = hashlib.sha256(files[name]).hexdigest()
+    (tmp_path / f"{name}.tmp-{os.getpid()}").write_bytes(b"partial garbage")
+    path = D.download_file(name, str(tmp_path), mirrors=(base,), sha256=digest)
+    assert D.sha256_file(path) == digest
+    assert not [p for p in os.listdir(tmp_path) if ".tmp-" in p]
+
+
+def test_dataset_mnist_downloads_end_to_end(http_mirror, tmp_path, monkeypatch):
+    """--dataset=mnist with an empty data_dir fetches all four archives
+    (mirror-patched) and parses them — read_data_sets parity."""
+    base, files, _ = http_mirror
+    monkeypatch.setattr(D, "MIRRORS", (base,))
+    monkeypatch.setattr(D, "MNIST_FILES", _digests(files))
+    monkeypatch.setattr(M, "VALIDATION_SIZE", 2)
+    ds = M.load_datasets(str(tmp_path), dataset="mnist")
+    assert ds.source == "mnist"
+    assert ds.train.num_examples == 6    # 8 - 2 validation
+    assert ds.validation.num_examples == 2
+    assert ds.test.num_examples == 4
+    assert ds.train.images.shape == (6, 784)
+    assert ds.train.images.max() <= 1.0
+
+
+def test_dataset_mnist_offline_raises_actionable_error(tmp_path, monkeypatch):
+    unreachable = "http://127.0.0.1:1/none/"
+    monkeypatch.setattr(D, "MIRRORS", (unreachable,))
+    with pytest.raises(FileNotFoundError, match="download"):
+        M.load_datasets(str(tmp_path / "nope"), dataset="mnist")
+
+
+def test_published_digest_table_shape():
+    """The real digest table stays intact (4 canonical archives)."""
+    assert set(D.MNIST_FILES) == {
+        M.TRAIN_IMAGES + ".gz", M.TRAIN_LABELS + ".gz",
+        M.TEST_IMAGES + ".gz", M.TEST_LABELS + ".gz",
+    }
+    assert all(len(v) == 64 for v in D.MNIST_FILES.values())
