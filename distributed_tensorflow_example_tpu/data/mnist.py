@@ -152,6 +152,11 @@ def synthesize_split(n: int, seed: int) -> DataSplit:
         images[i, gy : gy + gh, gx : gx + gw] = glyphs[labels[i]] * intensity
     images += rng.normal(0.0, 0.08, size=images.shape).astype(np.float32)
     np.clip(images, 0.0, 1.0, out=images)
+    # Quantize to the 8-bit pixel grid (k/255), exactly like real MNIST
+    # pixels: the device-resident fast path can then store the split as
+    # uint8 (4x less HBM + host->device transfer) with bit-exact
+    # reconstruction (parallel/epoch._pack_images).
+    images = np.round(images * 255.0).astype(np.float32) / np.float32(255.0)
     return DataSplit(images=images.reshape(n, 784), labels=one_hot(labels))
 
 

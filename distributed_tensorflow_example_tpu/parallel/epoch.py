@@ -96,6 +96,7 @@ def build_epoch_runner(
         state, costs, accs = run1(state, img_u8, lbl, key, epoch)
         return state, costs[0], accs[0]
 
+    runner.jitted = run1.jitted
     return runner
 
 
@@ -158,6 +159,7 @@ def build_run_to_completion(
     def run(state: TrainState, img_u8, lbl, key, epoch_offset: int = 0):
         return jitted(state, img_u8, lbl, key, jnp.int32(epoch_offset))
 
+    run.jitted = jitted  # exposed for graph observability (utils.hlo)
     return run
 
 
@@ -280,6 +282,7 @@ def build_local_run_to_completion(
         def run(state, img_u8, lbl, key, epoch_offset: int = 0):
             return jitted(state, img_u8, lbl, key, jnp.int32(epoch_offset))
 
+        run.jitted = jitted
         return run
 
     return build

@@ -163,6 +163,22 @@ def run(cfg: Config) -> Dict[str, Any]:
     if cfg.profile and chief:
         jax.profiler.start_trace(cfg.logs_path + "/profile")
 
+    def dump_graph(jitted, *args) -> None:
+        """--profile graph observability: the TPU-native analog of the
+        reference's TB graph write (example.py:146) — StableHLO +
+        optimized HLO text next to the profiler trace (utils.hlo).
+        Plain-int args are marshalled to int32 exactly as the epoch
+        runners' call wrappers do."""
+        if cfg.profile and chief:
+            import jax.numpy as jnp
+
+            from ..utils.hlo import dump_graph as _dump
+
+            args = tuple(
+                jnp.int32(a) if isinstance(a, int) else a for a in args
+            )
+            _dump(jitted, args, cfg.logs_path, "train_step")
+
     # global_step parity: the reference's global_step counts every
     # worker's update (≈3x per round under 3 async workers, SURVEY.md
     # §3.3); in local-SGD mode each of the dp shards applies one update
@@ -254,6 +270,8 @@ def run(cfg: Config) -> Dict[str, Any]:
                 runner = epoch_lib.build_run_to_completion(
                     cfg, mesh, spec, optimizer, batch_count, n_ep
                 )
+            dump_graph(runner.jitted, state, img_d, lbl_d, shuffle_key,
+                       start_epoch)
             t0 = time.time()
             state, costs2d, accs2d = runner(
                 state, img_d, lbl_d, shuffle_key, start_epoch
@@ -268,6 +286,8 @@ def run(cfg: Config) -> Dict[str, Any]:
             epoch_runner = epoch_lib.build_epoch_runner(
                 cfg, mesh, spec, optimizer, batch_count
             )
+            dump_graph(epoch_runner.jitted, state, img_d, lbl_d,
+                       shuffle_key, start_epoch)
             for epoch in range(start_epoch, cfg.training_epochs):
                 t0 = time.time()
                 state, costs, accs = epoch_runner(
@@ -306,6 +326,7 @@ def run(cfg: Config) -> Dict[str, Any]:
         from ..data.prefetch import Prefetcher
 
         steps_done = start_epoch * iterator.batches_per_epoch
+        graph_dumped = False
         for epoch in range(start_epoch, cfg.training_epochs):
             batch_count = iterator.batches_per_epoch  # example.py:153
             count = 0
@@ -322,6 +343,9 @@ def run(cfg: Config) -> Dict[str, Any]:
                         batch_y = jax.make_array_from_process_local_data(
                             batch_sharding, batch_y
                         )
+                    if not graph_dumped:
+                        graph_dumped = True
+                        dump_graph(train_step, state, batch_x, batch_y)
                     state, cost_dev, acc_dev = train_step(state, batch_x, batch_y)
                     steps_done += 1
                     # host-side step counter: state.step advances 1 per call

@@ -1,0 +1,45 @@
+"""Compiled-graph observability: HLO/StableHLO text dumps.
+
+Reference parity: the reference writes its TF graph into the
+TensorBoard event log (``FileWriter(logs_path, graph=...)``,
+/root/reference/example.py:146) so the operator can inspect what will
+execute. The TPU-native analog of "the graph" is the XLA program:
+``--profile`` dumps, next to the profiler trace,
+
+- ``<name>.stablehlo.txt`` — the portable StableHLO module as traced
+  (the artifact to diff across JAX versions), and
+- ``<name>.hlo.txt`` — the optimized HLO the TPU actually runs (post
+  XLA fusion/layout; the artifact to read for performance work).
+
+Dumping lowers/compiles through the persistent compilation cache, so
+the subsequent real execution of the same program is a cache hit, not
+a second compile.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+
+def dump_graph(jitted, args: Sequence, logs_path: str, name: str) -> list[str]:
+    """Write StableHLO + optimized-HLO text for ``jitted(*args)`` into
+    ``logs_path``; returns the paths written. Never raises — graph
+    observability must not take down training (errors are reported to
+    stdout and the run continues)."""
+    written: list[str] = []
+    try:
+        lowered = jitted.lower(*args)
+        os.makedirs(logs_path, exist_ok=True)
+        p = os.path.join(logs_path, f"{name}.stablehlo.txt")
+        with open(p, "w") as f:
+            f.write(lowered.as_text())
+        written.append(p)
+        compiled = lowered.compile()
+        p = os.path.join(logs_path, f"{name}.hlo.txt")
+        with open(p, "w") as f:
+            f.write(compiled.as_text())
+        written.append(p)
+    except Exception as e:  # pragma: no cover - backend-dependent
+        print(f"NOTE: HLO dump for {name!r} failed: {e}")
+    return written

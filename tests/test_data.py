@@ -147,10 +147,13 @@ def test_pack_images_uint8_when_exact_float32_otherwise():
     np.testing.assert_array_equal(
         packed.astype(np.float32) / np.float32(255.0), exact
     )
-    synth = M.synthesize_split(8, seed=2).images  # noise: not 8-bit exact
-    packed2 = _pack_images(synth)
+    arbitrary = np.random.RandomState(2).rand(8, 16).astype(np.float32)
+    packed2 = _pack_images(arbitrary)  # continuous values: not 8-bit exact
     assert packed2.dtype == np.float32
-    np.testing.assert_array_equal(packed2, synth)
+    np.testing.assert_array_equal(packed2, arbitrary)
+    # the synthetic dataset is quantized at generation, so it packs to u8
+    synth = M.synthesize_split(8, seed=2).images
+    assert _pack_images(synth).dtype == np.uint8
 
 
 def test_epoch_iterator_drop_remainder_false():
