@@ -77,6 +77,9 @@ class Config:
     # ---- data (example.py:46-48) ----
     data_dir: str = "MNIST_data"
     dataset: str = "auto"           # auto | mnist | synthetic
+    mnist_mirrors: tuple[str, ...] = ()  # override download mirrors
+                                         # (e.g. an internal HTTP mirror);
+                                         # empty = the built-in list
     synthetic_train_size: int = 55000   # synthetic fallback split sizes
     synthetic_test_size: int = 10000    # (mirror the MNIST split by default)
     shard_data: bool = True         # reference workers each consume the FULL
@@ -162,6 +165,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data_dir", type=str, default=d.data_dir)
     p.add_argument("--dataset", type=str, default=d.dataset,
                    choices=["auto", "mnist", "synthetic"])
+    p.add_argument("--mnist_mirrors", type=lambda s: tuple(filter(None, s.split(","))),
+                   default=d.mnist_mirrors, metavar="URL1,URL2,...",
+                   help="override MNIST download mirrors (base URLs)")
     p.add_argument("--synthetic_train_size", type=int, default=d.synthetic_train_size)
     p.add_argument("--synthetic_test_size", type=int, default=d.synthetic_test_size)
     p.add_argument("--no_shard_data", dest="shard_data", action="store_false")

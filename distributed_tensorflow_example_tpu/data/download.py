@@ -109,7 +109,7 @@ def download_file(
         return dest
     errors = []
     for base in mirrors:
-        url = base + name
+        url = base.rstrip("/") + "/" + name  # tolerate no trailing slash
         # visible per-attempt line: on silently-dropping networks each
         # attempt can run to its timeout, and this must not look like a
         # hang (read_data_sets printed progress too)

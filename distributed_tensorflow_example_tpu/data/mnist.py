@@ -199,6 +199,30 @@ def load_idx_dataset(data_dir: str) -> Dataset:
     )
 
 
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0  # jax not initialized: single-process semantics
+
+
+def _process_count() -> int:
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def _download_barrier() -> None:
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("mnist_download")
+
+
 def idx_files_present(data_dir: str) -> bool:
     return all(
         os.path.exists(os.path.join(data_dir, n))
@@ -213,6 +237,7 @@ def load_datasets(
     seed: int = 0,
     synthetic_train_size: int = 55000,
     synthetic_test_size: int = 10000,
+    mirrors=None,
 ) -> Dataset:
     """Replacement for ``input_data.read_data_sets`` (example.py:47-48).
 
@@ -224,6 +249,12 @@ def load_datasets(
     (the right default for air-gapped machines).
     """
     if dataset in ("mnist", "auto") and idx_files_present(data_dir):
+        if dataset == "mnist" and _process_count() > 1:
+            # Join the barrier even on the files-present path: a peer
+            # that raced ahead (e.g. the chief finishing its download)
+            # is waiting in it, and every process passes through exactly
+            # one of the two mnist branches.
+            _download_barrier()
         return load_idx_dataset(data_dir)
     if dataset == "mnist":
         from .download import DownloadError, download_mnist
@@ -231,23 +262,14 @@ def load_datasets(
         # Multi-process: only the chief downloads (data_dir is commonly
         # shared); everyone barriers, then re-checks the files. A bare
         # per-process download would hit the mirrors N times over.
-        pidx, pcnt = 0, 1
-        try:
-            import jax
-
-            pidx, pcnt = jax.process_index(), jax.process_count()
-        except Exception:
-            pass  # jax not initialized: single-process semantics
         err: Exception | None = None
-        if pidx == 0:
+        if _process_index() == 0:
             try:
-                download_mnist(data_dir)
+                download_mnist(data_dir, mirrors=mirrors or None)
             except DownloadError as e:
                 err = e
-        if pcnt > 1:
-            from jax.experimental import multihost_utils
-
-            multihost_utils.sync_global_devices("mnist_download")
+        if _process_count() > 1:
+            _download_barrier()
         if not idx_files_present(data_dir):
             raise FileNotFoundError(
                 f"MNIST IDX files not found in {data_dir!r} and download "

@@ -111,6 +111,7 @@ def run(cfg: Config) -> Dict[str, Any]:
         cfg.data_dir, cfg.dataset, seed=0,
         synthetic_train_size=cfg.synthetic_train_size,
         synthetic_test_size=cfg.synthetic_test_size,
+        mirrors=cfg.mnist_mirrors,
     )
     mesh = mesh_lib.build_mesh(cfg.data_parallel, cfg.model_parallel)
     dp = mesh.shape[mesh_lib.DATA_AXIS]

@@ -44,3 +44,11 @@ def test_extension_flags():
     assert cfg.sync_period == 5
     assert cfg.grad_reduce == "sum"
     assert cfg.naive_ce and cfg.pallas
+
+
+def test_mnist_mirror_flag():
+    cfg = parse_config([
+        "--mnist_mirrors=http://mirror.internal/mnist/,http://b/m/",
+    ])
+    assert cfg.mnist_mirrors == ("http://mirror.internal/mnist/", "http://b/m/")
+    assert parse_config([]).mnist_mirrors == ()

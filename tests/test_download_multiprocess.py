@@ -1,0 +1,129 @@
+"""Chief-only download under jax.distributed (the multi-process arm of
+data.mnist.load_datasets): with 2 real OS processes sharing a data_dir,
+only process 0 downloads, both barrier, both parse — and the mirror
+sees each archive exactly once."""
+
+import gzip
+import hashlib
+import http.server
+import json
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+
+import numpy as np
+
+from distributed_tensorflow_example_tpu.data import mnist as M
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+cfg = json.load(open(sys.argv[1]))
+jax.distributed.initialize(
+    coordinator_address=cfg["coord"], num_processes=2,
+    process_id=int(sys.argv[2]),
+)
+from distributed_tensorflow_example_tpu.data import download as D
+from distributed_tensorflow_example_tpu.data import mnist as M
+D.MNIST_FILES = cfg["digests"]          # fixture archives, not canonical
+M.VALIDATION_SIZE = 2
+ds = M.load_datasets(cfg["data_dir"], dataset="mnist",
+                     mirrors=tuple(cfg["mirrors"]))
+assert ds.source == "mnist"
+assert ds.train.num_examples == 6, ds.train.num_examples
+print(f"proc {jax.process_index()} ok")
+jax.distributed.shutdown()
+"""
+
+
+def _tiny_archives():
+    rng = np.random.RandomState(0)
+
+    def images(n):
+        pix = rng.randint(0, 256, size=(n, 28, 28), dtype=np.uint8)
+        return struct.pack(">IIII", M.IMAGE_MAGIC, n, 28, 28) + pix.tobytes()
+
+    def labels(n):
+        lab = rng.randint(0, 10, size=n).astype(np.uint8)
+        return struct.pack(">II", M.LABEL_MAGIC, n) + lab.tobytes()
+
+    return {
+        M.TRAIN_IMAGES + ".gz": gzip.compress(images(8)),
+        M.TRAIN_LABELS + ".gz": gzip.compress(labels(8)),
+        M.TEST_IMAGES + ".gz": gzip.compress(images(4)),
+        M.TEST_LABELS + ".gz": gzip.compress(labels(4)),
+    }
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_chief_only_download(tmp_path):
+    files = _tiny_archives()
+    hits: list = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            name = self.path.rsplit("/", 1)[-1]
+            hits.append(name)
+            payload = files.get(name)
+            if payload is None:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    data_dir = tmp_path / "mnist"
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps({
+        "coord": f"127.0.0.1:{_free_port()}",
+        "data_dir": str(data_dir),
+        "mirrors": [f"http://127.0.0.1:{srv.server_address[1]}/mnist/"],
+        "digests": {k: hashlib.sha256(v).hexdigest() for k, v in files.items()},
+    }))
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script), str(cfg_path), str(i)],
+                cwd=REPO, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+            for i in range(2)
+        ]
+        try:
+            outs = [p.communicate(timeout=240)[0] for p in procs]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, out[-3000:]
+        assert "proc 0 ok" in outs[0] and "proc 1 ok" in outs[1]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+    # each archive fetched exactly once (chief-only; worker barriered)
+    assert sorted(hits) == sorted(files.keys()), hits
