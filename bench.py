@@ -123,6 +123,8 @@ def bench_config(name: str, cfg, epochs_full: int = 20, repeats: int = 5):
     # the run whose wall is the median carries the reported metrics
     rep = min(results, key=lambda r: abs(r["total_time_s"] * scale - median_wall))
     peak = _chip_peak_flops()
+    if peak is not None:
+        peak *= max(rep["devices"], 1)  # aggregate peak: MFU is per-fleet
     flops_step = _model_flops_per_step(
         tuple(cfg.hidden_sizes), rep["global_batch"],
         input_size=cfg.input_size, num_classes=cfg.num_classes,
@@ -261,6 +263,10 @@ def main(argv=None) -> int:
     baseline_s = _load_measured_baseline()
 
     if args.cpu_baseline:
+        if args.epochs != 20:
+            p.error("--cpu-baseline records the measured 20-epoch number; "
+                    "run it without --epochs (extrapolations must not be "
+                    "recorded as measurements)")
         r = bench_config("cpu_baseline", base, epochs_full=20,
                          repeats=args.repeats)
         print(json.dumps(r), file=sys.stderr)

@@ -55,6 +55,21 @@ class DownloadError(RuntimeError):
     pass
 
 
+def _reap_stale_temps(dest: str, max_age_s: float = 3600.0) -> None:
+    """Remove abandoned ``<dest>.tmp-<pid>`` files from killed runs.
+    Age-gated so a concurrent process's in-flight download (writing its
+    own pid-suffixed temp right now) is left alone."""
+    import glob
+    import time
+
+    for tmp in glob.glob(dest + ".tmp-*"):
+        try:
+            if time.time() - os.path.getmtime(tmp) > max_age_s:
+                os.remove(tmp)
+        except OSError:
+            pass  # raced with its owner; harmless
+
+
 def sha256_file(path: str) -> str:
     h = hashlib.sha256()
     with open(path, "rb") as f:
@@ -107,6 +122,7 @@ def download_file(
     dest = os.path.join(data_dir, name)
     if os.path.exists(dest) and (sha256 is None or sha256_file(dest) == sha256):
         return dest
+    _reap_stale_temps(dest)
     errors = []
     for base in mirrors:
         url = base.rstrip("/") + "/" + name  # tolerate no trailing slash

@@ -266,7 +266,10 @@ def load_datasets(
         if _process_index() == 0:
             try:
                 download_mnist(data_dir, mirrors=mirrors or None)
-            except DownloadError as e:
+            except Exception as e:  # noqa: BLE001 — ANY chief failure
+                # must still reach the barrier below, or every other
+                # process hangs in the collective (e.g. PermissionError
+                # from makedirs is not a DownloadError)
                 err = e
         if _process_count() > 1:
             _download_barrier()

@@ -391,18 +391,18 @@ def run(cfg: Config) -> Dict[str, Any]:
     # Final eval (example.py:177-179): chief-only in spirit; every
     # process computes (cheap, collective-free divergence is impossible
     # under SPMD) but only chief prints.
-    if fast and eval_pending is not None:
+    if eval_pending is not None:        # fast path, eval already on-device
         test_acc = float(eval_pending) / fast_eval.n
-    else:
+    elif fast:                          # fast per-epoch path
         params = get_params(state) if async_mode else state.params
-        if fast:
-            test_acc = fast_eval(params)
-        else:
-            eval_step = step_lib.build_eval_step(cfg, mesh, spec)
-            test_acc = _eval_accuracy(
-                eval_step, params, dataset.test.images, dataset.test.labels,
-                dp, chunk=max(cfg.eval_batch_size, dp),
-            )
+        test_acc = fast_eval(params)
+    else:                               # host path
+        params = get_params(state) if async_mode else state.params
+        eval_step = step_lib.build_eval_step(cfg, mesh, spec)
+        test_acc = _eval_accuracy(
+            eval_step, params, dataset.test.images, dataset.test.labels,
+            dp, chunk=max(cfg.eval_batch_size, dp),
+        )
     total_time = time.time() - begin_time
     cost = float(cost)
     if chief:

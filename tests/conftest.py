@@ -8,14 +8,15 @@ jax_platforms at interpreter start, so we override it the same way).
 """
 
 import os
-import re
+import sys
 
-flags = re.sub(
-    r"--xla_force_host_platform_device_count=\d+",
-    "",
-    os.environ.get("XLA_FLAGS", ""),
-).strip()
-os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import force_cpu_device_flags  # noqa: E402
+
+os.environ["XLA_FLAGS"] = force_cpu_device_flags(
+    os.environ.get("XLA_FLAGS", ""), 8
+)
 
 import jax  # noqa: E402
 
