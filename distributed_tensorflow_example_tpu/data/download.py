@@ -120,9 +120,10 @@ def download_file(
         mirrors = MIRRORS  # resolved at call time (tests patch the module)
     os.makedirs(data_dir, exist_ok=True)
     dest = os.path.join(data_dir, name)
+    _reap_stale_temps(dest)  # before the early-return: a completed file
+    # can coexist with another process's abandoned temp
     if os.path.exists(dest) and (sha256 is None or sha256_file(dest) == sha256):
         return dest
-    _reap_stale_temps(dest)
     errors = []
     for base in mirrors:
         url = base.rstrip("/") + "/" + name  # tolerate no trailing slash

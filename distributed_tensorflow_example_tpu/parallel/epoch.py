@@ -112,23 +112,15 @@ def _memo(key, build):
 
 
 def _data_fingerprint(images: np.ndarray, labels: np.ndarray):
-    """Cheap identity for memoizing data-closing builders: shapes, a
-    strided position-weighted image checksum spanning the WHOLE range
-    (edge-only sums would let middle-row edits collide), and a
-    position-weighted label checksum (a plain labels.sum() is
-    degenerate for one-hot rows — always N)."""
-    n = images.shape[0]
-    stride = max(1, n // 256)
-    sample = np.asarray(images[::stride], np.float64)
-    img_pos = np.arange(sample.shape[0], dtype=np.float64) % 8191 + 1
-    lbl64 = np.asarray(labels, np.float64)
-    class_w = np.arange(1, lbl64.shape[-1] + 1, dtype=np.float64)
-    row_vals = lbl64 @ class_w                      # one-hot -> class id + 1
-    pos_w = np.arange(len(row_vals), dtype=np.float64) % 8191 + 1
+    """Exact identity for memoizing data-closing builders: CRC32 over
+    the full contents (collision-proof for cache purposes; ~10 ms for
+    the 43 MB train set — far cheaper than a wrong-data eval)."""
+    import zlib
+
     return (
         images.shape, labels.shape, str(images.dtype),
-        float((sample.sum(axis=tuple(range(1, sample.ndim))) * img_pos).sum()),
-        float((row_vals * pos_w).sum()),
+        zlib.crc32(np.ascontiguousarray(images).tobytes()),
+        zlib.crc32(np.ascontiguousarray(labels).tobytes()),
     )
 
 

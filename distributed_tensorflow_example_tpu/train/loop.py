@@ -393,16 +393,16 @@ def run(cfg: Config) -> Dict[str, Any]:
     # under SPMD) but only chief prints.
     if eval_pending is not None:        # fast path, eval already on-device
         test_acc = float(eval_pending) / fast_eval.n
-    elif fast:                          # fast per-epoch path
+    else:
         params = get_params(state) if async_mode else state.params
-        test_acc = fast_eval(params)
-    else:                               # host path
-        params = get_params(state) if async_mode else state.params
-        eval_step = step_lib.build_eval_step(cfg, mesh, spec)
-        test_acc = _eval_accuracy(
-            eval_step, params, dataset.test.images, dataset.test.labels,
-            dp, chunk=max(cfg.eval_batch_size, dp),
-        )
+        if fast:                        # fast per-epoch path
+            test_acc = fast_eval(params)
+        else:                           # host path
+            eval_step = step_lib.build_eval_step(cfg, mesh, spec)
+            test_acc = _eval_accuracy(
+                eval_step, params, dataset.test.images, dataset.test.labels,
+                dp, chunk=max(cfg.eval_batch_size, dp),
+            )
     total_time = time.time() - begin_time
     cost = float(cost)
     if chief:
