@@ -151,7 +151,7 @@ def bench_config(name: str, cfg, epochs_full: int = 20, repeats: int = 5):
 
 
 def bench_mxu(pallas: bool, repeats: int = 3, hidden=(4096, 4096),
-              batch: int = 8192, epochs: int = 10):
+              batch: int = 8192, epochs: int = 20):
     """Steady-state MXU utilization: wide bf16 MLP, whole run compiled
     as one executable (parallel/epoch.build_run_to_completion), timed on
     its second invocation so compile cost is excluded. This is the
@@ -189,7 +189,12 @@ def bench_mxu(pallas: bool, repeats: int = 3, hidden=(4096, 4096),
 
     def once(state):
         state, costs, accs = runner(state, img_d, lbl_d, key, 0)
-        jax.block_until_ready(costs)
+        # synchronize via an explicit host fetch: on the tunnelled
+        # backend block_until_ready can return before execution
+        # finishes, silently timing an empty queue (measured: 0.2 ms
+        # "runs" of a 1.4 s program). The fetch adds ~1 RTT (~0.1 s)
+        # per trial, a disclosed few-percent overstatement of step time.
+        np.asarray(costs)
         return state
 
     state = once(state)  # compile + first run

@@ -197,9 +197,13 @@ def run(cfg: Config) -> Dict[str, Any]:
         fast_eval = epoch_lib.build_fast_eval(
             cfg, mesh, spec, dataset.test.images, dataset.test.labels
         )
-        # block on every staged transfer (device_put is async; blocking
-        # on one array does not cover the others)
-        jax.block_until_ready((img_d, lbl_d, fast_eval.staged))
+        # wait for every staged transfer with a fetch-backed barrier:
+        # device_put is async and block_until_ready can return early on
+        # this backend (utils.sync), which would leak the upload into
+        # the timed window below
+        from ..utils.sync import hard_sync
+
+        hard_sync((img_d, lbl_d, fast_eval.staged))
 
     begin_time = time.time()       # example.py:136
     frequency = cfg.frequency      # example.py:137

@@ -1,0 +1,40 @@
+"""Reliable device synchronization.
+
+On the tunnelled TPU backend in this image, ``jax.block_until_ready``
+can return before execution or transfer actually completes (measured:
+sub-millisecond "completion" of second-long programs). The only
+trustworthy barrier is a host fetch of a value that *depends* on the
+arrays in question. ``hard_sync`` builds that dependency explicitly: a
+trivial jitted reduction consumes one element of every leaf and the
+scalar result is fetched. Used where timing scope matters (the bench
+methodology keeps the one-time dataset upload outside the timed
+window, BASELINE.md) — correctness paths never rely on
+block_until_ready ordering.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _probe(leaves):
+    total = jnp.float32(0)
+    for x in leaves:
+        first = jax.lax.slice(x.reshape(-1), (0,), (1,))
+        total = total + jnp.sum(first.astype(jnp.float32))
+    return total
+
+
+_probe_jit = jax.jit(_probe)
+
+
+def hard_sync(tree) -> None:
+    """Block until every array leaf of ``tree`` is resident and its
+    producing computation/transfer has finished."""
+    leaves = [x for x in jax.tree.leaves(tree)
+              if isinstance(x, jax.Array)]
+    if not leaves:
+        return
+    float(np.asarray(_probe_jit(leaves)))

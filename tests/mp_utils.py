@@ -1,0 +1,60 @@
+"""Shared launcher for multi-OS-process CLI tests (the reference's
+4-host run pattern, README.md:11-16, replayed over a localhost
+jax.distributed coordinator on the CPU backend)."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(task_index: int, port: int, num_processes: int,
+           devices_per_proc: int, extra: list[str]) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["DTX_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={devices_per_proc}"
+    ).strip()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "distributed_tensorflow_example_tpu.main",
+            "--job_name=worker", f"--task_index={task_index}",
+            f"--coordinator_address=127.0.0.1:{port}",
+            f"--num_processes={num_processes}",
+            "--dataset=synthetic", "--no_summaries",
+            "--compilation_cache=",
+            *extra,
+        ],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def run_all(num_processes: int, devices_per_proc: int, extra: list[str],
+            timeout: int = 280) -> list[str]:
+    port = free_port()
+    procs = [
+        launch(i, port, num_processes, devices_per_proc, extra)
+        for i in range(num_processes)
+    ]
+    try:
+        outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    finally:
+        # a hung rendezvous must not orphan coordinator-bound workers
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-3000:]
+    return outs

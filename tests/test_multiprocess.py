@@ -6,53 +6,18 @@ localhost coordinator — the analog of the reference's 4-host run
 (README.md:11-16, the only way the reference was ever 'tested'). Covers
 jax.distributed bootstrap from the reference flags, the
 make_array_from_process_local_data batch assembly in the host loop, and
-chief-only final prints.
+chief-only final prints. (Larger topologies, cross-process TP, and
+kill/resume live in test_multiprocess_scale.py.)
 """
 
-import os
-import socket
-import subprocess
-import sys
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from mp_utils import run_all
 
 
 def test_two_process_localhost_training():
-    port = _free_port()
-    env = dict(os.environ)
-    env["DTX_PLATFORM"] = "cpu"
-    env["XLA_FLAGS"] = (
-        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=2"
-    ).strip()
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-
-    def launch(task_index: int):
-        return subprocess.Popen(
-            [
-                sys.executable, "-m", "distributed_tensorflow_example_tpu.main",
-                "--job_name=worker", f"--task_index={task_index}",
-                f"--coordinator_address=127.0.0.1:{port}",
-                "--num_processes=2",
-                "--training_epochs=1", "--batch_size=64", "--frequency=5",
-                "--dataset=synthetic", "--synthetic_train_size=1024",
-                "--synthetic_test_size=256", "--no_summaries",
-                "--compilation_cache=",
-            ],
-            cwd=REPO, env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        )
-
-    procs = [launch(0), launch(1)]
-    outs = [p.communicate(timeout=280)[0] for p in procs]
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, out[-3000:]
-
+    outs = run_all(2, 2, [
+        "--training_epochs=1", "--batch_size=64", "--frequency=5",
+        "--synthetic_train_size=1024", "--synthetic_test_size=256",
+    ])
     chief_out, worker_out = outs
     # chief prints the final block (example.py:177-182); non-chief doesn't
     assert "Test-Accuracy:" in chief_out and "done" in chief_out, chief_out[-2000:]

@@ -14,14 +14,9 @@ Everything runs the real CLI binary, as the reference was run.
 import os
 import re
 import signal
-import socket
-import subprocess
-import sys
 import time
 
-import pytest
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from mp_utils import free_port, launch, run_all
 
 
 def _final_ckpts(ckpt_dir: str) -> list[str]:
@@ -33,59 +28,10 @@ def _final_ckpts(ckpt_dir: str) -> list[str]:
             if re.fullmatch(r"ckpt-\d+\.npz", n)]
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def _launch(task_index: int, port: int, num_processes: int,
-            devices_per_proc: int, extra: list[str]):
-    env = dict(os.environ)
-    env["DTX_PLATFORM"] = "cpu"
-    env["XLA_FLAGS"] = (
-        env.get("XLA_FLAGS", "")
-        + f" --xla_force_host_platform_device_count={devices_per_proc}"
-    ).strip()
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    return subprocess.Popen(
-        [
-            sys.executable, "-m", "distributed_tensorflow_example_tpu.main",
-            "--job_name=worker", f"--task_index={task_index}",
-            f"--coordinator_address=127.0.0.1:{port}",
-            f"--num_processes={num_processes}",
-            "--dataset=synthetic", "--no_summaries",
-            "--compilation_cache=",
-            *extra,
-        ],
-        cwd=REPO, env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-    )
-
-
-def _run_all(num_processes: int, devices_per_proc: int, extra: list[str],
-             timeout: int = 280):
-    port = _free_port()
-    procs = [
-        _launch(i, port, num_processes, devices_per_proc, extra)
-        for i in range(num_processes)
-    ]
-    try:
-        outs = [p.communicate(timeout=timeout)[0] for p in procs]
-    finally:
-        # a hung rendezvous must not orphan coordinator-bound workers
-        for p in procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGKILL)
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, out[-3000:]
-    return outs
-
-
 def test_four_process_sync_dp():
     """4 procs x 2 devices = 8-way sync DP; every process steps in
     lockstep and only the chief prints the final block."""
-    outs = _run_all(4, 2, [
+    outs = run_all(4, 2, [
         "--training_epochs=1", "--batch_size=64", "--frequency=2",
         "--synthetic_train_size=512", "--synthetic_test_size=128",
     ])
@@ -101,7 +47,7 @@ def test_four_process_sync_dp():
 def test_tensor_parallel_across_processes():
     """mp=2 across 2 single-device processes: the Megatron row-split
     psum in every forward/backward crosses the process boundary."""
-    outs = _run_all(2, 1, [
+    outs = run_all(2, 1, [
         "--training_epochs=1", "--batch_size=32", "--frequency=2",
         "--model_parallel=2", "--data_parallel=1",
         "--synthetic_train_size=256", "--synthetic_test_size=64",
@@ -118,13 +64,13 @@ def test_checkpoint_kill_resume_multiprocess(tmp_path):
     devices), the kill loses all in-memory state, and the resumed run
     continues from the checkpoint to completion."""
     ckpt = str(tmp_path / "ckpt")
-    port = _free_port()
+    port = free_port()
     common = [
         "--training_epochs=3", "--batch_size=32", "--frequency=2",
         "--synthetic_train_size=256", "--synthetic_test_size=64",
         f"--checkpoint_dir={ckpt}", "--checkpoint_every=4",
     ]
-    procs = [_launch(i, port, 2, 1, common) for i in range(2)]
+    procs = [launch(i, port, 2, 1, common) for i in range(2)]
     try:
         deadline = time.time() + 240
         while time.time() < deadline and not _final_ckpts(ckpt):
@@ -139,7 +85,7 @@ def test_checkpoint_kill_resume_multiprocess(tmp_path):
         for p in procs:
             p.wait(timeout=30)
 
-    outs = _run_all(2, 1, common + ["--resume"])
+    outs = run_all(2, 1, common + ["--resume"])
     chief = outs[0]
     assert "Resumed from" in chief, chief[-2000:]
     assert "Test-Accuracy:" in chief and "done" in chief, chief[-2000:]
