@@ -73,6 +73,12 @@ class Config:
                                     # steps (TPU-native async-staleness analog,
                                     # SURVEY.md §7 semantic mapping)
     grad_reduce: str = "mean"       # mean | sum over the data axis
+    fsdp: bool = False              # ZeRO-3 sharding: params + optimizer
+                                    # state split 1/dp per device, gathered
+                                    # at use, grads reduce-scattered
+                                    # (parallel/fsdp.py)
+    remat: bool = False             # jax.checkpoint the forward: recompute
+                                    # activations in backward (HBM<->FLOPs)
 
     # ---- data (example.py:46-48) ----
     data_dir: str = "MNIST_data"
@@ -162,6 +168,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sync_period", type=int, default=d.sync_period)
     p.add_argument("--grad_reduce", type=str, default=d.grad_reduce,
                    choices=["mean", "sum"])
+    p.add_argument("--fsdp", action="store_true",
+                   help="ZeRO-3: shard params+optimizer state 1/dp per device")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize activations in the backward pass")
     p.add_argument("--data_dir", type=str, default=d.data_dir)
     p.add_argument("--dataset", type=str, default=d.dataset,
                    choices=["auto", "mnist", "synthetic"])

@@ -296,7 +296,8 @@ def _build_local_run_to_completion(
                     from .step import _loss_and_acc
 
                     return _loss_and_acc(
-                        spec, p, x, y, styles, cfg.naive_ce, cfg.pallas
+                        spec, p, x, y, styles, cfg.naive_ce, cfg.pallas,
+                        cfg.remat,
                     )
 
                 (cost, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(

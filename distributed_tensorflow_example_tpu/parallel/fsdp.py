@@ -1,0 +1,195 @@
+"""ZeRO-3 / FSDP-style fully-sharded data parallelism.
+
+Absent from the reference (SURVEY.md §2c: its ~79.5k params fit
+anywhere — /root/reference/example.py:76-82), but the mesh/sharding
+core leaves it a natural slot, and it is the TPU-native answer the
+moment parameters outgrow one chip's HBM. Where the reference's
+parameter server *centralizes* shared state on one host
+(example.py:55-57), FSDP *partitions* it across all of them.
+
+Layout: every floating-point array leaf of the train state (params AND
+optimizer slots) is flattened, zero-padded to a multiple of the
+data-axis size ``dp``, and stored as ``[dp, chunk]`` sharded
+``P('data')`` — each device holds 1/dp of the model + optimizer memory
+(the ZeRO-3 partitioning). Integer scalars (global step, Adam's count)
+stay replicated.
+
+Per step (the scaling-book recipe):
+  1. all-gather the param shards over ICI -> full params (transient),
+  2. local fwd/bwd on this shard's batch slice,
+  3. reduce-scatter (``psum_scatter``) the gradients -> a 1/dp shard,
+  4. optimizer update on the 1/dp shard only.
+The gathered params live only inside the compiled step, so peak HBM is
+state/dp + one transient full copy; the per-step collective bytes equal
+sync DP's single allreduce (an allreduce *is* reduce-scatter +
+all-gather). Elementwise optimizers (SGD/momentum/Adam) commute with
+the flat partitioning, so the update each shard applies is exactly the
+full update restricted to its slice — verified against the 1-device
+step in tests/test_fsdp.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..models import mlp
+from ..train.state import TrainState
+from . import mesh as mesh_lib
+from .mesh import DATA_AXIS, MODEL_AXIS
+from .step import _loss_and_acc
+
+
+def _is_sharded_leaf(a) -> bool:
+    """Float arrays are sharded; integer scalars/counters replicate.
+    Inspects dtype without materializing (host leaves must not be
+    device-transferred just to be classified)."""
+    return np.ndim(a) >= 1 and jnp.issubdtype(jnp.result_type(a), jnp.floating)
+
+
+def shard_state_host(state: TrainState, dp: int) -> TrainState:
+    """Flatten + zero-pad + reshape every float leaf to [dp, chunk]."""
+
+    def conv(a):
+        if not _is_sharded_leaf(a):
+            return a
+        flat = np.asarray(a).reshape(-1)
+        chunk = -(-flat.size // dp)
+        pad = chunk * dp - flat.size
+        if pad:
+            flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+        return flat.reshape(dp, chunk)
+
+    return jax.tree.map(conv, state)
+
+
+def unshard_state_host(state, template: TrainState) -> TrainState:
+    """Inverse of shard_state_host (host-side; used for checkpoints so
+    the on-disk layout stays the portable unsharded one)."""
+    state = jax.device_get(state)
+
+    def conv(s, t):
+        if not _is_sharded_leaf(t):
+            return np.asarray(s)
+        t = np.asarray(t)
+        return np.asarray(s).reshape(-1)[: t.size].reshape(t.shape)
+
+    return jax.tree.map(conv, state, template)
+
+
+def fsdp_specs(template: TrainState) -> TrainState:
+    """PartitionSpec tree for the state: P('data') on the leading
+    [dp, chunk] dim of every float leaf, replicated otherwise. The
+    predicate depends only on dtype/ndim-class, so the template may be
+    in either layout (full or sharded) — no copy is made."""
+    return jax.tree.map(
+        lambda a: P(DATA_AXIS) if _is_sharded_leaf(a) else P(), template
+    )
+
+
+def _gather_full(leaf2d, shape):
+    """Inside shard_map: [1, chunk] local shard -> full [shape] params."""
+    flat = jax.lax.all_gather(leaf2d[0], DATA_AXIS, tiled=True)
+    size = int(np.prod(shape))
+    return flat[:size].reshape(shape)
+
+
+def _scatter_grad(g, chunk: int, dp: int):
+    """Inside shard_map: full grad -> summed 1/dp shard [chunk]."""
+    flat = g.reshape(-1)
+    pad = chunk * dp - flat.size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return jax.lax.psum_scatter(flat, DATA_AXIS, scatter_dimension=0, tiled=True)
+
+
+def _unwrap(a):
+    """[1, chunk] local block -> [chunk] flat shard (pass ints through)."""
+    return a[0] if _is_sharded_leaf(a) else a
+
+
+def _rewrap(a):
+    return a[None] if _is_sharded_leaf(a) else a
+
+
+def build_fsdp_train_step(
+    cfg, mesh, spec: mlp.MLPSpec, optimizer, full_template: TrainState
+) -> Callable:
+    """FSDP step: (sharded_state, x, y) -> (sharded_state, cost, acc).
+
+    ``full_template`` supplies the unsharded leaf shapes (host arrays or
+    ShapeDtypeStructs). State is donated; params never materialize
+    outside the step.
+    """
+    if mesh.shape[MODEL_AXIS] != 1:
+        raise ValueError("FSDP composes over the data axis; set model_parallel=1")
+    dp = mesh.shape[DATA_AXIS]
+    styles = mesh_lib.layer_styles(spec, 1)
+    shapes = {k: tuple(np.shape(v)) for k, v in full_template.params.items()}
+    sspecs = fsdp_specs(full_template)
+
+    def shard_step(state: TrainState, x, y):
+        params_full = {
+            k: _gather_full(state.params[k], shapes[k]) for k in state.params
+        }
+
+        def loss_fn(p):
+            return _loss_and_acc(
+                spec, p, x, y, styles, cfg.naive_ce, cfg.pallas, cfg.remat
+            )
+
+        (cost, acc), grads_full = jax.value_and_grad(loss_fn, has_aux=True)(
+            params_full
+        )
+        grads = {
+            k: _scatter_grad(grads_full[k], state.params[k].shape[1], dp)
+            for k in grads_full
+        }
+        if cfg.grad_reduce == "mean" and dp > 1:
+            grads = jax.tree.map(lambda g: g / dp, grads)
+        local_p = jax.tree.map(_unwrap, state.params)
+        local_o = jax.tree.map(_unwrap, state.opt_state)
+        new_p, new_o = optimizer.update(grads, local_o, local_p)
+        cost = jax.lax.pmean(cost, DATA_AXIS)
+        acc = jax.lax.pmean(acc, DATA_AXIS)
+        return (
+            TrainState(
+                state.step + 1,
+                jax.tree.map(_rewrap, new_p),
+                jax.tree.map(_rewrap, new_o),
+            ),
+            cost,
+            acc,
+        )
+
+    fn = jax.shard_map(
+        shard_step,
+        mesh=mesh,
+        in_specs=(sspecs, P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(sspecs, P(), P()),
+    )
+    return jax.jit(fn, donate_argnums=0)
+
+
+def build_gather_params(mesh, full_template: TrainState) -> Callable:
+    """jit'd (sharded_state) -> full replicated param pytree — one
+    all-gather per leaf; used for eval and checkpointing."""
+    shapes = {k: tuple(np.shape(v)) for k, v in full_template.params.items()}
+    sspecs = fsdp_specs(full_template)
+    out_specs = {k: P() for k in shapes}
+
+    def shard_gather(state: TrainState):
+        return {k: _gather_full(state.params[k], shapes[k]) for k in state.params}
+
+    # all_gather output is bitwise-identical on every shard, but the
+    # varying-manual-axes checker cannot prove replication — disable it
+    # for this collective-only function.
+    fn = jax.shard_map(
+        shard_gather, mesh=mesh, in_specs=(sspecs,), out_specs=out_specs,
+        check_vma=False,
+    )
+    return jax.jit(fn)

@@ -68,8 +68,15 @@ def forward_local(spec: mlp.MLPSpec, params, x, styles, use_pallas: bool = False
     return mlp.apply(spec, params, x, styles=styles, model_axis=MODEL_AXIS)
 
 
-def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas):
-    logits = forward_local(spec, params, x, styles, use_pallas)
+def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas, remat=False):
+    fwd = lambda p, xx: forward_local(spec, p, xx, styles, use_pallas)
+    if remat:
+        # jax.checkpoint: recompute activations in the backward pass
+        # instead of saving them — trades MXU FLOPs for HBM, the
+        # standard lever once hidden sizes grow (SURVEY has no analog:
+        # TF 1.2 always stored every activation).
+        fwd = jax.checkpoint(fwd)
+    logits = fwd(params, x)
     cost = losses.cross_entropy(logits, y, naive=naive)
     acc = metrics.accuracy(logits, y)
     return cost, acc
@@ -83,7 +90,9 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer) -> C
 
     def body(state: TrainState, x, y):
         def loss_fn(p):
-            return _loss_and_acc(spec, p, x, y, styles, cfg.naive_ce, cfg.pallas)
+            return _loss_and_acc(
+                spec, p, x, y, styles, cfg.naive_ce, cfg.pallas, cfg.remat
+            )
 
         (cost, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
         # shard_map's transpose has already psum'd grads over 'data'
@@ -187,7 +196,9 @@ def build_local_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer, state_templa
         local_o = jax.tree.map(lambda a: a[0], state.opt_state)
 
         def loss_fn(p):
-            return _loss_and_acc(spec, p, x, y, styles, cfg.naive_ce, cfg.pallas)
+            return _loss_and_acc(
+                spec, p, x, y, styles, cfg.naive_ce, cfg.pallas, cfg.remat
+            )
 
         (cost, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(local_p)
         new_p, new_o = optimizer.update(grads, local_o, local_p)

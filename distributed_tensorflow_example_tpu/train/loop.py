@@ -98,6 +98,12 @@ def _eval_accuracy(eval_step, params, images, labels, dp: int, chunk: int) -> fl
 
 def run(cfg: Config) -> Dict[str, Any]:
     """Train per the config; returns the metrics the reference prints."""
+    # Pure config validation first — before bootstrap/dataset work, so a
+    # bad flag combination fails fast and never strands peer processes.
+    if cfg.fsdp and cfg.sync_period > 1:
+        raise ValueError("--fsdp requires the synchronous step (sync_period=1)")
+    if cfg.fsdp and cfg.model_parallel > 1:
+        raise ValueError("--fsdp composes over the data axis; set model_parallel=1")
     cluster.bootstrap(cfg)
     cluster.enable_compilation_cache(cfg)
     if cfg.debug_nans:
@@ -120,9 +126,12 @@ def run(cfg: Config) -> Dict[str, Any]:
 
     global_batch = _global_batch(cfg, dp)
     async_mode = cfg.sync_period > 1
+    fsdp_mode = cfg.fsdp
     fast = (
         cfg.fast_loop and proc_cnt == 1
         and (cfg.shard_data or dp == 1)
+        # FSDP runs in the host loop (its state layout is step-local)
+        and not fsdp_mode
         # async fast path runs the whole program on-device; periodic
         # host-side checkpoints need the host loop
         and not (async_mode and (cfg.checkpoint_every or cfg.model_parallel > 1))
@@ -132,7 +141,19 @@ def run(cfg: Config) -> Dict[str, Any]:
     # every process — deterministic, no chief broadcast needed.
     state = create_train_state(jax.random.PRNGKey(cfg.seed), spec, optimizer)
 
-    if async_mode:
+    full_template = None
+    if fsdp_mode:
+        from ..parallel import fsdp as fsdp_lib
+
+        full_template = jax.tree.map(np.asarray, state)
+        state = fsdp_lib.shard_state_host(state, dp)
+        train_step = fsdp_lib.build_fsdp_train_step(
+            cfg, mesh, spec, optimizer, full_template
+        )
+        param_sync = None
+        get_params = fsdp_lib.build_gather_params(mesh, full_template)
+        sspecs = fsdp_lib.fsdp_specs(state)
+    elif async_mode:
         state = step_lib.stack_state(state, dp)
         train_step = (
             None if fast
@@ -153,7 +174,14 @@ def run(cfg: Config) -> Dict[str, Any]:
     if cfg.resume and cfg.checkpoint_dir:
         path = ckpt_lib.latest_checkpoint(cfg.checkpoint_dir)
         if path:
-            state, _, start_epoch = ckpt_lib.restore_checkpoint(path, state)
+            if fsdp_mode:
+                # checkpoints keep the portable unsharded layout
+                full, _, start_epoch = ckpt_lib.restore_checkpoint(
+                    path, full_template
+                )
+                state = fsdp_lib.shard_state_host(full, dp)
+            else:
+                state, _, start_epoch = ckpt_lib.restore_checkpoint(path, state)
             state = mesh_lib.place_state(state, mesh, sspecs)
             print(f"Resumed from {path} at epoch {start_epoch}")
 
@@ -219,6 +247,10 @@ def run(cfg: Config) -> Dict[str, Any]:
             from jax.experimental import multihost_utils
 
             to_save = multihost_utils.process_allgather(state, tiled=True)
+        if fsdp_mode:
+            from ..parallel import fsdp as fsdp_lib
+
+            to_save = fsdp_lib.unshard_state_host(to_save, full_template)
         if chief:
             ckpt_lib.save_checkpoint(cfg.checkpoint_dir, to_save, step, resume_epoch)
 
@@ -398,7 +430,9 @@ def run(cfg: Config) -> Dict[str, Any]:
     if eval_pending is not None:        # fast path, eval already on-device
         test_acc = float(eval_pending) / fast_eval.n
     else:
-        params = get_params(state) if async_mode else state.params
+        params = (
+            get_params(state) if (async_mode or fsdp_mode) else state.params
+        )
         if fast:                        # fast per-epoch path
             test_acc = fast_eval(params)
         else:                           # host path

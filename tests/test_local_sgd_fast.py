@@ -57,3 +57,29 @@ def test_learns(devices8):
     state, costs, accs = runner(state, img_d, lbl_d, jax.random.PRNGKey(3))
     costs = np.asarray(costs)  # [epochs, spe]
     assert costs[-1].mean() < costs[0].mean()
+
+
+def test_remat_numerically_inert(devices8):
+    """--remat threads into the scanned local-SGD runner's loss and
+    changes nothing numerically (recompute == stored activations)."""
+
+    def go(remat):
+        cfg = Config(learning_rate=0.2, sync_period=3, remat=remat)
+        mesh = mesh_lib.build_mesh(8, 1)
+        opt = make_optimizer(cfg)
+        state = step_lib.stack_state(
+            create_train_state(jax.random.PRNGKey(1), SPEC, opt), 8
+        )
+        state = mesh_lib.place_state(state, mesh, step_lib._stacked_specs(state))
+        runner = epoch_lib.build_local_run_to_completion(
+            cfg, mesh, SPEC, opt, 6, 1
+        )(state)
+        rng = np.random.RandomState(0)
+        n = 8 * 6 * 4
+        imgs = rng.rand(n, SPEC.input_size).astype(np.float32)
+        lbls = np.eye(SPEC.num_classes, dtype=np.float32)[rng.randint(0, 4, n)]
+        img_d, lbl_d, _ = epoch_lib.shard_dataset(mesh, imgs, lbls, 8 * 4)
+        state, _, _ = runner(state, img_d, lbl_d, jax.random.PRNGKey(3))
+        return np.asarray(jax.device_get(state.params["W1"]))
+
+    np.testing.assert_array_equal(go(False), go(True))
