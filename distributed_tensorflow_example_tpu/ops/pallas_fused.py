@@ -120,13 +120,23 @@ def _forward_pallas(spec: mlp.MLPSpec, params, x):
     except (AttributeError, TypeError):
         vma = None
     if vma:
-        if hasattr(jax.lax, "pcast"):
-            flat_params = [
-                jax.lax.pcast(p, tuple(sorted(vma)), to="varying")
-                for p in flat_params
-            ]
-        else:  # older JAX
-            flat_params = [jax.lax.pvary(p, tuple(sorted(vma))) for p in flat_params]
+
+        def lift(p):
+            # Lift only the axes a param is still invariant over:
+            # replicated DP params need the full vma, while FSDP hands
+            # in all-gathered params that are already varying.
+            try:
+                have = set(jax.typeof(p).vma)
+            except (AttributeError, TypeError):
+                have = set()
+            missing = tuple(sorted(set(vma) - have))
+            if not missing:
+                return p
+            if hasattr(jax.lax, "pcast"):
+                return jax.lax.pcast(p, missing, to="varying")
+            return jax.lax.pvary(p, missing)  # older JAX
+
+        flat_params = [lift(p) for p in flat_params]
     _sds = (
         (lambda shape, dt: jax.ShapeDtypeStruct(shape, dt, vma=vma))
         if vma

@@ -146,7 +146,7 @@ def run(cfg: Config) -> Dict[str, Any]:
         from ..parallel import fsdp as fsdp_lib
 
         full_template = jax.tree.map(np.asarray, state)
-        state = fsdp_lib.shard_state_host(state, dp)
+        state = fsdp_lib.shard_state_host(full_template, dp)
         train_step = fsdp_lib.build_fsdp_train_step(
             cfg, mesh, spec, optimizer, full_template
         )

@@ -77,6 +77,16 @@ def test_fsdp_deep_model_adam(devices8):
         np.testing.assert_allclose(p1[k], p8[k], rtol=2e-5, atol=2e-6, err_msg=k)
 
 
+def test_fsdp_composes_with_pallas_and_remat(devices8):
+    """--fsdp --pallas --remat: the gathered params feed the fused
+    forward unchanged; updates still match the single-device step."""
+    cfg = Config(learning_rate=0.05, pallas=True, remat=True)
+    p1, _ = _run_single(Config(learning_rate=0.05), SPEC)
+    p8, _, _ = _run_fsdp(cfg, SPEC, 8)
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p8[k], rtol=2e-5, atol=2e-6, err_msg=k)
+
+
 def test_fsdp_state_is_actually_sharded(devices8):
     """Each device holds exactly one [1, chunk] block of every float
     leaf — 1/dp of the model + optimizer memory, the ZeRO-3 claim."""

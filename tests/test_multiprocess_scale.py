@@ -58,6 +58,41 @@ def test_tensor_parallel_across_processes():
     assert "Cost: nan" not in chief.lower(), chief[-2000:]
 
 
+def test_fsdp_across_processes():
+    """--fsdp over 2 processes x 2 devices: the per-step parameter
+    all-gather and gradient reduce-scatter cross the process boundary,
+    and the final eval's param gather feeds the chief's accuracy."""
+    outs = run_all(2, 2, [
+        "--training_epochs=1", "--batch_size=32", "--frequency=2",
+        "--fsdp",
+        "--synthetic_train_size=256", "--synthetic_test_size=64",
+    ])
+    chief, worker = outs
+    assert "Test-Accuracy:" in chief and "done" in chief, chief[-2000:]
+    assert "Cost: nan" not in chief.lower(), chief[-2000:]
+    assert "Test-Accuracy:" not in worker
+
+
+def test_fsdp_checkpoint_resume_multiprocess(tmp_path):
+    """--fsdp + checkpointing across 2 processes: the save allgathers
+    the [dp, chunk]-sharded state from non-addressable devices and
+    writes the portable unsharded layout; --resume re-shards it."""
+    ckpt = str(tmp_path / "ckpt")
+    common = [
+        "--training_epochs=1", "--batch_size=32", "--frequency=2",
+        "--fsdp", "--synthetic_train_size=128", "--synthetic_test_size=64",
+        f"--checkpoint_dir={ckpt}",
+    ]
+    outs = run_all(2, 2, common)
+    assert "done" in outs[0], outs[0][-2000:]
+    assert _final_ckpts(ckpt), "no checkpoint written at exit"
+
+    outs = run_all(2, 2, common + ["--resume", "--training_epochs=2"])
+    chief = outs[0]
+    assert "Resumed from" in chief, chief[-2000:]
+    assert "Test-Accuracy:" in chief and "done" in chief, chief[-2000:]
+
+
 def test_checkpoint_kill_resume_multiprocess(tmp_path):
     """Save -> SIGKILL mid-run -> --resume: the save goes through
     process_allgather (multi-process leaves span non-addressable
