@@ -230,24 +230,33 @@ def _match_vma(val, like):
 
 
 def _bwd(spec, res, g):
+    """Backward in the same mixed precision as the forward: matmul
+    inputs in ``compute_dtype`` (bf16 keeps the MXU at native rate —
+    the backward is 2/3 of the step FLOPs), accumulation and the
+    elementwise delta chain in float32."""
     params, x, hiddens = res
     L = spec.num_layers
-    acts = (x.astype(jnp.float32),) + hiddens  # inputs to layers 1..L
+    cdt = spec.compute_dtype
+    mm = lambda a, b: jnp.dot(
+        a.astype(cdt), b.astype(cdt), preferred_element_type=jnp.float32
+    )
+    acts = (x,) + hiddens  # inputs to layers 1..L
     dW = {}
     db = {}
-    delta = g.astype(jnp.float32)  # dL/dz_L
+    delta = g.astype(jnp.float32)  # dL/dz_L (chain stays f32 for precision)
     for i in range(L, 0, -1):
-        a_in = acts[i - 1]
-        dW[f"W{i}"] = a_in.T @ delta
+        dW[f"W{i}"] = mm(acts[i - 1].T, delta)
         db[f"b{i}"] = jnp.sum(delta, axis=0)
         if i > 1:
-            da = delta @ params[f"W{i}"].astype(jnp.float32).T
-            delta = da * _act_grad(spec.activation, hiddens[i - 2])
+            da = mm(delta, params[f"W{i}"].T)
+            delta = da * _act_grad(spec.activation, hiddens[i - 2]).astype(
+                jnp.float32
+            )
     dparams = {
         k: _match_vma(v, params[k]).astype(params[k].dtype)
         for k, v in {**dW, **db}.items()
     }
-    dx = (delta @ params["W1"].astype(jnp.float32).T).astype(x.dtype)
+    dx = mm(delta, params["W1"].T).astype(x.dtype)
     return dparams, dx
 
 

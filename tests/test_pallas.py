@@ -71,6 +71,36 @@ def test_forward_matches_xla_bfloat16():
     assert not np.array_equal(want, f32_out)
 
 
+def test_grads_match_xla_bfloat16():
+    """The custom-VJP backward's compute_dtype casts (bf16 matmul
+    inputs, f32 accumulation and delta chain) must track the XLA
+    autodiff gradients of the same bf16 forward to bf16-scale
+    tolerance — the f32 tests elide every one of those casts."""
+    spec = mlp.MLPSpec(
+        input_size=16, hidden_sizes=(12, 8), num_classes=4,
+        activation="relu", compute_dtype=jnp.bfloat16,
+    )
+    params = mlp.init(jax.random.PRNGKey(0), spec)
+    rng = np.random.RandomState(1)
+    x = rng.rand(24, spec.input_size).astype(np.float32)
+    y = np.eye(spec.num_classes, dtype=np.float32)[
+        rng.randint(0, spec.num_classes, 24)
+    ]
+
+    def loss(p, fwd):
+        logits = fwd(spec, p, x)
+        return -jnp.mean(jnp.sum(y * jax.nn.log_softmax(logits), axis=-1))
+
+    g_xla = jax.grad(lambda p: loss(p, lambda s, p_, x_: mlp.apply(s, p_, x_)))(params)
+    g_pal = jax.grad(lambda p: loss(p, pallas_fused.mlp_forward))(params)
+    for k in g_xla:
+        ref = np.asarray(g_xla[k])
+        scale = max(np.abs(ref).max(), 1e-3)
+        np.testing.assert_allclose(
+            np.asarray(g_pal[k]) / scale, ref / scale, atol=2e-2, err_msg=k,
+        )
+
+
 def test_dp8_training_equivalence_with_pallas(devices8):
     """One DP-8 sharded pallas step == the XLA step (the custom-VJP
     psum reinsertion is load-bearing here)."""
