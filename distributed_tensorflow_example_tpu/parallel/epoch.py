@@ -149,24 +149,15 @@ def build_run_to_completion(
         cfg, mesh, spec, optimizer, steps_per_epoch, num_epochs))
 
 
-def _build_run_to_completion(
-    cfg, mesh, spec: mlp.MLPSpec, optimizer, steps_per_epoch: int, num_epochs: int
+def _build_scan_runner(
+    mesh, sspecs, step_body: Callable, steps_per_epoch: int, num_epochs: int
 ) -> Callable:
-    """The whole training run as ONE XLA executable: nested scan over
-    (epochs x steps), per-epoch on-device reshuffle. Returns
-    (state, costs[E, spe], accs[E, spe]).
-
-    This is the logical endpoint of the reference->TPU inversion
-    (SURVEY.md §3.3): the reference crossed the network three times per
-    step; here the *entire 20-epoch run* (example.py:150-163) is a
-    single device program — the host only uploads data once and fetches
-    the metric arrays once at the end.
-    """
-    dp = mesh.shape[DATA_AXIS]
-    mp = mesh.shape[MODEL_AXIS]
-    styles = mesh_lib.layer_styles(spec, mp)
-    sspecs = mesh_lib.state_pspecs(spec, optimizer, mp)
-    step_body = make_sync_step_body(cfg, spec, styles, dp, optimizer)
+    """The generic whole-run-as-one-executable machinery: nested scan
+    over (epochs x steps) with a per-epoch on-device bulk shuffle-gather
+    and contiguous slices in the hot loop, parameterized by a per-shard
+    ``step_body`` (state, x, y) -> (state, cost, acc) and its state
+    PartitionSpec tree. Shared by the sync, local-SGD, and FSDP
+    runners."""
 
     def shard_run(state: TrainState, img_u8, lbl, key, epoch_offset):
         n_local = img_u8.shape[0]
@@ -216,6 +207,53 @@ def _build_run_to_completion(
 
     run.jitted = jitted  # exposed for graph observability (utils.hlo)
     return run
+
+
+def _build_run_to_completion(
+    cfg, mesh, spec: mlp.MLPSpec, optimizer, steps_per_epoch: int, num_epochs: int
+) -> Callable:
+    """The whole training run as ONE XLA executable. Returns
+    (state, costs[E, spe], accs[E, spe]).
+
+    This is the logical endpoint of the reference->TPU inversion
+    (SURVEY.md §3.3): the reference crossed the network three times per
+    step; here the *entire 20-epoch run* (example.py:150-163) is a
+    single device program — the host only uploads data once and fetches
+    the metric arrays once at the end.
+    """
+    dp = mesh.shape[DATA_AXIS]
+    mp = mesh.shape[MODEL_AXIS]
+    styles = mesh_lib.layer_styles(spec, mp)
+    sspecs = mesh_lib.state_pspecs(spec, optimizer, mp)
+    step_body = make_sync_step_body(cfg, spec, styles, dp, optimizer)
+    return _build_scan_runner(mesh, sspecs, step_body, steps_per_epoch, num_epochs)
+
+
+def build_fsdp_run_to_completion(
+    cfg, mesh, spec: mlp.MLPSpec, optimizer, full_template,
+    steps_per_epoch: int, num_epochs: int,
+) -> Callable:
+    """FSDP's whole-run program: the same nested-scan machinery with the
+    ZeRO-3 step body (all-gather params, reduce-scatter grads, 1/dp
+    shard update — parallel/fsdp.py) in the hot loop."""
+    from . import fsdp as fsdp_lib
+
+    if mesh.shape[MODEL_AXIS] != 1:
+        raise ValueError("FSDP composes over the data axis; set model_parallel=1")
+    key = ("fsdp_run", cfg, mesh, spec, optimizer.name, steps_per_epoch,
+           num_epochs)
+
+    def build():
+        dp = mesh.shape[DATA_AXIS]
+        step_body = fsdp_lib.make_fsdp_step_body(
+            cfg, spec, dp, optimizer, full_template
+        )
+        sspecs = fsdp_lib.fsdp_specs(full_template)
+        return _build_scan_runner(
+            mesh, sspecs, step_body, steps_per_epoch, num_epochs
+        )
+
+    return _memo(key, build)
 
 
 def build_local_run_to_completion(
@@ -269,98 +307,53 @@ def _build_local_run_to_completion(
             return jax.lax.pcast(m, DATA_AXIS, to="varying")
         return jax.lax.pvary(m, DATA_AXIS)
 
-    def shard_run(state: TrainState, img_u8, lbl, key, epoch_offset):
-        n_local = img_u8.shape[0]
-        b = n_local // steps_per_epoch
-        shard_id = jax.lax.axis_index(DATA_AXIS)
-        shard_key = jax.random.fold_in(key, shard_id)
+    def step_body(state: TrainState, x, y):
+        local_p = jax.tree.map(lambda a: a[0], state.params)
+        local_o = jax.tree.map(lambda a: a[0], state.opt_state)
 
-        def epoch_body(state, epoch_idx):
-            perm = jax.random.permutation(
-                jax.random.fold_in(shard_key, epoch_idx), n_local
+        def loss_fn(p):
+            from .step import _loss_and_acc
+
+            return _loss_and_acc(
+                spec, p, x, y, styles, cfg.naive_ce, cfg.pallas, cfg.remat
             )
-            # same bulk-gather-then-contiguous-slices layout as the sync
-            # runner above
-            shuf_img = jnp.take(img_u8, perm, axis=0)
-            shuf_lbl = jnp.take(lbl, perm, axis=0)
 
-            def body(state, step_idx):
-                x = _normalize(
-                    jax.lax.dynamic_slice_in_dim(shuf_img, step_idx * b, b)
-                )
-                y = jax.lax.dynamic_slice_in_dim(shuf_lbl, step_idx * b, b)
-                local_p = jax.tree.map(lambda a: a[0], state.params)
-                local_o = jax.tree.map(lambda a: a[0], state.opt_state)
-
-                def loss_fn(p):
-                    from .step import _loss_and_acc
-
-                    return _loss_and_acc(
-                        spec, p, x, y, styles, cfg.naive_ce, cfg.pallas,
-                        cfg.remat,
-                    )
-
-                (cost, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                    local_p
-                )
-                new_p, new_o = optimizer.update(grads, local_o, local_p)
-                new_state = TrainState(
-                    state.step + 1,
-                    jax.tree.map(lambda a: a[None], new_p),
-                    jax.tree.map(lambda a: a[None], new_o),
-                )
-                # Reconcile every K-th step (HOGWILD staleness window).
-                # lax.cond, not a where-select: the predicate derives from
-                # the replicated step counter (uniform across shards), so
-                # the param-sized pmean allreduce only *executes* on sync
-                # steps — a where-select would pay the full cross-shard
-                # traffic every step, defeating local-SGD's purpose.
-                def reconcile(s):
-                    return TrainState(
-                        s.step,
-                        jax.tree.map(avg, s.params),
-                        jax.tree.map(avg, s.opt_state),
-                    )
-
-                if K == 1:
-                    new_state = reconcile(new_state)
-                else:
-                    do_sync = (new_state.step % K) == 0
-                    new_state = jax.lax.cond(
-                        do_sync, reconcile, lambda s: s, new_state
-                    )
-                cost = jax.lax.pmean(cost, DATA_AXIS)
-                acc = jax.lax.pmean(acc, DATA_AXIS)
-                return new_state, (cost, acc)
-
-            state, (costs, accs) = jax.lax.scan(
-                body, state, jnp.arange(steps_per_epoch, dtype=jnp.int32)
-            )
-            return state, (costs, accs)
-
-        state, (costs, accs) = jax.lax.scan(
-            epoch_body, state,
-            epoch_offset + jnp.arange(num_epochs, dtype=jnp.int32),
+        (cost, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(local_p)
+        new_p, new_o = optimizer.update(grads, local_o, local_p)
+        new_state = TrainState(
+            state.step + 1,
+            jax.tree.map(lambda a: a[None], new_p),
+            jax.tree.map(lambda a: a[None], new_o),
         )
-        return state, costs, accs
+        # Reconcile every K-th step (HOGWILD staleness window).
+        # lax.cond, not a where-select: the predicate derives from
+        # the replicated step counter (uniform across shards), so
+        # the param-sized pmean allreduce only *executes* on sync
+        # steps — a where-select would pay the full cross-shard
+        # traffic every step, defeating local-SGD's purpose.
+        def reconcile(s):
+            return TrainState(
+                s.step,
+                jax.tree.map(avg, s.params),
+                jax.tree.map(avg, s.opt_state),
+            )
+
+        if K == 1:
+            new_state = reconcile(new_state)
+        else:
+            do_sync = (new_state.step % K) == 0
+            new_state = jax.lax.cond(do_sync, reconcile, lambda s: s, new_state)
+        cost = jax.lax.pmean(cost, DATA_AXIS)
+        acc = jax.lax.pmean(acc, DATA_AXIS)
+        return new_state, cost, acc
 
     from .step import _stacked_specs
 
     def build(state_template):
-        sspecs = _stacked_specs(state_template)
-        fn = jax.shard_map(
-            shard_run,
-            mesh=mesh,
-            in_specs=(sspecs, P(DATA_AXIS), P(DATA_AXIS), P(), P()),
-            out_specs=(sspecs, P(), P()),
+        return _build_scan_runner(
+            mesh, _stacked_specs(state_template), step_body,
+            steps_per_epoch, num_epochs,
         )
-        jitted = jax.jit(fn, donate_argnums=0)
-
-        def run(state, img_u8, lbl, key, epoch_offset: int = 0):
-            return jitted(state, img_u8, lbl, key, jnp.int32(epoch_offset))
-
-        run.jitted = jitted
-        return run
 
     return build
 

@@ -116,21 +116,15 @@ def _rewrap(a):
     return a[None] if _is_sharded_leaf(a) else a
 
 
-def build_fsdp_train_step(
-    cfg, mesh, spec: mlp.MLPSpec, optimizer, full_template: TrainState
+def make_fsdp_step_body(
+    cfg, spec: mlp.MLPSpec, dp: int, optimizer, full_template: TrainState
 ) -> Callable:
-    """FSDP step: (sharded_state, x, y) -> (sharded_state, cost, acc).
-
-    ``full_template`` supplies the unsharded leaf shapes (host arrays or
-    ShapeDtypeStructs). State is donated; params never materialize
-    outside the step.
-    """
-    if mesh.shape[MODEL_AXIS] != 1:
-        raise ValueError("FSDP composes over the data axis; set model_parallel=1")
-    dp = mesh.shape[DATA_AXIS]
+    """The per-shard FSDP step body (state, x, y) -> (state, cost, acc)
+    — shared by the host-fed step (build_fsdp_train_step) and the
+    device-resident scan runner (parallel/epoch.py) so both train with
+    identical semantics. State leaves arrive as [1, chunk] local blocks."""
     styles = mesh_lib.layer_styles(spec, 1)
     shapes = {k: tuple(np.shape(v)) for k, v in full_template.params.items()}
-    sspecs = fsdp_specs(full_template)
 
     def shard_step(state: TrainState, x, y):
         params_full = {
@@ -165,6 +159,24 @@ def build_fsdp_train_step(
             cost,
             acc,
         )
+
+    return shard_step
+
+
+def build_fsdp_train_step(
+    cfg, mesh, spec: mlp.MLPSpec, optimizer, full_template: TrainState
+) -> Callable:
+    """FSDP step: (sharded_state, x, y) -> (sharded_state, cost, acc).
+
+    ``full_template`` supplies the unsharded leaf shapes (host arrays or
+    ShapeDtypeStructs). State is donated; params never materialize
+    outside the step.
+    """
+    if mesh.shape[MODEL_AXIS] != 1:
+        raise ValueError("FSDP composes over the data axis; set model_parallel=1")
+    dp = mesh.shape[DATA_AXIS]
+    sspecs = fsdp_specs(full_template)
+    shard_step = make_fsdp_step_body(cfg, spec, dp, optimizer, full_template)
 
     fn = jax.shard_map(
         shard_step,

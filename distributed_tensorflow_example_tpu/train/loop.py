@@ -130,10 +130,9 @@ def run(cfg: Config) -> Dict[str, Any]:
     fast = (
         cfg.fast_loop and proc_cnt == 1
         and (cfg.shard_data or dp == 1)
-        # FSDP runs in the host loop (its state layout is step-local)
-        and not fsdp_mode
-        # async fast path runs the whole program on-device; periodic
-        # host-side checkpoints need the host loop
+        # fsdp/async fast paths run the whole program on-device;
+        # periodic host-side checkpoints need the host loop
+        and not (fsdp_mode and cfg.checkpoint_every)
         and not (async_mode and (cfg.checkpoint_every or cfg.model_parallel > 1))
     )
 
@@ -147,8 +146,11 @@ def run(cfg: Config) -> Dict[str, Any]:
 
         full_template = jax.tree.map(np.asarray, state)
         state = fsdp_lib.shard_state_host(full_template, dp)
-        train_step = fsdp_lib.build_fsdp_train_step(
-            cfg, mesh, spec, optimizer, full_template
+        train_step = (
+            None if fast
+            else fsdp_lib.build_fsdp_train_step(
+                cfg, mesh, spec, optimizer, full_template
+            )
         )
         param_sync = None
         get_params = fsdp_lib.build_gather_params(mesh, full_template)
@@ -304,6 +306,11 @@ def run(cfg: Config) -> Dict[str, Any]:
                 runner = epoch_lib.build_local_run_to_completion(
                     cfg, mesh, spec, optimizer, batch_count, n_ep
                 )(state)
+            elif fsdp_mode:
+                runner = epoch_lib.build_fsdp_run_to_completion(
+                    cfg, mesh, spec, optimizer, full_template, batch_count,
+                    n_ep,
+                )
             else:
                 runner = epoch_lib.build_run_to_completion(
                     cfg, mesh, spec, optimizer, batch_count, n_ep
@@ -317,7 +324,8 @@ def run(cfg: Config) -> Dict[str, Any]:
             # enqueue the final eval now so it executes on-device while
             # the host fetches and formats the per-step metrics
             eval_pending = fast_eval.dispatch(
-                get_params(state) if async_mode else state.params
+                get_params(state) if (async_mode or fsdp_mode)
+                else state.params
             )
             costs2d = np.asarray(costs2d)
             accs2d = np.asarray(accs2d)
@@ -325,7 +333,10 @@ def run(cfg: Config) -> Dict[str, Any]:
             for e_off in range(n_ep):
                 cost = emit_epoch(start_epoch + e_off, costs2d[e_off],
                                   accs2d[e_off], avg_step_s)
-        else:
+        elif not (async_mode or fsdp_mode):
+            # per-epoch runner (sync layout only; fast async/fsdp always
+            # take the whole-run branch above — they reach here solely
+            # when no epochs remain, so nothing must be built)
             epoch_runner = epoch_lib.build_epoch_runner(
                 cfg, mesh, spec, optimizer, batch_count
             )

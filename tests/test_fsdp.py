@@ -149,7 +149,7 @@ def test_fsdp_end_to_end_run(devices8, monkeypatch, tmp_path):
         logs_path=str(tmp_path / "logs"),
     )
     res = loop_mod.run(cfg)
-    assert res["fast_loop"] is False
+    assert res["fast_loop"] is True  # FSDP rides the whole-run scan path
     assert np.isfinite(res["final_cost"])
     assert res["steps"] == 10
 
@@ -161,6 +161,57 @@ def test_fsdp_end_to_end_run(devices8, monkeypatch, tmp_path):
 
     res2 = loop_mod.run(cfg.replace(resume=True, training_epochs=2))
     assert res2["steps"] == 20
+
+
+def test_fsdp_fast_runner_equals_sync_fast_runner(devices8):
+    """The FSDP whole-run scan program must produce the same parameter
+    trajectory as the plain sync whole-run program (identical shuffle
+    keying and data layout; only the state partitioning differs)."""
+    from distributed_tensorflow_example_tpu.parallel import epoch as epoch_lib
+
+    spec = SPEC
+    cfg = Config(learning_rate=0.05, optimizer="adam")
+    mesh = mesh_lib.build_mesh(8, 1)
+    opt = make_optimizer(cfg)
+    rng = np.random.RandomState(0)
+    n = 8 * 6 * 4
+    imgs = (rng.randint(0, 256, size=(n, spec.input_size)) / 255.0).astype(
+        np.float32
+    )
+    lbls = np.eye(spec.num_classes, dtype=np.float32)[
+        rng.randint(0, spec.num_classes, n)
+    ]
+    img_d, lbl_d, spe = epoch_lib.shard_dataset(mesh, imgs, lbls, 8 * 4)
+    key = jax.random.PRNGKey(7)
+
+    # sync path
+    state_s = create_train_state(jax.random.PRNGKey(1), spec, opt)
+    state_s = mesh_lib.place_state(
+        state_s, mesh, mesh_lib.state_pspecs(spec, opt, 1)
+    )
+    run_s = epoch_lib.build_run_to_completion(cfg, mesh, spec, opt, spe, 2)
+    state_s, costs_s, _ = run_s(state_s, img_d, lbl_d, key)
+
+    # fsdp path, same data/key
+    full = jax.tree.map(
+        np.asarray, create_train_state(jax.random.PRNGKey(1), spec, opt)
+    )
+    state_f = fsdp_lib.shard_state_host(full, 8)
+    state_f = mesh_lib.place_state(state_f, mesh, fsdp_lib.fsdp_specs(full))
+    run_f = epoch_lib.build_fsdp_run_to_completion(
+        cfg, mesh, spec, opt, full, spe, 2
+    )
+    state_f, costs_f, _ = run_f(state_f, img_d, lbl_d, key)
+
+    np.testing.assert_allclose(
+        np.asarray(costs_f), np.asarray(costs_s), rtol=1e-5, atol=1e-6
+    )
+    gather = fsdp_lib.build_gather_params(mesh, full)
+    p_f = jax.device_get(gather(state_f))
+    p_s = jax.device_get(state_s.params)
+    for k in p_s:
+        np.testing.assert_allclose(p_f[k], p_s[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)
 
 
 def test_fsdp_rejects_async(devices8):
