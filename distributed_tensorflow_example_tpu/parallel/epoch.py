@@ -124,14 +124,11 @@ def _data_fingerprint(images: np.ndarray, labels: np.ndarray):
     )
 
 
-def build_epoch_runner(
-    cfg, mesh, spec: mlp.MLPSpec, optimizer, steps_per_epoch: int
-) -> Callable:
-    """jit'd (state, images_u8, labels, epoch_key) ->
-    (state, costs[spe], accs[spe]) — one XLA executable per epoch.
-    (The single-epoch view of build_run_to_completion, used when the
-    host needs control between epochs, e.g. periodic checkpoints.)"""
-    run1 = build_run_to_completion(cfg, mesh, spec, optimizer, steps_per_epoch, 1)
+def _epoch_view(run1: Callable) -> Callable:
+    """Wrap a num_epochs=1 run-to-completion program as a per-epoch
+    runner (state, img, lbl, key, epoch) -> (state, costs[spe],
+    accs[spe]) — used when the host needs control between epochs,
+    e.g. periodic checkpoints."""
 
     def runner(state: TrainState, img_u8, lbl, key, epoch: int):
         state, costs, accs = run1(state, img_u8, lbl, key, epoch)
@@ -139,6 +136,16 @@ def build_epoch_runner(
 
     runner.jitted = run1.jitted
     return runner
+
+
+def build_epoch_runner(
+    cfg, mesh, spec: mlp.MLPSpec, optimizer, steps_per_epoch: int
+) -> Callable:
+    """jit'd (state, images_u8, labels, epoch_key) ->
+    (state, costs[spe], accs[spe]) — one XLA executable per epoch."""
+    return _epoch_view(
+        build_run_to_completion(cfg, mesh, spec, optimizer, steps_per_epoch, 1)
+    )
 
 
 def build_run_to_completion(
@@ -227,6 +234,16 @@ def _build_run_to_completion(
     sspecs = mesh_lib.state_pspecs(spec, optimizer, mp)
     step_body = make_sync_step_body(cfg, spec, styles, dp, optimizer)
     return _build_scan_runner(mesh, sspecs, step_body, steps_per_epoch, num_epochs)
+
+
+def build_fsdp_epoch_runner(
+    cfg, mesh, spec: mlp.MLPSpec, optimizer, full_template,
+    steps_per_epoch: int,
+) -> Callable:
+    """Single-epoch view of the FSDP whole-run program."""
+    return _epoch_view(build_fsdp_run_to_completion(
+        cfg, mesh, spec, optimizer, full_template, steps_per_epoch, 1
+    ))
 
 
 def build_fsdp_run_to_completion(

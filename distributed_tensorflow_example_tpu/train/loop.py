@@ -130,9 +130,8 @@ def run(cfg: Config) -> Dict[str, Any]:
     fast = (
         cfg.fast_loop and proc_cnt == 1
         and (cfg.shard_data or dp == 1)
-        # fsdp/async fast paths run the whole program on-device;
-        # periodic host-side checkpoints need the host loop
-        and not (fsdp_mode and cfg.checkpoint_every)
+        # async fast path runs the whole program on-device; periodic
+        # host-side checkpoints need the host loop
         and not (async_mode and (cfg.checkpoint_every or cfg.model_parallel > 1))
     )
 
@@ -333,13 +332,19 @@ def run(cfg: Config) -> Dict[str, Any]:
             for e_off in range(n_ep):
                 cost = emit_epoch(start_epoch + e_off, costs2d[e_off],
                                   accs2d[e_off], avg_step_s)
-        elif not (async_mode or fsdp_mode):
-            # per-epoch runner (sync layout only; fast async/fsdp always
-            # take the whole-run branch above — they reach here solely
-            # when no epochs remain, so nothing must be built)
-            epoch_runner = epoch_lib.build_epoch_runner(
-                cfg, mesh, spec, optimizer, batch_count
-            )
+        elif not async_mode:
+            # per-epoch runner, for host control between epochs
+            # (periodic checkpoints). Fast async always takes the
+            # whole-run branch above — it reaches here solely when no
+            # epochs remain, so nothing must be built for it.
+            if fsdp_mode:
+                epoch_runner = epoch_lib.build_fsdp_epoch_runner(
+                    cfg, mesh, spec, optimizer, full_template, batch_count
+                )
+            else:
+                epoch_runner = epoch_lib.build_epoch_runner(
+                    cfg, mesh, spec, optimizer, batch_count
+                )
             dump_graph(epoch_runner.jitted, state, img_d, lbl_d,
                        shuffle_key, start_epoch)
             for epoch in range(start_epoch, cfg.training_epochs):

@@ -128,8 +128,12 @@ def test_shard_unshard_roundtrip_exact():
         np.testing.assert_array_equal(leaf, flat_b[key], err_msg=key)
 
 
-def test_fsdp_end_to_end_run(devices8, monkeypatch, tmp_path):
-    """loop.run --fsdp: trains, evals, checkpoints in the portable
+@pytest.mark.parametrize("ckpt_every", [0, 5],
+                         ids=["whole_run", "per_epoch"])
+def test_fsdp_end_to_end_run(devices8, monkeypatch, tmp_path, ckpt_every):
+    """loop.run --fsdp on both fast paths (checkpoint_every=0 takes the
+    whole-run program with the overlapped eval dispatch; >0 takes the
+    per-epoch runner): trains, evals, checkpoints in the portable
     unsharded layout, and resumes."""
     import distributed_tensorflow_example_tpu.train.loop as loop_mod
     from distributed_tensorflow_example_tpu.data import mnist as M
@@ -146,10 +150,11 @@ def test_fsdp_end_to_end_run(devices8, monkeypatch, tmp_path):
         training_epochs=1, batch_size=80, learning_rate=0.05,
         optimizer="adam", activation="relu", hidden_sizes=(32,),
         fsdp=True, summaries=False, checkpoint_dir=str(tmp_path),
+        checkpoint_every=ckpt_every,
         logs_path=str(tmp_path / "logs"),
     )
     res = loop_mod.run(cfg)
-    assert res["fast_loop"] is True  # FSDP rides the whole-run scan path
+    assert res["fast_loop"] is True  # FSDP rides the scan paths
     assert np.isfinite(res["final_cost"])
     assert res["steps"] == 10
 
