@@ -271,7 +271,7 @@ def run(cfg: Config) -> Dict[str, Any]:
             save_state(step, resume_epoch)
             last_ckpt_step = step
 
-    eval_pending = None  # device array from fast_eval.dispatch (overlapped)
+    eval_pending = None  # host scalar: eval count fetched with the metrics
     if fast:
         shuffle_key = jax.random.PRNGKey(cfg.seed + 0x5EED)
 
@@ -320,14 +320,17 @@ def run(cfg: Config) -> Dict[str, Any]:
             state, costs2d, accs2d = runner(
                 state, img_d, lbl_d, shuffle_key, start_epoch
             )
-            # enqueue the final eval now so it executes on-device while
-            # the host fetches and formats the per-step metrics
+            # enqueue the final eval now so it executes on-device right
+            # after the run, then fetch metrics AND the eval count in a
+            # single device_get — every separate fetch through the
+            # tunnel costs a full round trip
             eval_pending = fast_eval.dispatch(
                 get_params(state) if (async_mode or fsdp_mode)
                 else state.params
             )
-            costs2d = np.asarray(costs2d)
-            accs2d = np.asarray(accs2d)
+            costs2d, accs2d, eval_pending = jax.device_get(
+                (costs2d, accs2d, eval_pending)
+            )
             avg_step_s = (time.time() - t0) / (n_ep * batch_count)
             for e_off in range(n_ep):
                 cost = emit_epoch(start_epoch + e_off, costs2d[e_off],
@@ -352,8 +355,8 @@ def run(cfg: Config) -> Dict[str, Any]:
                 state, costs, accs = epoch_runner(
                     state, img_d, lbl_d, shuffle_key, epoch
                 )
-                costs = np.asarray(costs)
-                accs = np.asarray(accs)
+                # one round trip for both metric arrays
+                costs, accs = jax.device_get((costs, accs))
                 avg_step_s = (time.time() - t0) / batch_count
                 cost = emit_epoch(epoch, costs, accs, avg_step_s)
                 maybe_checkpoint(epoch + 1)
@@ -443,7 +446,7 @@ def run(cfg: Config) -> Dict[str, Any]:
     # Final eval (example.py:177-179): chief-only in spirit; every
     # process computes (cheap, collective-free divergence is impossible
     # under SPMD) but only chief prints.
-    if eval_pending is not None:        # fast path, eval already on-device
+    if eval_pending is not None:        # fast path, eval count already fetched
         test_acc = float(eval_pending) / fast_eval.n
     else:
         params = (
