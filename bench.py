@@ -285,9 +285,10 @@ def main(argv=None) -> int:
         return 0
 
     if args.all_configs:
-        # BASELINE.json's five configs (SURVEY.md §6). Configs 1-3's
-        # ps/worker topologies map per SURVEY.md §7: async -> local-SGD
-        # analog or summed-replica sync; sync -> the psum step.
+        # BASELINE.json's five configs (SURVEY.md §6) plus the pallas
+        # and local-SGD variants. Configs 1-3's ps/worker topologies map
+        # per SURVEY.md §7: async -> local-SGD analog or summed-replica
+        # sync; sync -> the psum step.
         import jax
 
         n = len(jax.devices())
@@ -301,6 +302,10 @@ def main(argv=None) -> int:
             ("deeper_relu_adam", base.replace(
                 hidden_sizes=(256, 128), activation="relu", optimizer="adam",
                 learning_rate=0.001)),
+            # the true async analog (HOGWILD staleness as local SGD,
+            # SURVEY.md §7): divergent replicas, reconcile every 5 steps
+            ("local_sgd_async_k5", base.replace(
+                data_parallel=dp3, batch_size=102, sync_period=5)),
             ("8way_dp", base.replace(
                 data_parallel=min(8, n), batch_size=104)),
             ("reference_default_pallas", base.replace(pallas=True)),

@@ -59,6 +59,42 @@ def test_learns(devices8):
     assert costs[-1].mean() < costs[0].mean()
 
 
+def test_fast_runner_tp_equals_single_device(devices8):
+    """The whole-run scan program under a (4,2) dp x tp mesh matches the
+    (4,1) pure-DP program step for step (Megatron split changes nothing
+    numerically on the fast path either)."""
+    def go(dp, mp):
+        cfg = Config(learning_rate=0.2, model_parallel=mp)
+        mesh = mesh_lib.build_mesh(dp, mp)
+        opt = make_optimizer(cfg)
+        state = create_train_state(jax.random.PRNGKey(1), SPEC, opt)
+        state = mesh_lib.place_state(
+            state, mesh, mesh_lib.state_pspecs(SPEC, opt, mp)
+        )
+        runner = epoch_lib.build_run_to_completion(cfg, mesh, SPEC, opt, 6, 2)
+        rng = np.random.RandomState(0)
+        n = 8 * 6 * 4
+        imgs = (rng.randint(0, 256, size=(n, SPEC.input_size)) / 255.0).astype(
+            np.float32
+        )
+        lbls = np.eye(SPEC.num_classes, dtype=np.float32)[
+            rng.randint(0, 4, n)
+        ]
+        img_d, lbl_d, spe = epoch_lib.shard_dataset(mesh, imgs, lbls, 8 * 4)
+        assert spe == 6
+        state, costs, _ = runner(state, img_d, lbl_d, jax.random.PRNGKey(3))
+        return jax.device_get(state.params), np.asarray(costs)
+
+    # same dp on both meshes so the data sharding (and thus the
+    # trajectory) is identical; only the model axis differs
+    p_tp, c_tp = go(4, 2)
+    p_dp4, c_dp4 = go(4, 1)
+    np.testing.assert_allclose(c_tp, c_dp4, rtol=1e-5, atol=1e-6)
+    for k in p_dp4:
+        np.testing.assert_allclose(p_tp[k], p_dp4[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)
+
+
 def test_remat_numerically_inert(devices8):
     """--remat threads into the scanned local-SGD runner's loss and
     changes nothing numerically (recompute == stored activations)."""
