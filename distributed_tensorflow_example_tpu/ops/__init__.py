@@ -1,4 +1,7 @@
 from .losses import cross_entropy, stable_cross_entropy, naive_cross_entropy
 from .metrics import accuracy
 
+# NOTE: ring_attention / pallas_fused are imported as submodules
+# (pkg.ops.ring_attention.ring_attention) — re-exporting the
+# ring_attention *function* here would shadow its module name.
 __all__ = ["cross_entropy", "stable_cross_entropy", "naive_cross_entropy", "accuracy"]
