@@ -44,6 +44,9 @@ def test_extension_flags():
     assert cfg.sync_period == 5
     assert cfg.grad_reduce == "sum"
     assert cfg.naive_ce and cfg.pallas
+    cfg = parse_config(["--fsdp", "--remat"])
+    assert cfg.fsdp and cfg.remat
+    assert not parse_config([]).fsdp and not parse_config([]).remat
 
 
 def test_mnist_mirror_flag():
