@@ -50,8 +50,13 @@ def _interpret() -> bool:
     return jax.default_backend() == "cpu"
 
 
-def _make_kernel(blk: int, causal: bool, compute_dtype):
-    def kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
+def _make_kernel(blk: int, causal: bool, compute_dtype,
+                 return_stats: bool = False):
+    def kernel(q_ref, k_ref, v_ref, o_ref, *rest):
+        if return_stats:
+            m_out, l_out, m_scr, l_scr, acc_scr = rest
+        else:
+            m_scr, l_scr, acc_scr = rest
         iq = pl.program_id(1)
         j = pl.program_id(2)
         nk = pl.num_programs(2)
@@ -92,9 +97,16 @@ def _make_kernel(blk: int, causal: bool, compute_dtype):
 
         @pl.when(j == nk - 1)
         def _finalize():
-            o_ref[0] = (
-                acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
-            ).astype(o_ref.dtype)
+            if return_stats:
+                # raw partials for cross-block merging (ring SP): the
+                # un-normalized accumulator plus its (m, l) statistics
+                o_ref[0] = acc_scr[...].astype(o_ref.dtype)
+                m_out[0] = m_scr[...]
+                l_out[0] = l_scr[...]
+            else:
+                o_ref[0] = (
+                    acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
+                ).astype(o_ref.dtype)
 
     return kernel
 
@@ -137,6 +149,61 @@ def _flash_forward(q, k, v, causal: bool, blk: int):
         interpret=_interpret(),
     )(qf, kf, vf)
     return out.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)[:, :s]
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _flash_stats(q, k, v, causal: bool, blk: int):
+    """Raw softmax partials for cross-block merging (the ring SP
+    composition, ring_attention.ring_flash_attention): returns
+    (acc [B,S,H,D] un-normalized f32, m [B,S,H,1], l [B,S,H,1]).
+    Requires S % blk == 0 (callers fall back to XLA blocks otherwise).
+    """
+    b, s, h, d = q.shape
+    if s % blk or k.shape[1] != s:
+        raise ValueError(f"_flash_stats needs S % {blk} == 0, got {s}")
+
+    def prep(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    qf, kf, vf = prep(q), prep(k), prep(v)
+    try:
+        vma = jax.typeof(qf).vma
+    except (AttributeError, TypeError):
+        vma = None
+    _sds = (
+        (lambda shape: jax.ShapeDtypeStruct(shape, jnp.float32, vma=vma))
+        if vma else (lambda shape: jax.ShapeDtypeStruct(shape, jnp.float32))
+    )
+    nq = s // blk
+    grid = (b * h, nq, nq)
+    acc, m, l = pl.pallas_call(
+        _make_kernel(blk, causal, q.dtype, return_stats=True),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, blk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, blk, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, blk, 1), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, blk, 1), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            _sds((b * h, s, d)), _sds((b * h, s, 1)), _sds((b * h, s, 1)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk, 1), jnp.float32),
+            pltpu.VMEM((blk, 1), jnp.float32),
+            pltpu.VMEM((blk, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf)
+
+    def un(x):
+        return x.reshape(b, h, s, -1).transpose(0, 2, 1, 3)
+
+    return un(acc), un(m), un(l)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))

@@ -97,6 +97,29 @@ def _block(q, k, v, m, l, o, q_off, k_off, causal: bool):
     return m_new, l_new, o_new
 
 
+def _lift_varying(x, axis_name: str):
+    """Declare an axis-invariant constant varying over ``axis_name`` —
+    ring loop carries start as invariant zeros but are rebound to
+    q-dependent (varying) values, and the carry types must match."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_name, to="varying")
+    return jax.lax.pvary(x, axis_name)  # older JAX
+
+
+def _rotate_unless_last(kv, t, n, axis_name: str):
+    """Pass k/v to the next ring neighbor, skipping the redundant final
+    rotation. Rotation happens AFTER a step consumes its block."""
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    return jax.lax.cond(
+        t < n - 1,
+        lambda kv_: jax.tree.map(
+            functools.partial(jax.lax.ppermute, axis_name=axis_name,
+                              perm=perm), kv_),
+        lambda kv_: kv_,
+        kv,
+    )
+
+
 def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     """Sequence-parallel attention inside shard_map.
 
@@ -111,37 +134,18 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     b, lq, h, d = q.shape
     lk = k.shape[1]
 
-    def lift(x):
-        # initial accumulators are axis-invariant constants, but the
-        # loop rebinds them to q-dependent (varying) values — declare
-        # them varying up front so the carry types match
-        if hasattr(jax.lax, "pcast"):
-            return jax.lax.pcast(x, axis_name, to="varying")
-        return jax.lax.pvary(x, axis_name)  # older JAX
-
-    m = lift(jnp.full((b, h, lq), NEG_INF, jnp.float32))
-    l = lift(jnp.zeros((b, h, lq), jnp.float32))
-    o = lift(jnp.zeros((b, lq, h, d), jnp.float32))
+    m = _lift_varying(jnp.full((b, h, lq), NEG_INF, jnp.float32), axis_name)
+    l = _lift_varying(jnp.zeros((b, h, lq), jnp.float32), axis_name)
+    o = _lift_varying(jnp.zeros((b, lq, h, d), jnp.float32), axis_name)
     q_off = idx * lq
 
-    # ring: pass k/v to the next shard each step; at step t this shard
-    # holds the block that started on shard (idx - t) mod n
-    perm = [(j, (j + 1) % n) for j in range(n)]
-
+    # ring: at step t this shard holds the block that started on shard
+    # (idx - t) mod n
     def step(t, carry):
         k_t, v_t, m_, l_, o_ = carry
         k_off = ((idx - t) % n) * lk
         m_, l_, o_ = _block(q, k_t, v_t, m_, l_, o_, q_off, k_off, causal)
-        # rotate AFTER consuming; the last rotation is skipped via cond
-        # below (avoids one redundant transfer)
-        k_t, v_t = jax.lax.cond(
-            t < n - 1,
-            lambda kv: jax.tree.map(
-                functools.partial(jax.lax.ppermute, axis_name=axis_name,
-                                  perm=perm), kv),
-            lambda kv: kv,
-            (k_t, v_t),
-        )
+        k_t, v_t = _rotate_unless_last((k_t, v_t), t, n, axis_name)
         return k_t, v_t, m_, l_, o_
 
     _, _, m, l, o = jax.lax.fori_loop(0, n, step, (k, v, m, l, o))
@@ -150,3 +154,86 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     l = jnp.maximum(l, 1e-30)
     out = o / jnp.transpose(l, (0, 2, 1))[..., None]
     return out.astype(q.dtype)
+
+
+def _merge_partials(m, l, o, m_b, l_b, acc_b):
+    """Combine two un-normalized softmax partials by their (max,
+    normalizer) statistics — the cross-block analog of _block's online
+    update. Layout [B, L, H, 1] for m/l, [B, L, H, D] for o/acc."""
+    m_new = jnp.maximum(m, m_b)
+    a = jnp.exp(m - m_new)
+    b = jnp.exp(m_b - m_new)
+    return m_new, l * a + l_b * b, o * a + acc_b * b
+
+
+def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
+                         stats_fn=None):
+    """Ring SP composed with the intra-chip flash kernel: the ring
+    moves k/v blocks between chips (ppermute) while each block pair is
+    computed by ops/flash_attention's tiled Pallas kernel returning raw
+    (acc, m, l) partials, merged across ring steps by _merge_partials.
+    This is the full long-context stack: O(S/n) HBM per chip from the
+    ring AND no [L, L] score materialization within a chip.
+
+    Under causal masking each kv block is classified once per step —
+    strictly-past blocks run the unmasked kernel, the diagonal block
+    runs the causal kernel (local positions align), and future blocks
+    are skipped outright (no kernel launch, no wasted MXU work —
+    unlike single-chip flash where masked tiles still execute).
+
+    Forward/inference path (the Pallas stats kernel has no VJP); train
+    with ``ring_attention``, which is differentiable. On CPU backends
+    (and local blocks not divisible by the 256 tile) this delegates to
+    ``ring_attention`` — identical math, XLA blocks.
+
+    ``stats_fn(q, k, v, causal) -> (acc, m, l)`` overrides the block
+    backend (tests inject an XLA implementation so the ring/branch/
+    merge machinery is exercised on the CPU mesh, where interpret-mode
+    Pallas cannot run inside shard_map).
+    """
+    from . import flash_attention as fa
+
+    lq = q.shape[1]
+    if stats_fn is None:
+        if fa._interpret() or lq % fa._BLK or k.shape[1] != lq:
+            return ring_attention(q, k, v, axis_name, causal)
+        stats_fn = lambda q_, k_, v_, c: fa._flash_stats(
+            q_, k_, v_, c, fa._BLK)
+
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, _, h, d = q.shape
+
+    m = _lift_varying(jnp.full((b, lq, h, 1), NEG_INF, jnp.float32),
+                      axis_name)
+    l = _lift_varying(jnp.zeros((b, lq, h, 1), jnp.float32), axis_name)
+    o = _lift_varying(jnp.zeros((b, lq, h, d), jnp.float32), axis_name)
+
+    def step(t, carry):
+        k_t, v_t, m_, l_, o_ = carry
+        rel = (idx - t) % n  # which block of the sequence we hold now
+
+        def merge_with(block_causal):
+            def go(args):
+                m0, l0, o0 = args
+                acc_b, m_b, l_b = stats_fn(q, k_t, v_t, block_causal)
+                return _merge_partials(m0, l0, o0, m_b, l_b, acc_b)
+
+            return go
+
+        if causal:
+            # 0: future block (skip), 1: diagonal (causal kernel),
+            # 2: past block (unmasked kernel)
+            branch = jnp.where(rel > idx, 0, jnp.where(rel == idx, 1, 2))
+            m_, l_, o_ = jax.lax.switch(
+                branch,
+                [lambda args: args, merge_with(True), merge_with(False)],
+                (m_, l_, o_),
+            )
+        else:
+            m_, l_, o_ = merge_with(False)((m_, l_, o_))
+        k_t, v_t = _rotate_unless_last((k_t, v_t), t, n, axis_name)
+        return k_t, v_t, m_, l_, o_
+
+    _, _, m, l, o = jax.lax.fori_loop(0, n, step, (k, v, m, l, o))
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)

@@ -91,6 +91,110 @@ def test_single_shard_degenerates_to_dense(devices8):
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
+def test_ring_flash_delegates_on_cpu(devices8):
+    """On CPU backends ring_flash_attention must produce exactly the
+    ring_attention result (it delegates: interpret-mode Pallas cannot
+    run inside shard_map)."""
+    q, k, v = _inputs(seed=11)
+    mesh = Mesh(np.array(devices8), ("seq",))
+
+    def shard(fn):
+        return jax.jit(
+            jax.shard_map(
+                functools.partial(fn, axis_name="seq", causal=True),
+                mesh=mesh,
+                in_specs=(P(None, "seq"),) * 3,
+                out_specs=P(None, "seq"),
+            )
+        )
+
+    got = np.asarray(shard(ra.ring_flash_attention)(q, k, v))
+    want = np.asarray(shard(ra.ring_attention)(q, k, v))
+    np.testing.assert_array_equal(got, want)
+
+
+def _xla_stats(q, k, v, causal):
+    """Dense XLA block-stats backend with _flash_stats' contract —
+    injected into ring_flash_attention so its switch/merge/rotate
+    machinery runs on the CPU mesh (interpret-mode Pallas cannot run
+    inside shard_map; the kernel itself is covered elsewhere)."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        lq, lk = s.shape[-2], s.shape[-1]
+        s = jnp.where(jnp.tril(jnp.ones((lq, lk), bool)), s, ra.NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(s <= ra.NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    tr = lambda x: jnp.transpose(x, (0, 2, 1))[..., None]
+    return acc, tr(m), tr(l)
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+def test_ring_flash_machinery_matches_dense(devices8, causal):
+    """The real ring_flash step body — branch classification (skip /
+    diagonal-causal / past-unmasked), partial merging, and rotation —
+    on the 8-shard mesh, with the kernel swapped for an XLA stats
+    backend of identical contract."""
+    q, k, v = _inputs(seed=13)
+    mesh = Mesh(np.array(devices8), ("seq",))
+    fn = jax.jit(
+        jax.shard_map(
+            functools.partial(ra.ring_flash_attention, axis_name="seq",
+                              causal=causal, stats_fn=_xla_stats),
+            mesh=mesh,
+            in_specs=(P(None, "seq"),) * 3,
+            out_specs=P(None, "seq"),
+        )
+    )
+    got = np.asarray(fn(q, k, v))
+    want = np.asarray(ra.attention(q, k, v, causal=causal))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal_tail", [False, True],
+                         ids=["past_block", "diag_block"])
+def test_flash_stats_merge_equals_dense(causal_tail):
+    """The exact per-step computation of ring_flash_attention, run
+    without shard_map (so the interpret-mode kernel covers it on CPU):
+    stats over two kv blocks merged by _merge_partials must equal dense
+    attention over the concatenated sequence. past_block: q attends an
+    earlier unmasked block + its causal diagonal block; this is the
+    causal ring's two-branch structure."""
+    from distributed_tensorflow_example_tpu.ops import flash_attention as fa
+
+    blk = 256
+    rng = np.random.RandomState(7)
+    q = rng.randn(1, blk, 2, 8).astype(np.float32)
+    kv_a = [rng.randn(1, blk, 2, 8).astype(np.float32) for _ in range(2)]
+    kv_b = [rng.randn(1, blk, 2, 8).astype(np.float32) for _ in range(2)]
+
+    m = jnp.full((1, blk, 2, 1), ra.NEG_INF, jnp.float32)
+    l = jnp.zeros((1, blk, 2, 1), jnp.float32)
+    o = jnp.zeros((1, blk, 2, 8), jnp.float32)
+    # block A: strictly past (unmasked); block B: diagonal (causal when
+    # causal_tail)
+    acc, mb, lb = fa._flash_stats(q, kv_a[0], kv_a[1], False, blk)
+    m, l, o = ra._merge_partials(m, l, o, mb, lb, acc)
+    acc, mb, lb = fa._flash_stats(q, kv_b[0], kv_b[1], causal_tail, blk)
+    m, l, o = ra._merge_partials(m, l, o, mb, lb, acc)
+    got = np.asarray(o / jnp.maximum(l, 1e-30))
+
+    # dense over [A; B] with q positioned at the B block
+    k_full = np.concatenate([kv_a[0], kv_b[0]], axis=1)
+    v_full = np.concatenate([kv_a[1], kv_b[1]], axis=1)
+    if causal_tail:
+        # emulate global causal: q row i attends all of A plus B[:i+1]
+        qp = np.concatenate([np.zeros_like(q), q], axis=1)
+        want_full = np.asarray(ra.attention(qp, k_full, v_full, causal=True))
+        want = want_full[:, blk:]
+    else:
+        want = np.asarray(ra.attention(q, k_full, v_full, causal=False))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
 def test_masked_row_guard():
     """A q row with every key masked out (possible under non-contiguous
     custom masks) must return zeros, not NaN — the NEG_INF + l-guard
