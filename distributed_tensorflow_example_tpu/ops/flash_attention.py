@@ -1,4 +1,4 @@
-"""Blockwise (flash-style) attention as a Pallas TPU kernel.
+"""Blockwise (flash-style) attention as Pallas TPU kernels.
 
 The intra-chip complement to ops/ring_attention.py: the ring splits the
 sequence ACROSS chips (ppermute neighbor exchange); this kernel makes
@@ -21,14 +21,25 @@ accumulator unchanged), so correctness needs no per-tile control flow;
 the wasted half of the causal grid is accepted for simplicity.
 
 Training: ``flash_attention`` carries a ``jax.custom_vjp`` whose
-backward recomputes the dense probabilities in plain XLA from the
-saved (q, k, v) — the same kernel-forward/XLA-backward split as
-ops/pallas_fused.py. The O(S·blk) memory win therefore applies to the
-forward/inference path; a backward in O(S) would need its own kernel
-and is out of scope here (documented, not hidden).
+backward is ALSO tiled Pallas (``_make_dq_kernel`` /
+``_make_dkv_kernel``): the forward saves only (o, m, l) — O(S)
+residuals — and each backward tile recomputes its probabilities from
+the saved softmax statistics (``_bwd_tile``, shared by both kernels),
+applies the softmax VJP ``ds = p * (dp - rowsum(do*o))``, and
+accumulates dq (streaming k tiles past each q tile) and dk/dv
+(streaming q tiles past each k tile) in VMEM scratch. Forward AND
+backward are O(S·blk) — long-context training memory is bounded by
+HBM, not by an [S, S] score tensor.
 
-On non-TPU backends the kernel runs in Pallas interpret mode, so the
-CPU test suite exercises the same code path bit-for-bit.
+Ragged shapes (S not a multiple of the 256 tile) by direction:
+non-causal ragged runs exact dense XLA in BOTH directions (padded keys
+would corrupt real rows); causal ragged keeps the O(S·blk) kernel
+FORWARD (padded keys sit in every real row's causal future) but takes
+the dense O(S²) backward — pad or trim S to a tile multiple when
+training causal long-context at ragged lengths.
+
+On non-TPU backends the kernels run in Pallas interpret mode, so the
+CPU test suite exercises the same code paths bit-for-bit.
 """
 
 from __future__ import annotations
@@ -70,17 +81,7 @@ def _make_kernel(blk: int, causal: bool, compute_dtype,
         q = q_ref[0].astype(compute_dtype)         # [blk, d]
         k = k_ref[0].astype(compute_dtype)
         v = v_ref[0].astype(compute_dtype)
-        scale = 1.0 / np.sqrt(q.shape[-1])
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale                                   # [blk, blk]
-        if causal:
-            q_pos = iq * blk + jax.lax.broadcasted_iota(
-                jnp.int32, (blk, blk), 0)
-            k_pos = j * blk + jax.lax.broadcasted_iota(
-                jnp.int32, (blk, blk), 1)
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        s = _tile_scores(q, k, iq, j, blk, causal)
         m = m_scr[...]
         m_blk = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, m_blk)
@@ -111,6 +112,152 @@ def _make_kernel(blk: int, causal: bool, compute_dtype,
     return kernel
 
 
+def _tile_scores(q, k, q_tile, k_tile, blk: int, causal: bool):
+    """Scaled q·kᵀ for one tile pair with the global-position causal
+    mask — shared by the forward and both backward kernels."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if causal:
+        q_pos = q_tile * blk + jax.lax.broadcasted_iota(
+            jnp.int32, (blk, blk), 0)
+        k_pos = k_tile * blk + jax.lax.broadcasted_iota(
+            jnp.int32, (blk, blk), 1)
+        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+    return s
+
+
+def _bwd_tile(q, k, v, do, m, l, dlt, q_tile, k_tile, blk: int,
+              causal: bool):
+    """Shared backward tile math: recompute this tile's normalized
+    probabilities from the saved (m, l) stats and apply the softmax VJP.
+    Returns (p, ds, scale)."""
+    s = _tile_scores(q, k, q_tile, k_tile, blk, causal)
+    p = jnp.exp(s - m) / jnp.maximum(l, 1e-30)
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    dp = jax.lax.dot_general(                     # do @ v^T
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - dlt)
+    return p, ds, 1.0 / np.sqrt(q.shape[-1])
+
+
+def _make_dq_kernel(blk: int, causal: bool, compute_dtype):
+    """dq accumulation: grid (bh, iq, jk), jk innermost sequential."""
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, dlt_ref,
+               dq_ref, dq_scr):
+        iq = pl.program_id(1)
+        j = pl.program_id(2)
+        nk = pl.num_programs(2)
+
+        @pl.when(j == 0)
+        def _init():
+            dq_scr[...] = jnp.zeros_like(dq_scr[...])
+
+        k = k_ref[0].astype(compute_dtype)
+        _, ds, scale = _bwd_tile(
+            q_ref[0].astype(compute_dtype), k,
+            v_ref[0].astype(compute_dtype),
+            do_ref[0].astype(compute_dtype),
+            m_ref[0], l_ref[0], dlt_ref[0], iq, j, blk, causal,
+        )
+        dq_scr[...] += jax.lax.dot_general(       # ds @ k
+            ds.astype(compute_dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+        @pl.when(j == nk - 1)
+        def _finalize():
+            dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+    return kernel
+
+
+def _make_dkv_kernel(blk: int, causal: bool, compute_dtype):
+    """dk/dv accumulation: grid (bh, jk, iq), iq innermost sequential
+    (each program owns one k tile and streams q tiles through it)."""
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, dlt_ref,
+               dk_ref, dv_ref, dk_scr, dv_scr):
+        j = pl.program_id(1)
+        i = pl.program_id(2)
+        nq = pl.num_programs(2)
+
+        @pl.when(i == 0)
+        def _init():
+            dk_scr[...] = jnp.zeros_like(dk_scr[...])
+            dv_scr[...] = jnp.zeros_like(dv_scr[...])
+
+        q = q_ref[0].astype(compute_dtype)
+        do = do_ref[0].astype(compute_dtype)
+        p, ds, scale = _bwd_tile(
+            q, k_ref[0].astype(compute_dtype),
+            v_ref[0].astype(compute_dtype), do,
+            m_ref[0], l_ref[0], dlt_ref[0], i, j, blk, causal,
+        )
+        dv_scr[...] += jax.lax.dot_general(       # p^T @ do
+            p.astype(compute_dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_scr[...] += jax.lax.dot_general(       # ds^T @ q
+            ds.astype(compute_dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+        @pl.when(i == nq - 1)
+        def _finalize():
+            dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+            dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+    return kernel
+
+
+def _flash_call(qf, kf, vf, causal: bool, blk: int, return_stats: bool):
+    """Shared forward launcher on pre-flattened [BH, S, D] arrays with
+    S % blk == 0. return_stats=False -> normalized output [BH, S, D];
+    True -> (acc f32, m, l) raw partials."""
+    bh, s, d = qf.shape
+    try:
+        vma = jax.typeof(qf).vma
+    except (AttributeError, TypeError):
+        vma = None
+
+    def sds(shape, dt):
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dt, vma=vma)
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    nt = s // blk
+    tile_d = pl.BlockSpec((1, blk, d), lambda b, i, j: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, blk, d), lambda b, i, j: (b, j, 0))
+    tile_1 = pl.BlockSpec((1, blk, 1), lambda b, i, j: (b, i, 0))
+    if return_stats:
+        out_specs = [tile_d, tile_1, tile_1]
+        out_shape = [sds((bh, s, d), jnp.float32),
+                     sds((bh, s, 1), jnp.float32),
+                     sds((bh, s, 1), jnp.float32)]
+    else:
+        out_specs = tile_d
+        out_shape = sds((bh, s, d), qf.dtype)
+    return pl.pallas_call(
+        _make_kernel(blk, causal, qf.dtype, return_stats),
+        grid=(bh, nt, nt),
+        in_specs=[tile_d, kv_spec, kv_spec],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((blk, 1), jnp.float32),   # running max m
+            pltpu.VMEM((blk, 1), jnp.float32),   # normalizer l
+            pltpu.VMEM((blk, d), jnp.float32),   # un-normalized output
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf)
+
+
 @functools.partial(jax.jit, static_argnums=(3, 4))
 def _flash_forward(q, k, v, causal: bool, blk: int):
     """[B, S, H, D] -> [B, S, H, D] via the tiled kernel."""
@@ -128,36 +275,18 @@ def _flash_forward(q, k, v, causal: bool, blk: int):
         # the exact dense path instead
         return dense_attention(q, k, v, causal=False)
 
-    qf, kf, vf = prep(q), prep(k), prep(v)
-    nq = s_pad // blk
-    grid = (b * h, nq, nq)
-    out = pl.pallas_call(
-        _make_kernel(blk, causal, q.dtype),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, blk, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, blk, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, blk, d), lambda bh, i, j: (bh, j, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, blk, d), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s_pad, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((blk, 1), jnp.float32),   # running max m
-            pltpu.VMEM((blk, 1), jnp.float32),   # normalizer l
-            pltpu.VMEM((blk, d), jnp.float32),   # un-normalized output
-        ],
-        interpret=_interpret(),
-    )(qf, kf, vf)
+    out = _flash_call(prep(q), prep(k), prep(v), causal, blk,
+                      return_stats=False)
     return out.reshape(b, h, s_pad, d).transpose(0, 2, 1, 3)[:, :s]
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4))
 def _flash_stats(q, k, v, causal: bool, blk: int):
     """Raw softmax partials for cross-block merging (the ring SP
-    composition, ring_attention.ring_flash_attention): returns
-    (acc [B,S,H,D] un-normalized f32, m [B,S,H,1], l [B,S,H,1]).
-    Requires S % blk == 0 (callers fall back to XLA blocks otherwise).
-    """
+    composition, ring_attention.ring_flash_attention) and for the
+    backward's O(S) residuals: returns (acc [B,S,H,D] un-normalized
+    f32, m [B,S,H,1], l [B,S,H,1]). Requires S % blk == 0 (callers
+    fall back to XLA paths otherwise)."""
     b, s, h, d = q.shape
     if s % blk or k.shape[1] != s:
         raise ValueError(f"_flash_stats needs S % {blk} == 0, got {s}")
@@ -165,40 +294,8 @@ def _flash_stats(q, k, v, causal: bool, blk: int):
     def prep(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
-    qf, kf, vf = prep(q), prep(k), prep(v)
-    try:
-        vma = jax.typeof(qf).vma
-    except (AttributeError, TypeError):
-        vma = None
-    _sds = (
-        (lambda shape: jax.ShapeDtypeStruct(shape, jnp.float32, vma=vma))
-        if vma else (lambda shape: jax.ShapeDtypeStruct(shape, jnp.float32))
-    )
-    nq = s // blk
-    grid = (b * h, nq, nq)
-    acc, m, l = pl.pallas_call(
-        _make_kernel(blk, causal, q.dtype, return_stats=True),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, blk, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, blk, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, blk, d), lambda bh, i, j: (bh, j, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, blk, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, blk, 1), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, blk, 1), lambda bh, i, j: (bh, i, 0)),
-        ],
-        out_shape=[
-            _sds((b * h, s, d)), _sds((b * h, s, 1)), _sds((b * h, s, 1)),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((blk, 1), jnp.float32),
-            pltpu.VMEM((blk, 1), jnp.float32),
-            pltpu.VMEM((blk, d), jnp.float32),
-        ],
-        interpret=_interpret(),
-    )(qf, kf, vf)
+    acc, m, l = _flash_call(prep(q), prep(k), prep(v), causal, blk,
+                            return_stats=True)
 
     def un(x):
         return x.reshape(b, h, s, -1).transpose(0, 2, 1, 3)
@@ -206,22 +303,88 @@ def _flash_stats(q, k, v, causal: bool, blk: int):
     return un(acc), un(m), un(l)
 
 
+@functools.partial(jax.jit, static_argnums=(7, 8))
+def _flash_backward(q, k, v, o, m, l, do, causal: bool, blk: int):
+    """O(S·blk) backward: (dq, dk, dv) from the forward residuals.
+    Layouts as _flash_stats ([B, S, H, ...])."""
+    b, s, h, d = q.shape
+
+    def prep(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, x.shape[-1])
+
+    qf, kf, vf, dof, mf, lf = map(prep, (q, k, v, do, m, l))
+    # delta_i = rowsum(do * o): the only O(S) precomputation
+    dlt = prep(jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32),
+        axis=-1, keepdims=True,
+    ))
+    nt = s // blk
+    tile_d = lambda: pl.BlockSpec((1, blk, d), lambda bh, a, b_: (bh, a, 0))
+    tile_d_b = lambda: pl.BlockSpec((1, blk, d), lambda bh, a, b_: (bh, b_, 0))
+    tile_1 = lambda: pl.BlockSpec((1, blk, 1), lambda bh, a, b_: (bh, a, 0))
+    tile_1_b = lambda: pl.BlockSpec((1, blk, 1), lambda bh, a, b_: (bh, b_, 0))
+    scr = lambda w: pltpu.VMEM((blk, w), jnp.float32)
+
+    dq = pl.pallas_call(
+        _make_dq_kernel(blk, causal, q.dtype),
+        grid=(b * h, nt, nt),
+        # q/do/m/l/dlt indexed by the q-tile (2nd grid dim); k/v by
+        # the inner jk dim
+        in_specs=[tile_d(), tile_d_b(), tile_d_b(), tile_d(),
+                  tile_1(), tile_1(), tile_1()],
+        out_specs=tile_d(),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[scr(d)],
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, mf, lf, dlt)
+
+    dk, dv = pl.pallas_call(
+        _make_dkv_kernel(blk, causal, q.dtype),
+        grid=(b * h, nt, nt),
+        # k/v indexed by the k-tile (2nd grid dim); q/do/m/l/dlt by
+        # the inner iq dim
+        in_specs=[tile_d_b(), tile_d(), tile_d(), tile_d_b(),
+                  tile_1_b(), tile_1_b(), tile_1_b()],
+        out_specs=[tile_d(), tile_d()],
+        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, s, d), v.dtype)],
+        scratch_shapes=[scr(d), scr(d)],
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, mf, lf, dlt)
+
+    def un(x):
+        return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    return un(dq), un(dk), un(dv)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def flash_attention(q, k, v, causal: bool = False):
-    """Tiled attention forward on the MXU; O(S·blk) forward memory."""
+    """Tiled attention on the MXU; O(S·blk) memory forward AND backward
+    (the backward kernels recompute tile probabilities from the saved
+    softmax statistics)."""
     return _flash_forward(q, k, v, causal, _BLK)
 
 
 def _fwd(q, k, v, causal):
-    return flash_attention(q, k, v, causal), (q, k, v)
+    s = q.shape[1]
+    if s % _BLK or k.shape[1] != s:
+        # ragged: kernel forward where legal (causal), dense backward —
+        # see the module docstring's ragged-shapes paragraph
+        return flash_attention(q, k, v, causal), (q, k, v, None, None, None)
+    acc, m, l = _flash_stats(q, k, v, causal, _BLK)
+    o = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    return o, (q, k, v, o, m, l)
 
 
 def _bwd(causal, res, g):
-    # dense recompute in XLA (documented O(S^2) backward)
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: dense_attention(q_, k_, v_, causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, o, m, l = res
+    if o is None:
+        # dense recompute in XLA (ragged shapes only)
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: dense_attention(q_, k_, v_, causal), q, k, v)
+        return vjp(g)
+    return _flash_backward(q, k, v, o, m, l, g, causal, _BLK)
 
 
 flash_attention.defvjp(_fwd, _bwd)
