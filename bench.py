@@ -239,21 +239,40 @@ def bench_flash_attention(s: int = 4096, b: int = 4, h: int = 8,
     f_dense = jax.jit(lambda a, b_, c: ra.attention(a, b_, c, causal=True))
     row = {"config": "flash_attention", "shape": f"[{b},{s},{h},{d}] causal f32"}
     n_disp = 8
-    for name, f in (("flash", f_flash), ("dense", f_dense)):
-        out = np.asarray(f(q, k, v))  # compile + first run
+
+    def timed(f, fetch):
+        """Median per-dispatch wall over ``repeats`` chains of
+        ``n_disp`` dispatches, fetching only the last output (the 33 MB
+        result transfer through the tunnel would otherwise swamp the
+        device time being measured); ``fetch`` picks the array to
+        block on."""
+        np.asarray(fetch(f(q, k, v)))  # compile + first run
         walls = []
         for _ in range(max(1, repeats)):
-            # dispatch a chain and fetch only the last output: the
-            # 33 MB result transfer through the tunnel would otherwise
-            # swamp the device time being measured
             t0 = time.time()
             outs = [f(q, k, v) for _ in range(n_disp)]
-            np.asarray(outs[-1])
+            np.asarray(fetch(outs[-1]))
             walls.append((time.time() - t0) / n_disp)
-        row[f"{name}_wall_s"] = round(statistics.median(walls), 4)
+        return round(statistics.median(walls), 4)
+
+    row["flash_wall_s"] = timed(f_flash, lambda o: o)
+    row["dense_wall_s"] = timed(f_dense, lambda o: o)
     row["speedup"] = round(row["dense_wall_s"] / row["flash_wall_s"], 2)
     row["max_abs_diff"] = float(np.max(np.abs(
         np.asarray(f_flash(q, k, v)) - np.asarray(f_dense(q, k, v)))))
+    # backward (training) path: the O(S) Pallas backward vs dense VJP
+    import jax.numpy as jnp
+
+    g_flash = jax.jit(jax.grad(
+        lambda a, b_, c: jnp.sum(fa.flash_attention(a, b_, c, True) ** 2),
+        argnums=(0, 1, 2)))
+    g_dense = jax.jit(jax.grad(
+        lambda a, b_, c: jnp.sum(ra.attention(a, b_, c, causal=True) ** 2),
+        argnums=(0, 1, 2)))
+    row["flash_grad_wall_s"] = timed(g_flash, lambda o: o[0])
+    row["dense_grad_wall_s"] = timed(g_dense, lambda o: o[0])
+    row["grad_speedup"] = round(
+        row["dense_grad_wall_s"] / row["flash_grad_wall_s"], 2)
     # max-context probe: S=16384, [2,S,8,64] (distinct random q/k/v —
     # identical tensors would make the softmax degenerately peaked)
     rng2 = np.random.RandomState(1)

@@ -44,6 +44,22 @@ def test_four_process_sync_dp():
         assert "Batch:   8 of   8," in w, w[-2000:]
 
 
+def test_three_process_reference_topology():
+    """The reference's exact worker count — 3 training processes
+    (example.py:24-26's three workers, minus the ps SPMD eliminates) —
+    over the localhost coordinator."""
+    outs = run_all(3, 1, [
+        "--training_epochs=1", "--batch_size=48", "--frequency=2",
+        "--synthetic_train_size=384", "--synthetic_test_size=96",
+    ])
+    chief, *workers = outs
+    assert "Test-Accuracy:" in chief and "done" in chief, chief[-2000:]
+    # 384 examples / 3 procs / 16-per-proc batch = 8 steps per process
+    assert "Batch:   8 of   8," in chief, chief[-2000:]
+    for w in workers:
+        assert "Test-Accuracy:" not in w
+
+
 def test_tensor_parallel_across_processes():
     """mp=2 across 2 single-device processes: the Megatron row-split
     psum in every forward/backward crosses the process boundary."""
