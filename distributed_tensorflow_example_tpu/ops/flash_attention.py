@@ -16,9 +16,11 @@ Beyond-reference capability (the reference has no attention at all,
 /root/reference/example.py:84-90; SURVEY.md §5).
 
 Causal masking is by global position. Fully-masked (future) k tiles
-reduce to arithmetic no-ops (``m_blk = NEG_INF`` leaves every
-accumulator unchanged), so correctness needs no per-tile control flow;
-the wasted half of the causal grid is accepted for simplicity.
+are skipped outright with ``pl.when`` (their online update would be an
+arithmetic no-op — ``m_blk = NEG_INF`` leaves every accumulator
+unchanged — so skipping is purely a ~2x MXU saving, not a correctness
+requirement); the backward kernels skip their off-frontier tiles the
+same way.
 
 Training: ``flash_attention`` carries a ``jax.custom_vjp`` whose
 backward is ALSO tiled Pallas (``_make_dq_kernel`` /
@@ -78,23 +80,33 @@ def _make_kernel(blk: int, causal: bool, compute_dtype,
             l_scr[...] = jnp.zeros_like(l_scr[...])
             acc_scr[...] = jnp.zeros_like(acc_scr[...])
 
-        q = q_ref[0].astype(compute_dtype)         # [blk, d]
-        k = k_ref[0].astype(compute_dtype)
-        v = v_ref[0].astype(compute_dtype)
-        s = _tile_scores(q, k, iq, j, blk, causal)
-        m = m_scr[...]
-        m_blk = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m, m_blk)
-        p = jnp.exp(s - m_new)
-        # fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
-        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
-        alpha = jnp.exp(m - m_new)
-        m_scr[...] = m_new
-        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            p.astype(compute_dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        # under causal masking, k tiles past the q tile's frontier are
+        # arithmetic no-ops — skip their matmuls outright (`causal` is
+        # Python-static: non-causal kernels get no conditional at all)
+        def _compute():
+            q = q_ref[0].astype(compute_dtype)     # [blk, d]
+            k = k_ref[0].astype(compute_dtype)
+            v = v_ref[0].astype(compute_dtype)
+            s = _tile_scores(q, k, iq, j, blk, causal)
+            m = m_scr[...]
+            m_blk = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m, m_blk)
+            p = jnp.exp(s - m_new)
+            # fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
+            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+            alpha = jnp.exp(m - m_new)
+            m_scr[...] = m_new
+            l_scr[...] = l_scr[...] * alpha + jnp.sum(
+                p, axis=-1, keepdims=True)
+            acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+                p.astype(compute_dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        if causal:
+            pl.when(j <= iq)(_compute)
+        else:
+            _compute()
 
         @pl.when(j == nk - 1)
         def _finalize():
@@ -158,17 +170,23 @@ def _make_dq_kernel(blk: int, causal: bool, compute_dtype):
         def _init():
             dq_scr[...] = jnp.zeros_like(dq_scr[...])
 
-        k = k_ref[0].astype(compute_dtype)
-        _, ds, scale = _bwd_tile(
-            q_ref[0].astype(compute_dtype), k,
-            v_ref[0].astype(compute_dtype),
-            do_ref[0].astype(compute_dtype),
-            m_ref[0], l_ref[0], dlt_ref[0], iq, j, blk, causal,
-        )
-        dq_scr[...] += jax.lax.dot_general(       # ds @ k
-            ds.astype(compute_dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
+        def _compute():
+            k = k_ref[0].astype(compute_dtype)
+            _, ds, scale = _bwd_tile(
+                q_ref[0].astype(compute_dtype), k,
+                v_ref[0].astype(compute_dtype),
+                do_ref[0].astype(compute_dtype),
+                m_ref[0], l_ref[0], dlt_ref[0], iq, j, blk, causal,
+            )
+            dq_scr[...] += jax.lax.dot_general(   # ds @ k
+                ds.astype(compute_dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+
+        if causal:  # skip k tiles past the causal frontier
+            pl.when(j <= iq)(_compute)
+        else:
+            _compute()
 
         @pl.when(j == nk - 1)
         def _finalize():
@@ -192,21 +210,27 @@ def _make_dkv_kernel(blk: int, causal: bool, compute_dtype):
             dk_scr[...] = jnp.zeros_like(dk_scr[...])
             dv_scr[...] = jnp.zeros_like(dv_scr[...])
 
-        q = q_ref[0].astype(compute_dtype)
-        do = do_ref[0].astype(compute_dtype)
-        p, ds, scale = _bwd_tile(
-            q, k_ref[0].astype(compute_dtype),
-            v_ref[0].astype(compute_dtype), do,
-            m_ref[0], l_ref[0], dlt_ref[0], i, j, blk, causal,
-        )
-        dv_scr[...] += jax.lax.dot_general(       # p^T @ do
-            p.astype(compute_dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dk_scr[...] += jax.lax.dot_general(       # ds^T @ q
-            ds.astype(compute_dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
+        def _compute():
+            q = q_ref[0].astype(compute_dtype)
+            do = do_ref[0].astype(compute_dtype)
+            p, ds, scale = _bwd_tile(
+                q, k_ref[0].astype(compute_dtype),
+                v_ref[0].astype(compute_dtype), do,
+                m_ref[0], l_ref[0], dlt_ref[0], i, j, blk, causal,
+            )
+            dv_scr[...] += jax.lax.dot_general(   # p^T @ do
+                p.astype(compute_dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dk_scr[...] += jax.lax.dot_general(   # ds^T @ q
+                ds.astype(compute_dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+
+        if causal:  # q tiles before this k tile see none of its keys
+            pl.when(i >= j)(_compute)
+        else:
+            _compute()
 
         @pl.when(i == nq - 1)
         def _finalize():
