@@ -327,6 +327,58 @@ def _flash_stats(q, k, v, causal: bool, blk: int):
     return un(acc), un(m), un(l)
 
 
+def _flash_backward_flat(qf, kf, vf, dof, mf, lf, dlt, causal: bool,
+                         blk: int, compute_dtype):
+    """Pallas backward on pre-flattened [BH, S, ...] operands with a
+    precomputed ``dlt`` (rowsum(do*o)); returns FLAT f32 (dq, dk, dv)
+    so callers that accumulate across blocks (the ring VJP) never
+    quantize partials to the input dtype."""
+    bh, s, d = qf.shape
+    try:
+        vma = jax.typeof(qf).vma
+    except (AttributeError, TypeError):
+        vma = None
+
+    def sds():
+        if vma:
+            return jax.ShapeDtypeStruct((bh, s, d), jnp.float32, vma=vma)
+        return jax.ShapeDtypeStruct((bh, s, d), jnp.float32)
+
+    nt = s // blk
+    tile_d = lambda: pl.BlockSpec((1, blk, d), lambda b_h, a, b_: (b_h, a, 0))
+    tile_d_b = lambda: pl.BlockSpec((1, blk, d), lambda b_h, a, b_: (b_h, b_, 0))
+    tile_1 = lambda: pl.BlockSpec((1, blk, 1), lambda b_h, a, b_: (b_h, a, 0))
+    tile_1_b = lambda: pl.BlockSpec((1, blk, 1), lambda b_h, a, b_: (b_h, b_, 0))
+    scr = lambda w: pltpu.VMEM((blk, w), jnp.float32)
+
+    dq = pl.pallas_call(
+        _make_dq_kernel(blk, causal, compute_dtype),
+        grid=(bh, nt, nt),
+        # q/do/m/l/dlt indexed by the q-tile (2nd grid dim); k/v by
+        # the inner jk dim
+        in_specs=[tile_d(), tile_d_b(), tile_d_b(), tile_d(),
+                  tile_1(), tile_1(), tile_1()],
+        out_specs=tile_d(),
+        out_shape=sds(),
+        scratch_shapes=[scr(d)],
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, mf, lf, dlt)
+
+    dk, dv = pl.pallas_call(
+        _make_dkv_kernel(blk, causal, compute_dtype),
+        grid=(bh, nt, nt),
+        # k/v indexed by the k-tile (2nd grid dim); q/do/m/l/dlt by
+        # the inner iq dim
+        in_specs=[tile_d_b(), tile_d(), tile_d(), tile_d_b(),
+                  tile_1_b(), tile_1_b(), tile_1_b()],
+        out_specs=[tile_d(), tile_d()],
+        out_shape=[sds(), sds()],
+        scratch_shapes=[scr(d), scr(d)],
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, mf, lf, dlt)
+    return dq, dk, dv
+
+
 @functools.partial(jax.jit, static_argnums=(7, 8))
 def _flash_backward(q, k, v, o, m, l, do, causal: bool, blk: int):
     """O(S·blk) backward: (dq, dk, dv) from the forward residuals.
@@ -342,44 +394,13 @@ def _flash_backward(q, k, v, o, m, l, do, causal: bool, blk: int):
         do.astype(jnp.float32) * o.astype(jnp.float32),
         axis=-1, keepdims=True,
     ))
-    nt = s // blk
-    tile_d = lambda: pl.BlockSpec((1, blk, d), lambda bh, a, b_: (bh, a, 0))
-    tile_d_b = lambda: pl.BlockSpec((1, blk, d), lambda bh, a, b_: (bh, b_, 0))
-    tile_1 = lambda: pl.BlockSpec((1, blk, 1), lambda bh, a, b_: (bh, a, 0))
-    tile_1_b = lambda: pl.BlockSpec((1, blk, 1), lambda bh, a, b_: (bh, b_, 0))
-    scr = lambda w: pltpu.VMEM((blk, w), jnp.float32)
+    dq, dk, dv = _flash_backward_flat(
+        qf, kf, vf, dof, mf, lf, dlt, causal, blk, q.dtype)
 
-    dq = pl.pallas_call(
-        _make_dq_kernel(blk, causal, q.dtype),
-        grid=(b * h, nt, nt),
-        # q/do/m/l/dlt indexed by the q-tile (2nd grid dim); k/v by
-        # the inner jk dim
-        in_specs=[tile_d(), tile_d_b(), tile_d_b(), tile_d(),
-                  tile_1(), tile_1(), tile_1()],
-        out_specs=tile_d(),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-        scratch_shapes=[scr(d)],
-        interpret=_interpret(),
-    )(qf, kf, vf, dof, mf, lf, dlt)
+    def un(x, dt):
+        return x.reshape(b, h, s, d).transpose(0, 2, 1, 3).astype(dt)
 
-    dk, dv = pl.pallas_call(
-        _make_dkv_kernel(blk, causal, q.dtype),
-        grid=(b * h, nt, nt),
-        # k/v indexed by the k-tile (2nd grid dim); q/do/m/l/dlt by
-        # the inner iq dim
-        in_specs=[tile_d_b(), tile_d(), tile_d(), tile_d_b(),
-                  tile_1_b(), tile_1_b(), tile_1_b()],
-        out_specs=[tile_d(), tile_d()],
-        out_shape=[jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
-                   jax.ShapeDtypeStruct((b * h, s, d), v.dtype)],
-        scratch_shapes=[scr(d), scr(d)],
-        interpret=_interpret(),
-    )(qf, kf, vf, dof, mf, lf, dlt)
-
-    def un(x):
-        return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
-
-    return un(dq), un(dk), un(dv)
+    return un(dq, q.dtype), un(dk, k.dtype), un(dv, v.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))

@@ -100,7 +100,14 @@ def _block(q, k, v, m, l, o, q_off, k_off, causal: bool):
 def _lift_varying(x, axis_name: str):
     """Declare an axis-invariant constant varying over ``axis_name`` —
     ring loop carries start as invariant zeros but are rebound to
-    q-dependent (varying) values, and the carry types must match."""
+    q-dependent (varying) values, and the carry types must match.
+    Idempotent: values already varying (e.g. zeros_like of a varying
+    input) pass through."""
+    try:
+        if axis_name in jax.typeof(x).vma:
+            return x
+    except (AttributeError, TypeError):
+        pass
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axis_name, to="varying")
     return jax.lax.pvary(x, axis_name)  # older JAX
@@ -181,25 +188,36 @@ def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
     are skipped outright (no kernel launch, no wasted MXU work —
     unlike single-chip flash where masked tiles still execute).
 
-    Forward/inference path (the Pallas stats kernel has no VJP); train
-    with ``ring_attention``, which is differentiable. On CPU backends
-    (and local blocks not divisible by the 256 tile) this delegates to
-    ``ring_attention`` — identical math, XLA blocks.
+    On CPU backends (and local blocks not divisible by the 256 tile)
+    this delegates to ``ring_attention`` — identical math, XLA blocks,
+    differentiable by autodiff.
 
     ``stats_fn(q, k, v, causal) -> (acc, m, l)`` overrides the block
     backend (tests inject an XLA implementation so the ring/branch/
     merge machinery is exercised on the CPU mesh, where interpret-mode
-    Pallas cannot run inside shard_map).
+    Pallas cannot run inside shard_map); the stats_fn path is
+    forward-only.
+
+    Training: the kernel path is differentiable — its custom VJP
+    (``_rf_bwd``) runs a second ring pass in which each k/v block
+    travels WITH its gradient accumulators, every shard adding its
+    block-pair contribution via the flash backward kernels
+    (O(L·blk) per pair, no [L, L] scores).
     """
     from . import flash_attention as fa
 
     lq = q.shape[1]
-    if stats_fn is None:
-        if fa._interpret() or lq % fa._BLK or k.shape[1] != lq:
-            return ring_attention(q, k, v, axis_name, causal)
-        stats_fn = lambda q_, k_, v_, c: fa._flash_stats(
-            q_, k_, v_, c, fa._BLK)
+    if stats_fn is not None:
+        return _ring_flash_impl(q, k, v, axis_name, causal, stats_fn)[0]
+    if fa._interpret() or lq % fa._BLK or k.shape[1] != lq:
+        return ring_attention(q, k, v, axis_name, causal)
+    return _ring_flash_diff(q, k, v, axis_name, causal)
 
+
+def _ring_flash_impl(q, k, v, axis_name: str, causal: bool, stats_fn):
+    """The forward ring loop; returns (o, m, l) — the normalized output
+    plus its softmax statistics (the custom VJP's residuals)."""
+    lq = q.shape[1]
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, _, h, d = q.shape
@@ -236,4 +254,100 @@ def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
         return k_t, v_t, m_, l_, o_
 
     _, _, m, l, o = jax.lax.fori_loop(0, n, step, (k, v, m, l, o))
-    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype), m, l
+
+
+def _rotate_always(tree, axis_name: str, n):
+    """One ring rotation of every leaf (the backward pass rotates all n
+    steps so traveling accumulators arrive back home)."""
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    return jax.tree.map(
+        functools.partial(jax.lax.ppermute, axis_name=axis_name, perm=perm),
+        tree,
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_flash_diff(q, k, v, axis_name: str, causal: bool):
+    from . import flash_attention as fa
+
+    stats_fn = lambda q_, k_, v_, c: fa._flash_stats(q_, k_, v_, c, fa._BLK)
+    return _ring_flash_impl(q, k, v, axis_name, causal, stats_fn)[0]
+
+
+def _rf_fwd(q, k, v, axis_name, causal):
+    from . import flash_attention as fa
+
+    stats_fn = lambda q_, k_, v_, c: fa._flash_stats(q_, k_, v_, c, fa._BLK)
+    o, m, l = _ring_flash_impl(q, k, v, axis_name, causal, stats_fn)
+    return o, (q, k, v, o, m, l)
+
+
+def _rf_bwd(axis_name, causal, res, do):
+    """The backward ring: k/v blocks travel the ring again, this time
+    carrying their dk/dv accumulators; each shard adds its (q block x
+    visiting block) contribution with the flash backward kernels and
+    accumulates dq locally. The accumulators rotate on every step (n
+    rotations bring them home); the k/v blocks skip the final, dead
+    rotation. All ring traffic and accumulation run in the kernels'
+    flat [BH, L, ...] layout with the loop-invariant prologue (layout
+    transposes and the dlt = rowsum(do*o) reduction) hoisted out of
+    the loop, and partials stay f32 end to end."""
+    from . import flash_attention as fa
+
+    q, k, v, o, m, l = res
+    b, lq, h, d = q.shape
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+
+    def prep(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, lq, x.shape[-1])
+
+    qf, dof, mf, lf = map(prep, (q, do, m, l))
+    dlt = prep(jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32),
+        axis=-1, keepdims=True,
+    ))
+    kf, vf = prep(k), prep(v)
+    zeros = lambda: _lift_varying(
+        jnp.zeros((b * h, lq, d), jnp.float32), axis_name)
+    dq0, dk0, dv0 = zeros(), zeros(), zeros()
+
+    def step(t, carry):
+        k_t, v_t, dk_t, dv_t, dq_ = carry
+        rel = (idx - t) % n
+
+        def contrib(block_causal):
+            def go(args):
+                dk0_, dv0_, dq0_ = args
+                dqp, dkp, dvp = fa._flash_backward_flat(
+                    qf, k_t, v_t, dof, mf, lf, dlt, block_causal,
+                    fa._BLK, q.dtype,
+                )
+                return dk0_ + dkp, dv0_ + dvp, dq0_ + dqp
+
+            return go
+
+        if causal:
+            branch = jnp.where(rel > idx, 0, jnp.where(rel == idx, 1, 2))
+            dk_t, dv_t, dq_ = jax.lax.switch(
+                branch,
+                [lambda args: args, contrib(True), contrib(False)],
+                (dk_t, dv_t, dq_),
+            )
+        else:
+            dk_t, dv_t, dq_ = contrib(False)((dk_t, dv_t, dq_))
+        k_t, v_t = _rotate_unless_last((k_t, v_t), t, n, axis_name)
+        dk_t, dv_t = _rotate_always((dk_t, dv_t), axis_name, n)
+        return k_t, v_t, dk_t, dv_t, dq_
+
+    _, _, dk, dv, dq = jax.lax.fori_loop(
+        0, n, step, (kf, vf, dk0, dv0, dq0))
+
+    def un(x, dt):
+        return x.reshape(b, h, lq, d).transpose(0, 2, 1, 3).astype(dt)
+
+    return un(dq, q.dtype), un(dk, k.dtype), un(dv, v.dtype)
+
+
+_ring_flash_diff.defvjp(_rf_fwd, _rf_bwd)
