@@ -38,7 +38,9 @@ non-causal ragged runs exact dense XLA in BOTH directions (padded keys
 would corrupt real rows); causal ragged keeps the O(S·blk) kernel
 FORWARD (padded keys sit in every real row's causal future) but takes
 the dense O(S²) backward — pad or trim S to a tile multiple when
-training causal long-context at ragged lengths.
+training causal long-context at ragged lengths. Cross-length q/k
+(``k.shape[1] != q.shape[1]``) always delegates to the dense path,
+which supports it non-causally and rejects it causally.
 
 On non-TPU backends the kernels run in Pallas interpret mode, so the
 CPU test suite exercises the same code paths bit-for-bit.
@@ -292,12 +294,16 @@ def _flash_forward(q, k, v, causal: bool, blk: int):
         x = jnp.pad(x, ((0, 0), (0, s_pad - s), (0, 0), (0, 0)))
         return x.transpose(0, 2, 1, 3).reshape(b * h, s_pad, d)
 
-    if s_pad != s and not causal:
-        # padded q rows are sliced off, and under causal masking padded
-        # KEYS sit strictly in every real row's future — but non-causal
-        # ragged shapes would let padded keys contribute, so they take
-        # the exact dense path instead
-        return dense_attention(q, k, v, causal=False)
+    if k.shape[1] != s or (s_pad != s and not causal):
+        # Two dense-fallback cases: (1) cross-length q/k — the kernel's
+        # tiling assumes square [S, S] score geometry, and
+        # dense_attention handles unequal lengths (causal cross-length
+        # is rejected there with a clear error rather than a confusing
+        # reshape failure here); (2) non-causal ragged S — padded q
+        # rows are sliced off, and under causal masking padded KEYS sit
+        # strictly in every real row's future, but non-causal ragged
+        # shapes would let padded keys contribute.
+        return dense_attention(q, k, v, causal=causal)
 
     out = _flash_call(prep(q), prep(k), prep(v), causal, blk,
                       return_stats=False)

@@ -54,13 +54,21 @@ def attention(q, k, v, causal: bool = False):
     """Plain softmax attention, single device. [B, S, H, D] layout.
 
     The oracle for the ring version; also usable directly for short
-    sequences.
+    sequences. Unequal q/k lengths are supported non-causally; under
+    ``causal=True`` they are rejected (a top-left-aligned tril would
+    silently assume q position i aligns with k position i, which is
+    not the conventional bottom-right alignment).
     """
     scale = 1.0 / np.sqrt(q.shape[-1])
     # [B, H, Sq, Sk]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         sq, sk = scores.shape[-2], scores.shape[-1]
+        if sq != sk:
+            raise ValueError(
+                f"causal attention requires equal q/k lengths, got "
+                f"sq={sq}, sk={sk}"
+            )
         mask = jnp.tril(jnp.ones((sq, sk), bool))
         scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)

@@ -122,3 +122,30 @@ def test_grads_match_dense():
             np.asarray(gf), np.asarray(gd), rtol=5e-4, atol=5e-5,
             err_msg=name,
         )
+
+
+def test_cross_length_falls_back_to_dense():
+    """k shorter than q (non-causal): the kernel cannot tile the
+    rectangular score geometry, so the dense path must be taken — and
+    be exact (ADVICE r2: this used to die in prep() with a reshape
+    error)."""
+    rng = np.random.RandomState(11)
+    q = rng.randn(2, 512, 2, 8).astype(np.float32)
+    k = rng.randn(2, 256, 2, 8).astype(np.float32)
+    v = rng.randn(2, 256, 2, 8).astype(np.float32)
+    want = np.asarray(ra.attention(q, k, v, causal=False))
+    got = np.asarray(fa.flash_attention(q, k, v, False))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_cross_length_causal_rejected():
+    """Causal cross-length has no conventional alignment here; it must
+    raise a clear ValueError, not a reshape failure (ADVICE r2)."""
+    rng = np.random.RandomState(12)
+    q = rng.randn(2, 512, 2, 8).astype(np.float32)
+    k = rng.randn(2, 256, 2, 8).astype(np.float32)
+    v = rng.randn(2, 256, 2, 8).astype(np.float32)
+    with pytest.raises(ValueError, match="equal q/k lengths"):
+        fa.flash_attention(q, k, v, True)
+    with pytest.raises(ValueError, match="equal q/k lengths"):
+        ra.attention(q, k, v, causal=True)
