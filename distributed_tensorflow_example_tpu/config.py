@@ -96,6 +96,9 @@ class Config:
     summaries: bool = True
     summaries_all_hosts: bool = False   # reference logs on every machine
                                         # (example.py:145-146); chief-only default
+    eval_all_hosts: bool = False        # reference prints the final eval on
+                                        # every worker (example.py:177);
+                                        # chief-only default
     profile: bool = False               # jax.profiler trace into logs_path
     debug_nans: bool = False
 
@@ -183,6 +186,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no_shard_data", dest="shard_data", action="store_false")
     p.add_argument("--no_summaries", dest="summaries", action="store_false")
     p.add_argument("--summaries_all_hosts", action="store_true")
+    p.add_argument("--eval_all_hosts", action="store_true",
+                   help="print Test-Accuracy on every process, as the "
+                        "reference's per-worker final eval does")
     p.add_argument("--profile", action="store_true")
     p.add_argument("--debug_nans", action="store_true")
     p.add_argument("--checkpoint_dir", type=str, default=d.checkpoint_dir)

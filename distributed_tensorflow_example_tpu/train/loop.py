@@ -189,6 +189,15 @@ def run(cfg: Config) -> Dict[str, Any]:
     writer = None
     if cfg.summaries and (chief or cfg.summaries_all_hosts):
         writer = SummaryWriter(cfg.logs_path)  # example.py:145-146
+        # the reference attaches its graph to the event log
+        # (FileWriter(logs_path, graph=..., example.py:146)); write the
+        # equivalent GraphDef record so TB's Graphs tab is populated
+        from ..utils.summary import mlp_graph_nodes
+
+        writer.add_graph(mlp_graph_nodes(
+            cfg.input_size, tuple(cfg.hidden_sizes), cfg.num_classes,
+            cfg.activation, optimizer=cfg.optimizer,
+        ))
 
     if cfg.profile and chief:
         jax.profiler.start_trace(cfg.logs_path + "/profile")
@@ -462,8 +471,13 @@ def run(cfg: Config) -> Dict[str, Any]:
             )
     total_time = time.time() - begin_time
     cost = float(cost)
-    if chief:
+    # the reference runs + prints the final eval on EVERY worker
+    # (example.py:177); chief-only by default here, with
+    # --eval_all_hosts mirroring the reference behavior the same way
+    # --summaries_all_hosts mirrors per-machine logging
+    if chief or cfg.eval_all_hosts:
         print("Test-Accuracy: %2.2f" % test_acc)          # example.py:177
+    if chief:
         print("Total Time: %3.2fs" % float(total_time))   # example.py:178
         print("Final Cost: %.4f" % cost)                  # example.py:179
 

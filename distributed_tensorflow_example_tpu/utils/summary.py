@@ -80,18 +80,78 @@ def encode_scalar_summary(values: dict[str, float]) -> bytes:
     return out
 
 
+def encode_node_def(name: str, op: str, inputs: tuple[str, ...] = ()) -> bytes:
+    """NodeDef{ name=1, op=2, repeated input=3 } (node_def.proto)."""
+    msg = _bytes_field(1, name.encode()) + _bytes_field(2, op.encode())
+    for inp in inputs:
+        msg += _bytes_field(3, inp.encode())
+    return msg
+
+
+def encode_graph_def(nodes) -> bytes:
+    """GraphDef{ repeated node=1, versions=4{producer=1} } from
+    (name, op, inputs) triples (graph.proto)."""
+    out = b"".join(_bytes_field(1, encode_node_def(*n)) for n in nodes)
+    out += _bytes_field(4, _int64_field(1, 27))  # VersionDef.producer
+    return out
+
+
+def mlp_graph_nodes(input_size: int, hidden_sizes, num_classes: int,
+                    activation: str, optimizer: str = "sgd"):
+    """The training graph as (name, op, inputs) triples, mirroring the
+    reference's graph build (/root/reference/example.py:60-129: x/y_
+    placeholders, W/b variables, MatMul+Add+activation per layer,
+    Softmax output, cross_entropy, accuracy, the optimizer's apply op
+    and global_step) so the TensorBoard Graphs tab shows the same
+    structure the reference's ``FileWriter(logs_path, graph=...)``
+    (example.py:146) published."""
+    act_op = {"sigmoid": "Sigmoid", "relu": "Relu", "tanh": "Tanh",
+              "gelu": "Gelu"}.get(activation, activation.capitalize())
+    opt_op = {"sgd": "ApplyGradientDescent", "momentum": "ApplyMomentum",
+              "adam": "ApplyAdam"}.get(optimizer, "ApplyGradientDescent")
+    nodes = [
+        ("x", "Placeholder", ()),
+        ("y_", "Placeholder", ()),
+        ("global_step", "VariableV2", ()),
+    ]
+    sizes = (input_size, *tuple(hidden_sizes), num_classes)
+    prev = "x"
+    n_layers = len(sizes) - 1
+    for i in range(n_layers):
+        w, b = f"W{i + 1}", f"b{i + 1}"
+        nodes += [(w, "VariableV2", ()), (b, "VariableV2", ())]
+        mm, z = f"layer{i + 1}/MatMul", f"z{i + 2}"
+        nodes += [(mm, "MatMul", (prev, w)), (z, "Add", (mm, b))]
+        if i < n_layers - 1:
+            a = f"a{i + 2}"
+            nodes.append((a, act_op, (z,)))
+            prev = a
+        else:
+            nodes.append(("y", "Softmax", (z,)))
+    nodes += [
+        ("cross_entropy", "Mean", ("y", "y_")),
+        ("accuracy", "Mean", ("y", "y_")),
+        ("train", opt_op, ("cross_entropy", "global_step")),
+    ]
+    return nodes
+
+
 def encode_event(
     wall_time: float,
     step: int | None = None,
     file_version: str | None = None,
     scalars: dict[str, float] | None = None,
+    graph_def: bytes | None = None,
 ) -> bytes:
-    """Event{ wall_time=1(double), step=2(int64), file_version=3, summary=5 }."""
+    """Event{ wall_time=1(double), step=2(int64), file_version=3,
+    graph_def=4(bytes), summary=5 }."""
     msg = _double_field(1, wall_time)
     if step is not None:
         msg += _int64_field(2, step)
     if file_version is not None:
         msg += _bytes_field(3, file_version.encode())
+    if graph_def is not None:
+        msg += _bytes_field(4, graph_def)
     if scalars:
         msg += _bytes_field(5, encode_scalar_summary(scalars))
     return msg
@@ -128,6 +188,13 @@ class SummaryWriter:
     def add_scalars(self, step: int, values: dict[str, float]) -> None:
         """``writer.add_summary(summary, step)`` equivalent (example.py:163)."""
         self._write_event(encode_event(time.time(), step=step, scalars=values))
+
+    def add_graph(self, nodes) -> None:
+        """``FileWriter(logdir, graph=...)`` equivalent (example.py:146):
+        write the graph record TensorBoard's Graphs tab reads. ``nodes``
+        is a list of (name, op, inputs) triples (see mlp_graph_nodes)."""
+        self._write_event(encode_event(
+            time.time(), graph_def=encode_graph_def(nodes)))
 
     def flush(self) -> None:
         self._f.flush()
@@ -192,7 +259,8 @@ def read_event_file(path: str):
             raise ValueError("payload CRC mismatch")
         pos += 12 + length + 4
 
-        ev = {"wall_time": None, "step": None, "file_version": None, "scalars": {}}
+        ev = {"wall_time": None, "step": None, "file_version": None,
+              "scalars": {}, "graph_nodes": None}
         for field, _wire, val in _parse_fields(payload):
             if field == 1:
                 ev["wall_time"] = val
@@ -200,6 +268,21 @@ def read_event_file(path: str):
                 ev["step"] = val
             elif field == 3:
                 ev["file_version"] = val.decode()
+            elif field == 4:
+                nodes = []
+                for gfield, _gw, gval in _parse_fields(val):
+                    if gfield == 1:  # NodeDef
+                        name, op, inputs = None, None, []
+                        for nfield, _nw, nval in _parse_fields(gval):
+                            if nfield == 1:
+                                name = nval.decode()
+                            elif nfield == 2:
+                                op = nval.decode()
+                            elif nfield == 3:
+                                inputs.append(nval.decode())
+                        nodes.append(
+                            {"name": name, "op": op, "inputs": inputs})
+                ev["graph_nodes"] = nodes
             elif field == 5:
                 for sfield, _w, sval in _parse_fields(val):
                     if sfield == 1:

@@ -26,3 +26,18 @@ def test_two_process_localhost_training():
     # each process sees (1024/2)/32 = 16 batches per epoch
     assert "Batch:  16 of  16," in chief_out, chief_out[-2000:]
     assert "Batch:  16 of  16," in worker_out
+
+
+def test_eval_all_hosts_prints_everywhere():
+    """--eval_all_hosts mirrors the reference's per-worker final eval
+    (example.py:177: every worker prints Test-Accuracy)."""
+    outs = run_all(2, 2, [
+        "--training_epochs=1", "--batch_size=64", "--frequency=5",
+        "--synthetic_train_size=512", "--synthetic_test_size=128",
+        "--eval_all_hosts",
+    ])
+    chief_out, worker_out = outs
+    assert "Test-Accuracy:" in chief_out, chief_out[-2000:]
+    assert "Test-Accuracy:" in worker_out, worker_out[-2000:]
+    # the rest of the final block stays chief-only
+    assert "Total Time:" in chief_out and "Total Time:" not in worker_out

@@ -41,3 +41,46 @@ def test_event_file_roundtrip(tmp_path):
     assert abs(events[1]["scalars"]["cost"] - 2.5) < 1e-6
     assert abs(events[2]["scalars"]["accuracy"] - 0.75) < 1e-6
     assert events[1]["wall_time"] > 0
+
+
+def test_graph_event_roundtrip(tmp_path):
+    """The reference writes its graph into the event log
+    (FileWriter(logs_path, graph=...), example.py:146); the writer's
+    GraphDef record must parse back with the model's structure."""
+    from distributed_tensorflow_example_tpu.utils.summary import mlp_graph_nodes
+
+    w = SummaryWriter(str(tmp_path))
+    w.add_graph(mlp_graph_nodes(784, (100,), 10, "sigmoid"))
+    w.close()
+    files = glob.glob(os.path.join(str(tmp_path), "events.out.tfevents.*"))
+    events = read_event_file(files[0])
+    graphs = [e for e in events if e["graph_nodes"]]
+    assert len(graphs) == 1
+    nodes = {n["name"]: n for n in graphs[0]["graph_nodes"]}
+    # the reference's graph shape: placeholders, variables, the two
+    # matmuls, sigmoid, softmax, loss/metric/train ops
+    for name in ("x", "y_", "W1", "b1", "W2", "b2", "global_step",
+                 "y", "cross_entropy", "accuracy", "train"):
+        assert name in nodes, name
+    assert nodes["layer1/MatMul"]["op"] == "MatMul"
+    assert nodes["layer1/MatMul"]["inputs"] == ["x", "W1"]
+    assert nodes["a2"]["op"] == "Sigmoid"
+    assert nodes["y"]["op"] == "Softmax"
+
+
+def test_run_writes_graph_event(tmp_path):
+    """End-to-end: a training run's event file carries the graph record
+    (example.py:146 parity), alongside the per-step scalars."""
+    from distributed_tensorflow_example_tpu.config import Config
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    run(Config(
+        training_epochs=1, batch_size=32, dataset="synthetic",
+        synthetic_train_size=64, synthetic_test_size=32,
+        logs_path=str(tmp_path), frequency=2, compilation_cache="",
+    ))
+    files = glob.glob(os.path.join(str(tmp_path), "events.out.tfevents.*"))
+    assert len(files) == 1
+    events = read_event_file(files[0])
+    assert any(e["graph_nodes"] for e in events)
+    assert any(e["scalars"].get("cost") is not None for e in events)
