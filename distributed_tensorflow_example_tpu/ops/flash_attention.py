@@ -386,6 +386,47 @@ def _flash_backward_flat(qf, kf, vf, dof, mf, lf, dlt, causal: bool,
     return dq, dk, dv
 
 
+def _xla_stats(q, k, v, causal: bool):
+    """XLA reference implementation of ``_flash_stats``' contract
+    ([B, L, H, D] in; (acc, m, l) raw softmax partials out). Injected
+    where the Pallas kernel cannot run — interpret mode inside
+    shard_map on CPU meshes (the driver's ring-gradient dryrun) — so
+    the ring machinery is exercised against identical block semantics."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        lq, lk = s.shape[-2], s.shape[-1]
+        s = jnp.where(jnp.tril(jnp.ones((lq, lk), bool)), s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    tr = lambda x: jnp.transpose(x, (0, 2, 1))[..., None]
+    return acc, tr(m), tr(l)
+
+
+def _xla_backward_flat(qf, kf, vf, dof, mf, lf, dlt, causal: bool,
+                       blk: int, compute_dtype):
+    """XLA reference implementation of ``_flash_backward_flat``'s
+    contract (flat [BH, L, ...] operands, saved (m, l) stats, f32
+    partials out) — the injectable sibling of ``_xla_stats`` for the
+    backward ring."""
+    scale = 1.0 / np.sqrt(qf.shape[-1])
+    s = jnp.einsum("nqd,nkd->nqk", qf, kf).astype(jnp.float32) * scale
+    if causal:
+        lq, lk = s.shape[-2], s.shape[-1]
+        s = jnp.where(jnp.tril(jnp.ones((lq, lk), bool)), s, NEG_INF)
+    p = jnp.exp(s - mf) / jnp.maximum(lf, 1e-30)   # mf/lf: [N, L, 1]
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    dp = jnp.einsum("nqd,nkd->nqk", dof, vf).astype(jnp.float32)
+    ds = p * (dp - dlt)                            # dlt: [N, L, 1]
+    dq = jnp.einsum("nqk,nkd->nqd", ds, kf.astype(jnp.float32)) * scale
+    dk = jnp.einsum("nqk,nqd->nkd", ds, qf.astype(jnp.float32)) * scale
+    dv = jnp.einsum("nqk,nqd->nkd", p, dof.astype(jnp.float32))
+    return dq, dk, dv
+
+
 @functools.partial(jax.jit, static_argnums=(7, 8))
 def _flash_backward(q, k, v, o, m, l, do, causal: bool, blk: int):
     """O(S·blk) backward: (dq, dk, dv) from the forward residuals.
