@@ -48,12 +48,25 @@ class Config:
     frequency: int = 100            # steps between throughput prints
 
     # ---- model (example.py:76-90; BASELINE config 4 extensions) ----
+    model: str = "mlp"              # mlp (reference family) | transformer
+                                    # (beyond-reference, wires the
+                                    # flash/ring attention stack into
+                                    # the training pipeline)
     input_size: int = 784
     num_classes: int = 10
     hidden_sizes: tuple[int, ...] = (100,)
     activation: str = "sigmoid"     # sigmoid | relu | tanh | gelu
     param_dtype: str = "float32"
     compute_dtype: str = "float32"  # bfloat16 puts the matmuls on the MXU native dtype
+
+    # ---- transformer family (models/transformer.py) ----
+    seq_len: int = 28               # input viewed as seq_len tokens
+    d_model: int = 128
+    n_heads: int = 4
+    num_blocks: int = 2
+    d_ff: int = 256
+    attention: str = "dense"        # dense | flash; --pallas also selects flash
+    causal: bool = False            # causal (LM-style) attention mask
 
     # ---- loss (example.py:92-96) ----
     naive_ce: bool = False          # reproduce the reference's unstable log(softmax) CE
@@ -151,6 +164,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--logs_path", type=str, default=d.logs_path)
     p.add_argument("--seed", type=int, default=d.seed)
     p.add_argument("--frequency", type=int, default=d.frequency)
+    p.add_argument("--model", type=str, default=d.model,
+                   choices=["mlp", "transformer"])
+    p.add_argument("--seq_len", type=int, default=d.seq_len)
+    p.add_argument("--d_model", type=int, default=d.d_model)
+    p.add_argument("--n_heads", type=int, default=d.n_heads)
+    p.add_argument("--num_blocks", type=int, default=d.num_blocks)
+    p.add_argument("--d_ff", type=int, default=d.d_ff)
+    p.add_argument("--attention", type=str, default=d.attention,
+                   choices=["dense", "flash"])
+    p.add_argument("--causal", action="store_true")
     p.add_argument("--input_size", type=int, default=d.input_size)
     p.add_argument("--num_classes", type=int, default=d.num_classes)
     p.add_argument("--hidden_sizes", type=_parse_hidden, default=d.hidden_sizes,

@@ -132,12 +132,18 @@ def _glyph_array(digit: int) -> np.ndarray:
     return np.array([[int(c) for c in row] for row in g], dtype=np.float32)
 
 
-def synthesize_split(n: int, seed: int) -> DataSplit:
+def synthesize_split(n: int, seed: int, input_size: int = 784) -> DataSplit:
     """Deterministic MNIST-like data: upscaled glyphs + jitter + noise.
 
     Learnable by the reference MLP to high accuracy, which is what the
     end-to-end and bench paths need; statistically it is NOT MNIST and
     accuracy numbers on it are labelled as synthetic (Dataset.source).
+
+    ``input_size != 784`` tiles (or truncates) each flattened 28x28
+    glyph image to the requested feature width, keeping the labels
+    learnable — this is what lets non-MNIST-shaped configs (e.g. the
+    long-sequence transformer, ``--input_size=1024 --seq_len=256``)
+    run through the same end-to-end driver.
     """
     rng = np.random.RandomState(seed)
     labels = rng.randint(0, 10, size=n).astype(np.uint8)
@@ -157,16 +163,24 @@ def synthesize_split(n: int, seed: int) -> DataSplit:
     # uint8 (4x less HBM + host->device transfer) with bit-exact
     # reconstruction (parallel/epoch._pack_images).
     images = np.round(images * 255.0).astype(np.float32) / np.float32(255.0)
-    return DataSplit(images=images.reshape(n, 784), labels=one_hot(labels))
+    flat = images.reshape(n, 784)
+    if input_size != 784:
+        flat = np.ascontiguousarray(
+            np.tile(flat, (1, -(-input_size // 784)))[:, :input_size])
+    return DataSplit(images=flat, labels=one_hot(labels))
 
 
 def synthesize_dataset(
-    seed: int = 0, train_size: int = 55000, test_size: int = 10000
+    seed: int = 0, train_size: int = 55000, test_size: int = 10000,
+    input_size: int = 784,
 ) -> Dataset:
     return Dataset(
-        train=synthesize_split(train_size, seed=seed + 1),
-        validation=synthesize_split(max(train_size // 11, 10), seed=seed + 2),
-        test=synthesize_split(test_size, seed=seed + 3),
+        train=synthesize_split(train_size, seed=seed + 1,
+                               input_size=input_size),
+        validation=synthesize_split(max(train_size // 11, 10), seed=seed + 2,
+                                    input_size=input_size),
+        test=synthesize_split(test_size, seed=seed + 3,
+                              input_size=input_size),
         source="synthetic",
     )
 
@@ -238,6 +252,7 @@ def load_datasets(
     synthetic_train_size: int = 55000,
     synthetic_test_size: int = 10000,
     mirrors=None,
+    input_size: int = 784,
 ) -> Dataset:
     """Replacement for ``input_data.read_data_sets`` (example.py:47-48).
 
@@ -247,7 +262,18 @@ def load_datasets(
     behavior. ``auto`` uses real files when already present, otherwise
     the deterministic synthetic fallback — never touching the network
     (the right default for air-gapped machines).
+
+    ``input_size != 784`` (non-MNIST-shaped configs, e.g. the
+    long-sequence transformer) requires ``--dataset=synthetic``: real
+    MNIST bytes are inherently 784-dim.
     """
+    if input_size != 784:
+        if dataset == "mnist" or (dataset == "auto"
+                                  and idx_files_present(data_dir)):
+            raise ValueError(
+                f"input_size={input_size}: real MNIST IDX data is 784-dim; "
+                "use --dataset=synthetic for non-MNIST-shaped configs")
+        dataset = "synthetic"  # auto resolves to the only shape that fits
     if dataset in ("mnist", "auto") and idx_files_present(data_dir):
         if dataset == "mnist" and _process_count() > 1:
             # Join the barrier even on the files-present path: a peer
@@ -282,7 +308,8 @@ def load_datasets(
             ) from err
         return load_idx_dataset(data_dir)
     return synthesize_dataset(
-        seed=seed, train_size=synthetic_train_size, test_size=synthetic_test_size
+        seed=seed, train_size=synthetic_train_size,
+        test_size=synthetic_test_size, input_size=input_size,
     )
 
 

@@ -1,0 +1,200 @@
+"""Transformer model family (beyond-reference capability).
+
+The reference's only model is the 2-layer sigmoid MLP
+(/root/reference/example.py:74-90); SURVEY.md §5 records attention and
+long context as absent upstream. This module supplies the model family
+that WIRES the framework's long-context primitives
+(ops/flash_attention.py, ops/ring_attention.py) into the actual
+training pipeline: a pre-LN encoder classifier whose attention backend
+is selectable per spec — XLA dense for short sequences, the flash
+Pallas kernels for tile-aligned long ones — running through the same
+driver, SPMD step, fast scan paths, checkpointing, summaries and eval
+as the MLP (`--model=transformer`).
+
+TPU-native design notes:
+- images (or any flat feature vector) are viewed as a sequence:
+  ``[B, input_size] -> [B, seq_len, input_size/seq_len]`` tokens, so
+  the MNIST pipeline feeds it unchanged;
+- matmuls take ``compute_dtype`` inputs with f32 accumulation
+  (``preferred_element_type``), exactly like models/mlp.py — bfloat16
+  puts them on the MXU's native input width;
+- layer norms and softmax statistics stay in f32;
+- the whole forward is one XLA computation; with
+  ``attention='flash'`` the score matrix is never materialized
+  (O(S·blk) memory; ragged lengths fall back to exact dense inside
+  ops/flash_attention).
+
+Params are a flat ``{name: array}`` dict like the MLP's — checkpoint
+and FSDP-flattening friendly, PartitionSpec tree = replicated P() for
+every leaf (data parallelism; transformer TP is out of scope, guarded
+in parallel/mesh.layer_styles).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, jnp.ndarray]
+
+from .mlp import _ACTIVATIONS  # one activation table for every family
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerSpec:
+    input_size: int = 784
+    num_classes: int = 10
+    seq_len: int = 28              # tokens; input_size must divide evenly
+    d_model: int = 128
+    n_heads: int = 4
+    num_blocks: int = 2
+    d_ff: int = 256
+    activation: str = "gelu"
+    attention: str = "dense"       # dense | flash (ops/flash_attention)
+    causal: bool = False
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+
+    @property
+    def d_feature(self) -> int:
+        if self.input_size % self.seq_len:
+            raise ValueError(
+                f"input_size={self.input_size} not divisible by "
+                f"seq_len={self.seq_len}")
+        return self.input_size // self.seq_len
+
+    @property
+    def d_head(self) -> int:
+        if self.d_model % self.n_heads:
+            raise ValueError(
+                f"d_model={self.d_model} not divisible by "
+                f"n_heads={self.n_heads}")
+        return self.d_model // self.n_heads
+
+
+def init(key: jax.Array, spec: TransformerSpec) -> Params:
+    """Seeded init: scaled-normal weights (1/sqrt(fan_in)), 0.02-normal
+    positional embeddings, zero biases, unit layer-norm gains. Unlike
+    the MLP's reference-mandated N(0,1) (example.py:76-82), this family
+    is beyond-reference, so it uses the init that actually trains a
+    transformer. Structure comes from ``param_shapes`` — the one source
+    of truth shared with ``param_pspecs``/``num_params``."""
+    shapes = param_shapes(spec)
+    pd = spec.param_dtype
+    random_names = [n for n in shapes if "W" in n or n == "pos"]
+    keys = dict(zip(random_names, jax.random.split(key, len(random_names))))
+    p: Params = {}
+    for name, shape in shapes.items():
+        if name == "pos":
+            p[name] = (0.02 * jax.random.normal(
+                keys[name], shape, dtype=jnp.float32)).astype(pd)
+        elif "W" in name:
+            p[name] = (jax.random.normal(keys[name], shape, jnp.float32)
+                       / jnp.sqrt(jnp.float32(shape[0]))).astype(pd)
+        elif name.endswith("_g"):
+            p[name] = jnp.ones(shape, pd)
+        else:
+            p[name] = jnp.zeros(shape, pd)
+    return p
+
+
+def param_shapes(spec: TransformerSpec) -> Dict[str, tuple[int, ...]]:
+    """Analytic {name: shape} map — the single source of truth for the
+    parameter tree's structure (init, pspecs and num_params derive from
+    it without materializing weights)."""
+    d, ff, f = spec.d_model, spec.d_ff, spec.d_feature
+    shapes: Dict[str, tuple[int, ...]] = {
+        "W_in": (f, d), "b_in": (d,), "pos": (spec.seq_len, d),
+        "lnf_g": (d,), "lnf_b": (d,),
+        "W_head": (d, spec.num_classes), "b_head": (spec.num_classes,),
+    }
+    for i in range(spec.num_blocks):
+        shapes.update({
+            f"L{i}_ln1_g": (d,), f"L{i}_ln1_b": (d,),
+            f"L{i}_Wqkv": (d, 3 * d), f"L{i}_bqkv": (3 * d,),
+            f"L{i}_Wo": (d, d), f"L{i}_bo": (d,),
+            f"L{i}_ln2_g": (d,), f"L{i}_ln2_b": (d,),
+            f"L{i}_W1": (d, ff), f"L{i}_b1": (ff,),
+            f"L{i}_W2": (ff, d), f"L{i}_b2": (d,),
+        })
+    return shapes
+
+
+def param_pspecs(spec: TransformerSpec) -> Dict[str, "jax.sharding.PartitionSpec"]:
+    """Replicated P() for every leaf (pure data parallelism)."""
+    from jax.sharding import PartitionSpec as P
+
+    return {name: P() for name in param_shapes(spec)}
+
+
+def _layer_norm(x, g, b):
+    x = x.astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * g.astype(jnp.float32) \
+        + b.astype(jnp.float32)
+
+
+def _attend(spec: TransformerSpec, q, k, v):
+    """[B, S, H, Dh] in/out via the selected backend."""
+    if spec.attention == "flash":
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, spec.causal)
+    from ..ops.ring_attention import attention
+
+    return attention(q, k, v, causal=spec.causal)
+
+
+def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Forward to logits. ``x``: [B, input_size] (viewed as seq_len
+    tokens) or already [B, S, F]."""
+    cdt = spec.compute_dtype
+    b = x.shape[0]
+    s, f, d = spec.seq_len, spec.d_feature, spec.d_model
+    h = x.reshape(b, s, f).astype(cdt)
+
+    def mm(a, w_name, b_name):
+        acc = jnp.dot(a.astype(cdt), params[w_name].astype(cdt),
+                      preferred_element_type=jnp.float32)
+        return acc + params[b_name].astype(jnp.float32)
+
+    h = mm(h, "W_in", "b_in") + params["pos"].astype(jnp.float32)[None]
+    act = _ACTIVATIONS[spec.activation]
+    for i in range(spec.num_blocks):
+        a = _layer_norm(h, params[f"L{i}_ln1_g"], params[f"L{i}_ln1_b"])
+        qkv = mm(a, f"L{i}_Wqkv", f"L{i}_bqkv")          # [B, S, 3D]
+        q, k, v = jnp.split(qkv.astype(cdt), 3, axis=-1)
+        shape = (b, s, spec.n_heads, spec.d_head)
+        att = _attend(spec, q.reshape(shape), k.reshape(shape),
+                      v.reshape(shape))
+        h = h + mm(att.reshape(b, s, d), f"L{i}_Wo", f"L{i}_bo")
+        a = _layer_norm(h, params[f"L{i}_ln2_g"], params[f"L{i}_ln2_b"])
+        a = act(mm(a, f"L{i}_W1", f"L{i}_b1")).astype(cdt)
+        h = h + mm(a, f"L{i}_W2", f"L{i}_b2")
+    h = _layer_norm(h, params["lnf_g"], params["lnf_b"])
+    pooled = jnp.mean(h, axis=1)                          # [B, D]
+    return mm(pooled, "W_head", "b_head").astype(jnp.float32)
+
+
+def num_params(spec: TransformerSpec) -> int:
+    import math
+
+    return sum(math.prod(s) for s in param_shapes(spec).values())
+
+
+def flops_per_step(spec: TransformerSpec, batch: int) -> float:
+    """Analytic fwd+bwd matmul+attention FLOPs per training step (fwd
+    2*MACs, bwd 4*MACs; attention 4*B*H*S^2*Dh fwd, x3 for fwd+bwd),
+    for bench MFU accounting."""
+    d, ff, f, s = spec.d_model, spec.d_ff, spec.d_feature, spec.seq_len
+    macs_tok = f * d + spec.num_blocks * (3 * d * d + d * d + d * ff + ff * d)
+    macs = batch * (s * macs_tok + d * spec.num_classes)
+    attn = 4.0 * batch * spec.n_heads * s * s * spec.d_head \
+        * spec.num_blocks * (0.5 if spec.causal else 1.0)
+    # 3.5x forward for fwd+bwd attention — the same accounting as
+    # bench._attn_flops (backward ~2.5x forward on top of the forward)
+    return 6.0 * macs + 3.5 * attn

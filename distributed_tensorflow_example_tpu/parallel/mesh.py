@@ -60,11 +60,20 @@ def build_mesh(data_parallel: int = -1, model_parallel: int = 1, devices=None) -
     )
 
 
-def layer_styles(spec: MLPSpec, model_parallel: int) -> list[str]:
+def layer_styles(spec, model_parallel: int) -> list[str]:
     """Per-layer TP style: 'col' (column-split), 'row' (row-split + psum),
     or 'rep' (replicated). Layers alternate col/row so activations only
     need one psum per pair; the final layer stays replicated when the
     alternation would leave the logits sharded."""
+    from ..models.transformer import TransformerSpec
+
+    if isinstance(spec, TransformerSpec):
+        if model_parallel > 1:
+            raise ValueError(
+                "tensor parallelism is not implemented for the "
+                "transformer family; set model_parallel=1 (DP/FSDP "
+                "compose as usual)")
+        return ["rep"]
     styles = []
     for i in range(1, spec.num_layers + 1):
         if model_parallel == 1:
@@ -88,8 +97,13 @@ def layer_styles(spec: MLPSpec, model_parallel: int) -> list[str]:
     return styles
 
 
-def param_pspecs(spec: MLPSpec, model_parallel: int = 1) -> Dict[str, P]:
+def param_pspecs(spec, model_parallel: int = 1) -> Dict[str, P]:
     """PartitionSpecs for the param pytree — the replica_device_setter analog."""
+    from ..models import transformer
+
+    if isinstance(spec, transformer.TransformerSpec):
+        layer_styles(spec, model_parallel)  # TP guard
+        return transformer.param_pspecs(spec)
     out: Dict[str, P] = {}
     for i, st in enumerate(layer_styles(spec, model_parallel), start=1):
         if st == "col":

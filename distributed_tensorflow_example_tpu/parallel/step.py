@@ -52,14 +52,21 @@ from . import mesh as mesh_lib
 from .mesh import DATA_AXIS, MODEL_AXIS
 
 
-def forward_local(spec: mlp.MLPSpec, params, x, styles, use_pallas: bool = False):
+def forward_local(spec, params, x, styles, use_pallas: bool = False):
     """Per-shard forward to (replicated) logits; TP-aware (example.py:87-89).
 
-    The fused Pallas kernel handles the pure data-parallel case for
-    activations whose VJP is expressible from the saved activation
+    Model-family dispatch: TransformerSpec routes to the transformer
+    forward (its Pallas path is the flash-attention backend, selected
+    on the spec itself). For the MLP, the fused Pallas kernel handles
+    the pure data-parallel case for activations whose VJP is
+    expressible from the saved activation
     (pallas_fused.SUPPORTED_ACTIVATIONS); TP shards the hidden dim and
     gelu's VJP needs the pre-activation, so those fall to the XLA path.
     """
+    from ..models import transformer
+
+    if isinstance(spec, transformer.TransformerSpec):
+        return transformer.apply(spec, params, x)
     if use_pallas and all(s == "rep" for s in styles):
         from ..ops import pallas_fused
 

@@ -45,9 +45,28 @@ from .optim import make_optimizer
 from .state import create_train_state
 
 
-def make_spec(cfg: Config) -> MLPSpec:
+def make_spec(cfg: Config):
     import jax.numpy as jnp
 
+    if cfg.model == "transformer":
+        from ..models.transformer import TransformerSpec
+
+        return TransformerSpec(
+            input_size=cfg.input_size,
+            num_classes=cfg.num_classes,
+            seq_len=cfg.seq_len,
+            d_model=cfg.d_model,
+            n_heads=cfg.n_heads,
+            num_blocks=cfg.num_blocks,
+            d_ff=cfg.d_ff,
+            activation=(cfg.activation if cfg.activation != "sigmoid"
+                        else "gelu"),  # the reference default doesn't
+                                       # apply to this family
+            attention="flash" if cfg.pallas else cfg.attention,
+            causal=cfg.causal,
+            param_dtype=jnp.dtype(cfg.param_dtype),
+            compute_dtype=jnp.dtype(cfg.compute_dtype),
+        )
     return MLPSpec(
         input_size=cfg.input_size,
         hidden_sizes=tuple(cfg.hidden_sizes),
@@ -118,6 +137,7 @@ def run(cfg: Config) -> Dict[str, Any]:
         synthetic_train_size=cfg.synthetic_train_size,
         synthetic_test_size=cfg.synthetic_test_size,
         mirrors=cfg.mnist_mirrors,
+        input_size=cfg.input_size,
     )
     mesh = mesh_lib.build_mesh(cfg.data_parallel, cfg.model_parallel)
     dp = mesh.shape[mesh_lib.DATA_AXIS]
@@ -192,12 +212,15 @@ def run(cfg: Config) -> Dict[str, Any]:
         # the reference attaches its graph to the event log
         # (FileWriter(logs_path, graph=..., example.py:146)); write the
         # equivalent GraphDef record so TB's Graphs tab is populated
-        from ..utils.summary import mlp_graph_nodes
+        from ..utils.summary import mlp_graph_nodes, transformer_graph_nodes
 
-        writer.add_graph(mlp_graph_nodes(
-            cfg.input_size, tuple(cfg.hidden_sizes), cfg.num_classes,
-            cfg.activation, optimizer=cfg.optimizer,
-        ))
+        if cfg.model == "transformer":
+            writer.add_graph(transformer_graph_nodes(cfg.num_blocks))
+        else:
+            writer.add_graph(mlp_graph_nodes(
+                cfg.input_size, tuple(cfg.hidden_sizes), cfg.num_classes,
+                cfg.activation, optimizer=cfg.optimizer,
+            ))
 
     if cfg.profile and chief:
         jax.profiler.start_trace(cfg.logs_path + "/profile")

@@ -31,9 +31,11 @@ class TrainState:
 
 def create_train_state(key: jax.Array, spec, optimizer) -> TrainState:
     """``init_op`` equivalent (example.py:129): build the full state pytree."""
-    from ..models import mlp
+    from ..models import mlp, transformer
 
-    params = mlp.init(key, spec)
+    fam = (transformer if isinstance(spec, transformer.TransformerSpec)
+           else mlp)
+    params = fam.init(key, spec)
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,

@@ -136,6 +136,40 @@ def mlp_graph_nodes(input_size: int, hidden_sizes, num_classes: int,
     return nodes
 
 
+def transformer_graph_nodes(num_blocks: int):
+    """Graph triples for the transformer family (models/transformer.py)
+    — coarse block-level structure for the TB Graphs tab (tensor dims
+    are not part of this skeleton, only the op topology)."""
+    nodes = [
+        ("x", "Placeholder", ()),
+        ("y_", "Placeholder", ()),
+        ("global_step", "VariableV2", ()),
+        ("embed/MatMul", "MatMul", ("x",)),
+        ("embed/pos_add", "Add", ("embed/MatMul",)),
+    ]
+    prev = "embed/pos_add"
+    for i in range(num_blocks):
+        blk = f"block{i}"
+        nodes += [
+            (f"{blk}/ln1", "LayerNorm", (prev,)),
+            (f"{blk}/attention", "MultiHeadAttention", (f"{blk}/ln1",)),
+            (f"{blk}/residual1", "Add", (prev, f"{blk}/attention")),
+            (f"{blk}/ln2", "LayerNorm", (f"{blk}/residual1",)),
+            (f"{blk}/ffn", "MatMul", (f"{blk}/ln2",)),
+            (f"{blk}/residual2", "Add", (f"{blk}/residual1", f"{blk}/ffn")),
+        ]
+        prev = f"{blk}/residual2"
+    nodes += [
+        ("lnf", "LayerNorm", (prev,)),
+        ("pool", "Mean", ("lnf",)),
+        ("y", "Softmax", ("pool",)),
+        ("cross_entropy", "Mean", ("y", "y_")),
+        ("accuracy", "Mean", ("y", "y_")),
+        ("train", "ApplyGradientDescent", ("cross_entropy", "global_step")),
+    ]
+    return nodes
+
+
 def encode_event(
     wall_time: float,
     step: int | None = None,
