@@ -189,50 +189,34 @@ def bench_config(name: str, cfg, epochs_full: int = 20, repeats: int = 5):
     return row
 
 
-def bench_mxu(pallas: bool, repeats: int = 3, hidden=(4096, 4096),
-              batch: int = 8192, epochs: int = 20):
-    """Steady-state MXU utilization: wide bf16 MLP, whole run compiled
-    as one executable (parallel/epoch.build_run_to_completion), timed on
-    its second invocation so compile cost is excluded. This is the
-    'show the framework can feed the MXU' row (VERDICT r1 weak #2)."""
+def _steady_state_step_time(cfg, spec, mesh, img_d, lbl_d, spe,
+                            epochs: int, repeats: int) -> float:
+    """Shared steady-state harness: the whole run compiled as ONE
+    executable (parallel/epoch.build_run_to_completion), compile run
+    first, then ``repeats`` timed invocations threading the donated
+    state; median per-step seconds. Synchronizes via an explicit host
+    fetch: on the tunnelled backend block_until_ready can return before
+    execution finishes, silently timing an empty queue (measured:
+    0.2 ms "runs" of a 1.4 s program); the fetch adds ~1 RTT per
+    trial, a disclosed few-percent overstatement of step time."""
     import jax
     import numpy as np
 
-    from distributed_tensorflow_example_tpu.config import Config
-    from distributed_tensorflow_example_tpu.models.mlp import MLPSpec
     from distributed_tensorflow_example_tpu.parallel import epoch as epoch_lib
     from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
     from distributed_tensorflow_example_tpu.train.optim import make_optimizer
     from distributed_tensorflow_example_tpu.train.state import create_train_state
 
-    import jax.numpy as jnp
-
-    cfg = Config(batch_size=batch, compute_dtype="bfloat16",
-                 activation="relu", hidden_sizes=hidden, pallas=pallas,
-                 summaries=False)
-    spec = MLPSpec(input_size=784, hidden_sizes=hidden, num_classes=10,
-                   activation="relu", compute_dtype=jnp.bfloat16)
-    mesh = mesh_lib.build_mesh(1, 1)
     opt = make_optimizer(cfg)
     state = create_train_state(jax.random.PRNGKey(1), spec, opt)
     state = mesh_lib.place_state(state, mesh,
                                  mesh_lib.state_pspecs(spec, opt, 1))
-    # uint8-exact images so the HBM-resident dataset stays compact
-    rng = np.random.RandomState(0)
-    n = batch * 8
-    images = rng.randint(0, 256, size=(n, 784)).astype(np.float32) / np.float32(255.0)
-    labels = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
-    img_d, lbl_d, spe = epoch_lib.shard_dataset(mesh, images, labels, batch)
-    runner = epoch_lib.build_run_to_completion(cfg, mesh, spec, opt, spe, epochs)
+    runner = epoch_lib.build_run_to_completion(cfg, mesh, spec, opt, spe,
+                                               epochs)
     key = jax.random.PRNGKey(0)
 
     def once(state):
-        state, costs, accs = runner(state, img_d, lbl_d, key, 0)
-        # synchronize via an explicit host fetch: on the tunnelled
-        # backend block_until_ready can return before execution
-        # finishes, silently timing an empty queue (measured: 0.2 ms
-        # "runs" of a 1.4 s program). The fetch adds ~1 RTT (~0.1 s)
-        # per trial, a disclosed few-percent overstatement of step time.
+        state, costs, _ = runner(state, img_d, lbl_d, key, 0)
         np.asarray(costs)
         return state
 
@@ -242,15 +226,45 @@ def bench_mxu(pallas: bool, repeats: int = 3, hidden=(4096, 4096),
         t0 = time.time()
         state = once(state)
         walls.append(time.time() - t0)
-    steps = spe * epochs
-    step_s = statistics.median(walls) / steps
+    return statistics.median(walls) / (spe * epochs)
+
+
+def bench_mxu(pallas: bool, repeats: int = 3, hidden=(4096, 4096),
+              batch: int = 8192, epochs: int = 20):
+    """Steady-state MXU utilization: wide bf16 MLP, whole run compiled
+    as one executable, timed by _steady_state_step_time so compile cost
+    is excluded. This is the 'show the framework can feed the MXU' row
+    (VERDICT r1 weak #2)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_example_tpu.config import Config
+    from distributed_tensorflow_example_tpu.models.mlp import MLPSpec
+    from distributed_tensorflow_example_tpu.parallel import epoch as epoch_lib
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+
+    cfg = Config(batch_size=batch, compute_dtype="bfloat16",
+                 activation="relu", hidden_sizes=hidden, pallas=pallas,
+                 summaries=False)
+    spec = MLPSpec(input_size=784, hidden_sizes=hidden, num_classes=10,
+                   activation="relu", compute_dtype=jnp.bfloat16)
+    mesh = mesh_lib.build_mesh(1, 1)
+    # uint8-exact images so the HBM-resident dataset stays compact
+    rng = np.random.RandomState(0)
+    n = batch * 8
+    images = rng.randint(0, 256, size=(n, 784)).astype(np.float32) / np.float32(255.0)
+    labels = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+    img_d, lbl_d, spe = epoch_lib.shard_dataset(mesh, images, labels, batch)
+    step_s = _steady_state_step_time(cfg, spec, mesh, img_d, lbl_d, spe,
+                                     epochs, repeats)
     peak = _chip_peak_flops()
     flops_step = _model_flops_per_step(hidden, batch)
     return {
         "config": "mxu_wide_pallas" if pallas else "mxu_wide",
         "model": f"784-{'-'.join(map(str, hidden))}-10 relu bf16",
         "global_batch": batch,
-        "steps_timed": steps,
+        "steps_timed": spe * epochs,
         "step_time_ms": round(step_s * 1000, 3),
         "examples_per_sec": round(batch / step_s, 1),
         "model_flops_per_step": flops_step,
@@ -413,6 +427,24 @@ def bench_flash_attention(s: int = 4096, b: int = 4, h: int = 8,
     row.update({"flash_grad_" + k: v
                 for k, v in _rate(grad_flops, row["flash_grad_wall_s"],
                                   peak).items()})
+    # production-kernel anchor: jax's bundled TPU flash kernel on the
+    # same shape and scale — a RELATIVE number, so tunnel congestion
+    # cancels (measured on this chip: both sit at ~0.6-0.7 TFLOP/s
+    # while a 4096^3 matmul varies 16-156 TFLOP/s with the window;
+    # vs_ref_kernel > 1 means this repo's kernel is faster)
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as jax_flash)
+
+        qh, kh, vh = (jnp.transpose(t_, (0, 2, 1, 3)) for t_ in (q, k, v))
+        f_ref = jax.jit(lambda a, b_, c: jax_flash(
+            a, b_, c, causal=True, sm_scale=1.0 / float(np.sqrt(d))))
+        row["ref_kernel_wall_s"] = _timed_chain(
+            f_ref, (qh, kh, vh), lambda o: o, repeats=repeats)
+        row["vs_ref_kernel"] = round(
+            row["ref_kernel_wall_s"] / row["flash_wall_s"], 2)
+    except Exception as e:  # bundled kernel absent/changed: not our row
+        row["ref_kernel_error"] = str(e)[:120]
     # max-context probe: S=16384, [2,S,8,64] (distinct random q/k/v —
     # identical tensors would make the softmax degenerately peaked),
     # where dense would need a 17 GB score tensor — reported as an
@@ -431,6 +463,57 @@ def bench_flash_attention(s: int = 4096, b: int = 4, h: int = 8,
     row.update({"s16384_" + k: v
                 for k, v in _rate(_attn_flops(b2, s2, h, d, causal=True),
                                   row["s16384_wall_s"], peak).items()})
+    return row
+
+
+def bench_transformer(seq: int = 1024, batch: int = 32, repeats: int = 3,
+                      steps: int = 32):
+    """Long-context TRAINING throughput through the real pipeline: the
+    transformer family (models/transformer.py) with causal flash
+    attention, bf16 compute, whole epoch compiled as one scan program —
+    the same steady-state method as bench_mxu. Reports both attention
+    backends; MFU uses transformer.flops_per_step (matmuls + the
+    bench-consistent 3.5x-forward attention accounting)."""
+    import numpy as np
+
+    from distributed_tensorflow_example_tpu.config import Config
+    from distributed_tensorflow_example_tpu.models import transformer as tfm
+    from distributed_tensorflow_example_tpu.parallel import epoch as epoch_lib
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.train.loop import make_spec
+
+    row = {"config": "transformer_flash_long_context",
+           "model": f"S={seq} d_model=256 blocks=4 heads=8 bf16 causal",
+           "global_batch": batch}
+    peak = _chip_peak_flops()
+    # mesh and the staged HBM dataset are backend-invariant: build and
+    # transfer them once (host->device traffic must stay out of the
+    # measurement loop)
+    mesh = mesh_lib.build_mesh(1, 1)
+    rng = np.random.RandomState(0)
+    n = batch * steps
+    images = rng.randint(0, 256, size=(n, 4 * seq)).astype(
+        np.float32) / np.float32(255.0)
+    labels = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+    img_d, lbl_d, spe = epoch_lib.shard_dataset(mesh, images, labels, batch)
+    for backend in ("flash", "dense"):
+        cfg = Config(
+            model="transformer", attention=backend, causal=True,
+            input_size=4 * seq, seq_len=seq, d_model=256, n_heads=8,
+            num_blocks=4, d_ff=1024, compute_dtype="bfloat16",
+            optimizer="adam", learning_rate=1e-3, batch_size=batch,
+            dataset="synthetic", summaries=False,
+        )
+        spec = make_spec(cfg)
+        step_s = _steady_state_step_time(cfg, spec, mesh, img_d, lbl_d,
+                                         spe, 1, repeats)
+        flops = tfm.flops_per_step(spec, batch)
+        row[f"{backend}_step_time_ms"] = round(step_s * 1000, 2)
+        row[f"{backend}_examples_per_sec"] = round(batch / step_s, 1)
+        row.update({f"{backend}_{kk}": v
+                    for kk, v in _rate(flops, step_s, peak).items()})
+    row["speedup_flash_vs_dense"] = round(
+        row["dense_step_time_ms"] / row["flash_step_time_ms"], 2)
     return row
 
 
@@ -622,6 +705,7 @@ def main(argv=None) -> int:
         guarded("pallas_parity", bench_pallas_parity)
         guarded("flash_attention", bench_flash_attention)
         guarded("ring_flash", bench_ring_flash)
+        guarded("transformer_flash_long_context", bench_transformer)
 
     # headline candidates exclude the learning-regime row: its lr=0.5
     # wall-clock must never masquerade as the reference headline when
