@@ -81,6 +81,10 @@ class Config:
     # ---- parallelism (SURVEY.md §7; replaces replica_device_setter) ----
     data_parallel: int = -1         # -1: all devices on the data axis
     model_parallel: int = 1         # Megatron-style TP over the hidden dim
+    sequence_parallel: int = 1      # transformer only: shard the token axis
+                                    # over a ('data','seq') mesh; attention
+                                    # runs the ppermute ring
+                                    # (ops/ring_attention) inside the step
     sync_period: int = 1            # 1 = fully synchronous psum every step;
                                     # K>1 = local SGD, params averaged every K
                                     # steps (TPU-native async-staleness analog,
@@ -191,6 +195,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--adam_eps", type=float, default=d.adam_eps)
     p.add_argument("--data_parallel", type=int, default=d.data_parallel)
     p.add_argument("--model_parallel", type=int, default=d.model_parallel)
+    p.add_argument("--sequence_parallel", type=int, default=d.sequence_parallel,
+                   help="transformer only: shard the token axis over a "
+                        "('data','seq') mesh (ring attention in the step)")
     p.add_argument("--sync_period", type=int, default=d.sync_period)
     p.add_argument("--grad_reduce", type=str, default=d.grad_reduce,
                    choices=["mean", "sum"])

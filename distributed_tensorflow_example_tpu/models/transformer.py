@@ -138,8 +138,20 @@ def _layer_norm(x, g, b):
         + b.astype(jnp.float32)
 
 
-def _attend(spec: TransformerSpec, q, k, v):
-    """[B, S, H, Dh] in/out via the selected backend."""
+def _attend(spec: TransformerSpec, q, k, v, seq_axis: str | None):
+    """[B, S(local), H, Dh] in/out via the selected backend.
+
+    With ``seq_axis`` set (sequence-parallel training inside shard_map)
+    attention runs over the RING: k/v blocks travel between shards via
+    ppermute while each block pair is computed locally —
+    ``ring_flash_attention`` uses the Pallas kernels where the local
+    block is tile-aligned and the exact XLA ring otherwise."""
+    if seq_axis is not None:
+        from ..ops.ring_attention import ring_attention, ring_flash_attention
+
+        ring = (ring_flash_attention if spec.attention == "flash"
+                else ring_attention)
+        return ring(q, k, v, seq_axis, causal=spec.causal)
     if spec.attention == "flash":
         from ..ops.flash_attention import flash_attention
 
@@ -149,12 +161,25 @@ def _attend(spec: TransformerSpec, q, k, v):
     return attention(q, k, v, causal=spec.causal)
 
 
-def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray,
+          seq_axis: str | None = None) -> jnp.ndarray:
     """Forward to logits. ``x``: [B, input_size] (viewed as seq_len
-    tokens) or already [B, S, F]."""
+    tokens) or already [B, S, F].
+
+    ``seq_axis`` enables sequence parallelism inside shard_map: ``x``
+    arrives as this shard's contiguous block of the token axis
+    ([B, input_size/n]); positional embeddings are sliced by the
+    shard's global offset, attention runs over the ppermute ring, the
+    token-wise blocks (LN/FFN/residuals) need no communication, and
+    the mean-pool is completed with a pmean across shards — after
+    which the logits are sequence-invariant on every shard.
+    """
     cdt = spec.compute_dtype
     b = x.shape[0]
     s, f, d = spec.seq_len, spec.d_feature, spec.d_model
+    if seq_axis is not None:
+        n_shards = jax.lax.psum(1, seq_axis)
+        s = spec.seq_len // n_shards
     h = x.reshape(b, s, f).astype(cdt)
 
     def mm(a, w_name, b_name):
@@ -162,7 +187,12 @@ def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray) -> jnp.ndarray:
                       preferred_element_type=jnp.float32)
         return acc + params[b_name].astype(jnp.float32)
 
-    h = mm(h, "W_in", "b_in") + params["pos"].astype(jnp.float32)[None]
+    pos = params["pos"].astype(jnp.float32)
+    if seq_axis is not None:
+        # this shard's slice of the global positional table
+        off = jax.lax.axis_index(seq_axis) * s
+        pos = jax.lax.dynamic_slice_in_dim(pos, off, s, axis=0)
+    h = mm(h, "W_in", "b_in") + pos[None]
     act = _ACTIVATIONS[spec.activation]
     for i in range(spec.num_blocks):
         a = _layer_norm(h, params[f"L{i}_ln1_g"], params[f"L{i}_ln1_b"])
@@ -170,13 +200,16 @@ def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray) -> jnp.ndarray:
         q, k, v = jnp.split(qkv.astype(cdt), 3, axis=-1)
         shape = (b, s, spec.n_heads, spec.d_head)
         att = _attend(spec, q.reshape(shape), k.reshape(shape),
-                      v.reshape(shape))
+                      v.reshape(shape), seq_axis)
         h = h + mm(att.reshape(b, s, d), f"L{i}_Wo", f"L{i}_bo")
         a = _layer_norm(h, params[f"L{i}_ln2_g"], params[f"L{i}_ln2_b"])
         a = act(mm(a, f"L{i}_W1", f"L{i}_b1")).astype(cdt)
         h = h + mm(a, f"L{i}_W2", f"L{i}_b2")
     h = _layer_norm(h, params["lnf_g"], params["lnf_b"])
     pooled = jnp.mean(h, axis=1)                          # [B, D]
+    if seq_axis is not None:
+        # complete the global token mean; logits become seq-invariant
+        pooled = jax.lax.pmean(pooled, seq_axis)
     return mm(pooled, "W_head", "b_head").astype(jnp.float32)
 
 

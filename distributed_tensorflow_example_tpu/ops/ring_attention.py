@@ -105,20 +105,25 @@ def _block(q, k, v, m, l, o, q_off, k_off, causal: bool):
     return m_new, l_new, o_new
 
 
-def _lift_varying(x, axis_name: str):
-    """Declare an axis-invariant constant varying over ``axis_name`` —
-    ring loop carries start as invariant zeros but are rebound to
-    q-dependent (varying) values, and the carry types must match.
-    Idempotent: values already varying (e.g. zeros_like of a varying
-    input) pass through."""
+def _lift_varying(x, ref):
+    """Declare an axis-invariant constant varying over every manual
+    axis ``ref`` is varying over — ring loop carries start as invariant
+    zeros but are rebound to q-dependent (varying) values, and the
+    carry types must match. Matching REF (rather than just the ring
+    axis) matters under multi-axis meshes: in ('data','seq') SP+DP
+    training q is varying over both axes, so the carries must be too.
+    Idempotent for axes already varying."""
     try:
-        if axis_name in jax.typeof(x).vma:
-            return x
+        want = set(jax.typeof(ref).vma)
+        have = set(jax.typeof(x).vma)
     except (AttributeError, TypeError):
-        pass
+        return x
+    missing = tuple(sorted(want - have))
+    if not missing:
+        return x
     if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(x, axis_name, to="varying")
-    return jax.lax.pvary(x, axis_name)  # older JAX
+        return jax.lax.pcast(x, missing, to="varying")
+    return jax.lax.pvary(x, missing)  # older JAX
 
 
 def _rotate_unless_last(kv, t, n, axis_name: str):
@@ -149,9 +154,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     b, lq, h, d = q.shape
     lk = k.shape[1]
 
-    m = _lift_varying(jnp.full((b, h, lq), NEG_INF, jnp.float32), axis_name)
-    l = _lift_varying(jnp.zeros((b, h, lq), jnp.float32), axis_name)
-    o = _lift_varying(jnp.zeros((b, lq, h, d), jnp.float32), axis_name)
+    m = _lift_varying(jnp.full((b, h, lq), NEG_INF, jnp.float32), q)
+    l = _lift_varying(jnp.zeros((b, h, lq), jnp.float32), q)
+    o = _lift_varying(jnp.zeros((b, lq, h, d), jnp.float32), q)
     q_off = idx * lq
 
     # ring: at step t this shard holds the block that started on shard
@@ -230,10 +235,9 @@ def _ring_flash_impl(q, k, v, axis_name: str, causal: bool, stats_fn):
     idx = jax.lax.axis_index(axis_name)
     b, _, h, d = q.shape
 
-    m = _lift_varying(jnp.full((b, lq, h, 1), NEG_INF, jnp.float32),
-                      axis_name)
-    l = _lift_varying(jnp.zeros((b, lq, h, 1), jnp.float32), axis_name)
-    o = _lift_varying(jnp.zeros((b, lq, h, d), jnp.float32), axis_name)
+    m = _lift_varying(jnp.full((b, lq, h, 1), NEG_INF, jnp.float32), q)
+    l = _lift_varying(jnp.zeros((b, lq, h, 1), jnp.float32), q)
+    o = _lift_varying(jnp.zeros((b, lq, h, d), jnp.float32), q)
 
     def step(t, carry):
         k_t, v_t, m_, l_, o_ = carry
@@ -318,7 +322,7 @@ def _rf_bwd(axis_name, causal, res, do):
     ))
     kf, vf = prep(k), prep(v)
     zeros = lambda: _lift_varying(
-        jnp.zeros((b * h, lq, d), jnp.float32), axis_name)
+        jnp.zeros((b * h, lq, d), jnp.float32), qf)
     dq0, dk0, dv0 = zeros(), zeros(), zeros()
 
     def step(t, carry):

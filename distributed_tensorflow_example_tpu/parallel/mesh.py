@@ -33,6 +33,7 @@ from ..models.mlp import MLPSpec
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
 
 
 def build_mesh(data_parallel: int = -1, model_parallel: int = 1, devices=None) -> Mesh:
@@ -58,6 +59,30 @@ def build_mesh(data_parallel: int = -1, model_parallel: int = 1, devices=None) -
     return Mesh(
         dev_array, (DATA_AXIS, MODEL_AXIS), axis_types=(AxisType.Auto, AxisType.Auto)
     )
+
+
+def build_seq_mesh(data_parallel: int, sequence_parallel: int,
+                   devices=None) -> Mesh:
+    """('data', 'seq') mesh for sequence-parallel transformer training:
+    the batch splits over 'data', each example's token axis splits over
+    'seq' (ring attention moves k/v blocks between the seq shards via
+    ppermute — neighbor ICI traffic on real slices)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if data_parallel < 1 or sequence_parallel < 1:
+        raise ValueError(
+            f"mesh axes must be >= 1, got data_parallel={data_parallel}, "
+            f"sequence_parallel={sequence_parallel}")
+    need = data_parallel * sequence_parallel
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {data_parallel}x{sequence_parallel} needs {need} "
+            f"devices, have {len(devices)}")
+    import numpy as np
+
+    dev_array = np.array(devices[:need]).reshape(
+        data_parallel, sequence_parallel)
+    return Mesh(dev_array, (DATA_AXIS, SEQ_AXIS),
+                axis_types=(AxisType.Auto, AxisType.Auto))
 
 
 def layer_styles(spec, model_parallel: int) -> list[str]:

@@ -52,7 +52,8 @@ from . import mesh as mesh_lib
 from .mesh import DATA_AXIS, MODEL_AXIS
 
 
-def forward_local(spec, params, x, styles, use_pallas: bool = False):
+def forward_local(spec, params, x, styles, use_pallas: bool = False,
+                  seq_axis: str | None = None):
     """Per-shard forward to (replicated) logits; TP-aware (example.py:87-89).
 
     Model-family dispatch: TransformerSpec routes to the transformer
@@ -66,7 +67,7 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False):
     from ..models import transformer
 
     if isinstance(spec, transformer.TransformerSpec):
-        return transformer.apply(spec, params, x)
+        return transformer.apply(spec, params, x, seq_axis=seq_axis)
     if use_pallas and all(s == "rep" for s in styles):
         from ..ops import pallas_fused
 
@@ -75,8 +76,10 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False):
     return mlp.apply(spec, params, x, styles=styles, model_axis=MODEL_AXIS)
 
 
-def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas, remat=False):
-    fwd = lambda p, xx: forward_local(spec, p, xx, styles, use_pallas)
+def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas, remat=False,
+                  seq_axis=None):
+    fwd = lambda p, xx: forward_local(spec, p, xx, styles, use_pallas,
+                                      seq_axis)
     if remat:
         # jax.checkpoint: recompute activations in the backward pass
         # instead of saving them — trades MXU FLOPs for HBM, the
@@ -89,7 +92,8 @@ def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas, remat=False):
     return cost, acc
 
 
-def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer) -> Callable:
+def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
+                        seq_axis: str | None = None) -> Callable:
     """The per-shard synchronous step body (state, x, y) -> (state, cost,
     acc) — shared by the host-fed step (build_train_step) and the
     device-resident scan paths (parallel/epoch.py) so both train with
@@ -98,7 +102,8 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer) -> C
     def body(state: TrainState, x, y):
         def loss_fn(p):
             return _loss_and_acc(
-                spec, p, x, y, styles, cfg.naive_ce, cfg.pallas, cfg.remat
+                spec, p, x, y, styles, cfg.naive_ce, cfg.pallas, cfg.remat,
+                seq_axis,
             )
 
         (cost, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
@@ -122,15 +127,22 @@ def build_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer) -> Callable:
     round-trip, SURVEY.md §3.3).
     """
     dp = mesh.shape[DATA_AXIS]
-    mp = mesh.shape[MODEL_AXIS]
+    mp = mesh.shape.get(MODEL_AXIS, 1)
+    seq_axis = mesh_lib.SEQ_AXIS if mesh_lib.SEQ_AXIS in mesh.shape else None
     styles = mesh_lib.layer_styles(spec, mp)
     sspecs = mesh_lib.state_pspecs(spec, optimizer, mp)
-    shard_step = make_sync_step_body(cfg, spec, styles, dp, optimizer)
+    shard_step = make_sync_step_body(cfg, spec, styles, dp, optimizer,
+                                     seq_axis)
 
+    # under a ('data','seq') mesh the batch splits over 'data' and each
+    # example's flat token axis splits over 'seq' (contiguous blocks —
+    # the ring's layout contract); labels are per-example, data-only
+    x_spec = (P(DATA_AXIS, mesh_lib.SEQ_AXIS) if seq_axis
+              else P(DATA_AXIS))
     fn = jax.shard_map(
         shard_step,
         mesh=mesh,
-        in_specs=(sspecs, P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(sspecs, x_spec, P(DATA_AXIS)),
         out_specs=(sspecs, P(), P()),
     )
     return jax.jit(fn, donate_argnums=0)
@@ -142,19 +154,23 @@ def build_eval_step(cfg, mesh, spec: mlp.MLPSpec) -> Callable:
     Masked so the eval set can be zero-padded to a multiple of the data
     axis; chunked callers sum counts exactly.
     """
-    mp = mesh.shape[MODEL_AXIS]
+    mp = mesh.shape.get(MODEL_AXIS, 1)
+    seq_axis = mesh_lib.SEQ_AXIS if mesh_lib.SEQ_AXIS in mesh.shape else None
     styles = mesh_lib.layer_styles(spec, mp)
     pp = mesh_lib.param_pspecs(spec, mp)
 
     def shard_eval(params, x, y, mask):
-        logits = forward_local(spec, params, x, styles, cfg.pallas)
+        logits = forward_local(spec, params, x, styles, cfg.pallas,
+                               seq_axis)
         correct = (jnp.argmax(logits, -1) == jnp.argmax(y, -1)).astype(jnp.float32)
         return jax.lax.psum(jnp.sum(correct * mask), DATA_AXIS)
 
+    x_spec = (P(DATA_AXIS, mesh_lib.SEQ_AXIS) if seq_axis
+              else P(DATA_AXIS))
     fn = jax.shard_map(
         shard_eval,
         mesh=mesh,
-        in_specs=(pp, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(pp, x_spec, P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=P(),
     )
     return jax.jit(fn)

@@ -123,6 +123,21 @@ def run(cfg: Config) -> Dict[str, Any]:
         raise ValueError("--fsdp requires the synchronous step (sync_period=1)")
     if cfg.fsdp and cfg.model_parallel > 1:
         raise ValueError("--fsdp composes over the data axis; set model_parallel=1")
+    if cfg.sequence_parallel < 1:
+        raise ValueError(
+            f"sequence_parallel={cfg.sequence_parallel} must be >= 1")
+    if cfg.sequence_parallel > 1:
+        if cfg.model != "transformer":
+            raise ValueError("--sequence_parallel requires --model=transformer "
+                             "(the MLP has no token axis)")
+        if cfg.model_parallel > 1 or cfg.fsdp or cfg.sync_period > 1:
+            raise ValueError("--sequence_parallel composes with data "
+                             "parallelism only (model_parallel=1, no fsdp, "
+                             "sync_period=1)")
+        if cfg.seq_len % cfg.sequence_parallel:
+            raise ValueError(
+                f"seq_len={cfg.seq_len} must divide evenly over "
+                f"sequence_parallel={cfg.sequence_parallel}")
     cluster.bootstrap(cfg)
     cluster.enable_compilation_cache(cfg)
     if cfg.debug_nans:
@@ -139,7 +154,13 @@ def run(cfg: Config) -> Dict[str, Any]:
         mirrors=cfg.mnist_mirrors,
         input_size=cfg.input_size,
     )
-    mesh = mesh_lib.build_mesh(cfg.data_parallel, cfg.model_parallel)
+    if cfg.sequence_parallel > 1:
+        sp = cfg.sequence_parallel
+        dp_req = (len(jax.devices()) // sp if cfg.data_parallel == -1
+                  else cfg.data_parallel)
+        mesh = mesh_lib.build_seq_mesh(max(dp_req, 1), sp)
+    else:
+        mesh = mesh_lib.build_mesh(cfg.data_parallel, cfg.model_parallel)
     dp = mesh.shape[mesh_lib.DATA_AXIS]
     spec = make_spec(cfg)
     optimizer = make_optimizer(cfg)
@@ -150,6 +171,9 @@ def run(cfg: Config) -> Dict[str, Any]:
     fast = (
         cfg.fast_loop and proc_cnt == 1
         and (cfg.shard_data or dp == 1)
+        # sequence-parallel steps shard x over ('data','seq'), which the
+        # scan runners' P('data') dataset layout doesn't express yet
+        and cfg.sequence_parallel == 1
         # async fast path runs the whole program on-device; periodic
         # host-side checkpoints need the host loop
         and not (async_mode and (cfg.checkpoint_every or cfg.model_parallel > 1))
@@ -412,10 +436,18 @@ def run(cfg: Config) -> Dict[str, Any]:
         # assemble the global array explicitly (a bare numpy arg would be
         # treated as the full global batch on every process).
         batch_sharding = None
+        x_sharding = None
         if proc_cnt > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             batch_sharding = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
+            # x must be committed with the step's own layout — on a
+            # ('data','seq') mesh that is P('data','seq'); committing
+            # P('data') would force a reshard collective every step
+            x_sharding = (
+                NamedSharding(mesh, P(mesh_lib.DATA_AXIS, mesh_lib.SEQ_AXIS))
+                if mesh_lib.SEQ_AXIS in mesh.shape else batch_sharding
+            )
         start_time = time.time()  # example.py:149
         from ..data.prefetch import Prefetcher
 
@@ -432,7 +464,7 @@ def run(cfg: Config) -> Dict[str, Any]:
                 for i, (batch_x, batch_y) in batches:
                     if batch_sharding is not None:
                         batch_x = jax.make_array_from_process_local_data(
-                            batch_sharding, batch_x
+                            x_sharding, batch_x
                         )
                         batch_y = jax.make_array_from_process_local_data(
                             batch_sharding, batch_y
@@ -521,7 +553,8 @@ def run(cfg: Config) -> Dict[str, Any]:
         "examples_seen": examples_seen,
         "examples_per_sec": examples_seen / total_time if total_time > 0 else 0.0,
         "dataset_source": dataset.source,
-        "devices": dp * mesh.shape[mesh_lib.MODEL_AXIS],
+        "devices": dp * mesh.shape.get(mesh_lib.MODEL_AXIS, 1)
+        * mesh.shape.get(mesh_lib.SEQ_AXIS, 1),
         "global_batch": global_batch,
         "fast_loop": fast,
     }
