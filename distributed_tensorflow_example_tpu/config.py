@@ -67,6 +67,7 @@ class Config:
     d_ff: int = 256
     attention: str = "dense"        # dense | flash; --pallas also selects flash
     causal: bool = False            # causal (LM-style) attention mask
+    num_experts: int = 0            # >0: top-1 (Switch-style) MoE FFN
 
     # ---- loss (example.py:92-96) ----
     naive_ce: bool = False          # reproduce the reference's unstable log(softmax) CE
@@ -81,6 +82,10 @@ class Config:
     # ---- parallelism (SURVEY.md §7; replaces replica_device_setter) ----
     data_parallel: int = -1         # -1: all devices on the data axis
     model_parallel: int = 1         # Megatron-style TP over the hidden dim
+    expert_parallel: int = 1        # MoE transformer only: shard the expert
+                                    # stacks over a ('data','expert') mesh
+                                    # (weights, optimizer state and expert
+                                    # FLOPs split 1/n per device)
     sequence_parallel: int = 1      # transformer only: shard the token axis
                                     # over a ('data','seq') mesh; attention
                                     # runs the ppermute ring
@@ -178,6 +183,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--attention", type=str, default=d.attention,
                    choices=["dense", "flash"])
     p.add_argument("--causal", action="store_true")
+    p.add_argument("--num_experts", type=int, default=d.num_experts,
+                   help="transformer FFN becomes a top-1 MoE with this "
+                        "many experts (0 = dense FFN)")
+    p.add_argument("--expert_parallel", type=int, default=d.expert_parallel,
+                   help="MoE only: shard expert weights+FLOPs over a "
+                        "('data','expert') mesh")
     p.add_argument("--input_size", type=int, default=d.input_size)
     p.add_argument("--num_classes", type=int, default=d.num_classes)
     p.add_argument("--hidden_sizes", type=_parse_hidden, default=d.hidden_sizes,

@@ -55,6 +55,8 @@ class TransformerSpec:
     activation: str = "gelu"
     attention: str = "dense"       # dense | flash (ops/flash_attention)
     causal: bool = False
+    num_experts: int = 0           # 0 = dense FFN; >0 = top-1 (Switch-
+                                   # style) mixture-of-experts FFN
     param_dtype: jnp.dtype = jnp.float32
     compute_dtype: jnp.dtype = jnp.float32
 
@@ -92,8 +94,11 @@ def init(key: jax.Array, spec: TransformerSpec) -> Params:
             p[name] = (0.02 * jax.random.normal(
                 keys[name], shape, dtype=jnp.float32)).astype(pd)
         elif "W" in name:
+            # expert weights are [E, fan_in, fan_out]: scale by the
+            # per-expert fan-in, not the expert count
+            fan_in = shape[-2] if len(shape) == 3 else shape[0]
             p[name] = (jax.random.normal(keys[name], shape, jnp.float32)
-                       / jnp.sqrt(jnp.float32(shape[0]))).astype(pd)
+                       / jnp.sqrt(jnp.float32(fan_in))).astype(pd)
         elif name.endswith("_g"):
             p[name] = jnp.ones(shape, pd)
         else:
@@ -117,17 +122,40 @@ def param_shapes(spec: TransformerSpec) -> Dict[str, tuple[int, ...]]:
             f"L{i}_Wqkv": (d, 3 * d), f"L{i}_bqkv": (3 * d,),
             f"L{i}_Wo": (d, d), f"L{i}_bo": (d,),
             f"L{i}_ln2_g": (d,), f"L{i}_ln2_b": (d,),
-            f"L{i}_W1": (d, ff), f"L{i}_b1": (ff,),
-            f"L{i}_W2": (ff, d), f"L{i}_b2": (d,),
         })
+        if spec.num_experts:
+            e = spec.num_experts
+            shapes.update({
+                f"L{i}_Wr": (d, e),                 # router
+                f"L{i}_We1": (e, d, ff), f"L{i}_be1": (e, ff),
+                f"L{i}_We2": (e, ff, d), f"L{i}_be2": (e, d),
+            })
+        else:
+            shapes.update({
+                f"L{i}_W1": (d, ff), f"L{i}_b1": (ff,),
+                f"L{i}_W2": (ff, d), f"L{i}_b2": (d,),
+            })
     return shapes
 
 
-def param_pspecs(spec: TransformerSpec) -> Dict[str, "jax.sharding.PartitionSpec"]:
-    """Replicated P() for every leaf (pure data parallelism)."""
+_EXPERT_LEAVES = ("_We1", "_be1", "_We2", "_be2")
+
+
+def param_pspecs(spec: TransformerSpec, expert_axis: str | None = None,
+                 ) -> Dict[str, "jax.sharding.PartitionSpec"]:
+    """Replicated P() for every leaf; under expert parallelism the
+    per-expert weight stacks shard their leading E dim over
+    ``expert_axis`` (the router stays replicated — every shard needs
+    the full gate distribution)."""
     from jax.sharding import PartitionSpec as P
 
-    return {name: P() for name in param_shapes(spec)}
+    out = {}
+    for name, shape in param_shapes(spec).items():
+        if expert_axis and any(name.endswith(s) for s in _EXPERT_LEAVES):
+            out[name] = P(expert_axis, *([None] * (len(shape) - 1)))
+        else:
+            out[name] = P()
+    return out
 
 
 def _layer_norm(x, g, b):
@@ -161,8 +189,50 @@ def _attend(spec: TransformerSpec, q, k, v, seq_axis: str | None):
     return attention(q, k, v, causal=spec.causal)
 
 
+def _moe_ffn(spec: TransformerSpec, params: Params, i: int, a, act, cdt,
+             expert_axis: str | None):
+    """Top-1 (Switch-style) mixture-of-experts FFN for block ``i``.
+
+    Exact "dense dispatch": every (local) expert runs on every token
+    and the router's one-hot selects — no capacity factor, no dropped
+    tokens, fully differentiable through the gate probability. Under
+    expert parallelism (``expert_axis``) each shard holds E/n experts'
+    weights and computes ONLY those (1/n of the expert FLOPs and
+    memory); the one-hot is sliced by the shard's expert offset and the
+    partial outputs combine with one psum. (All-to-all token dispatch
+    is the sparse-capacity optimization of the same math; this
+    implementation trades its bandwidth savings for exactness.)
+    """
+    gate_logits = jnp.dot(
+        a.astype(cdt), params[f"L{i}_Wr"].astype(cdt),
+        preferred_element_type=jnp.float32)               # [B, S, E]
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    onehot = jax.nn.one_hot(jnp.argmax(probs, axis=-1), spec.num_experts,
+                            dtype=jnp.float32)            # [B, S, E]
+    gate = jnp.sum(probs * onehot, axis=-1, keepdims=True)  # [B, S, 1]
+    we1, be1 = params[f"L{i}_We1"], params[f"L{i}_be1"]
+    we2, be2 = params[f"L{i}_We2"], params[f"L{i}_be2"]
+    sel = onehot
+    if expert_axis is not None:
+        off = jax.lax.axis_index(expert_axis) * we1.shape[0]
+        sel = jax.lax.dynamic_slice_in_dim(onehot, off, we1.shape[0],
+                                           axis=2)
+    h1 = jnp.einsum("bsd,edf->bsef", a.astype(cdt), we1.astype(cdt),
+                    preferred_element_type=jnp.float32) \
+        + be1.astype(jnp.float32)
+    h1 = act(h1).astype(cdt)
+    h2 = jnp.einsum("bsef,efd->bsed", h1, we2.astype(cdt),
+                    preferred_element_type=jnp.float32) \
+        + be2.astype(jnp.float32)
+    out = jnp.einsum("bsed,bse->bsd", h2, sel)
+    if expert_axis is not None:
+        out = jax.lax.psum(out, expert_axis)
+    return gate * out
+
+
 def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray,
-          seq_axis: str | None = None) -> jnp.ndarray:
+          seq_axis: str | None = None,
+          expert_axis: str | None = None) -> jnp.ndarray:
     """Forward to logits. ``x``: [B, input_size] (viewed as seq_len
     tokens) or already [B, S, F].
 
@@ -203,8 +273,11 @@ def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray,
                       v.reshape(shape), seq_axis)
         h = h + mm(att.reshape(b, s, d), f"L{i}_Wo", f"L{i}_bo")
         a = _layer_norm(h, params[f"L{i}_ln2_g"], params[f"L{i}_ln2_b"])
-        a = act(mm(a, f"L{i}_W1", f"L{i}_b1")).astype(cdt)
-        h = h + mm(a, f"L{i}_W2", f"L{i}_b2")
+        if spec.num_experts:
+            h = h + _moe_ffn(spec, params, i, a, act, cdt, expert_axis)
+        else:
+            a = act(mm(a, f"L{i}_W1", f"L{i}_b1")).astype(cdt)
+            h = h + mm(a, f"L{i}_W2", f"L{i}_b2")
     h = _layer_norm(h, params["lnf_g"], params["lnf_b"])
     pooled = jnp.mean(h, axis=1)                          # [B, D]
     if seq_axis is not None:
@@ -224,7 +297,13 @@ def flops_per_step(spec: TransformerSpec, batch: int) -> float:
     2*MACs, bwd 4*MACs; attention 4*B*H*S^2*Dh fwd, x3 for fwd+bwd),
     for bench MFU accounting."""
     d, ff, f, s = spec.d_model, spec.d_ff, spec.d_feature, spec.seq_len
-    macs_tok = f * d + spec.num_blocks * (3 * d * d + d * d + d * ff + ff * d)
+    if spec.num_experts:
+        # dense-dispatch MoE computes every expert (plus the router);
+        # under EP each device computes 1/n of this
+        ffn = spec.num_experts * (d * ff + ff * d) + d * spec.num_experts
+    else:
+        ffn = d * ff + ff * d
+    macs_tok = f * d + spec.num_blocks * (3 * d * d + d * d + ffn)
     macs = batch * (s * macs_tok + d * spec.num_classes)
     attn = 4.0 * batch * spec.n_heads * s * s * spec.d_head \
         * spec.num_blocks * (0.5 if spec.causal else 1.0)

@@ -34,6 +34,7 @@ from ..models.mlp import MLPSpec
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
 
 
 def build_mesh(data_parallel: int = -1, model_parallel: int = 1, devices=None) -> Mesh:
@@ -61,28 +62,51 @@ def build_mesh(data_parallel: int = -1, model_parallel: int = 1, devices=None) -
     )
 
 
+def _build_2d_mesh(data_parallel: int, n: int, axis_name: str,
+                   devices=None) -> Mesh:
+    """('data', axis_name) mesh shared by the sequence- and expert-
+    parallel layouts; validates sizes against the device pool."""
+    devices = list(devices if devices is not None else jax.devices())
+    if data_parallel < 1 or n < 1:
+        raise ValueError(
+            f"mesh axes must be >= 1, got data_parallel={data_parallel}, "
+            f"{axis_name}={n}")
+    need = data_parallel * n
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {data_parallel}x{n} needs {need} devices, "
+            f"have {len(devices)}")
+    import numpy as np
+
+    dev_array = np.array(devices[:need]).reshape(data_parallel, n)
+    return Mesh(dev_array, (DATA_AXIS, axis_name),
+                axis_types=(AxisType.Auto, AxisType.Auto))
+
+
+def axis_if_present(mesh: Mesh, name: str) -> str | None:
+    """``name`` if the mesh has that axis, else None — the step/loop
+    probe for optional mesh flavors (seq/expert)."""
+    return name if name in mesh.shape else None
+
+
 def build_seq_mesh(data_parallel: int, sequence_parallel: int,
                    devices=None) -> Mesh:
     """('data', 'seq') mesh for sequence-parallel transformer training:
     the batch splits over 'data', each example's token axis splits over
     'seq' (ring attention moves k/v blocks between the seq shards via
     ppermute — neighbor ICI traffic on real slices)."""
-    devices = list(devices if devices is not None else jax.devices())
-    if data_parallel < 1 or sequence_parallel < 1:
-        raise ValueError(
-            f"mesh axes must be >= 1, got data_parallel={data_parallel}, "
-            f"sequence_parallel={sequence_parallel}")
-    need = data_parallel * sequence_parallel
-    if need > len(devices):
-        raise ValueError(
-            f"mesh {data_parallel}x{sequence_parallel} needs {need} "
-            f"devices, have {len(devices)}")
-    import numpy as np
+    return _build_2d_mesh(data_parallel, sequence_parallel, SEQ_AXIS,
+                          devices)
 
-    dev_array = np.array(devices[:need]).reshape(
-        data_parallel, sequence_parallel)
-    return Mesh(dev_array, (DATA_AXIS, SEQ_AXIS),
-                axis_types=(AxisType.Auto, AxisType.Auto))
+
+def build_expert_mesh(data_parallel: int, expert_parallel: int,
+                      devices=None) -> Mesh:
+    """('data', 'expert') mesh for expert-parallel MoE training: the
+    batch splits over 'data', each MoE layer's expert stack splits over
+    'expert' (models/transformer._moe_ffn combines the per-shard
+    partial outputs with one psum)."""
+    return _build_2d_mesh(data_parallel, expert_parallel, EXPERT_AXIS,
+                          devices)
 
 
 def layer_styles(spec, model_parallel: int) -> list[str]:
@@ -122,13 +146,14 @@ def layer_styles(spec, model_parallel: int) -> list[str]:
     return styles
 
 
-def param_pspecs(spec, model_parallel: int = 1) -> Dict[str, P]:
+def param_pspecs(spec, model_parallel: int = 1,
+                 expert_axis: str | None = None) -> Dict[str, P]:
     """PartitionSpecs for the param pytree — the replica_device_setter analog."""
     from ..models import transformer
 
     if isinstance(spec, transformer.TransformerSpec):
         layer_styles(spec, model_parallel)  # TP guard
-        return transformer.param_pspecs(spec)
+        return transformer.param_pspecs(spec, expert_axis)
     out: Dict[str, P] = {}
     for i, st in enumerate(layer_styles(spec, model_parallel), start=1):
         if st == "col":
@@ -143,11 +168,12 @@ def param_pspecs(spec, model_parallel: int = 1) -> Dict[str, P]:
     return out
 
 
-def state_pspecs(spec: MLPSpec, optimizer, model_parallel: int = 1):
+def state_pspecs(spec, optimizer, model_parallel: int = 1,
+                 expert_axis: str | None = None):
     """Spec tree matching a TrainState pytree."""
     from ..train.state import TrainState
 
-    pp = param_pspecs(spec, model_parallel)
+    pp = param_pspecs(spec, model_parallel, expert_axis)
     return TrainState(step=P(), params=pp, opt_state=optimizer.state_pspecs(pp))
 
 

@@ -53,7 +53,8 @@ from .mesh import DATA_AXIS, MODEL_AXIS
 
 
 def forward_local(spec, params, x, styles, use_pallas: bool = False,
-                  seq_axis: str | None = None):
+                  seq_axis: str | None = None,
+                  expert_axis: str | None = None):
     """Per-shard forward to (replicated) logits; TP-aware (example.py:87-89).
 
     Model-family dispatch: TransformerSpec routes to the transformer
@@ -67,7 +68,8 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
     from ..models import transformer
 
     if isinstance(spec, transformer.TransformerSpec):
-        return transformer.apply(spec, params, x, seq_axis=seq_axis)
+        return transformer.apply(spec, params, x, seq_axis=seq_axis,
+                                 expert_axis=expert_axis)
     if use_pallas and all(s == "rep" for s in styles):
         from ..ops import pallas_fused
 
@@ -77,9 +79,9 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
 
 
 def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas, remat=False,
-                  seq_axis=None):
+                  seq_axis=None, expert_axis=None):
     fwd = lambda p, xx: forward_local(spec, p, xx, styles, use_pallas,
-                                      seq_axis)
+                                      seq_axis, expert_axis)
     if remat:
         # jax.checkpoint: recompute activations in the backward pass
         # instead of saving them — trades MXU FLOPs for HBM, the
@@ -93,7 +95,8 @@ def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas, remat=False,
 
 
 def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
-                        seq_axis: str | None = None) -> Callable:
+                        seq_axis: str | None = None,
+                        expert_axis: str | None = None) -> Callable:
     """The per-shard synchronous step body (state, x, y) -> (state, cost,
     acc) — shared by the host-fed step (build_train_step) and the
     device-resident scan paths (parallel/epoch.py) so both train with
@@ -103,7 +106,7 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
         def loss_fn(p):
             return _loss_and_acc(
                 spec, p, x, y, styles, cfg.naive_ce, cfg.pallas, cfg.remat,
-                seq_axis,
+                seq_axis, expert_axis,
             )
 
         (cost, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
@@ -128,11 +131,12 @@ def build_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer) -> Callable:
     """
     dp = mesh.shape[DATA_AXIS]
     mp = mesh.shape.get(MODEL_AXIS, 1)
-    seq_axis = mesh_lib.SEQ_AXIS if mesh_lib.SEQ_AXIS in mesh.shape else None
+    seq_axis = mesh_lib.axis_if_present(mesh, mesh_lib.SEQ_AXIS)
+    expert_axis = mesh_lib.axis_if_present(mesh, mesh_lib.EXPERT_AXIS)
     styles = mesh_lib.layer_styles(spec, mp)
-    sspecs = mesh_lib.state_pspecs(spec, optimizer, mp)
+    sspecs = mesh_lib.state_pspecs(spec, optimizer, mp, expert_axis)
     shard_step = make_sync_step_body(cfg, spec, styles, dp, optimizer,
-                                     seq_axis)
+                                     seq_axis, expert_axis)
 
     # under a ('data','seq') mesh the batch splits over 'data' and each
     # example's flat token axis splits over 'seq' (contiguous blocks —
@@ -155,13 +159,14 @@ def build_eval_step(cfg, mesh, spec: mlp.MLPSpec) -> Callable:
     axis; chunked callers sum counts exactly.
     """
     mp = mesh.shape.get(MODEL_AXIS, 1)
-    seq_axis = mesh_lib.SEQ_AXIS if mesh_lib.SEQ_AXIS in mesh.shape else None
+    seq_axis = mesh_lib.axis_if_present(mesh, mesh_lib.SEQ_AXIS)
+    expert_axis = mesh_lib.axis_if_present(mesh, mesh_lib.EXPERT_AXIS)
     styles = mesh_lib.layer_styles(spec, mp)
-    pp = mesh_lib.param_pspecs(spec, mp)
+    pp = mesh_lib.param_pspecs(spec, mp, expert_axis)
 
     def shard_eval(params, x, y, mask):
         logits = forward_local(spec, params, x, styles, cfg.pallas,
-                               seq_axis)
+                               seq_axis, expert_axis)
         correct = (jnp.argmax(logits, -1) == jnp.argmax(y, -1)).astype(jnp.float32)
         return jax.lax.psum(jnp.sum(correct * mask), DATA_AXIS)
 

@@ -64,6 +64,7 @@ def make_spec(cfg: Config):
                                        # apply to this family
             attention="flash" if cfg.pallas else cfg.attention,
             causal=cfg.causal,
+            num_experts=cfg.num_experts,
             param_dtype=jnp.dtype(cfg.param_dtype),
             compute_dtype=jnp.dtype(cfg.compute_dtype),
         )
@@ -126,6 +127,25 @@ def run(cfg: Config) -> Dict[str, Any]:
     if cfg.sequence_parallel < 1:
         raise ValueError(
             f"sequence_parallel={cfg.sequence_parallel} must be >= 1")
+    if cfg.expert_parallel < 1:
+        raise ValueError(
+            f"expert_parallel={cfg.expert_parallel} must be >= 1")
+    if cfg.num_experts < 0:
+        raise ValueError(f"num_experts={cfg.num_experts} must be >= 0")
+    if cfg.num_experts and cfg.model != "transformer":
+        raise ValueError("--num_experts applies to --model=transformer only")
+    if cfg.expert_parallel > 1:
+        if not cfg.num_experts:
+            raise ValueError("--expert_parallel requires --num_experts > 0")
+        if cfg.num_experts % cfg.expert_parallel:
+            raise ValueError(
+                f"num_experts={cfg.num_experts} must divide evenly over "
+                f"expert_parallel={cfg.expert_parallel}")
+        if (cfg.model_parallel > 1 or cfg.fsdp or cfg.sync_period > 1
+                or cfg.sequence_parallel > 1):
+            raise ValueError("--expert_parallel composes with data "
+                             "parallelism only (model_parallel=1, no fsdp, "
+                             "sync_period=1, sequence_parallel=1)")
     if cfg.sequence_parallel > 1:
         if cfg.model != "transformer":
             raise ValueError("--sequence_parallel requires --model=transformer "
@@ -154,11 +174,13 @@ def run(cfg: Config) -> Dict[str, Any]:
         mirrors=cfg.mnist_mirrors,
         input_size=cfg.input_size,
     )
-    if cfg.sequence_parallel > 1:
-        sp = cfg.sequence_parallel
-        dp_req = (len(jax.devices()) // sp if cfg.data_parallel == -1
+    if cfg.sequence_parallel > 1 or cfg.expert_parallel > 1:
+        n_axis = max(cfg.sequence_parallel, cfg.expert_parallel)
+        dp_req = (len(jax.devices()) // n_axis if cfg.data_parallel == -1
                   else cfg.data_parallel)
-        mesh = mesh_lib.build_seq_mesh(max(dp_req, 1), sp)
+        builder = (mesh_lib.build_seq_mesh if cfg.sequence_parallel > 1
+                   else mesh_lib.build_expert_mesh)
+        mesh = builder(max(dp_req, 1), n_axis)
     else:
         mesh = mesh_lib.build_mesh(cfg.data_parallel, cfg.model_parallel)
     dp = mesh.shape[mesh_lib.DATA_AXIS]
@@ -172,8 +194,9 @@ def run(cfg: Config) -> Dict[str, Any]:
         cfg.fast_loop and proc_cnt == 1
         and (cfg.shard_data or dp == 1)
         # sequence-parallel steps shard x over ('data','seq'), which the
-        # scan runners' P('data') dataset layout doesn't express yet
-        and cfg.sequence_parallel == 1
+        # scan runners' P('data') dataset layout doesn't express yet;
+        # expert-parallel state pspecs likewise
+        and cfg.sequence_parallel == 1 and cfg.expert_parallel == 1
         # async fast path runs the whole program on-device; periodic
         # host-side checkpoints need the host loop
         and not (async_mode and (cfg.checkpoint_every or cfg.model_parallel > 1))
@@ -211,7 +234,9 @@ def run(cfg: Config) -> Dict[str, Any]:
         train_step = None if fast else step_lib.build_train_step(cfg, mesh, spec, optimizer)
         param_sync = None
         get_params = None
-        sspecs = mesh_lib.state_pspecs(spec, optimizer, cfg.model_parallel)
+        sspecs = mesh_lib.state_pspecs(
+            spec, optimizer, cfg.model_parallel,
+            mesh_lib.axis_if_present(mesh, mesh_lib.EXPERT_AXIS))
     state = mesh_lib.place_state(state, mesh, sspecs)
     print("Variables initialized ...")  # example.py:130
 
@@ -554,7 +579,8 @@ def run(cfg: Config) -> Dict[str, Any]:
         "examples_per_sec": examples_seen / total_time if total_time > 0 else 0.0,
         "dataset_source": dataset.source,
         "devices": dp * mesh.shape.get(mesh_lib.MODEL_AXIS, 1)
-        * mesh.shape.get(mesh_lib.SEQ_AXIS, 1),
+        * mesh.shape.get(mesh_lib.SEQ_AXIS, 1)
+        * mesh.shape.get(mesh_lib.EXPERT_AXIS, 1),
         "global_batch": global_batch,
         "fast_loop": fast,
     }
