@@ -82,6 +82,11 @@ class Config:
     # ---- parallelism (SURVEY.md §7; replaces replica_device_setter) ----
     data_parallel: int = -1         # -1: all devices on the data axis
     model_parallel: int = 1         # Megatron-style TP over the hidden dim
+    pipeline_parallel: int = 1      # transformer only: GPipe stages over a
+                                    # ('data','stage') mesh; each stage holds
+                                    # num_blocks/N consecutive encoder blocks
+    microbatches: int = 4           # GPipe microbatches per local batch
+                                    # (pipeline_parallel > 1 only)
     expert_parallel: int = 1        # MoE transformer only: shard the expert
                                     # stacks over a ('data','expert') mesh
                                     # (weights, optimizer state and expert
@@ -206,6 +211,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--adam_eps", type=float, default=d.adam_eps)
     p.add_argument("--data_parallel", type=int, default=d.data_parallel)
     p.add_argument("--model_parallel", type=int, default=d.model_parallel)
+    p.add_argument("--pipeline_parallel", type=int, default=d.pipeline_parallel,
+                   help="transformer only: GPipe pipeline stages over a "
+                        "('data','stage') mesh")
+    p.add_argument("--microbatches", type=int, default=d.microbatches,
+                   help="GPipe microbatches per local batch")
     p.add_argument("--sequence_parallel", type=int, default=d.sequence_parallel,
                    help="transformer only: shard the token axis over a "
                         "('data','seq') mesh (ring attention in the step)")

@@ -230,6 +230,38 @@ def _moe_ffn(spec: TransformerSpec, params: Params, i: int, a, act, cdt,
     return gate * out
 
 
+def _mm(params_or_bp, a, w_name, b_name, cdt):
+    acc = jnp.dot(a.astype(cdt), params_or_bp[w_name].astype(cdt),
+                  preferred_element_type=jnp.float32)
+    return acc + params_or_bp[b_name].astype(jnp.float32)
+
+
+def _block_forward(spec: TransformerSpec, bp: Params, h, act, cdt,
+                   seq_axis: str | None = None,
+                   expert_axis: str | None = None, moe_block: int = 0,
+                   full_params: Params | None = None):
+    """One encoder block on ``h`` [B, S(local), D]. ``bp`` holds the
+    block's leaves under their UNPREFIXED names (ln1_g, Wqkv, ...) so
+    the same body serves the regular forward (dict views of L{i}_*)
+    and the pipelined forward (lax.scan over stacked stages)."""
+    b, s, d = h.shape
+    a = _layer_norm(h, bp["ln1_g"], bp["ln1_b"])
+    qkv = _mm(bp, a, "Wqkv", "bqkv", cdt)                # [B, S, 3D]
+    q, k, v = jnp.split(qkv.astype(cdt), 3, axis=-1)
+    shape = (b, s, spec.n_heads, spec.d_head)
+    att = _attend(spec, q.reshape(shape), k.reshape(shape),
+                  v.reshape(shape), seq_axis)
+    h = h + _mm(bp, att.reshape(b, s, d), "Wo", "bo", cdt)
+    a = _layer_norm(h, bp["ln2_g"], bp["ln2_b"])
+    if spec.num_experts:
+        h = h + _moe_ffn(spec, full_params, moe_block, a, act, cdt,
+                         expert_axis)
+    else:
+        a = act(_mm(bp, a, "W1", "b1", cdt)).astype(cdt)
+        h = h + _mm(bp, a, "W2", "b2", cdt)
+    return h
+
+
 def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray,
           seq_axis: str | None = None,
           expert_axis: str | None = None) -> jnp.ndarray:
@@ -252,38 +284,153 @@ def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray,
         s = spec.seq_len // n_shards
     h = x.reshape(b, s, f).astype(cdt)
 
-    def mm(a, w_name, b_name):
-        acc = jnp.dot(a.astype(cdt), params[w_name].astype(cdt),
-                      preferred_element_type=jnp.float32)
-        return acc + params[b_name].astype(jnp.float32)
-
     pos = params["pos"].astype(jnp.float32)
     if seq_axis is not None:
         # this shard's slice of the global positional table
         off = jax.lax.axis_index(seq_axis) * s
         pos = jax.lax.dynamic_slice_in_dim(pos, off, s, axis=0)
-    h = mm(h, "W_in", "b_in") + pos[None]
+    h = _mm(params, h, "W_in", "b_in", cdt) + pos[None]
     act = _ACTIVATIONS[spec.activation]
     for i in range(spec.num_blocks):
-        a = _layer_norm(h, params[f"L{i}_ln1_g"], params[f"L{i}_ln1_b"])
-        qkv = mm(a, f"L{i}_Wqkv", f"L{i}_bqkv")          # [B, S, 3D]
-        q, k, v = jnp.split(qkv.astype(cdt), 3, axis=-1)
-        shape = (b, s, spec.n_heads, spec.d_head)
-        att = _attend(spec, q.reshape(shape), k.reshape(shape),
-                      v.reshape(shape), seq_axis)
-        h = h + mm(att.reshape(b, s, d), f"L{i}_Wo", f"L{i}_bo")
-        a = _layer_norm(h, params[f"L{i}_ln2_g"], params[f"L{i}_ln2_b"])
-        if spec.num_experts:
-            h = h + _moe_ffn(spec, params, i, a, act, cdt, expert_axis)
-        else:
-            a = act(mm(a, f"L{i}_W1", f"L{i}_b1")).astype(cdt)
-            h = h + mm(a, f"L{i}_W2", f"L{i}_b2")
+        bp = {k[len(f"L{i}_"):]: v for k, v in params.items()
+              if k.startswith(f"L{i}_")}
+        h = _block_forward(spec, bp, h, act, cdt, seq_axis, expert_axis,
+                           moe_block=i, full_params=params)
     h = _layer_norm(h, params["lnf_g"], params["lnf_b"])
     pooled = jnp.mean(h, axis=1)                          # [B, D]
     if seq_axis is not None:
         # complete the global token mean; logits become seq-invariant
         pooled = jax.lax.pmean(pooled, seq_axis)
-    return mm(pooled, "W_head", "b_head").astype(jnp.float32)
+    return _mm(params, pooled, "W_head", "b_head", cdt).astype(jnp.float32)
+
+
+_BLOCK_LEAVES = ("ln1_g", "ln1_b", "Wqkv", "bqkv", "Wo", "bo",
+                 "ln2_g", "ln2_b", "W1", "b1", "W2", "b2")
+
+
+def pipeline_stack_params(spec: TransformerSpec, params: Params) -> Params:
+    """Regroup the flat ``L{i}_*`` block leaves into stacked
+    ``blk_*`` arrays with a leading ``[num_blocks, ...]`` dim — the
+    layout pipeline parallelism shards ``P('stage')`` on (each stage
+    holds its contiguous num_blocks/n_stages slice). Embed/head/final-
+    LN leaves stay replicated under their own names. Dense FFN only
+    (the driver guards MoE+PP; this guard covers library callers)."""
+    if spec.num_experts:
+        raise ValueError(
+            "pipeline parallelism supports the dense FFN only "
+            "(num_experts=0)")
+    out = {k: v for k, v in params.items() if not k.startswith("L")}
+    for leaf in _BLOCK_LEAVES:
+        out[f"blk_{leaf}"] = jnp.stack(
+            [params[f"L{i}_{leaf}"] for i in range(spec.num_blocks)])
+    return out
+
+
+def pipeline_unstack_params(spec: TransformerSpec, stacked: Params) -> Params:
+    """Inverse of pipeline_stack_params. Note checkpoints of PP runs
+    store the STACKED layout (stage-count-agnostic — any stage count
+    dividing num_blocks restores it — but NOT interchangeable with the
+    flat non-PP layout); this inverse serves tests and conversions."""
+    out = {k: v for k, v in stacked.items() if not k.startswith("blk_")}
+    for leaf in _BLOCK_LEAVES:
+        for i in range(spec.num_blocks):
+            out[f"L{i}_{leaf}"] = stacked[f"blk_{leaf}"][i]
+    return out
+
+
+def pipeline_train_state(spec: TransformerSpec, optimizer, state):
+    """Re-layout a freshly created TrainState for pipeline parallelism:
+    stacked block params with optimizer slots initialized on the
+    stacked layout — the one place the PP state shape is defined."""
+    from ..train.state import TrainState
+
+    stacked = pipeline_stack_params(spec, state.params)
+    return TrainState(step=state.step, params=stacked,
+                      opt_state=optimizer.init(stacked))
+
+
+def pipeline_param_pspecs(spec: TransformerSpec, stage_axis: str,
+                          ) -> Dict[str, "jax.sharding.PartitionSpec"]:
+    """Specs for the stacked layout: blk_* shard their block dim over
+    ``stage_axis``; everything else replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    shapes = param_shapes(spec)
+    out = {}
+    for name in shapes:
+        if name.startswith("L0_"):
+            leaf = name[len("L0_"):]
+            out[f"blk_{leaf}"] = P(stage_axis,
+                                   *([None] * len(shapes[name])))
+        elif not name.startswith("L"):
+            out[name] = P()
+    return out
+
+
+def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
+                   stage_axis: str, n_stages: int,
+                   num_microbatches: int) -> jnp.ndarray:
+    """GPipe-style pipeline-parallel forward inside shard_map.
+
+    ``params`` is the stacked layout (pipeline_stack_params) with the
+    block dim sharded over ``stage_axis`` — each stage holds
+    num_blocks/n_stages consecutive blocks, applied by a lax.scan.
+    The local batch splits into ``num_microbatches``; at tick t stage s
+    processes microbatch t-s, then hands its activations to stage s+1
+    with a single ppermute (neighbor ICI traffic on real slices; the
+    schedule runs M + n_stages - 1 ticks, the standard GPipe bubble).
+    Stage 0 embeds incoming microbatches; the LAST stage computes the
+    head, and the collected logits are shared with a psum so every
+    stage returns identical [B, num_classes] logits — the surrounding
+    loss/eval plumbing is unchanged. The backward pass is jax.grad
+    through this forward: shard_map transposes each ppermute into the
+    reverse hop, which IS the reverse pipeline schedule.
+    """
+    cdt = spec.compute_dtype
+    b = x.shape[0]
+    s, f, d = spec.seq_len, spec.d_feature, spec.d_model
+    m_cnt = num_microbatches
+    if b % m_cnt:
+        raise ValueError(
+            f"local batch {b} must divide into microbatches={m_cnt}")
+    mb = b // m_cnt
+    sidx = jax.lax.axis_index(stage_axis)
+    act = _ACTIVATIONS[spec.activation]
+    micro = x.reshape(m_cnt, mb, s, f)
+    local_blocks = {k[len("blk_"):]: v for k, v in params.items()
+                    if k.startswith("blk_")}       # leaves [K, ...]
+
+    def run_local(h):
+        def body(h_, bp):
+            return _block_forward(spec, bp, h_, act, cdt), None
+
+        h_, _ = jax.lax.scan(body, h, local_blocks)
+        return h_
+
+    pos = params["pos"].astype(jnp.float32)
+    perm = [(j, j + 1) for j in range(n_stages - 1)]
+    recv = jnp.zeros((mb, s, d), jnp.float32)
+    collected = jnp.zeros((m_cnt, mb, spec.num_classes), jnp.float32)
+    last = n_stages - 1
+    for t in range(m_cnt + n_stages - 1):
+        # stage 0 ingests microbatch t (t >= m_cnt re-embeds the final
+        # microbatch; those outputs can never reach the last stage
+        # within the schedule, so they are dead by construction)
+        x_t = micro[min(t, m_cnt - 1)].astype(cdt)
+        emb = _mm(params, x_t, "W_in", "b_in", cdt) + pos[None]
+        h_in = jnp.where(jnp.equal(sidx, 0), emb, recv)
+        h_out = run_local(h_in)
+        m = t - (n_stages - 1)
+        if 0 <= m < m_cnt:   # static schedule index
+            hl = _layer_norm(h_out, params["lnf_g"], params["lnf_b"])
+            logits_t = _mm(params, jnp.mean(hl, axis=1), "W_head",
+                           "b_head", cdt)
+            collected = collected.at[m].set(
+                jnp.where(jnp.equal(sidx, last), logits_t, 0.0))
+        if n_stages > 1 and t < m_cnt + n_stages - 2:
+            recv = jax.lax.ppermute(h_out, stage_axis, perm)
+    logits = jax.lax.psum(collected, stage_axis)
+    return logits.reshape(b, spec.num_classes).astype(jnp.float32)
 
 
 def num_params(spec: TransformerSpec) -> int:

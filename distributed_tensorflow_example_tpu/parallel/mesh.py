@@ -35,6 +35,7 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
+STAGE_AXIS = "stage"
 
 
 def build_mesh(data_parallel: int = -1, model_parallel: int = 1, devices=None) -> Mesh:
@@ -81,6 +82,26 @@ def _build_2d_mesh(data_parallel: int, n: int, axis_name: str,
     dev_array = np.array(devices[:need]).reshape(data_parallel, n)
     return Mesh(dev_array, (DATA_AXIS, axis_name),
                 axis_types=(AxisType.Auto, AxisType.Auto))
+
+
+def build_stage_mesh(data_parallel: int, pipeline_parallel: int,
+                     devices=None) -> Mesh:
+    """('data', 'stage') mesh for pipeline-parallel transformer
+    training: each stage holds a contiguous slice of the encoder
+    blocks; activations hop stage->stage+1 via ppermute on the GPipe
+    microbatch schedule (models/transformer.apply_pipeline)."""
+    return _build_2d_mesh(data_parallel, pipeline_parallel, STAGE_AXIS,
+                          devices)
+
+
+def pipeline_state_pspecs(spec, optimizer, stage_axis: str):
+    """Spec tree for the PP-stacked TrainState layout."""
+    from ..models import transformer
+    from ..train.state import TrainState
+
+    pp = transformer.pipeline_param_pspecs(spec, stage_axis)
+    return TrainState(step=P(), params=pp,
+                      opt_state=optimizer.state_pspecs(pp))
 
 
 def axis_if_present(mesh: Mesh, name: str) -> str | None:

@@ -98,10 +98,14 @@ def _print_window(step: int, epoch: int, batch_i: int, batch_count: int,
           " AvgTime: %3.2fms" % float(elapsed_time * 1000 / frequency))
 
 
-def _eval_accuracy(eval_step, params, images, labels, dp: int, chunk: int) -> float:
-    """Full-test-set accuracy (example.py:177), zero-padded to the mesh."""
+def _eval_accuracy(eval_step, params, images, labels, dp: int, chunk: int,
+                   unit: int | None = None) -> float:
+    """Full-test-set accuracy (example.py:177), zero-padded to the mesh.
+    ``unit`` overrides the chunk-rounding granularity (e.g. dp x
+    microbatches under pipeline parallelism)."""
     n = images.shape[0]
-    chunk = max(dp, (min(chunk, n) // dp) * dp)
+    unit = unit or dp
+    chunk = max(unit, (min(chunk, n) // unit) * unit)
     correct = 0.0
     for off in range(0, n, chunk):
         x = images[off : off + chunk]
@@ -134,6 +138,26 @@ def run(cfg: Config) -> Dict[str, Any]:
         raise ValueError(f"num_experts={cfg.num_experts} must be >= 0")
     if cfg.num_experts and cfg.model != "transformer":
         raise ValueError("--num_experts applies to --model=transformer only")
+    if cfg.pipeline_parallel < 1:
+        raise ValueError(
+            f"pipeline_parallel={cfg.pipeline_parallel} must be >= 1")
+    if cfg.pipeline_parallel > 1:
+        if cfg.model != "transformer":
+            raise ValueError("--pipeline_parallel requires "
+                             "--model=transformer (the MLP has no stages)")
+        if cfg.num_blocks % cfg.pipeline_parallel:
+            raise ValueError(
+                f"num_blocks={cfg.num_blocks} must divide evenly over "
+                f"pipeline_parallel={cfg.pipeline_parallel}")
+        if cfg.microbatches < 1:
+            raise ValueError(f"microbatches={cfg.microbatches} must be >= 1")
+        if cfg.num_experts:
+            raise ValueError("--pipeline_parallel supports the dense FFN "
+                             "only (no --num_experts)")
+        if (cfg.model_parallel > 1 or cfg.fsdp or cfg.sync_period > 1
+                or cfg.sequence_parallel > 1 or cfg.expert_parallel > 1):
+            raise ValueError("--pipeline_parallel composes with data "
+                             "parallelism only")
     if cfg.expert_parallel > 1:
         if not cfg.num_experts:
             raise ValueError("--expert_parallel requires --num_experts > 0")
@@ -174,12 +198,16 @@ def run(cfg: Config) -> Dict[str, Any]:
         mirrors=cfg.mnist_mirrors,
         input_size=cfg.input_size,
     )
-    if cfg.sequence_parallel > 1 or cfg.expert_parallel > 1:
-        n_axis = max(cfg.sequence_parallel, cfg.expert_parallel)
+    if (cfg.sequence_parallel > 1 or cfg.expert_parallel > 1
+            or cfg.pipeline_parallel > 1):
+        n_axis = max(cfg.sequence_parallel, cfg.expert_parallel,
+                     cfg.pipeline_parallel)
         dp_req = (len(jax.devices()) // n_axis if cfg.data_parallel == -1
                   else cfg.data_parallel)
         builder = (mesh_lib.build_seq_mesh if cfg.sequence_parallel > 1
-                   else mesh_lib.build_expert_mesh)
+                   else mesh_lib.build_expert_mesh
+                   if cfg.expert_parallel > 1
+                   else mesh_lib.build_stage_mesh)
         mesh = builder(max(dp_req, 1), n_axis)
     else:
         mesh = mesh_lib.build_mesh(cfg.data_parallel, cfg.model_parallel)
@@ -188,6 +216,11 @@ def run(cfg: Config) -> Dict[str, Any]:
     optimizer = make_optimizer(cfg)
 
     global_batch = _global_batch(cfg, dp)
+    pp_mode = cfg.pipeline_parallel > 1
+    if pp_mode and (global_batch // dp) % cfg.microbatches:
+        raise ValueError(
+            f"per-shard batch {global_batch // dp} must divide into "
+            f"microbatches={cfg.microbatches}")
     async_mode = cfg.sync_period > 1
     fsdp_mode = cfg.fsdp
     fast = (
@@ -197,6 +230,7 @@ def run(cfg: Config) -> Dict[str, Any]:
         # scan runners' P('data') dataset layout doesn't express yet;
         # expert-parallel state pspecs likewise
         and cfg.sequence_parallel == 1 and cfg.expert_parallel == 1
+        and cfg.pipeline_parallel == 1
         # async fast path runs the whole program on-device; periodic
         # host-side checkpoints need the host loop
         and not (async_mode and (cfg.checkpoint_every or cfg.model_parallel > 1))
@@ -234,9 +268,20 @@ def run(cfg: Config) -> Dict[str, Any]:
         train_step = None if fast else step_lib.build_train_step(cfg, mesh, spec, optimizer)
         param_sync = None
         get_params = None
-        sspecs = mesh_lib.state_pspecs(
-            spec, optimizer, cfg.model_parallel,
-            mesh_lib.axis_if_present(mesh, mesh_lib.EXPERT_AXIS))
+        if pp_mode:
+            # pipeline layout: block leaves stacked [num_blocks, ...]
+            # and sharded over 'stage' (checkpoints keep this stacked
+            # layout — restorable at any stage count dividing
+            # num_blocks, but not interchangeable with non-PP runs)
+            from ..models import transformer as tfm_lib
+
+            state = tfm_lib.pipeline_train_state(spec, optimizer, state)
+            sspecs = mesh_lib.pipeline_state_pspecs(
+                spec, optimizer, mesh_lib.STAGE_AXIS)
+        else:
+            sspecs = mesh_lib.state_pspecs(
+                spec, optimizer, cfg.model_parallel,
+                mesh_lib.axis_if_present(mesh, mesh_lib.EXPERT_AXIS))
     state = mesh_lib.place_state(state, mesh, sspecs)
     print("Variables initialized ...")  # example.py:130
 
@@ -545,9 +590,11 @@ def run(cfg: Config) -> Dict[str, Any]:
             test_acc = fast_eval(params)
         else:                           # host path
             eval_step = step_lib.build_eval_step(cfg, mesh, spec)
+            eval_unit = dp * cfg.microbatches if pp_mode else dp
             test_acc = _eval_accuracy(
                 eval_step, params, dataset.test.images, dataset.test.labels,
-                dp, chunk=max(cfg.eval_batch_size, dp),
+                dp, chunk=max(cfg.eval_batch_size, eval_unit),
+                unit=eval_unit,
             )
     total_time = time.time() - begin_time
     cost = float(cost)
@@ -580,7 +627,8 @@ def run(cfg: Config) -> Dict[str, Any]:
         "dataset_source": dataset.source,
         "devices": dp * mesh.shape.get(mesh_lib.MODEL_AXIS, 1)
         * mesh.shape.get(mesh_lib.SEQ_AXIS, 1)
-        * mesh.shape.get(mesh_lib.EXPERT_AXIS, 1),
+        * mesh.shape.get(mesh_lib.EXPERT_AXIS, 1)
+        * mesh.shape.get(mesh_lib.STAGE_AXIS, 1),
         "global_batch": global_batch,
         "fast_loop": fast,
     }
