@@ -30,6 +30,20 @@ CELLS = [
     ("momentum_host", {"optimizer": "momentum", "fast_loop": False}),
 ]
 
+# tiny-transformer base shared by the family crossings below
+_TFM = {"model": "transformer", "d_model": 16, "n_heads": 2,
+        "num_blocks": 2, "d_ff": 32}
+CELLS += [
+    ("tfm_fast", {**_TFM, "optimizer": "adam", "learning_rate": 0.001}),
+    ("tfm_flash_remat", {**_TFM, "attention": "flash", "remat": True}),
+    ("tfm_fsdp_bf16", {**_TFM, "fsdp": True, "compute_dtype": "bfloat16"}),
+    ("tfm_sp", {**_TFM, "sequence_parallel": 4, "data_parallel": 2}),
+    ("tfm_moe_ep", {**_TFM, "num_experts": 4, "expert_parallel": 4,
+                    "data_parallel": 2}),
+    ("tfm_pp", {**_TFM, "pipeline_parallel": 2, "data_parallel": 4,
+                "microbatches": 2}),
+]
+
 
 @pytest.fixture(scope="module")
 def tiny_dataset():

@@ -396,3 +396,23 @@ def test_pp_validation():
     with pytest.raises(ValueError, match="dense FFN"):
         run(Config(model="transformer", pipeline_parallel=2,
                    num_blocks=2, num_experts=4))
+
+
+def test_pp_checkpoint_resume(devices8, tmp_path):
+    """PP checkpoints store the stacked layout; --resume continues a
+    pipeline run at the same stage count with the step counter intact."""
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    kw = dict(
+        model="transformer", pipeline_parallel=2, num_blocks=2,
+        data_parallel=4, microbatches=2, batch_size=64,
+        learning_rate=0.003, optimizer="adam", dataset="synthetic",
+        synthetic_train_size=512, synthetic_test_size=128,
+        summaries=False, compilation_cache="", frequency=4,
+        checkpoint_dir=str(tmp_path),
+    )
+    first = run(Config(training_epochs=1, **kw))
+    assert first["steps"] == 8
+    resumed = run(Config(training_epochs=2, resume=True, **kw))
+    assert resumed["steps"] == 16, resumed
+    assert np.isfinite(resumed["final_cost"])
