@@ -93,8 +93,13 @@ class Config:
                                     # FLOPs split 1/n per device)
     sequence_parallel: int = 1      # transformer only: shard the token axis
                                     # over a ('data','seq') mesh; attention
-                                    # runs the ppermute ring
-                                    # (ops/ring_attention) inside the step
+                                    # runs the --sp_impl layout inside the step
+    sp_impl: str = "ring"           # sequence-parallel attention layout:
+                                    # ring (ppermute k/v orbit,
+                                    # ops/ring_attention) | ulysses
+                                    # (head<->seq all_to_all,
+                                    # ops/ulysses_attention; needs
+                                    # n_heads % sequence_parallel == 0)
     sync_period: int = 1            # 1 = fully synchronous psum every step;
                                     # K>1 = local SGD, params averaged every K
                                     # steps (TPU-native async-staleness analog,
@@ -218,7 +223,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="GPipe microbatches per local batch")
     p.add_argument("--sequence_parallel", type=int, default=d.sequence_parallel,
                    help="transformer only: shard the token axis over a "
-                        "('data','seq') mesh (ring attention in the step)")
+                        "('data','seq') mesh (--sp_impl selects the layout)")
+    p.add_argument("--sp_impl", type=str, default=d.sp_impl,
+                   choices=["ring", "ulysses"],
+                   help="sequence-parallel attention: ppermute ring vs "
+                        "head<->seq all_to_all (DeepSpeed-Ulysses style)")
     p.add_argument("--sync_period", type=int, default=d.sync_period)
     p.add_argument("--grad_reduce", type=str, default=d.grad_reduce,
                    choices=["mean", "sum"])

@@ -25,9 +25,14 @@ TPU-native design notes:
   ops/flash_attention).
 
 Params are a flat ``{name: array}`` dict like the MLP's — checkpoint
-and FSDP-flattening friendly, PartitionSpec tree = replicated P() for
-every leaf (data parallelism; transformer TP is out of scope, guarded
-in parallel/mesh.layer_styles).
+and FSDP-flattening friendly. PartitionSpec tree = replicated P() for
+every leaf under pure data parallelism; Megatron-style tensor
+parallelism (``--model_parallel``, ``model_axis``) shards attention
+heads and the FFN hidden dim: ``Wqkv`` is laid out ``[d, 3, d]`` so a
+last-dim PartitionSpec gives every shard whole heads' q/k/v columns
+(heads are contiguous Dh-column blocks of d), ``Wo``/``W2`` row-split
+with one psum each per block, ``W1`` column-split — two psums per
+block total, the textbook Megatron count.
 """
 
 from __future__ import annotations
@@ -54,6 +59,9 @@ class TransformerSpec:
     d_ff: int = 256
     activation: str = "gelu"
     attention: str = "dense"       # dense | flash (ops/flash_attention)
+    sp_impl: str = "ring"          # sequence-parallel layout: ring
+                                   # (ppermute k/v orbit) | ulysses
+                                   # (head<->seq all_to_all)
     causal: bool = False
     num_experts: int = 0           # 0 = dense FFN; >0 = top-1 (Switch-
                                    # style) mixture-of-experts FFN
@@ -94,9 +102,10 @@ def init(key: jax.Array, spec: TransformerSpec) -> Params:
             p[name] = (0.02 * jax.random.normal(
                 keys[name], shape, dtype=jnp.float32)).astype(pd)
         elif "W" in name:
-            # expert weights are [E, fan_in, fan_out]: scale by the
-            # per-expert fan-in, not the expert count
-            fan_in = shape[-2] if len(shape) == 3 else shape[0]
+            # expert weights are [E, fan_in, fan_out] and Wqkv is
+            # [d, 3, d]: scale by the actual fan-in in either layout
+            fan_in = (shape[-2] if name.endswith(("We1", "We2"))
+                      else shape[0])
             p[name] = (jax.random.normal(keys[name], shape, jnp.float32)
                        / jnp.sqrt(jnp.float32(fan_in))).astype(pd)
         elif name.endswith("_g"):
@@ -119,7 +128,7 @@ def param_shapes(spec: TransformerSpec) -> Dict[str, tuple[int, ...]]:
     for i in range(spec.num_blocks):
         shapes.update({
             f"L{i}_ln1_g": (d,), f"L{i}_ln1_b": (d,),
-            f"L{i}_Wqkv": (d, 3 * d), f"L{i}_bqkv": (3 * d,),
+            f"L{i}_Wqkv": (d, 3, d), f"L{i}_bqkv": (3, d),
             f"L{i}_Wo": (d, d), f"L{i}_bo": (d,),
             f"L{i}_ln2_g": (d,), f"L{i}_ln2_b": (d,),
         })
@@ -141,18 +150,62 @@ def param_shapes(spec: TransformerSpec) -> Dict[str, tuple[int, ...]]:
 _EXPERT_LEAVES = ("_We1", "_be1", "_We2", "_be2")
 
 
-def param_pspecs(spec: TransformerSpec, expert_axis: str | None = None,
-                 ) -> Dict[str, "jax.sharding.PartitionSpec"]:
-    """Replicated P() for every leaf; under expert parallelism the
-    per-expert weight stacks shard their leading E dim over
-    ``expert_axis`` (the router stays replicated — every shard needs
-    the full gate distribution)."""
+def _tp_leaf_specs(model_axis: str):
+    """Per-block-leaf Megatron PartitionSpecs (unprefixed leaf name ->
+    spec); leaves not listed replicate. Shared by the flat and the
+    pipeline-stacked layouts."""
     from jax.sharding import PartitionSpec as P
 
+    return {
+        "Wqkv": P(None, None, model_axis), "bqkv": P(None, model_axis),
+        "Wo": P(model_axis, None), "bo": P(),
+        "W1": P(None, model_axis), "b1": P(model_axis),
+        "W2": P(model_axis, None), "b2": P(),
+    }
+
+
+def check_tp(spec: TransformerSpec, model_parallel: int) -> None:
+    """Validate a Megatron TP degree against the spec's dims. With a
+    MoE FFN only the attention side TP-shards (experts shard over the
+    expert axis instead), so d_ff divisibility applies to the dense
+    FFN alone."""
+    if model_parallel <= 1:
+        return
+    if spec.n_heads % model_parallel:
+        raise ValueError(
+            f"n_heads={spec.n_heads} must divide evenly over "
+            f"model_parallel={model_parallel}")
+    if not spec.num_experts and spec.d_ff % model_parallel:
+        raise ValueError(
+            f"d_ff={spec.d_ff} must divide evenly over "
+            f"model_parallel={model_parallel}")
+
+
+def param_pspecs(spec: TransformerSpec, expert_axis: str | None = None,
+                 model_axis: str | None = None,
+                 ) -> Dict[str, "jax.sharding.PartitionSpec"]:
+    """Replicated P() for every leaf, with two sharded flavors:
+
+    - ``expert_axis`` (expert parallelism): the per-expert weight
+      stacks shard their leading E dim (the router stays replicated —
+      every shard needs the full gate distribution);
+    - ``model_axis`` (Megatron tensor parallelism): per-block attention
+      and FFN weights shard the head/hidden dim — ``Wqkv [d,3,d]``
+      last-dim (whole heads per shard), ``Wo [d,d]`` first-dim
+      (row-split + psum), ``W1 [d,ff]`` last-dim, ``W2 [ff,d]``
+      first-dim (row-split + psum); the token-wise leaves (LN, embed,
+      pos, head) replicate. Optimizer state follows via state_pspecs.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    tp_specs = _tp_leaf_specs(model_axis)
     out = {}
     for name, shape in param_shapes(spec).items():
         if expert_axis and any(name.endswith(s) for s in _EXPERT_LEAVES):
             out[name] = P(expert_axis, *([None] * (len(shape) - 1)))
+        elif model_axis and name.startswith("L"):
+            leaf = name.split("_", 1)[1]
+            out[name] = tp_specs.get(leaf, P())
         else:
             out[name] = P()
     return out
@@ -170,11 +223,23 @@ def _attend(spec: TransformerSpec, q, k, v, seq_axis: str | None):
     """[B, S(local), H, Dh] in/out via the selected backend.
 
     With ``seq_axis`` set (sequence-parallel training inside shard_map)
-    attention runs over the RING: k/v blocks travel between shards via
-    ppermute while each block pair is computed locally —
-    ``ring_flash_attention`` uses the Pallas kernels where the local
-    block is tile-aligned and the exact XLA ring otherwise."""
+    attention runs in the layout ``spec.sp_impl`` selects: the RING —
+    k/v blocks travel between shards via ppermute while each block
+    pair is computed locally (``ring_flash_attention`` uses the Pallas
+    kernels where the local block is tile-aligned, the exact XLA ring
+    otherwise) — or ULYSSES — two all_to_alls re-shard seq<->heads so
+    each shard runs ordinary full-sequence attention on H/n heads
+    (ops/ulysses_attention)."""
     if seq_axis is not None:
+        if spec.sp_impl == "ulysses":
+            from ..ops.ulysses_attention import ulysses_attention
+
+            return ulysses_attention(q, k, v, seq_axis, causal=spec.causal,
+                                     use_flash=spec.attention == "flash")
+        if spec.sp_impl != "ring":
+            raise ValueError(
+                f"unknown sp_impl {spec.sp_impl!r}: expected 'ring' or "
+                f"'ulysses'")
         from ..ops.ring_attention import ring_attention, ring_flash_attention
 
         ring = (ring_flash_attention if spec.attention == "flash"
@@ -236,35 +301,59 @@ def _mm(params_or_bp, a, w_name, b_name, cdt):
     return acc + params_or_bp[b_name].astype(jnp.float32)
 
 
+def _row_psum(x, w, b, cdt, model_axis):
+    """Row-split projection: local [.., k_local] @ [k_local, n], psum'd
+    over ``model_axis`` (the partial-sum combine of Megatron's row
+    parallelism), bias added once after the reduction."""
+    acc = jnp.dot(x, w.astype(cdt), preferred_element_type=jnp.float32)
+    if model_axis is not None:
+        acc = jax.lax.psum(acc, model_axis)
+    return acc + b.astype(jnp.float32)
+
+
 def _block_forward(spec: TransformerSpec, bp: Params, h, act, cdt,
                    seq_axis: str | None = None,
                    expert_axis: str | None = None, moe_block: int = 0,
-                   full_params: Params | None = None):
+                   full_params: Params | None = None,
+                   model_axis: str | None = None):
     """One encoder block on ``h`` [B, S(local), D]. ``bp`` holds the
     block's leaves under their UNPREFIXED names (ln1_g, Wqkv, ...) so
     the same body serves the regular forward (dict views of L{i}_*)
-    and the pipelined forward (lax.scan over stacked stages)."""
+    and the pipelined forward (lax.scan over stacked stages).
+
+    Under tensor parallelism (``model_axis``) the leaves arrive as
+    their Megatron shards: Wqkv/bqkv hold this shard's heads (dl =
+    d/mp trailing columns), Wo its matching rows, W1/b1 the hidden
+    slice, W2 its rows — attention and the FFN inner product run on
+    1/mp of the width with ONE psum after each row-split matmul."""
     b, s, d = h.shape
     a = _layer_norm(h, bp["ln1_g"], bp["ln1_b"])
-    qkv = _mm(bp, a, "Wqkv", "bqkv", cdt)                # [B, S, 3D]
-    q, k, v = jnp.split(qkv.astype(cdt), 3, axis=-1)
-    shape = (b, s, spec.n_heads, spec.d_head)
+    # [B, S, 3, dl]: t indexes q/k/v, e the (local) head columns
+    qkv = jnp.einsum("bsd,dte->bste", a.astype(cdt),
+                     bp["Wqkv"].astype(cdt),
+                     preferred_element_type=jnp.float32) \
+        + bp["bqkv"].astype(jnp.float32)
+    q, k, v = (qkv[:, :, t].astype(cdt) for t in range(3))
+    local_heads = bp["Wqkv"].shape[-1] // spec.d_head
+    shape = (b, s, local_heads, spec.d_head)
     att = _attend(spec, q.reshape(shape), k.reshape(shape),
                   v.reshape(shape), seq_axis)
-    h = h + _mm(bp, att.reshape(b, s, d), "Wo", "bo", cdt)
+    h = h + _row_psum(att.reshape(b, s, -1).astype(cdt), bp["Wo"],
+                      bp["bo"], cdt, model_axis)
     a = _layer_norm(h, bp["ln2_g"], bp["ln2_b"])
     if spec.num_experts:
         h = h + _moe_ffn(spec, full_params, moe_block, a, act, cdt,
                          expert_axis)
     else:
         a = act(_mm(bp, a, "W1", "b1", cdt)).astype(cdt)
-        h = h + _mm(bp, a, "W2", "b2", cdt)
+        h = h + _row_psum(a, bp["W2"], bp["b2"], cdt, model_axis)
     return h
 
 
 def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray,
           seq_axis: str | None = None,
-          expert_axis: str | None = None) -> jnp.ndarray:
+          expert_axis: str | None = None,
+          model_axis: str | None = None) -> jnp.ndarray:
     """Forward to logits. ``x``: [B, input_size] (viewed as seq_len
     tokens) or already [B, S, F].
 
@@ -275,6 +364,13 @@ def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray,
     token-wise blocks (LN/FFN/residuals) need no communication, and
     the mean-pool is completed with a pmean across shards — after
     which the logits are sequence-invariant on every shard.
+
+    ``model_axis`` enables Megatron tensor parallelism inside
+    shard_map: the per-block attention/FFN leaves arrive width-sharded
+    (param_pspecs with model_axis), each shard computes its heads and
+    hidden slice, and the two row-split projections psum — activations
+    stay full-width and replicated across the model axis, so the
+    embed/LN/head plumbing is untouched.
     """
     cdt = spec.compute_dtype
     b = x.shape[0]
@@ -295,7 +391,8 @@ def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray,
         bp = {k[len(f"L{i}_"):]: v for k, v in params.items()
               if k.startswith(f"L{i}_")}
         h = _block_forward(spec, bp, h, act, cdt, seq_axis, expert_axis,
-                           moe_block=i, full_params=params)
+                           moe_block=i, full_params=params,
+                           model_axis=model_axis)
     h = _layer_norm(h, params["lnf_g"], params["lnf_b"])
     pooled = jnp.mean(h, axis=1)                          # [B, D]
     if seq_axis is not None:
@@ -350,18 +447,23 @@ def pipeline_train_state(spec: TransformerSpec, optimizer, state):
 
 
 def pipeline_param_pspecs(spec: TransformerSpec, stage_axis: str,
+                          model_axis: str | None = None,
                           ) -> Dict[str, "jax.sharding.PartitionSpec"]:
     """Specs for the stacked layout: blk_* shard their block dim over
-    ``stage_axis``; everything else replicated."""
+    ``stage_axis`` — and, under PPxTP (``model_axis``), their
+    head/hidden dim over the inner Megatron axis too (the stage spec
+    prepended to the per-leaf TP spec); everything else replicated."""
     from jax.sharding import PartitionSpec as P
 
+    tp_specs = _tp_leaf_specs(model_axis) if model_axis else {}
     shapes = param_shapes(spec)
     out = {}
     for name in shapes:
         if name.startswith("L0_"):
             leaf = name[len("L0_"):]
-            out[f"blk_{leaf}"] = P(stage_axis,
-                                   *([None] * len(shapes[name])))
+            inner = tuple(tp_specs.get(leaf, P())) or (None,) * len(
+                shapes[name])
+            out[f"blk_{leaf}"] = P(stage_axis, *inner)
         elif not name.startswith("L"):
             out[name] = P()
     return out
@@ -369,7 +471,8 @@ def pipeline_param_pspecs(spec: TransformerSpec, stage_axis: str,
 
 def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
                    stage_axis: str, n_stages: int,
-                   num_microbatches: int) -> jnp.ndarray:
+                   num_microbatches: int,
+                   model_axis: str | None = None) -> jnp.ndarray:
     """GPipe-style pipeline-parallel forward inside shard_map.
 
     ``params`` is the stacked layout (pipeline_stack_params) with the
@@ -402,7 +505,8 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
 
     def run_local(h):
         def body(h_, bp):
-            return _block_forward(spec, bp, h_, act, cdt), None
+            return _block_forward(spec, bp, h_, act, cdt,
+                                  model_axis=model_axis), None
 
         h_, _ = jax.lax.scan(body, h, local_blocks)
         return h_

@@ -1,0 +1,87 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second of the two canonical sequence/context-parallel layouts
+(absent from the reference, which has no sequence axis at all —
+/root/reference/example.py:69's inputs are flat ``[B, 784]``;
+SURVEY.md §5 "Long-context"):
+
+- **ring** (ops/ring_attention.py): k/v blocks orbit the shards via
+  ppermute; each shard keeps its token block. Communication is
+  neighbor-only (ICI-friendly) and overlaps compute, but attention
+  runs blockwise with online-softmax merging.
+- **ulysses** (this module): two ``all_to_all`` collectives re-shard
+  the tensors from sequence-sharded ``[B, S/n, H, Dh]`` to
+  head-sharded ``[B, S, H/n, Dh]`` and back. Between them every shard
+  sees the FULL sequence for its subset of heads, so attention runs
+  as one ordinary (dense or flash-kernel) call — no blockwise
+  merging, exact softmax by construction.
+
+Trade-off (the reason both exist, as in DeepSpeed-Ulysses vs Ring
+Attention): ulysses moves activations twice through an all-to-all
+(bisection bandwidth, head-count-limited parallelism ``n <= H``) but
+composes directly with the single-chip flash kernels at full sequence
+length; the ring's degree is bounded by tokens, not heads, and its
+traffic is neighbor-only, but it needs the stats-merging machinery.
+
+Both are selected per-run by ``--sp_impl {ring,ulysses}`` on the same
+``('data','seq')`` mesh — the layout contract (contiguous token
+blocks per shard) is identical, so switching is a flag, not a
+re-shard.
+
+Differentiability: ``lax.all_to_all`` is its own transpose (the
+reverse exchange), so ``jax.grad`` through this function yields the
+all-to-all of the local attention gradients — no custom VJP needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      axis_name: str, causal: bool = False,
+                      use_flash: bool = False) -> jnp.ndarray:
+    """Sequence-parallel attention via head<->sequence all-to-all.
+
+    Args:
+      q, k, v: ``[B, S_local, H, Dh]`` — this shard's contiguous token
+        block, all heads (the same layout the ring variant takes).
+      axis_name: the mesh axis the sequence is sharded over.
+      causal: standard causal mask (applied on the full local
+        sequence — no global-offset bookkeeping needed, unlike the
+        ring's blockwise masking).
+      use_flash: run the single-chip flash-attention Pallas kernels on
+        the gathered sequence (ops/flash_attention); otherwise the
+        exact XLA dense path.
+
+    Returns: ``[B, S_local, H, Dh]`` — sequence-sharded again.
+    """
+    n = jax.lax.psum(1, axis_name)
+    heads = q.shape[2]
+    if heads % n:
+        raise ValueError(
+            f"ulysses sequence parallelism needs n_heads ({heads}) "
+            f"divisible by the sequence-axis size ({n})")
+    if n == 1:
+        qg, kg, vg = q, k, v
+    else:
+        # [3, B, S/n, H, Dh] -> [3, B, S, H/n, Dh]: scatter heads,
+        # gather seq — q/k/v stacked so the exchange is ONE collective
+        # launch per direction instead of three
+        qkv = jax.lax.all_to_all(jnp.stack((q, k, v)), axis_name,
+                                 split_axis=3, concat_axis=2, tiled=True)
+        qg, kg, vg = qkv[0], qkv[1], qkv[2]
+    if use_flash:
+        from .flash_attention import flash_attention
+
+        out = flash_attention(qg, kg, vg, causal)
+    else:
+        from .ring_attention import attention
+
+        out = attention(qg, kg, vg, causal=causal)
+    if n == 1:
+        return out
+    # [B, S, H/n, Dh] -> [B, S/n, H, Dh]: scatter seq, gather heads
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)

@@ -232,7 +232,8 @@ def _build_run_to_completion(
     mp = mesh.shape[MODEL_AXIS]
     styles = mesh_lib.layer_styles(spec, mp)
     sspecs = mesh_lib.state_pspecs(spec, optimizer, mp)
-    step_body = make_sync_step_body(cfg, spec, styles, dp, optimizer)
+    step_body = make_sync_step_body(cfg, spec, styles, dp, optimizer,
+                                    model_axis=mesh_lib.tp_axis(spec, mp))
     return _build_scan_runner(mesh, sspecs, step_body, steps_per_epoch, num_epochs)
 
 
@@ -407,7 +408,8 @@ def _build_fast_eval(cfg, mesh, spec: mlp.MLPSpec, images: np.ndarray, labels: n
 
     def shard_eval(params, img_packed, y, m):
         x = _normalize(img_packed)
-        logits = forward_local(spec, params, x, styles, cfg.pallas)
+        logits = forward_local(spec, params, x, styles, cfg.pallas,
+                               model_axis=mesh_lib.tp_axis(spec, mp))
         correct = (jnp.argmax(logits, -1) == jnp.argmax(y, -1)).astype(jnp.float32)
         return jax.lax.psum(jnp.sum(correct * m), DATA_AXIS)
 

@@ -64,44 +64,66 @@ def build_mesh(data_parallel: int = -1, model_parallel: int = 1, devices=None) -
 
 
 def _build_2d_mesh(data_parallel: int, n: int, axis_name: str,
-                   devices=None) -> Mesh:
-    """('data', axis_name) mesh shared by the sequence- and expert-
-    parallel layouts; validates sizes against the device pool."""
+                   devices=None, model_parallel: int = 1) -> Mesh:
+    """('data', axis_name[, 'model']) mesh shared by the sequence-,
+    expert- and stage-parallel layouts; validates sizes against the
+    device pool. ``model_parallel > 1`` appends a third (innermost —
+    fastest ICI links on real slices, where the per-block TP psums
+    live) Megatron axis, composing tensor parallelism with the
+    layout's own axis."""
     devices = list(devices if devices is not None else jax.devices())
-    if data_parallel < 1 or n < 1:
+    if data_parallel < 1 or n < 1 or model_parallel < 1:
         raise ValueError(
             f"mesh axes must be >= 1, got data_parallel={data_parallel}, "
-            f"{axis_name}={n}")
-    need = data_parallel * n
+            f"{axis_name}={n}, model_parallel={model_parallel}")
+    need = data_parallel * n * model_parallel
     if need > len(devices):
         raise ValueError(
-            f"mesh {data_parallel}x{n} needs {need} devices, "
-            f"have {len(devices)}")
+            f"mesh {data_parallel}x{n}x{model_parallel} needs {need} "
+            f"devices, have {len(devices)}")
     import numpy as np
 
+    if model_parallel > 1:
+        dev_array = np.array(devices[:need]).reshape(
+            data_parallel, n, model_parallel)
+        return Mesh(dev_array, (DATA_AXIS, axis_name, MODEL_AXIS),
+                    axis_types=(AxisType.Auto,) * 3)
     dev_array = np.array(devices[:need]).reshape(data_parallel, n)
     return Mesh(dev_array, (DATA_AXIS, axis_name),
                 axis_types=(AxisType.Auto, AxisType.Auto))
 
 
 def build_stage_mesh(data_parallel: int, pipeline_parallel: int,
-                     devices=None) -> Mesh:
-    """('data', 'stage') mesh for pipeline-parallel transformer
-    training: each stage holds a contiguous slice of the encoder
-    blocks; activations hop stage->stage+1 via ppermute on the GPipe
-    microbatch schedule (models/transformer.apply_pipeline)."""
+                     devices=None, model_parallel: int = 1) -> Mesh:
+    """('data', 'stage'[, 'model']) mesh for pipeline-parallel
+    transformer training: each stage holds a contiguous slice of the
+    encoder blocks; activations hop stage->stage+1 via ppermute on the
+    GPipe microbatch schedule (models/transformer.apply_pipeline).
+    With ``model_parallel`` each stage's blocks are additionally
+    Megatron-sharded over the inner 'model' axis."""
     return _build_2d_mesh(data_parallel, pipeline_parallel, STAGE_AXIS,
-                          devices)
+                          devices, model_parallel)
 
 
-def pipeline_state_pspecs(spec, optimizer, stage_axis: str):
-    """Spec tree for the PP-stacked TrainState layout."""
+def pipeline_state_pspecs(spec, optimizer, stage_axis: str,
+                          model_axis: str | None = None):
+    """Spec tree for the PP-stacked TrainState layout (PPxTP when
+    ``model_axis`` is set)."""
     from ..models import transformer
     from ..train.state import TrainState
 
-    pp = transformer.pipeline_param_pspecs(spec, stage_axis)
+    pp = transformer.pipeline_param_pspecs(spec, stage_axis, model_axis)
     return TrainState(step=P(), params=pp,
                       opt_state=optimizer.state_pspecs(pp))
+
+
+def tp_axis(spec, model_parallel: int) -> str | None:
+    """MODEL_AXIS when the transformer family runs Megatron TP (the
+    MLP's TP goes through layer_styles instead)."""
+    from ..models.transformer import TransformerSpec
+
+    return (MODEL_AXIS if model_parallel > 1
+            and isinstance(spec, TransformerSpec) else None)
 
 
 def axis_if_present(mesh: Mesh, name: str) -> str | None:
@@ -111,23 +133,29 @@ def axis_if_present(mesh: Mesh, name: str) -> str | None:
 
 
 def build_seq_mesh(data_parallel: int, sequence_parallel: int,
-                   devices=None) -> Mesh:
-    """('data', 'seq') mesh for sequence-parallel transformer training:
-    the batch splits over 'data', each example's token axis splits over
-    'seq' (ring attention moves k/v blocks between the seq shards via
-    ppermute — neighbor ICI traffic on real slices)."""
+                   devices=None, model_parallel: int = 1) -> Mesh:
+    """('data', 'seq'[, 'model']) mesh for sequence-parallel
+    transformer training: the batch splits over 'data', each example's
+    token axis splits over 'seq' (ring attention moves k/v blocks
+    between the seq shards via ppermute — neighbor ICI traffic on real
+    slices). With ``model_parallel`` the attention heads / FFN hidden
+    additionally Megatron-shard over the inner 'model' axis."""
     return _build_2d_mesh(data_parallel, sequence_parallel, SEQ_AXIS,
-                          devices)
+                          devices, model_parallel)
 
 
 def build_expert_mesh(data_parallel: int, expert_parallel: int,
-                      devices=None) -> Mesh:
-    """('data', 'expert') mesh for expert-parallel MoE training: the
-    batch splits over 'data', each MoE layer's expert stack splits over
-    'expert' (models/transformer._moe_ffn combines the per-shard
-    partial outputs with one psum)."""
+                      devices=None, model_parallel: int = 1) -> Mesh:
+    """('data', 'expert'[, 'model']) mesh for expert-parallel MoE
+    training: the batch splits over 'data', each MoE layer's expert
+    stack splits over 'expert' (models/transformer._moe_ffn combines
+    the per-shard partial outputs with one psum). With
+    ``model_parallel`` the attention side of every block additionally
+    Megatron-shards over the inner 'model' axis (the expert FFNs stay
+    expert-sharded — within-expert width sharding is not a thing
+    here)."""
     return _build_2d_mesh(data_parallel, expert_parallel, EXPERT_AXIS,
-                          devices)
+                          devices, model_parallel)
 
 
 def layer_styles(spec, model_parallel: int) -> list[str]:
@@ -135,14 +163,14 @@ def layer_styles(spec, model_parallel: int) -> list[str]:
     or 'rep' (replicated). Layers alternate col/row so activations only
     need one psum per pair; the final layer stays replicated when the
     alternation would leave the logits sharded."""
+    from ..models import transformer
     from ..models.transformer import TransformerSpec
 
     if isinstance(spec, TransformerSpec):
-        if model_parallel > 1:
-            raise ValueError(
-                "tensor parallelism is not implemented for the "
-                "transformer family; set model_parallel=1 (DP/FSDP "
-                "compose as usual)")
+        # transformer TP shards heads/hidden via param_pspecs, not
+        # per-layer styles; validate the degree and return a no-op
+        # style list for the callers that iterate it
+        transformer.check_tp(spec, model_parallel)
         return ["rep"]
     styles = []
     for i in range(1, spec.num_layers + 1):
@@ -173,8 +201,9 @@ def param_pspecs(spec, model_parallel: int = 1,
     from ..models import transformer
 
     if isinstance(spec, transformer.TransformerSpec):
-        layer_styles(spec, model_parallel)  # TP guard
-        return transformer.param_pspecs(spec, expert_axis)
+        layer_styles(spec, model_parallel)  # TP validation
+        return transformer.param_pspecs(
+            spec, expert_axis, model_axis=tp_axis(spec, model_parallel))
     out: Dict[str, P] = {}
     for i, st in enumerate(layer_styles(spec, model_parallel), start=1):
         if st == "col":

@@ -55,7 +55,8 @@ from .mesh import DATA_AXIS, MODEL_AXIS
 def forward_local(spec, params, x, styles, use_pallas: bool = False,
                   seq_axis: str | None = None,
                   expert_axis: str | None = None,
-                  pipeline: tuple | None = None):
+                  pipeline: tuple | None = None,
+                  model_axis: str | None = None):
     """Per-shard forward to (replicated) logits; TP-aware (example.py:87-89).
 
     Model-family dispatch: TransformerSpec routes to the transformer
@@ -72,9 +73,11 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
         if pipeline is not None:
             stage_axis, n_stages, microbatches = pipeline
             return transformer.apply_pipeline(
-                spec, params, x, stage_axis, n_stages, microbatches)
+                spec, params, x, stage_axis, n_stages, microbatches,
+                model_axis=model_axis)
         return transformer.apply(spec, params, x, seq_axis=seq_axis,
-                                 expert_axis=expert_axis)
+                                 expert_axis=expert_axis,
+                                 model_axis=model_axis)
     if use_pallas and all(s == "rep" for s in styles):
         from ..ops import pallas_fused
 
@@ -84,9 +87,11 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
 
 
 def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas, remat=False,
-                  seq_axis=None, expert_axis=None, pipeline=None):
+                  seq_axis=None, expert_axis=None, pipeline=None,
+                  model_axis=None):
     fwd = lambda p, xx: forward_local(spec, p, xx, styles, use_pallas,
-                                      seq_axis, expert_axis, pipeline)
+                                      seq_axis, expert_axis, pipeline,
+                                      model_axis)
     if remat:
         # jax.checkpoint: recompute activations in the backward pass
         # instead of saving them — trades MXU FLOPs for HBM, the
@@ -102,7 +107,8 @@ def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas, remat=False,
 def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
                         seq_axis: str | None = None,
                         expert_axis: str | None = None,
-                        pipeline: tuple | None = None) -> Callable:
+                        pipeline: tuple | None = None,
+                        model_axis: str | None = None) -> Callable:
     """The per-shard synchronous step body (state, x, y) -> (state, cost,
     acc) — shared by the host-fed step (build_train_step) and the
     device-resident scan paths (parallel/epoch.py) so both train with
@@ -112,7 +118,7 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
         def loss_fn(p):
             return _loss_and_acc(
                 spec, p, x, y, styles, cfg.naive_ce, cfg.pallas, cfg.remat,
-                seq_axis, expert_axis, pipeline,
+                seq_axis, expert_axis, pipeline, model_axis,
             )
 
         (cost, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
@@ -131,17 +137,21 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
 def _pipeline_info(mesh, cfg, spec, optimizer=None):
     """(pipeline_tuple, param_or_state_pspecs) for a possibly-staged
     mesh — the one source of truth build_train_step and build_eval_step
-    share. With ``optimizer`` returns state pspecs, else param pspecs."""
+    share. With ``optimizer`` returns state pspecs, else param pspecs.
+    On a ('data','stage','model') mesh the stacked leaves also carry
+    their Megatron inner-axis sharding (PPxTP)."""
     stage_axis = mesh_lib.axis_if_present(mesh, mesh_lib.STAGE_AXIS)
     if not stage_axis:
         return None, None
+    model_axis = mesh_lib.tp_axis(spec, mesh.shape.get(MODEL_AXIS, 1))
     pipeline = (stage_axis, mesh.shape[stage_axis], cfg.microbatches)
     if optimizer is not None:
         return pipeline, mesh_lib.pipeline_state_pspecs(
-            spec, optimizer, stage_axis)
+            spec, optimizer, stage_axis, model_axis)
     from ..models import transformer
 
-    return pipeline, transformer.pipeline_param_pspecs(spec, stage_axis)
+    return pipeline, transformer.pipeline_param_pspecs(
+        spec, stage_axis, model_axis)
 
 
 def build_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer) -> Callable:
@@ -157,10 +167,12 @@ def build_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer) -> Callable:
     expert_axis = mesh_lib.axis_if_present(mesh, mesh_lib.EXPERT_AXIS)
     pipeline, pp_specs = _pipeline_info(mesh, cfg, spec, optimizer)
     styles = mesh_lib.layer_styles(spec, mp)
+    model_axis = mesh_lib.tp_axis(spec, mp)
     sspecs = (pp_specs if pipeline
               else mesh_lib.state_pspecs(spec, optimizer, mp, expert_axis))
     shard_step = make_sync_step_body(cfg, spec, styles, dp, optimizer,
-                                     seq_axis, expert_axis, pipeline)
+                                     seq_axis, expert_axis, pipeline,
+                                     model_axis)
 
     # under a ('data','seq') mesh the batch splits over 'data' and each
     # example's flat token axis splits over 'seq' (contiguous blocks —
@@ -187,11 +199,13 @@ def build_eval_step(cfg, mesh, spec: mlp.MLPSpec) -> Callable:
     expert_axis = mesh_lib.axis_if_present(mesh, mesh_lib.EXPERT_AXIS)
     pipeline, pp_specs = _pipeline_info(mesh, cfg, spec)
     styles = mesh_lib.layer_styles(spec, mp)
+    model_axis = mesh_lib.tp_axis(spec, mp)
     pp = pp_specs if pipeline else mesh_lib.param_pspecs(spec, mp, expert_axis)
 
     def shard_eval(params, x, y, mask):
         logits = forward_local(spec, params, x, styles, cfg.pallas,
-                               seq_axis, expert_axis, pipeline)
+                               seq_axis, expert_axis, pipeline,
+                               model_axis)
         correct = (jnp.argmax(logits, -1) == jnp.argmax(y, -1)).astype(jnp.float32)
         return jax.lax.psum(jnp.sum(correct * mask), DATA_AXIS)
 

@@ -63,6 +63,7 @@ def make_spec(cfg: Config):
                         else "gelu"),  # the reference default doesn't
                                        # apply to this family
             attention="flash" if cfg.pallas else cfg.attention,
+            sp_impl=cfg.sp_impl,
             causal=cfg.causal,
             num_experts=cfg.num_experts,
             param_dtype=jnp.dtype(cfg.param_dtype),
@@ -154,10 +155,10 @@ def run(cfg: Config) -> Dict[str, Any]:
         if cfg.num_experts:
             raise ValueError("--pipeline_parallel supports the dense FFN "
                              "only (no --num_experts)")
-        if (cfg.model_parallel > 1 or cfg.fsdp or cfg.sync_period > 1
+        if (cfg.fsdp or cfg.sync_period > 1
                 or cfg.sequence_parallel > 1 or cfg.expert_parallel > 1):
             raise ValueError("--pipeline_parallel composes with data "
-                             "parallelism only")
+                             "and tensor parallelism only")
     if cfg.expert_parallel > 1:
         if not cfg.num_experts:
             raise ValueError("--expert_parallel requires --num_experts > 0")
@@ -165,23 +166,34 @@ def run(cfg: Config) -> Dict[str, Any]:
             raise ValueError(
                 f"num_experts={cfg.num_experts} must divide evenly over "
                 f"expert_parallel={cfg.expert_parallel}")
-        if (cfg.model_parallel > 1 or cfg.fsdp or cfg.sync_period > 1
-                or cfg.sequence_parallel > 1):
+        if cfg.fsdp or cfg.sync_period > 1 or cfg.sequence_parallel > 1:
             raise ValueError("--expert_parallel composes with data "
-                             "parallelism only (model_parallel=1, no fsdp, "
+                             "and tensor parallelism only (no fsdp, "
                              "sync_period=1, sequence_parallel=1)")
+    if cfg.model == "transformer" and cfg.model_parallel > 1:
+        from ..models.transformer import check_tp
+
+        check_tp(make_spec(cfg), cfg.model_parallel)
     if cfg.sequence_parallel > 1:
         if cfg.model != "transformer":
             raise ValueError("--sequence_parallel requires --model=transformer "
                              "(the MLP has no token axis)")
-        if cfg.model_parallel > 1 or cfg.fsdp or cfg.sync_period > 1:
+        if cfg.fsdp or cfg.sync_period > 1:
             raise ValueError("--sequence_parallel composes with data "
-                             "parallelism only (model_parallel=1, no fsdp, "
+                             "and tensor parallelism only (no fsdp, "
                              "sync_period=1)")
         if cfg.seq_len % cfg.sequence_parallel:
             raise ValueError(
                 f"seq_len={cfg.seq_len} must divide evenly over "
                 f"sequence_parallel={cfg.sequence_parallel}")
+        local_heads = cfg.n_heads // max(cfg.model_parallel, 1)
+        if cfg.sp_impl == "ulysses" and local_heads % cfg.sequence_parallel:
+            raise ValueError(
+                f"--sp_impl=ulysses shards attention heads: n_heads="
+                f"{cfg.n_heads} (per model shard: {local_heads}) must "
+                f"divide evenly over "
+                f"sequence_parallel={cfg.sequence_parallel} "
+                f"(use --sp_impl=ring for degrees beyond the head count)")
     cluster.bootstrap(cfg)
     cluster.enable_compilation_cache(cfg)
     if cfg.debug_nans:
@@ -202,13 +214,14 @@ def run(cfg: Config) -> Dict[str, Any]:
             or cfg.pipeline_parallel > 1):
         n_axis = max(cfg.sequence_parallel, cfg.expert_parallel,
                      cfg.pipeline_parallel)
-        dp_req = (len(jax.devices()) // n_axis if cfg.data_parallel == -1
-                  else cfg.data_parallel)
+        dp_req = (len(jax.devices()) // (n_axis * cfg.model_parallel)
+                  if cfg.data_parallel == -1 else cfg.data_parallel)
         builder = (mesh_lib.build_seq_mesh if cfg.sequence_parallel > 1
                    else mesh_lib.build_expert_mesh
                    if cfg.expert_parallel > 1
                    else mesh_lib.build_stage_mesh)
-        mesh = builder(max(dp_req, 1), n_axis)
+        mesh = builder(max(dp_req, 1), n_axis,
+                       model_parallel=cfg.model_parallel)
     else:
         mesh = mesh_lib.build_mesh(cfg.data_parallel, cfg.model_parallel)
     dp = mesh.shape[mesh_lib.DATA_AXIS]
@@ -277,7 +290,8 @@ def run(cfg: Config) -> Dict[str, Any]:
 
             state = tfm_lib.pipeline_train_state(spec, optimizer, state)
             sspecs = mesh_lib.pipeline_state_pspecs(
-                spec, optimizer, mesh_lib.STAGE_AXIS)
+                spec, optimizer, mesh_lib.STAGE_AXIS,
+                mesh_lib.tp_axis(spec, cfg.model_parallel))
         else:
             sspecs = mesh_lib.state_pspecs(
                 spec, optimizer, cfg.model_parallel,

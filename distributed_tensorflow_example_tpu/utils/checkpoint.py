@@ -75,9 +75,17 @@ def restore_checkpoint(path: str, state_template: Any) -> Tuple[Any, int, int]:
         if key not in data:
             raise KeyError(f"checkpoint {path} missing leaf {key!r}")
         arr = data[key]
-        if arr.shape != tuple(np.shape(leaf)):
+        want = tuple(np.shape(leaf))
+        if arr.shape != want and arr.size == np.size(leaf) \
+                and key.endswith("qkv"):
+            # migration: transformer qkv leaves changed layout from
+            # (d, 3d)/(3d,) to (d, 3, d)/(3, d) when Megatron TP
+            # landed; the flat row-major order is identical (q|k|v
+            # column blocks), so old checkpoints restore by reshape
+            arr = arr.reshape(want)
+        if arr.shape != want:
             raise ValueError(
-                f"checkpoint leaf {key!r} shape {arr.shape} != expected {np.shape(leaf)}"
+                f"checkpoint leaf {key!r} shape {arr.shape} != expected {want}"
             )
         new_leaves.append(arr.astype(np.asarray(leaf).dtype))
     state = jax.tree_util.tree_unflatten(treedef, new_leaves)

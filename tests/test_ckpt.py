@@ -59,3 +59,39 @@ def test_shape_mismatch_rejected(tmp_path):
 
     with pytest.raises((ValueError, KeyError)):
         C.restore_checkpoint(path, other)
+
+
+def test_qkv_layout_migration(tmp_path):
+    """Transformer checkpoints written before the Megatron-TP qkv
+    re-layout ((d, 3d)/(3d,) -> (d, 3, d)/(3, d)) restore by reshape —
+    the flat row-major order is identical (q|k|v column blocks)."""
+    import numpy as np
+
+    from distributed_tensorflow_example_tpu.models.transformer import (
+        TransformerSpec)
+
+    spec = TransformerSpec(input_size=64, seq_len=8, d_model=16,
+                           n_heads=2, num_blocks=1, d_ff=32)
+    opt = make_optimizer(Config(model="transformer", optimizer="adam"))
+    state = create_train_state(jax.random.PRNGKey(0), spec, opt)
+    path = C.save_checkpoint(str(tmp_path), state, step=7, epoch=2)
+    # rewrite the archive with the PRE-r3 flat qkv layout
+    with np.load(path) as z:
+        data = {k: z[k] for k in z.files}
+    rewrote = 0
+    for k in list(data):
+        if k.endswith("Wqkv"):
+            d = data[k].shape[0]
+            data[k] = data[k].reshape(d, 3 * data[k].shape[-1])
+            rewrote += 1
+        elif k.endswith("bqkv"):
+            data[k] = data[k].reshape(-1)
+            rewrote += 1
+    assert rewrote >= 3  # params + both adam moments
+    np.savez(path, **data)
+    restored, step, epoch = C.restore_checkpoint(path, state)
+    assert (step, epoch) == (7, 2)
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_flatten_with_path(state)[0],
+            jax.tree_util.tree_flatten_with_path(restored)[0]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
