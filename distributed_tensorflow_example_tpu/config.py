@@ -68,12 +68,31 @@ class Config:
     attention: str = "dense"        # dense | flash; --pallas also selects flash
     causal: bool = False            # causal (LM-style) attention mask
     num_experts: int = 0            # >0: top-1 (Switch-style) MoE FFN
+    moe_dispatch: str = "dense"     # dense: every expert on every token,
+                                    # one-hot select (exact); alltoall:
+                                    # capacity-limited token dispatch —
+                                    # under --expert_parallel tokens
+                                    # shard over the expert axis and the
+                                    # buffers move with one all_to_all
+                                    # each way (GShard layout)
+    capacity_factor: float = 1.25   # alltoall per-expert buffer =
+                                    # ceil(cf * tokens / E); overflow
+                                    # tokens drop to the residual path
 
     # ---- loss (example.py:92-96) ----
     naive_ce: bool = False          # reproduce the reference's unstable log(softmax) CE
 
     # ---- optimizer (example.py:98-111; BASELINE config 4) ----
     optimizer: str = "sgd"          # sgd | momentum | adam
+    lr_schedule: str = "constant"   # constant | cosine | linear decay
+                                    # (reference: fixed lr, example.py:42)
+    warmup_steps: int = 0           # linear lr warmup 0->1 over N steps
+    schedule_steps: int = 0         # decay horizon; 0 = derived from
+                                    # training_epochs x steps-per-epoch
+    lr_min_factor: float = 0.0      # decay floor as a fraction of lr
+    grad_accum: int = 1             # accumulate N microbatch gradients
+                                    # per optimizer step (lax.scan inside
+                                    # the compiled step)
     momentum: float = 0.9
     adam_b1: float = 0.9
     adam_b2: float = 0.999
@@ -196,6 +215,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_experts", type=int, default=d.num_experts,
                    help="transformer FFN becomes a top-1 MoE with this "
                         "many experts (0 = dense FFN)")
+    p.add_argument("--moe_dispatch", type=str, default=d.moe_dispatch,
+                   choices=["dense", "alltoall"],
+                   help="MoE token routing: exact dense dispatch vs "
+                        "capacity-limited all_to_all (Switch/GShard)")
+    p.add_argument("--capacity_factor", type=float, default=d.capacity_factor,
+                   help="alltoall dispatch: per-expert buffer = "
+                        "ceil(cf * tokens / E)")
     p.add_argument("--expert_parallel", type=int, default=d.expert_parallel,
                    help="MoE only: shard expert weights+FLOPs over a "
                         "('data','expert') mesh")
@@ -210,6 +236,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--naive_ce", action="store_true")
     p.add_argument("--optimizer", type=str, default=d.optimizer,
                    choices=["sgd", "momentum", "adam"])
+    p.add_argument("--lr_schedule", type=str, default=d.lr_schedule,
+                   choices=["constant", "cosine", "linear"])
+    p.add_argument("--warmup_steps", type=int, default=d.warmup_steps)
+    p.add_argument("--schedule_steps", type=int, default=d.schedule_steps,
+                   help="lr decay horizon in steps (0: derived from the "
+                        "epoch count)")
+    p.add_argument("--lr_min_factor", type=float, default=d.lr_min_factor)
+    p.add_argument("--grad_accum", type=int, default=d.grad_accum,
+                   help="gradients accumulated over N microbatches per "
+                        "optimizer step")
     p.add_argument("--momentum", type=float, default=d.momentum)
     p.add_argument("--adam_b1", type=float, default=d.adam_b1)
     p.add_argument("--adam_b2", type=float, default=d.adam_b2)

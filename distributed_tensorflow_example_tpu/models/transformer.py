@@ -65,6 +65,14 @@ class TransformerSpec:
     causal: bool = False
     num_experts: int = 0           # 0 = dense FFN; >0 = top-1 (Switch-
                                    # style) mixture-of-experts FFN
+    moe_dispatch: str = "dense"    # dense (every expert on every token,
+                                   # one-hot select — exact) | alltoall
+                                   # (capacity-limited token dispatch,
+                                   # Switch/GShard style)
+    capacity_factor: float = 1.25  # alltoall only: per-expert buffer =
+                                   # ceil(cf * tokens / E); overflow
+                                   # tokens are dropped (residual path
+                                   # carries them)
     param_dtype: jnp.dtype = jnp.float32
     compute_dtype: jnp.dtype = jnp.float32
 
@@ -264,9 +272,10 @@ def _moe_ffn(spec: TransformerSpec, params: Params, i: int, a, act, cdt,
     expert parallelism (``expert_axis``) each shard holds E/n experts'
     weights and computes ONLY those (1/n of the expert FLOPs and
     memory); the one-hot is sliced by the shard's expert offset and the
-    partial outputs combine with one psum. (All-to-all token dispatch
-    is the sparse-capacity optimization of the same math; this
-    implementation trades its bandwidth savings for exactness.)
+    partial outputs combine with one psum. (``_moe_ffn_sparse`` is the
+    capacity-limited all-to-all realization of the same math,
+    selected by ``moe_dispatch='alltoall'``; this dense form trades
+    its compute/bandwidth savings for exactness.)
     """
     gate_logits = jnp.dot(
         a.astype(cdt), params[f"L{i}_Wr"].astype(cdt),
@@ -293,6 +302,80 @@ def _moe_ffn(spec: TransformerSpec, params: Params, i: int, a, act, cdt,
     if expert_axis is not None:
         out = jax.lax.psum(out, expert_axis)
     return gate * out
+
+
+def _moe_ffn_sparse(spec: TransformerSpec, params: Params, i: int, a, act,
+                    cdt, expert_axis: str | None):
+    """Capacity-limited token dispatch for the top-1 MoE FFN — the
+    sparse (Switch/GShard-style) realization of the same math as
+    ``_moe_ffn``'s dense dispatch.
+
+    Each token goes to ONE expert buffer of static capacity
+    ``C = ceil(capacity_factor * T / E)`` (position assigned by a
+    cumsum over the routing one-hot; tokens past capacity are dropped —
+    their FFN contribution is zero and the residual stream carries
+    them, exactly Switch Transformer's overflow semantics). Under
+    expert parallelism the ``[E, C, d]`` buffers are exchanged with ONE
+    ``all_to_all`` each way over the 'expert' axis, so every shard runs
+    only its E/n experts on the tokens routed to them from all data
+    positions: compute AND bandwidth scale with ``capacity_factor``,
+    not with E — the sparse optimization the dense dispatch trades for
+    exactness. With ample capacity (``cf >= E``) nothing drops and the
+    result equals dense dispatch bit-for-near (fp order aside).
+    """
+    import math
+
+    b, s, d = a.shape
+    t = b * s
+    e = spec.num_experts
+    cap = max(1, math.ceil(spec.capacity_factor * t / e))
+    x = a.reshape(t, d)
+    gate_logits = jnp.dot(
+        x.astype(cdt), params[f"L{i}_Wr"].astype(cdt),
+        preferred_element_type=jnp.float32)                 # [T, E]
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    idx_e = jnp.argmax(probs, axis=-1)                      # [T]
+    onehot = jax.nn.one_hot(idx_e, e, dtype=jnp.float32)    # [T, E]
+    gate = jnp.sum(probs * onehot, axis=-1)                 # [T]
+    # position of each token within its expert's buffer (0-based,
+    # arrival order = token order); routing via scatter/gather on a
+    # flat [E*C] slot index — O(T*E + E*C*d) memory, NOT the [T, E, C]
+    # one-hot dispatch tensor (cf*T^2 — it OOMs the moment a big eval
+    # batch walks through; overflow and out-slot both land in a trash
+    # row past the buffer)
+    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) - 1.0
+    keep = pos < cap
+    slot = jnp.where(keep, idx_e * cap + pos.astype(jnp.int32), e * cap)
+    buf = jnp.zeros((e * cap + 1, d), jnp.float32)
+    buf = buf.at[slot].add(x.astype(jnp.float32))[:-1].reshape(e, cap, d)
+
+    we1, be1 = params[f"L{i}_We1"], params[f"L{i}_be1"]     # [El, d, ff]
+    we2, be2 = params[f"L{i}_We2"], params[f"L{i}_be2"]
+    el = we1.shape[0]
+    if expert_axis is not None and el != e:
+        ep = e // el
+        # [ep, El, C, d]: send expert-group j to the shard owning it;
+        # receive every data shard's buffer for MY experts,
+        # concatenated along the capacity axis
+        buf = jax.lax.all_to_all(buf.reshape(ep, el, cap, d), expert_axis,
+                                 split_axis=0, concat_axis=2, tiled=True)
+        buf = buf.reshape(el, ep * cap, d)
+    h1 = act(jnp.einsum("ecd,edf->ecf", buf.astype(cdt), we1.astype(cdt),
+                        preferred_element_type=jnp.float32)
+             + be1[:, None].astype(jnp.float32)).astype(cdt)
+    h2 = jnp.einsum("ecf,efd->ecd", h1, we2.astype(cdt),
+                    preferred_element_type=jnp.float32) \
+        + be2[:, None].astype(jnp.float32)                  # [El, ep*C, d]
+    if expert_axis is not None and el != e:
+        # reverse exchange: hand each shard back its tokens' outputs
+        h2 = jax.lax.all_to_all(h2.reshape(el, ep, cap, d), expert_axis,
+                                split_axis=1, concat_axis=0, tiled=True)
+    # gather each token's processed row from its slot (trash row = 0
+    # for dropped tokens) and scale by the gate probability
+    h2_flat = jnp.concatenate(
+        [h2.reshape(e * cap, d), jnp.zeros((1, d), h2.dtype)])
+    out = h2_flat[slot] * (gate * keep.astype(jnp.float32))[:, None]
+    return out.reshape(b, s, d)
 
 
 def _mm(params_or_bp, a, w_name, b_name, cdt):
@@ -342,8 +425,16 @@ def _block_forward(spec: TransformerSpec, bp: Params, h, act, cdt,
                       bp["bo"], cdt, model_axis)
     a = _layer_norm(h, bp["ln2_g"], bp["ln2_b"])
     if spec.num_experts:
-        h = h + _moe_ffn(spec, full_params, moe_block, a, act, cdt,
-                         expert_axis)
+        if spec.moe_dispatch == "alltoall":
+            moe = _moe_ffn_sparse
+        elif spec.moe_dispatch == "dense":
+            moe = _moe_ffn
+        else:
+            raise ValueError(
+                f"unknown moe_dispatch {spec.moe_dispatch!r}: expected "
+                f"'dense' or 'alltoall'")
+        h = h + moe(spec, full_params, moe_block, a, act, cdt,
+                    expert_axis)
     else:
         a = act(_mm(bp, a, "W1", "b1", cdt)).astype(cdt)
         h = h + _row_psum(a, bp["W2"], bp["b2"], cdt, model_axis)
@@ -548,7 +639,12 @@ def flops_per_step(spec: TransformerSpec, batch: int) -> float:
     2*MACs, bwd 4*MACs; attention 4*B*H*S^2*Dh fwd, x3 for fwd+bwd),
     for bench MFU accounting."""
     d, ff, f, s = spec.d_model, spec.d_ff, spec.d_feature, spec.seq_len
-    if spec.num_experts:
+    if spec.num_experts and spec.moe_dispatch == "alltoall":
+        # sparse dispatch computes ~capacity_factor tokens' worth of
+        # one expert each (plus the router)
+        ffn = spec.capacity_factor * (d * ff + ff * d) \
+            + d * spec.num_experts
+    elif spec.num_experts:
         # dense-dispatch MoE computes every expert (plus the router);
         # under EP each device computes 1/n of this
         ffn = spec.num_experts * (d * ff + ff * d) + d * spec.num_experts

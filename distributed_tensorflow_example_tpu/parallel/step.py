@@ -108,30 +108,90 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
                         seq_axis: str | None = None,
                         expert_axis: str | None = None,
                         pipeline: tuple | None = None,
-                        model_axis: str | None = None) -> Callable:
+                        model_axis: str | None = None,
+                        batch_axes: tuple = (DATA_AXIS,)) -> Callable:
     """The per-shard synchronous step body (state, x, y) -> (state, cost,
     acc) — shared by the host-fed step (build_train_step) and the
     device-resident scan paths (parallel/epoch.py) so both train with
-    identical semantics."""
+    identical semantics. ``dp`` is the total number of batch shards
+    (the product of the ``batch_axes`` sizes — more than one axis under
+    sparse-dispatch expert parallelism, where tokens shard over
+    'expert' too)."""
 
-    def body(state: TrainState, x, y):
+    def grad_of(params, x, y):
         def loss_fn(p):
             return _loss_and_acc(
                 spec, p, x, y, styles, cfg.naive_ce, cfg.pallas, cfg.remat,
                 seq_axis, expert_axis, pipeline, model_axis,
             )
 
-        (cost, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
-        # shard_map's transpose has already psum'd grads over 'data'
-        # (params are data-unvarying); rescale for mean semantics.
+        return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+    def body(state: TrainState, x, y):
+        n = cfg.grad_accum
+        if n > 1:
+            # accumulate over n microbatches inside the compiled step:
+            # mean of the chunk gradients == the full-batch gradient
+            # (equal chunks, mean-CE), at 1/n the activation memory
+            if x.shape[0] % n:
+                raise ValueError(
+                    f"per-shard batch {x.shape[0]} must divide into "
+                    f"grad_accum={n} microbatches")
+            xs = x.reshape(n, x.shape[0] // n, *x.shape[1:])
+            ys = y.reshape(n, y.shape[0] // n, *y.shape[1:])
+
+            def accum(carry, xy):
+                g_acc, c_acc, a_acc = carry
+                (c, a), g = grad_of(state.params, *xy)
+                return (jax.tree.map(jnp.add, g_acc, g),
+                        c_acc + c, a_acc + a), None
+
+            # seed the carry with microbatch 0 (a plain zero init would
+            # be device-invariant while the accumulated values vary
+            # over the batch axes — scan requires matching types)
+            (c0, a0), g0 = grad_of(state.params, xs[0], ys[0])
+            (g_sum, c_sum, a_sum), _ = jax.lax.scan(
+                accum, (g0, c0, a0), (xs[1:], ys[1:]))
+            grads = jax.tree.map(lambda g: g / n, g_sum)
+            cost, acc = c_sum / n, a_sum / n
+        else:
+            (cost, acc), grads = grad_of(state.params, x, y)
+        # shard_map's transpose has already psum'd grads over the batch
+        # axes (params are batch-unvarying); rescale for mean semantics.
         if cfg.grad_reduce == "mean" and dp > 1:
             grads = jax.tree.map(lambda g: g / dp, grads)
         new_params, new_opt = optimizer.update(grads, state.opt_state, state.params)
-        cost = jax.lax.pmean(cost, DATA_AXIS)
-        acc = jax.lax.pmean(acc, DATA_AXIS)
+        cost = jax.lax.pmean(cost, batch_axes)
+        acc = jax.lax.pmean(acc, batch_axes)
         return TrainState(state.step + 1, new_params, new_opt), cost, acc
 
     return body
+
+
+def sparse_ep_mode(mesh, spec) -> bool:
+    """True when sparse-dispatch expert parallelism is active: tokens
+    then shard over BOTH ('data','expert') — the GShard layout where
+    the all_to_all exchange carries real (distinct-token) traffic and
+    expert FLOPs split 1/ep per shard — instead of replicating the
+    batch over the expert axis as the dense dispatch does."""
+    from ..models import transformer
+
+    return (mesh_lib.axis_if_present(mesh, mesh_lib.EXPERT_AXIS) is not None
+            and isinstance(spec, transformer.TransformerSpec)
+            and spec.num_experts > 0 and spec.moe_dispatch == "alltoall")
+
+
+def batch_layout(mesh, spec):
+    """(batch_axes, total_batch_shards, x_pspec, y_pspec) for the mesh —
+    the one source of truth for how the global batch maps onto it."""
+    dp = mesh.shape[DATA_AXIS]
+    seq_axis = mesh_lib.axis_if_present(mesh, mesh_lib.SEQ_AXIS)
+    if sparse_ep_mode(mesh, spec):
+        ep = mesh.shape[mesh_lib.EXPERT_AXIS]
+        axes = (DATA_AXIS, mesh_lib.EXPERT_AXIS)
+        return axes, dp * ep, P(axes), P(axes)
+    x_spec = P(DATA_AXIS, mesh_lib.SEQ_AXIS) if seq_axis else P(DATA_AXIS)
+    return (DATA_AXIS,), dp, x_spec, P(DATA_AXIS)
 
 
 def _pipeline_info(mesh, cfg, spec, optimizer=None):
@@ -161,7 +221,6 @@ def build_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer) -> Callable:
     leave the devices (the inverse of the reference's per-step parameter
     round-trip, SURVEY.md §3.3).
     """
-    dp = mesh.shape[DATA_AXIS]
     mp = mesh.shape.get(MODEL_AXIS, 1)
     seq_axis = mesh_lib.axis_if_present(mesh, mesh_lib.SEQ_AXIS)
     expert_axis = mesh_lib.axis_if_present(mesh, mesh_lib.EXPERT_AXIS)
@@ -170,19 +229,17 @@ def build_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer) -> Callable:
     model_axis = mesh_lib.tp_axis(spec, mp)
     sspecs = (pp_specs if pipeline
               else mesh_lib.state_pspecs(spec, optimizer, mp, expert_axis))
-    shard_step = make_sync_step_body(cfg, spec, styles, dp, optimizer,
+    # batch layout: x splits over 'data' (plus 'seq' for the token
+    # axis under sequence parallelism, plus 'expert' under
+    # sparse-dispatch EP where tokens shard over the expert axis too)
+    batch_axes, shards, x_spec, y_spec = batch_layout(mesh, spec)
+    shard_step = make_sync_step_body(cfg, spec, styles, shards, optimizer,
                                      seq_axis, expert_axis, pipeline,
-                                     model_axis)
-
-    # under a ('data','seq') mesh the batch splits over 'data' and each
-    # example's flat token axis splits over 'seq' (contiguous blocks —
-    # the ring's layout contract); labels are per-example, data-only
-    x_spec = (P(DATA_AXIS, mesh_lib.SEQ_AXIS) if seq_axis
-              else P(DATA_AXIS))
+                                     model_axis, batch_axes)
     fn = jax.shard_map(
         shard_step,
         mesh=mesh,
-        in_specs=(sspecs, x_spec, P(DATA_AXIS)),
+        in_specs=(sspecs, x_spec, y_spec),
         out_specs=(sspecs, P(), P()),
     )
     return jax.jit(fn, donate_argnums=0)
@@ -201,20 +258,19 @@ def build_eval_step(cfg, mesh, spec: mlp.MLPSpec) -> Callable:
     styles = mesh_lib.layer_styles(spec, mp)
     model_axis = mesh_lib.tp_axis(spec, mp)
     pp = pp_specs if pipeline else mesh_lib.param_pspecs(spec, mp, expert_axis)
+    batch_axes, _, x_spec, y_spec = batch_layout(mesh, spec)
 
     def shard_eval(params, x, y, mask):
         logits = forward_local(spec, params, x, styles, cfg.pallas,
                                seq_axis, expert_axis, pipeline,
                                model_axis)
         correct = (jnp.argmax(logits, -1) == jnp.argmax(y, -1)).astype(jnp.float32)
-        return jax.lax.psum(jnp.sum(correct * mask), DATA_AXIS)
+        return jax.lax.psum(jnp.sum(correct * mask), batch_axes)
 
-    x_spec = (P(DATA_AXIS, mesh_lib.SEQ_AXIS) if seq_axis
-              else P(DATA_AXIS))
     fn = jax.shard_map(
         shard_eval,
         mesh=mesh,
-        in_specs=(pp, x_spec, P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(pp, x_spec, y_spec, y_spec),
         out_specs=P(),
     )
     return jax.jit(fn)

@@ -66,6 +66,8 @@ def make_spec(cfg: Config):
             sp_impl=cfg.sp_impl,
             causal=cfg.causal,
             num_experts=cfg.num_experts,
+            moe_dispatch=cfg.moe_dispatch,
+            capacity_factor=cfg.capacity_factor,
             param_dtype=jnp.dtype(cfg.param_dtype),
             compute_dtype=jnp.dtype(cfg.compute_dtype),
         )
@@ -123,6 +125,7 @@ def _eval_accuracy(eval_step, params, images, labels, dp: int, chunk: int,
 
 def run(cfg: Config) -> Dict[str, Any]:
     """Train per the config; returns the metrics the reference prints."""
+    spec = make_spec(cfg)
     # Pure config validation first — before bootstrap/dataset work, so a
     # bad flag combination fails fast and never strands peer processes.
     if cfg.fsdp and cfg.sync_period > 1:
@@ -159,6 +162,14 @@ def run(cfg: Config) -> Dict[str, Any]:
                 or cfg.sequence_parallel > 1 or cfg.expert_parallel > 1):
             raise ValueError("--pipeline_parallel composes with data "
                              "and tensor parallelism only")
+    if cfg.grad_accum < 1:
+        raise ValueError(f"grad_accum={cfg.grad_accum} must be >= 1")
+    if cfg.grad_accum > 1 and (cfg.fsdp or cfg.sync_period > 1):
+        raise ValueError("--grad_accum runs on the synchronous step "
+                         "(no --fsdp, sync_period=1)")
+    if cfg.num_experts and cfg.capacity_factor <= 0:
+        raise ValueError(
+            f"capacity_factor={cfg.capacity_factor} must be > 0")
     if cfg.expert_parallel > 1:
         if not cfg.num_experts:
             raise ValueError("--expert_parallel requires --num_experts > 0")
@@ -173,7 +184,7 @@ def run(cfg: Config) -> Dict[str, Any]:
     if cfg.model == "transformer" and cfg.model_parallel > 1:
         from ..models.transformer import check_tp
 
-        check_tp(make_spec(cfg), cfg.model_parallel)
+        check_tp(spec, cfg.model_parallel)
     if cfg.sequence_parallel > 1:
         if cfg.model != "transformer":
             raise ValueError("--sequence_parallel requires --model=transformer "
@@ -225,15 +236,30 @@ def run(cfg: Config) -> Dict[str, Any]:
     else:
         mesh = mesh_lib.build_mesh(cfg.data_parallel, cfg.model_parallel)
     dp = mesh.shape[mesh_lib.DATA_AXIS]
-    spec = make_spec(cfg)
-    optimizer = make_optimizer(cfg)
 
-    global_batch = _global_batch(cfg, dp)
+    # total batch shards: dp, times ep under sparse-dispatch expert
+    # parallelism (tokens shard over the expert axis too — the GShard
+    # layout step_lib.batch_layout encodes)
+    batch_shards = step_lib.batch_layout(mesh, spec)[1]
+    global_batch = _global_batch(cfg, batch_shards)
+    # lr-schedule decay horizon, when not given explicitly: the run's
+    # own step count
+    total_steps = cfg.training_epochs * max(
+        1, dataset.train.images.shape[0] // global_batch)
+    optimizer = make_optimizer(cfg, total_steps)
     pp_mode = cfg.pipeline_parallel > 1
-    if pp_mode and (global_batch // dp) % cfg.microbatches:
-        raise ValueError(
-            f"per-shard batch {global_batch // dp} must divide into "
-            f"microbatches={cfg.microbatches}")
+    if pp_mode:
+        # the pipeline schedule sees one grad-accum chunk at a time
+        per_shard = global_batch // dp
+        if per_shard % cfg.grad_accum:
+            raise ValueError(
+                f"per-shard batch {per_shard} must divide into "
+                f"grad_accum={cfg.grad_accum}")
+        if (per_shard // cfg.grad_accum) % cfg.microbatches:
+            raise ValueError(
+                f"per-shard batch {per_shard // cfg.grad_accum} (after "
+                f"grad_accum={cfg.grad_accum}) must divide into "
+                f"microbatches={cfg.microbatches}")
     async_mode = cfg.sync_period > 1
     fsdp_mode = cfg.fsdp
     fast = (
@@ -522,16 +548,15 @@ def run(cfg: Config) -> Dict[str, Any]:
         batch_sharding = None
         x_sharding = None
         if proc_cnt > 1:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            from jax.sharding import NamedSharding
 
-            batch_sharding = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
-            # x must be committed with the step's own layout — on a
-            # ('data','seq') mesh that is P('data','seq'); committing
-            # P('data') would force a reshard collective every step
-            x_sharding = (
-                NamedSharding(mesh, P(mesh_lib.DATA_AXIS, mesh_lib.SEQ_AXIS))
-                if mesh_lib.SEQ_AXIS in mesh.shape else batch_sharding
-            )
+            # x/y must be committed with the step's own layout (from
+            # batch_layout: 'data' + 'seq' for the token axis + 'expert'
+            # under sparse-dispatch EP); committing a different spec
+            # would force a reshard collective every step
+            _, _, x_ps, y_ps = step_lib.batch_layout(mesh, spec)
+            batch_sharding = NamedSharding(mesh, y_ps)
+            x_sharding = NamedSharding(mesh, x_ps)
         start_time = time.time()  # example.py:149
         from ..data.prefetch import Prefetcher
 
@@ -604,10 +629,11 @@ def run(cfg: Config) -> Dict[str, Any]:
             test_acc = fast_eval(params)
         else:                           # host path
             eval_step = step_lib.build_eval_step(cfg, mesh, spec)
-            eval_unit = dp * cfg.microbatches if pp_mode else dp
+            eval_unit = (batch_shards * cfg.microbatches if pp_mode
+                         else batch_shards)
             test_acc = _eval_accuracy(
                 eval_step, params, dataset.test.images, dataset.test.labels,
-                dp, chunk=max(cfg.eval_batch_size, eval_unit),
+                batch_shards, chunk=max(cfg.eval_batch_size, eval_unit),
                 unit=eval_unit,
             )
     total_time = time.time() - begin_time

@@ -197,7 +197,8 @@ def test_tp_driver_end_to_end(devices8, tmp_path):
     assert res["test_accuracy"] > 0.15   # one epoch: above chance
 
 
-@pytest.mark.parametrize("flavor", ["sp", "pp", "ep", "ulysses"])
+@pytest.mark.parametrize("flavor", ["sp", "pp", "ep", "ulysses",
+                                    "ep_sparse"])
 def test_3d_tp_crossings_match_single_device(devices8, flavor):
     """2x2x2 three-axis meshes — ('data', seq|stage|expert, 'model') —
     crossing Megatron TP with each other parallelism flavor must match
@@ -223,6 +224,11 @@ def test_3d_tp_crossings_match_single_device(devices8, flavor):
         builder, pkw = mesh_lib.build_expert_mesh, {}
         kw["num_experts"] = 4
         ckw.update(num_experts=4, expert_parallel=2)
+        if flavor == "ep_sparse":
+            # sparse dispatch: tokens shard over 'expert' too (ample
+            # capacity so no drops -> exact layout equivalence)
+            kw.update(moe_dispatch="alltoall", capacity_factor=4.0)
+            ckw.update(moe_dispatch="alltoall", capacity_factor=4.0)
     spec = _spec(**kw)
     cfg = Config(**ckw)
     opt = make_optimizer(cfg)
@@ -267,6 +273,91 @@ def test_3d_tp_crossings_match_single_device(devices8, flavor):
     for k in p1:
         np.testing.assert_allclose(p3[k], p1[k], rtol=3e-5, atol=3e-6,
                                    err_msg=k)
+
+
+def test_moe_alltoall_matches_dense_with_ample_capacity():
+    """capacity_factor >= E means no token ever drops, so the sparse
+    (capacity-limited, Switch/GShard-style) dispatch computes exactly
+    the dense dispatch's math: top-1 expert output scaled by the gate
+    probability."""
+    kw = dict(num_experts=4, n_heads=2)
+    sd = _spec(moe_dispatch="dense", **kw)
+    ss = _spec(moe_dispatch="alltoall", capacity_factor=4.0, **kw)
+    params = tfm.init(jax.random.PRNGKey(3), sd)
+    x = np.random.RandomState(2).rand(4, 784).astype(np.float32)
+    want = np.asarray(jax.jit(lambda p, xx: tfm.apply(sd, p, xx))(params, x))
+    got = np.asarray(jax.jit(lambda p, xx: tfm.apply(ss, p, xx))(params, x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_moe_alltoall_drops_overflow_tokens():
+    """A tiny capacity forces overflow: the run still executes (dropped
+    tokens ride the residual stream) and the result diverges from the
+    no-drop dense dispatch."""
+    kw = dict(num_experts=4, n_heads=2)
+    ss = _spec(moe_dispatch="alltoall", capacity_factor=0.05, **kw)
+    sd = _spec(moe_dispatch="dense", **kw)
+    params = tfm.init(jax.random.PRNGKey(3), ss)
+    x = np.random.RandomState(2).rand(4, 784).astype(np.float32)
+    got = np.asarray(jax.jit(lambda p, xx: tfm.apply(ss, p, xx))(params, x))
+    want = np.asarray(jax.jit(lambda p, xx: tfm.apply(sd, p, xx))(params, x))
+    assert np.isfinite(got).all()
+    assert np.abs(got - want).max() > 1e-4
+
+
+def test_moe_alltoall_ep_step_matches_single_device(devices8):
+    """Sparse-dispatch expert parallelism shards TOKENS over the
+    expert axis too (the GShard layout): a DP2xEP4 step with ample
+    capacity must match the single-device sparse step — the two
+    all_to_alls and the doubled batch axes are layout, not math."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+    from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+    spec = _spec(num_experts=4, moe_dispatch="alltoall",
+                 capacity_factor=4.0)
+    cfg = Config(model="transformer", learning_rate=0.01, num_experts=4,
+                 moe_dispatch="alltoall", capacity_factor=4.0)
+    opt = make_optimizer(cfg)
+    rng = np.random.RandomState(13)
+    x = rng.rand(16, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 16)]
+
+    def one(mesh, expert_axis):
+        state = create_train_state(jax.random.PRNGKey(1), spec, opt)
+        state = mesh_lib.place_state(
+            state, mesh, mesh_lib.state_pspecs(spec, opt, 1, expert_axis))
+        step = step_lib.build_train_step(cfg, mesh, spec, opt)
+        new_state, cost, _ = step(state, x, y)
+        return jax.tree.map(np.asarray, new_state.params), float(cost)
+
+    p1, c1 = one(mesh_lib.build_mesh(1, 1, devices=devices8[:1]), None)
+    mesh_ep = mesh_lib.build_expert_mesh(2, 4, devices=devices8)
+    assert step_lib.sparse_ep_mode(mesh_ep, spec)
+    pep, cep = one(mesh_ep, mesh_lib.EXPERT_AXIS)
+    assert abs(c1 - cep) < 1e-5
+    for k in p1:
+        np.testing.assert_allclose(pep[k], p1[k], rtol=3e-5, atol=3e-6,
+                                   err_msg=k)
+
+
+def test_moe_alltoall_driver_end_to_end(devices8, tmp_path):
+    """Full driver run: --num_experts 4 --expert_parallel 2
+    --moe_dispatch alltoall on the DP4xEP2 mesh (host loop; tokens
+    sharded over both axes)."""
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    res = run(Config(
+        model="transformer", num_experts=4, expert_parallel=2,
+        moe_dispatch="alltoall", training_epochs=1, batch_size=32,
+        learning_rate=0.003, optimizer="adam",
+        synthetic_train_size=512, synthetic_test_size=128,
+        logs_path=str(tmp_path), summaries=False, frequency=8,
+        compilation_cache="",
+    ))
+    assert res["devices"] == 8
+    assert np.isfinite(res["final_cost"])
 
 
 def test_tp_param_pspecs_shard_blocks_only():
