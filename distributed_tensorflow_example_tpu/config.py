@@ -67,7 +67,10 @@ class Config:
     d_ff: int = 256
     attention: str = "dense"        # dense | flash; --pallas also selects flash
     causal: bool = False            # causal (LM-style) attention mask
-    num_experts: int = 0            # >0: top-1 (Switch-style) MoE FFN
+    num_experts: int = 0            # >0: MoE FFN (Switch/GShard style)
+    moe_topk: int = 1               # experts per token (1 = Switch,
+                                    # 2 = GShard top-2 with gates
+                                    # renormalized among the selected)
     moe_dispatch: str = "dense"     # dense: every expert on every token,
                                     # one-hot select (exact); alltoall:
                                     # capacity-limited token dispatch —
@@ -215,6 +218,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_experts", type=int, default=d.num_experts,
                    help="transformer FFN becomes a top-1 MoE with this "
                         "many experts (0 = dense FFN)")
+    p.add_argument("--moe_topk", type=int, default=d.moe_topk,
+                   help="experts per token (1 = Switch; 2 = GShard "
+                        "top-2, gates renormalized)")
     p.add_argument("--moe_dispatch", type=str, default=d.moe_dispatch,
                    choices=["dense", "alltoall"],
                    help="MoE token routing: exact dense dispatch vs "

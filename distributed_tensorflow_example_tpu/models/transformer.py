@@ -63,8 +63,12 @@ class TransformerSpec:
                                    # (ppermute k/v orbit) | ulysses
                                    # (head<->seq all_to_all)
     causal: bool = False
-    num_experts: int = 0           # 0 = dense FFN; >0 = top-1 (Switch-
-                                   # style) mixture-of-experts FFN
+    num_experts: int = 0           # 0 = dense FFN; >0 = mixture-of-
+                                   # experts FFN (Switch/GShard style)
+    moe_topk: int = 1              # experts per token: 1 = Switch
+                                   # (gate = raw top prob), >1 = GShard
+                                   # (gates renormalized among the
+                                   # selected experts)
     moe_dispatch: str = "dense"    # dense (every expert on every token,
                                    # one-hot select — exact) | alltoall
                                    # (capacity-limited token dispatch,
@@ -262,34 +266,49 @@ def _attend(spec: TransformerSpec, q, k, v, seq_axis: str | None):
     return attention(q, k, v, causal=spec.causal)
 
 
+def _route_topk(spec: TransformerSpec, probs):
+    """(gates [..., k], idx [..., k]) — the router's top-k choices.
+    Top-1 keeps the raw winning probability as the gate (Switch
+    Transformer); k > 1 renormalizes the gates among the selected
+    experts (the GShard top-2 convention). Differentiable through the
+    gate values (the selection itself is a hard argmax, as in both
+    papers)."""
+    gates, idx = jax.lax.top_k(probs, spec.moe_topk)
+    if spec.moe_topk > 1:
+        gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+    return gates, idx
+
+
 def _moe_ffn(spec: TransformerSpec, params: Params, i: int, a, act, cdt,
              expert_axis: str | None):
-    """Top-1 (Switch-style) mixture-of-experts FFN for block ``i``.
+    """Top-k mixture-of-experts FFN for block ``i`` (dense dispatch).
 
     Exact "dense dispatch": every (local) expert runs on every token
-    and the router's one-hot selects — no capacity factor, no dropped
-    tokens, fully differentiable through the gate probability. Under
-    expert parallelism (``expert_axis``) each shard holds E/n experts'
-    weights and computes ONLY those (1/n of the expert FLOPs and
-    memory); the one-hot is sliced by the shard's expert offset and the
-    partial outputs combine with one psum. (``_moe_ffn_sparse`` is the
-    capacity-limited all-to-all realization of the same math,
-    selected by ``moe_dispatch='alltoall'``; this dense form trades
-    its compute/bandwidth savings for exactness.)
+    and the router's gate-weighted selection combines — no capacity
+    factor, no dropped tokens, fully differentiable through the gate
+    probabilities. Under expert parallelism (``expert_axis``) each
+    shard holds E/n experts' weights and computes ONLY those (1/n of
+    the expert FLOPs and memory); the selection weights are sliced by
+    the shard's expert offset and the partial outputs combine with one
+    psum. (``_moe_ffn_sparse`` is the capacity-limited all-to-all
+    realization of the same math, selected by
+    ``moe_dispatch='alltoall'``; this dense form trades its
+    compute/bandwidth savings for exactness.)
     """
     gate_logits = jnp.dot(
         a.astype(cdt), params[f"L{i}_Wr"].astype(cdt),
         preferred_element_type=jnp.float32)               # [B, S, E]
     probs = jax.nn.softmax(gate_logits, axis=-1)
-    onehot = jax.nn.one_hot(jnp.argmax(probs, axis=-1), spec.num_experts,
-                            dtype=jnp.float32)            # [B, S, E]
-    gate = jnp.sum(probs * onehot, axis=-1, keepdims=True)  # [B, S, 1]
+    gates, idx = _route_topk(spec, probs)                 # [B, S, k]
+    # gate-weighted selection: sum of k weighted one-hots
+    sel = jnp.sum(
+        jax.nn.one_hot(idx, spec.num_experts, dtype=jnp.float32)
+        * gates[..., None], axis=-2)                      # [B, S, E]
     we1, be1 = params[f"L{i}_We1"], params[f"L{i}_be1"]
     we2, be2 = params[f"L{i}_We2"], params[f"L{i}_be2"]
-    sel = onehot
     if expert_axis is not None:
         off = jax.lax.axis_index(expert_axis) * we1.shape[0]
-        sel = jax.lax.dynamic_slice_in_dim(onehot, off, we1.shape[0],
+        sel = jax.lax.dynamic_slice_in_dim(sel, off, we1.shape[0],
                                            axis=2)
     h1 = jnp.einsum("bsd,edf->bsef", a.astype(cdt), we1.astype(cdt),
                     preferred_element_type=jnp.float32) \
@@ -301,17 +320,18 @@ def _moe_ffn(spec: TransformerSpec, params: Params, i: int, a, act, cdt,
     out = jnp.einsum("bsed,bse->bsd", h2, sel)
     if expert_axis is not None:
         out = jax.lax.psum(out, expert_axis)
-    return gate * out
+    return out
 
 
 def _moe_ffn_sparse(spec: TransformerSpec, params: Params, i: int, a, act,
                     cdt, expert_axis: str | None):
-    """Capacity-limited token dispatch for the top-1 MoE FFN — the
+    """Capacity-limited token dispatch for the top-k MoE FFN — the
     sparse (Switch/GShard-style) realization of the same math as
     ``_moe_ffn``'s dense dispatch.
 
-    Each token goes to ONE expert buffer of static capacity
-    ``C = ceil(capacity_factor * T / E)`` (position assigned by a
+    Each of a token's k routing choices goes to one expert buffer of
+    static capacity ``C = ceil(capacity_factor * T * k / E)``
+    (position assigned by a
     cumsum over the routing one-hot; tokens past capacity are dropped —
     their FFN contribution is zero and the residual stream carries
     them, exactly Switch Transformer's overflow semantics). Under
@@ -328,26 +348,34 @@ def _moe_ffn_sparse(spec: TransformerSpec, params: Params, i: int, a, act,
     b, s, d = a.shape
     t = b * s
     e = spec.num_experts
-    cap = max(1, math.ceil(spec.capacity_factor * t / e))
+    k = spec.moe_topk
+    cap = max(1, math.ceil(spec.capacity_factor * t * k / e))
     x = a.reshape(t, d)
     gate_logits = jnp.dot(
         x.astype(cdt), params[f"L{i}_Wr"].astype(cdt),
         preferred_element_type=jnp.float32)                 # [T, E]
     probs = jax.nn.softmax(gate_logits, axis=-1)
-    idx_e = jnp.argmax(probs, axis=-1)                      # [T]
-    onehot = jax.nn.one_hot(idx_e, e, dtype=jnp.float32)    # [T, E]
-    gate = jnp.sum(probs * onehot, axis=-1)                 # [T]
-    # position of each token within its expert's buffer (0-based,
-    # arrival order = token order); routing via scatter/gather on a
-    # flat [E*C] slot index — O(T*E + E*C*d) memory, NOT the [T, E, C]
-    # one-hot dispatch tensor (cf*T^2 — it OOMs the moment a big eval
-    # batch walks through; overflow and out-slot both land in a trash
-    # row past the buffer)
+    gates, idx = _route_topk(spec, probs)                   # [T, k]
+    # each (token, choice) pair is its own dispatch unit, flattened
+    # RANK-major ([k, T]): every token's FIRST choice claims buffer
+    # space before any token's second choice — the GShard priority
+    # rule (under overflow a high-gate first choice must never lose
+    # its slot to an earlier token's low-gate runner-up)
+    flat_e = idx.T.reshape(k * t)
+    onehot = jax.nn.one_hot(flat_e, e, dtype=jnp.float32)   # [k*T, E]
+    # position of each unit within its expert's buffer (0-based,
+    # arrival order = rank then token); routing via scatter/gather on
+    # a flat [E*C] slot index — O(T*k*E + E*C*d) memory, NOT the
+    # [T, E, C] one-hot dispatch tensor (cf*T^2 — it OOMs the moment a
+    # big eval batch walks through; overflow and out-slot both land in
+    # a trash row past the buffer)
     pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) - 1.0
     keep = pos < cap
-    slot = jnp.where(keep, idx_e * cap + pos.astype(jnp.int32), e * cap)
+    slot = jnp.where(keep, flat_e * cap + pos.astype(jnp.int32), e * cap)
+    xk = jnp.broadcast_to(x[None].astype(jnp.float32),
+                          (k, t, d)).reshape(k * t, d)
     buf = jnp.zeros((e * cap + 1, d), jnp.float32)
-    buf = buf.at[slot].add(x.astype(jnp.float32))[:-1].reshape(e, cap, d)
+    buf = buf.at[slot].add(xk)[:-1].reshape(e, cap, d)
 
     we1, be1 = params[f"L{i}_We1"], params[f"L{i}_be1"]     # [El, d, ff]
     we2, be2 = params[f"L{i}_We2"], params[f"L{i}_be2"]
@@ -370,11 +398,14 @@ def _moe_ffn_sparse(spec: TransformerSpec, params: Params, i: int, a, act,
         # reverse exchange: hand each shard back its tokens' outputs
         h2 = jax.lax.all_to_all(h2.reshape(el, ep, cap, d), expert_axis,
                                 split_axis=1, concat_axis=0, tiled=True)
-    # gather each token's processed row from its slot (trash row = 0
-    # for dropped tokens) and scale by the gate probability
+    # gather each (token, choice)'s processed row from its slot (trash
+    # row = 0 for dropped units), gate-weight, and sum over the k
+    # choices
     h2_flat = jnp.concatenate(
         [h2.reshape(e * cap, d), jnp.zeros((1, d), h2.dtype)])
-    out = h2_flat[slot] * (gate * keep.astype(jnp.float32))[:, None]
+    picked = h2_flat[slot].reshape(k, t, d)
+    w = gates.T * keep.astype(jnp.float32).reshape(k, t)
+    out = jnp.sum(picked * w[..., None], axis=0)
     return out.reshape(b, s, d)
 
 
@@ -640,9 +671,9 @@ def flops_per_step(spec: TransformerSpec, batch: int) -> float:
     for bench MFU accounting."""
     d, ff, f, s = spec.d_model, spec.d_ff, spec.d_feature, spec.seq_len
     if spec.num_experts and spec.moe_dispatch == "alltoall":
-        # sparse dispatch computes ~capacity_factor tokens' worth of
-        # one expert each (plus the router)
-        ffn = spec.capacity_factor * (d * ff + ff * d) \
+        # sparse dispatch computes ~capacity_factor * k tokens' worth
+        # of expert FFN per token (plus the router)
+        ffn = spec.capacity_factor * spec.moe_topk * (d * ff + ff * d) \
             + d * spec.num_experts
     elif spec.num_experts:
         # dense-dispatch MoE computes every expert (plus the router);

@@ -66,6 +66,7 @@ def make_spec(cfg: Config):
             sp_impl=cfg.sp_impl,
             causal=cfg.causal,
             num_experts=cfg.num_experts,
+            moe_topk=cfg.moe_topk,
             moe_dispatch=cfg.moe_dispatch,
             capacity_factor=cfg.capacity_factor,
             param_dtype=jnp.dtype(cfg.param_dtype),
@@ -170,6 +171,10 @@ def run(cfg: Config) -> Dict[str, Any]:
     if cfg.num_experts and cfg.capacity_factor <= 0:
         raise ValueError(
             f"capacity_factor={cfg.capacity_factor} must be > 0")
+    if cfg.num_experts and not 1 <= cfg.moe_topk <= cfg.num_experts:
+        raise ValueError(
+            f"moe_topk={cfg.moe_topk} must be in [1, num_experts="
+            f"{cfg.num_experts}]")
     if cfg.expert_parallel > 1:
         if not cfg.num_experts:
             raise ValueError("--expert_parallel requires --num_experts > 0")
@@ -527,12 +532,18 @@ def run(cfg: Config) -> Dict[str, Any]:
                 cost = emit_epoch(epoch, costs, accs, avg_step_s)
                 maybe_checkpoint(epoch + 1)
     else:
-        local_batch = global_batch // proc_cnt
+        # Under multi-process SEQUENCE parallelism x shards its token
+        # (column) axis, so a process's devices need rows outside its
+        # example shard: every process then iterates the FULL global
+        # batch (same seed -> identical order) and the feed below slices
+        # per-device blocks via make_array_from_callback.
+        seq_mp = proc_cnt > 1 and mesh_lib.SEQ_AXIS in mesh.shape
+        local_batch = global_batch if seq_mp else global_batch // proc_cnt
         iterator = EpochIterator(
             dataset.train,
             batch_size=local_batch,
             seed=cfg.seed,
-            shard=cfg.shard_data,
+            shard=cfg.shard_data and not seq_mp,
             process_index=proc_idx,
             process_count=proc_cnt,
         )
@@ -572,12 +583,24 @@ def run(cfg: Config) -> Dict[str, Any]:
                 batches = enumerate(prefetcher)
                 for i, (batch_x, batch_y) in batches:
                     if batch_sharding is not None:
-                        batch_x = jax.make_array_from_process_local_data(
-                            x_sharding, batch_x
-                        )
-                        batch_y = jax.make_array_from_process_local_data(
-                            batch_sharding, batch_y
-                        )
+                        if seq_mp:
+                            # every process holds the full batch; each
+                            # device takes its (row, token-block) slice
+                            bx, by = batch_x, batch_y
+                            batch_x = jax.make_array_from_callback(
+                                bx.shape, x_sharding, lambda idx: bx[idx]
+                            )
+                            batch_y = jax.make_array_from_callback(
+                                by.shape, batch_sharding,
+                                lambda idx: by[idx]
+                            )
+                        else:
+                            batch_x = jax.make_array_from_process_local_data(
+                                x_sharding, batch_x
+                            )
+                            batch_y = jax.make_array_from_process_local_data(
+                                batch_sharding, batch_y
+                            )
                     if not graph_dumped:
                         graph_dumped = True
                         dump_graph(train_step, state, batch_x, batch_y)

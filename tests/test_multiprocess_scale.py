@@ -140,3 +140,56 @@ def test_checkpoint_kill_resume_multiprocess(tmp_path):
     chief = outs[0]
     assert "Resumed from" in chief, chief[-2000:]
     assert "Test-Accuracy:" in chief and "done" in chief, chief[-2000:]
+
+
+def test_transformer_tp_across_processes():
+    """Transformer Megatron TP (mp=2) across 2 single-device processes:
+    each process holds half the attention heads and half the FFN
+    hidden; the two per-block row-split psums cross the process gap."""
+    outs = run_all(2, 1, [
+        "--model=transformer", "--optimizer=adam", "--learning_rate=0.003",
+        "--training_epochs=1", "--batch_size=32", "--frequency=2",
+        "--model_parallel=2", "--data_parallel=1",
+        "--synthetic_train_size=256", "--synthetic_test_size=64",
+    ])
+    chief = outs[0]
+    assert "Test-Accuracy:" in chief and "done" in chief, chief[-2000:]
+    assert "Cost: nan" not in chief.lower(), chief[-2000:]
+
+
+def test_sparse_moe_ep_across_processes():
+    """Sparse-dispatch expert parallelism over 2 processes x 2 devices
+    (dp=2 x ep=2): tokens shard over BOTH axes, so the [E, C, d]
+    buffer all_to_all crosses the process boundary each way."""
+    outs = run_all(2, 2, [
+        "--model=transformer", "--optimizer=adam", "--learning_rate=0.003",
+        "--num_experts=4", "--expert_parallel=2", "--moe_dispatch=alltoall",
+        "--training_epochs=1", "--batch_size=32", "--frequency=2",
+        "--data_parallel=2",
+        "--synthetic_train_size=256", "--synthetic_test_size=64",
+    ])
+    chief, worker = outs
+    assert "Test-Accuracy:" in chief and "done" in chief, chief[-2000:]
+    assert "Cost: nan" not in chief.lower(), chief[-2000:]
+    assert "Test-Accuracy:" not in worker
+
+
+def test_sequence_parallel_across_processes():
+    """Sequence parallelism (both layouts) across 2 single-device
+    processes: x shards its TOKEN axis over the process gap, so each
+    process iterates the full global batch and its device takes the
+    (row, token-block) slice (train/loop.py seq_mp feed); the ring's
+    ppermute / ulysses' all_to_all cross the boundary every block."""
+    for impl in ("ring", "ulysses"):
+        outs = run_all(2, 1, [
+            "--model=transformer", "--optimizer=adam",
+            "--learning_rate=0.003",
+            "--sequence_parallel=2", "--data_parallel=1",
+            f"--sp_impl={impl}",
+            "--training_epochs=1", "--batch_size=32", "--frequency=2",
+            "--synthetic_train_size=256", "--synthetic_test_size=64",
+        ])
+        chief = outs[0]
+        assert "Test-Accuracy:" in chief and "done" in chief, \
+            (impl, chief[-2000:])
+        assert "Cost: nan" not in chief.lower(), (impl, chief[-2000:])

@@ -305,6 +305,117 @@ def test_moe_alltoall_drops_overflow_tokens():
     assert np.abs(got - want).max() > 1e-4
 
 
+def test_moe_top2_sparse_matches_dense_with_ample_capacity():
+    """GShard top-2 routing: the sparse per-choice dispatch (2 slots
+    per token) must equal the dense gate-weighted combination when
+    nothing drops, and top-2 must actually mix two experts (differ
+    from top-1)."""
+    kw = dict(num_experts=4, n_heads=2, moe_topk=2)
+    sd = _spec(moe_dispatch="dense", **kw)
+    ss = _spec(moe_dispatch="alltoall", capacity_factor=4.0, **kw)
+    s1 = _spec(moe_dispatch="dense", num_experts=4, n_heads=2)
+    params = tfm.init(jax.random.PRNGKey(3), sd)
+    x = np.random.RandomState(2).rand(4, 784).astype(np.float32)
+    want = np.asarray(jax.jit(lambda p, xx: tfm.apply(sd, p, xx))(params, x))
+    got = np.asarray(jax.jit(lambda p, xx: tfm.apply(ss, p, xx))(params, x))
+    top1 = np.asarray(jax.jit(lambda p, xx: tfm.apply(s1, p, xx))(params, x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    assert np.abs(want - top1).max() > 1e-4  # two experts really mix
+
+
+def test_moe_top2_ep_step_matches_single_device(devices8):
+    """One top-2 sparse-EP step on the DP2xEP2 mesh == the
+    single-device top-2 sparse step (ample capacity)."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+    from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+    spec = _spec(num_experts=4, moe_dispatch="alltoall", moe_topk=2,
+                 capacity_factor=4.0)
+    cfg = Config(model="transformer", learning_rate=0.01, num_experts=4,
+                 moe_dispatch="alltoall", moe_topk=2, capacity_factor=4.0)
+    opt = make_optimizer(cfg)
+    rng = np.random.RandomState(17)
+    x = rng.rand(8, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]
+
+    def one(mesh, expert_axis):
+        state = create_train_state(jax.random.PRNGKey(1), spec, opt)
+        state = mesh_lib.place_state(
+            state, mesh, mesh_lib.state_pspecs(spec, opt, 1, expert_axis))
+        step = step_lib.build_train_step(cfg, mesh, spec, opt)
+        new_state, cost, _ = step(state, x, y)
+        return jax.tree.map(np.asarray, new_state.params), float(cost)
+
+    p1, c1 = one(mesh_lib.build_mesh(1, 1, devices=devices8[:1]), None)
+    pep, cep = one(mesh_lib.build_expert_mesh(2, 2, devices=devices8[:4]),
+                   mesh_lib.EXPERT_AXIS)
+    assert abs(c1 - cep) < 1e-5
+    for kk in p1:
+        np.testing.assert_allclose(pep[kk], p1[kk], rtol=3e-5, atol=3e-6,
+                                   err_msg=kk)
+
+
+def test_moe_top2_first_choices_win_under_overflow():
+    """GShard priority: under tight capacity, every token's FIRST
+    choice claims buffer space before any token's second choice.
+    Construction: 2 experts, 4 tokens; tokens 0-1 route top1->e1
+    top2->e0, tokens 2-3 top1->e0 top2->e1; capacity 2 per expert.
+    With rank-major priority each expert's buffer holds exactly the
+    two FIRST choices, so every second choice drops and the output is
+    each token's first-expert FFN scaled by its renormalized top gate.
+    (Token-major interleaving would instead let tokens 0-1's runner-up
+    choices evict tokens 2-3's first choices from e0.)"""
+    import jax.numpy as jnp
+
+    d, ff, e = 8, 16, 2
+    # cap = ceil(0.5 * T=4 * k=2 / E=2) = 2 slots per expert
+    spec = tfm.TransformerSpec(
+        input_size=32, seq_len=4, d_model=d, n_heads=2, num_blocks=1,
+        d_ff=ff, num_experts=e, moe_topk=2, moe_dispatch="alltoall",
+        capacity_factor=0.5)
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(1, 4, d).astype(np.float32))
+    # router: logit margin decides top-1; e0 column keyed to feature 0
+    wr = np.zeros((d, e), np.float32)
+    wr[0, 0], wr[0, 1] = 1.0, -1.0
+    a = a.at[0, 0, 0].set(-3.0).at[0, 1, 0].set(-3.0)   # t0,t1 -> e1
+    a = a.at[0, 2, 0].set(3.0).at[0, 3, 0].set(3.0)     # t2,t3 -> e0
+    params = {
+        "L0_Wr": jnp.asarray(wr),
+        "L0_We1": jnp.asarray(rng.randn(e, d, ff).astype(np.float32)),
+        "L0_be1": jnp.zeros((e, ff), jnp.float32),
+        "L0_We2": jnp.asarray(rng.randn(e, ff, d).astype(np.float32)),
+        "L0_be2": jnp.zeros((e, d), jnp.float32),
+    }
+    act = jax.nn.gelu
+    got = np.asarray(tfm._moe_ffn_sparse(spec, params, 0, a, act,
+                                         jnp.float32, None))
+
+    # oracle: first choices only, renormalized top gate
+    probs = np.asarray(jax.nn.softmax(np.asarray(a) @ wr, axis=-1))[0]
+    def expert_ffn(x_tok, ei):
+        h1 = np.asarray(act(x_tok @ np.asarray(params["L0_We1"][ei])))
+        return h1 @ np.asarray(params["L0_We2"][ei])
+    want = np.zeros((4, d), np.float32)
+    for tkn in range(4):
+        top1 = int(np.argmax(probs[tkn]))
+        g = np.sort(probs[tkn])[::-1]
+        gate0 = g[0] / (g[0] + g[1])
+        want[tkn] = gate0 * expert_ffn(np.asarray(a)[0, tkn], top1)
+    np.testing.assert_allclose(got[0], want, rtol=2e-5, atol=2e-5)
+
+
+def test_moe_topk_validation():
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    with pytest.raises(ValueError, match="moe_topk"):
+        run(Config(model="transformer", num_experts=4, moe_topk=5))
+    with pytest.raises(ValueError, match="moe_topk"):
+        run(Config(model="transformer", num_experts=4, moe_topk=0))
+
+
 def test_moe_alltoall_ep_step_matches_single_device(devices8):
     """Sparse-dispatch expert parallelism shards TOKENS over the
     expert axis too (the GShard layout): a DP2xEP4 step with ample
