@@ -79,8 +79,12 @@ class Config:
                                     # buffers move with one all_to_all
                                     # each way (GShard layout)
     capacity_factor: float = 1.25   # alltoall per-expert buffer =
-                                    # ceil(cf * tokens / E); overflow
+                                    # ceil(cf * tokens * k / E); overflow
                                     # tokens drop to the residual path
+    moe_aux_weight: float = 0.0     # > 0 adds the Switch load-balance
+                                    # loss (E * sum_e f_e*P_e per MoE
+                                    # block) to the objective; printed
+                                    # cost stays plain CE
 
     # ---- loss (example.py:92-96) ----
     naive_ce: bool = False          # reproduce the reference's unstable log(softmax) CE
@@ -227,7 +231,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "capacity-limited all_to_all (Switch/GShard)")
     p.add_argument("--capacity_factor", type=float, default=d.capacity_factor,
                    help="alltoall dispatch: per-expert buffer = "
-                        "ceil(cf * tokens / E)")
+                        "ceil(cf * tokens * k / E)")
+    p.add_argument("--moe_aux_weight", type=float, default=d.moe_aux_weight,
+                   help="weight of the Switch load-balance auxiliary "
+                        "loss (0 = off)")
     p.add_argument("--expert_parallel", type=int, default=d.expert_parallel,
                    help="MoE only: shard expert weights+FLOPs over a "
                         "('data','expert') mesh")

@@ -69,12 +69,16 @@ class TransformerSpec:
                                    # (gate = raw top prob), >1 = GShard
                                    # (gates renormalized among the
                                    # selected experts)
+    aux_loss_weight: float = 0.0   # > 0 adds the Switch load-balance
+                                   # loss E*sum_e(f_e*P_e) per MoE
+                                   # block to the training objective
+                                   # (reported cost stays plain CE)
     moe_dispatch: str = "dense"    # dense (every expert on every token,
                                    # one-hot select — exact) | alltoall
                                    # (capacity-limited token dispatch,
                                    # Switch/GShard style)
     capacity_factor: float = 1.25  # alltoall only: per-expert buffer =
-                                   # ceil(cf * tokens / E); overflow
+                                   # ceil(cf * tokens * k / E); overflow
                                    # tokens are dropped (residual path
                                    # carries them)
     param_dtype: jnp.dtype = jnp.float32
@@ -266,6 +270,32 @@ def _attend(spec: TransformerSpec, q, k, v, seq_axis: str | None):
     return attention(q, k, v, causal=spec.causal)
 
 
+def _load_balance_loss(spec: TransformerSpec, probs, top1_idx, axes=()):
+    """Switch Transformer's load-balance auxiliary loss for one MoE
+    block: ``E * sum_e f_e * P_e`` where ``f_e`` is the fraction of
+    tokens whose FIRST routing choice is expert e (non-differentiable
+    counts) and ``P_e`` the mean router probability mass on e
+    (differentiable) — minimized (value 1) by a uniform router, its
+    gradient pushes probability off overloaded experts. ``probs`` is
+    [..., E] over any leading token dims.
+
+    ``axes``: mesh axes the TOKENS are sharded over inside shard_map
+    (data, seq, and — sparse dispatch — expert). f and P are pmean'd
+    over them BEFORE combining, so every shard adds the
+    global-batch aux value and N-shard training matches the
+    single-device objective exactly (mean of per-shard products would
+    not)."""
+    e = spec.num_experts
+    flat = probs.reshape(-1, e)
+    f = jnp.mean(jax.nn.one_hot(top1_idx.reshape(-1), e,
+                                dtype=jnp.float32), axis=0)
+    p = jnp.mean(flat, axis=0)
+    if axes:
+        f = jax.lax.pmean(f, axes)
+        p = jax.lax.pmean(p, axes)
+    return e * jnp.sum(f * p)
+
+
 def _route_topk(spec: TransformerSpec, probs):
     """(gates [..., k], idx [..., k]) — the router's top-k choices.
     Top-1 keeps the raw winning probability as the gate (Switch
@@ -280,7 +310,7 @@ def _route_topk(spec: TransformerSpec, probs):
 
 
 def _moe_ffn(spec: TransformerSpec, params: Params, i: int, a, act, cdt,
-             expert_axis: str | None):
+             expert_axis: str | None, aux_axes=()):
     """Top-k mixture-of-experts FFN for block ``i`` (dense dispatch).
 
     Exact "dense dispatch": every (local) expert runs on every token
@@ -320,11 +350,11 @@ def _moe_ffn(spec: TransformerSpec, params: Params, i: int, a, act, cdt,
     out = jnp.einsum("bsed,bse->bsd", h2, sel)
     if expert_axis is not None:
         out = jax.lax.psum(out, expert_axis)
-    return out
+    return out, _load_balance_loss(spec, probs, idx[..., 0], aux_axes)
 
 
 def _moe_ffn_sparse(spec: TransformerSpec, params: Params, i: int, a, act,
-                    cdt, expert_axis: str | None):
+                    cdt, expert_axis: str | None, aux_axes=()):
     """Capacity-limited token dispatch for the top-k MoE FFN — the
     sparse (Switch/GShard-style) realization of the same math as
     ``_moe_ffn``'s dense dispatch.
@@ -406,7 +436,8 @@ def _moe_ffn_sparse(spec: TransformerSpec, params: Params, i: int, a, act,
     picked = h2_flat[slot].reshape(k, t, d)
     w = gates.T * keep.astype(jnp.float32).reshape(k, t)
     out = jnp.sum(picked * w[..., None], axis=0)
-    return out.reshape(b, s, d)
+    return out.reshape(b, s, d), _load_balance_loss(spec, probs,
+                                                    idx[:, 0], aux_axes)
 
 
 def _mm(params_or_bp, a, w_name, b_name, cdt):
@@ -429,11 +460,13 @@ def _block_forward(spec: TransformerSpec, bp: Params, h, act, cdt,
                    seq_axis: str | None = None,
                    expert_axis: str | None = None, moe_block: int = 0,
                    full_params: Params | None = None,
-                   model_axis: str | None = None):
+                   model_axis: str | None = None, aux_axes=()):
     """One encoder block on ``h`` [B, S(local), D]. ``bp`` holds the
     block's leaves under their UNPREFIXED names (ln1_g, Wqkv, ...) so
     the same body serves the regular forward (dict views of L{i}_*)
-    and the pipelined forward (lax.scan over stacked stages).
+    and the pipelined forward (lax.scan over stacked stages). Returns
+    ``(h, aux)`` — aux is the block's MoE load-balance loss (0.0 for
+    the dense FFN).
 
     Under tensor parallelism (``model_axis``) the leaves arrive as
     their Megatron shards: Wqkv/bqkv hold this shard's heads (dl =
@@ -455,6 +488,7 @@ def _block_forward(spec: TransformerSpec, bp: Params, h, act, cdt,
     h = h + _row_psum(att.reshape(b, s, -1).astype(cdt), bp["Wo"],
                       bp["bo"], cdt, model_axis)
     a = _layer_norm(h, bp["ln2_g"], bp["ln2_b"])
+    aux = jnp.float32(0.0)
     if spec.num_experts:
         if spec.moe_dispatch == "alltoall":
             moe = _moe_ffn_sparse
@@ -464,18 +498,20 @@ def _block_forward(spec: TransformerSpec, bp: Params, h, act, cdt,
             raise ValueError(
                 f"unknown moe_dispatch {spec.moe_dispatch!r}: expected "
                 f"'dense' or 'alltoall'")
-        h = h + moe(spec, full_params, moe_block, a, act, cdt,
-                    expert_axis)
+        ffn, aux = moe(spec, full_params, moe_block, a, act, cdt,
+                       expert_axis, aux_axes)
+        h = h + ffn
     else:
         a = act(_mm(bp, a, "W1", "b1", cdt)).astype(cdt)
         h = h + _row_psum(a, bp["W2"], bp["b2"], cdt, model_axis)
-    return h
+    return h, aux
 
 
 def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray,
           seq_axis: str | None = None,
           expert_axis: str | None = None,
-          model_axis: str | None = None) -> jnp.ndarray:
+          model_axis: str | None = None,
+          with_aux: bool = False, aux_axes=()) -> jnp.ndarray:
     """Forward to logits. ``x``: [B, input_size] (viewed as seq_len
     tokens) or already [B, S, F].
 
@@ -509,18 +545,26 @@ def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray,
         pos = jax.lax.dynamic_slice_in_dim(pos, off, s, axis=0)
     h = _mm(params, h, "W_in", "b_in", cdt) + pos[None]
     act = _ACTIVATIONS[spec.activation]
+    aux = jnp.float32(0.0)
     for i in range(spec.num_blocks):
         bp = {k[len(f"L{i}_"):]: v for k, v in params.items()
               if k.startswith(f"L{i}_")}
-        h = _block_forward(spec, bp, h, act, cdt, seq_axis, expert_axis,
-                           moe_block=i, full_params=params,
-                           model_axis=model_axis)
+        h, aux_i = _block_forward(spec, bp, h, act, cdt, seq_axis,
+                                  expert_axis, moe_block=i,
+                                  full_params=params,
+                                  model_axis=model_axis,
+                                  aux_axes=aux_axes)
+        aux = aux + aux_i
     h = _layer_norm(h, params["lnf_g"], params["lnf_b"])
     pooled = jnp.mean(h, axis=1)                          # [B, D]
     if seq_axis is not None:
         # complete the global token mean; logits become seq-invariant
         pooled = jax.lax.pmean(pooled, seq_axis)
-    return _mm(params, pooled, "W_head", "b_head", cdt).astype(jnp.float32)
+    logits = _mm(params, pooled, "W_head", "b_head", cdt).astype(jnp.float32)
+    if with_aux:
+        # per-block mean of the MoE load-balance loss
+        return logits, aux / spec.num_blocks
+    return logits
 
 
 _BLOCK_LEAVES = ("ln1_g", "ln1_b", "Wqkv", "bqkv", "Wo", "bo",
@@ -627,8 +671,9 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
 
     def run_local(h):
         def body(h_, bp):
-            return _block_forward(spec, bp, h_, act, cdt,
-                                  model_axis=model_axis), None
+            h2_, _aux = _block_forward(spec, bp, h_, act, cdt,
+                                       model_axis=model_axis)
+            return h2_, None   # PP is dense-FFN only: aux always 0
 
         h_, _ = jax.lax.scan(body, h, local_blocks)
         return h_

@@ -336,7 +336,8 @@ def _build_local_run_to_completion(
                 spec, p, x, y, styles, cfg.naive_ce, cfg.pallas, cfg.remat
             )
 
-        (cost, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(local_p)
+        (_total, (cost, acc)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(local_p)
         new_p, new_o = optimizer.update(grads, local_o, local_p)
         new_state = TrainState(
             state.step + 1,

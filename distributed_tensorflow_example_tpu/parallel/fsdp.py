@@ -132,13 +132,15 @@ def make_fsdp_step_body(
         }
 
         def loss_fn(p):
+            from .mesh import DATA_AXIS
+
             return _loss_and_acc(
-                spec, p, x, y, styles, cfg.naive_ce, cfg.pallas, cfg.remat
+                spec, p, x, y, styles, cfg.naive_ce, cfg.pallas, cfg.remat,
+                aux_axes=(DATA_AXIS,),
             )
 
-        (cost, acc), grads_full = jax.value_and_grad(loss_fn, has_aux=True)(
-            params_full
-        )
+        (_total, (cost, acc)), grads_full = jax.value_and_grad(
+            loss_fn, has_aux=True)(params_full)
         grads = {
             k: _scatter_grad(grads_full[k], state.params[k].shape[1], dp)
             for k in grads_full

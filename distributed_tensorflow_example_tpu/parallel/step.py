@@ -56,7 +56,8 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
                   seq_axis: str | None = None,
                   expert_axis: str | None = None,
                   pipeline: tuple | None = None,
-                  model_axis: str | None = None):
+                  model_axis: str | None = None,
+                  with_aux: bool = False, aux_axes: tuple = ()):
     """Per-shard forward to (replicated) logits; TP-aware (example.py:87-89).
 
     Model-family dispatch: TransformerSpec routes to the transformer
@@ -71,13 +72,18 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
 
     if isinstance(spec, transformer.TransformerSpec):
         if pipeline is not None:
+            if with_aux:
+                raise ValueError(
+                    "MoE aux loss is not available on the pipeline "
+                    "path (PP is dense-FFN only)")
             stage_axis, n_stages, microbatches = pipeline
             return transformer.apply_pipeline(
                 spec, params, x, stage_axis, n_stages, microbatches,
                 model_axis=model_axis)
         return transformer.apply(spec, params, x, seq_axis=seq_axis,
                                  expert_axis=expert_axis,
-                                 model_axis=model_axis)
+                                 model_axis=model_axis,
+                                 with_aux=with_aux, aux_axes=aux_axes)
     if use_pallas and all(s == "rep" for s in styles):
         from ..ops import pallas_fused
 
@@ -88,20 +94,37 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
 
 def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas, remat=False,
                   seq_axis=None, expert_axis=None, pipeline=None,
-                  model_axis=None):
-    fwd = lambda p, xx: forward_local(spec, p, xx, styles, use_pallas,
-                                      seq_axis, expert_axis, pipeline,
-                                      model_axis)
+                  model_axis=None, aux_axes=()):
+    """-> (objective, (reported_cost, accuracy)): the objective is what
+    gradients flow from (CE plus, for a MoE spec with
+    ``aux_loss_weight``, the weighted load-balance loss); the reported
+    cost stays plain CE so the reference's printed metric is
+    unchanged. ``aux_axes``: mesh axes the tokens shard over — the
+    balance loss pmean's its statistics across them so N-shard
+    training optimizes the same global objective as one device."""
+    aux_w = float(getattr(spec, "aux_loss_weight", 0.0))
+    want_aux = aux_w > 0.0 and pipeline is None
+
+    def fwd(p, xx):
+        if want_aux:
+            return forward_local(spec, p, xx, styles, use_pallas,
+                                 seq_axis, expert_axis, pipeline,
+                                 model_axis, with_aux=True,
+                                 aux_axes=aux_axes)
+        return forward_local(spec, p, xx, styles, use_pallas,
+                             seq_axis, expert_axis, pipeline,
+                             model_axis), jnp.float32(0.0)
+
     if remat:
         # jax.checkpoint: recompute activations in the backward pass
         # instead of saving them — trades MXU FLOPs for HBM, the
         # standard lever once hidden sizes grow (SURVEY has no analog:
         # TF 1.2 always stored every activation).
         fwd = jax.checkpoint(fwd)
-    logits = fwd(params, x)
+    logits, aux = fwd(params, x)
     cost = losses.cross_entropy(logits, y, naive=naive)
     acc = metrics.accuracy(logits, y)
-    return cost, acc
+    return cost + aux_w * aux, (cost, acc)
 
 
 def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
@@ -118,11 +141,15 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
     sparse-dispatch expert parallelism, where tokens shard over
     'expert' too)."""
 
+    # token-sharding axes for the MoE balance loss: the batch axes
+    # plus the sequence axis when the token dim itself is sharded
+    aux_axes = tuple(batch_axes) + ((seq_axis,) if seq_axis else ())
+
     def grad_of(params, x, y):
         def loss_fn(p):
             return _loss_and_acc(
                 spec, p, x, y, styles, cfg.naive_ce, cfg.pallas, cfg.remat,
-                seq_axis, expert_axis, pipeline, model_axis,
+                seq_axis, expert_axis, pipeline, model_axis, aux_axes,
             )
 
         return jax.value_and_grad(loss_fn, has_aux=True)(params)
@@ -142,20 +169,20 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
 
             def accum(carry, xy):
                 g_acc, c_acc, a_acc = carry
-                (c, a), g = grad_of(state.params, *xy)
+                (_t, (c, a)), g = grad_of(state.params, *xy)
                 return (jax.tree.map(jnp.add, g_acc, g),
                         c_acc + c, a_acc + a), None
 
             # seed the carry with microbatch 0 (a plain zero init would
             # be device-invariant while the accumulated values vary
             # over the batch axes — scan requires matching types)
-            (c0, a0), g0 = grad_of(state.params, xs[0], ys[0])
+            (_t0, (c0, a0)), g0 = grad_of(state.params, xs[0], ys[0])
             (g_sum, c_sum, a_sum), _ = jax.lax.scan(
                 accum, (g0, c0, a0), (xs[1:], ys[1:]))
             grads = jax.tree.map(lambda g: g / n, g_sum)
             cost, acc = c_sum / n, a_sum / n
         else:
-            (cost, acc), grads = grad_of(state.params, x, y)
+            (_total, (cost, acc)), grads = grad_of(state.params, x, y)
         # shard_map's transpose has already psum'd grads over the batch
         # axes (params are batch-unvarying); rescale for mean semantics.
         if cfg.grad_reduce == "mean" and dp > 1:
@@ -323,7 +350,8 @@ def build_local_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer, state_templa
                 spec, p, x, y, styles, cfg.naive_ce, cfg.pallas, cfg.remat
             )
 
-        (cost, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(local_p)
+        (_total, (cost, acc)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(local_p)
         new_p, new_o = optimizer.update(grads, local_o, local_p)
         cost = jax.lax.pmean(cost, DATA_AXIS)
         acc = jax.lax.pmean(acc, DATA_AXIS)

@@ -69,6 +69,7 @@ def make_spec(cfg: Config):
             moe_topk=cfg.moe_topk,
             moe_dispatch=cfg.moe_dispatch,
             capacity_factor=cfg.capacity_factor,
+            aux_loss_weight=cfg.moe_aux_weight,
             param_dtype=jnp.dtype(cfg.param_dtype),
             compute_dtype=jnp.dtype(cfg.compute_dtype),
         )
@@ -175,6 +176,11 @@ def run(cfg: Config) -> Dict[str, Any]:
         raise ValueError(
             f"moe_topk={cfg.moe_topk} must be in [1, num_experts="
             f"{cfg.num_experts}]")
+    if cfg.moe_aux_weight and not cfg.num_experts:
+        raise ValueError("--moe_aux_weight requires --num_experts > 0")
+    if cfg.moe_aux_weight < 0:
+        raise ValueError(
+            f"moe_aux_weight={cfg.moe_aux_weight} must be >= 0")
     if cfg.expert_parallel > 1:
         if not cfg.num_experts:
             raise ValueError("--expert_parallel requires --num_experts > 0")

@@ -42,6 +42,20 @@ CELLS += [
                     "data_parallel": 2}),
     ("tfm_pp", {**_TFM, "pipeline_parallel": 2, "data_parallel": 4,
                 "microbatches": 2}),
+    # r3 additions: transformer TP (2- and 3-axis), ulysses SP, sparse
+    # MoE with top-2 + aux loss, schedules + accumulation
+    ("tfm_tp", {**_TFM, "model_parallel": 2, "data_parallel": 4}),
+    ("tfm_pp_tp", {**_TFM, "pipeline_parallel": 2, "model_parallel": 2,
+                   "data_parallel": 2, "microbatches": 2}),
+    ("tfm_ulysses", {**_TFM, "sequence_parallel": 2, "data_parallel": 4,
+                     "sp_impl": "ulysses"}),
+    ("tfm_moe_sparse_aux", {**_TFM, "num_experts": 4,
+                            "expert_parallel": 2, "data_parallel": 2,
+                            "moe_dispatch": "alltoall", "moe_topk": 2,
+                            "moe_aux_weight": 0.01}),
+    ("sched_accum", {"optimizer": "adam", "learning_rate": 0.001,
+                     "lr_schedule": "cosine", "warmup_steps": 3,
+                     "grad_accum": 2}),
 ]
 
 

@@ -390,8 +390,9 @@ def test_moe_top2_first_choices_win_under_overflow():
         "L0_be2": jnp.zeros((e, d), jnp.float32),
     }
     act = jax.nn.gelu
-    got = np.asarray(tfm._moe_ffn_sparse(spec, params, 0, a, act,
-                                         jnp.float32, None))
+    out, _aux = tfm._moe_ffn_sparse(spec, params, 0, a, act,
+                                    jnp.float32, None)
+    got = np.asarray(out)
 
     # oracle: first choices only, renormalized top gate
     probs = np.asarray(jax.nn.softmax(np.asarray(a) @ wr, axis=-1))[0]
@@ -405,6 +406,115 @@ def test_moe_top2_first_choices_win_under_overflow():
         gate0 = g[0] / (g[0] + g[1])
         want[tkn] = gate0 * expert_ffn(np.asarray(a)[0, tkn], top1)
     np.testing.assert_allclose(got[0], want, rtol=2e-5, atol=2e-5)
+
+
+def test_moe_aux_loss_oracle_and_dispatch_agreement():
+    """The load-balance aux loss matches a numpy re-derivation
+    (E * sum_e f_e * P_e per block, averaged over blocks) and both
+    dispatches report the same value (they share the router)."""
+    kw = dict(num_experts=4, n_heads=2, aux_loss_weight=0.01)
+    sd = _spec(moe_dispatch="dense", **kw)
+    ss = _spec(moe_dispatch="alltoall", capacity_factor=4.0, **kw)
+    params = tfm.init(jax.random.PRNGKey(3), sd)
+    x = np.random.RandomState(2).rand(4, 784).astype(np.float32)
+    _, aux_d = jax.jit(
+        lambda p, xx: tfm.apply(sd, p, xx, with_aux=True))(params, x)
+    _, aux_s = jax.jit(
+        lambda p, xx: tfm.apply(ss, p, xx, with_aux=True))(params, x)
+    np.testing.assert_allclose(float(aux_d), float(aux_s), rtol=1e-5)
+    # direct oracle on one block's probs
+    probs = np.asarray(jax.nn.softmax(
+        np.random.RandomState(5).randn(32, 4).astype(np.float32), -1))
+    f = np.bincount(probs.argmax(-1), minlength=4) / probs.shape[0]
+    want = 4 * float(np.sum(f * probs.mean(0)))
+    got = float(tfm._load_balance_loss(
+        sd, jnp.asarray(probs), jnp.asarray(probs.argmax(-1))))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # aux >= 1 at the balanced optimum; random routers sit above it
+    assert float(aux_d) >= 0.99
+
+
+def test_moe_aux_loss_changes_grads_not_reported_cost(devices8):
+    """With --moe_aux_weight the optimized objective gains the
+    balance term (different params after one step) while the REPORTED
+    cost stays the plain CE of the same forward."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+    from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+    rng = np.random.RandomState(23)
+    x = rng.rand(8, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]
+    mesh = mesh_lib.build_mesh(1, 1, devices=devices8[:1])
+
+    def one(w):
+        spec = _spec(num_experts=4, aux_loss_weight=w)
+        cfg = Config(model="transformer", learning_rate=0.05,
+                     num_experts=4, moe_aux_weight=w)
+        opt = make_optimizer(cfg)
+        state = create_train_state(jax.random.PRNGKey(1), spec, opt)
+        state = mesh_lib.place_state(
+            state, mesh, mesh_lib.state_pspecs(spec, opt, 1))
+        step = step_lib.build_train_step(cfg, mesh, spec, opt)
+        new_state, cost, _ = step(state, x, y)
+        return jax.tree.map(np.asarray, new_state.params), float(cost)
+
+    p0, c0 = one(0.0)
+    p1, c1 = one(0.5)
+    assert abs(c0 - c1) < 1e-6          # reported cost: plain CE
+    router_moved = np.abs(p1["L0_Wr"] - p0["L0_Wr"]).max()
+    assert router_moved > 1e-7          # the balance term reached grads
+
+
+@pytest.mark.parametrize("mode", ["dp8", "sp", "ep_sparse"])
+def test_moe_aux_loss_sharded_matches_single_device(devices8, mode):
+    """With the aux loss ON, sharded training must still equal the
+    single-device step: the balance statistics (f, P) are pmean'd over
+    every token-sharding axis before combining, so each shard adds the
+    GLOBAL-batch aux (a per-shard aux would make mean-of-products
+    diverge from the single-device objective)."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+    from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+    kw = dict(num_experts=4, aux_loss_weight=0.3, n_heads=4)
+    ckw = dict(model="transformer", learning_rate=0.05, num_experts=4,
+               moe_aux_weight=0.3, n_heads=4)
+    if mode == "ep_sparse":
+        kw.update(moe_dispatch="alltoall", capacity_factor=4.0)
+        ckw.update(moe_dispatch="alltoall", capacity_factor=4.0)
+    spec = _spec(**kw)
+    cfg = Config(**ckw)
+    opt = make_optimizer(cfg)
+    rng = np.random.RandomState(29)
+    x = rng.rand(16, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 16)]
+
+    def one(mesh, expert_axis):
+        state = create_train_state(jax.random.PRNGKey(1), spec, opt)
+        state = mesh_lib.place_state(
+            state, mesh, mesh_lib.state_pspecs(spec, opt, 1, expert_axis))
+        step = step_lib.build_train_step(cfg, mesh, spec, opt)
+        new_state, cost, _ = step(state, x, y)
+        return jax.tree.map(np.asarray, new_state.params), float(cost)
+
+    p1, c1 = one(mesh_lib.build_mesh(1, 1, devices=devices8[:1]), None)
+    if mode == "dp8":
+        mesh = mesh_lib.build_mesh(8, 1, devices=devices8)
+        ea = None
+    elif mode == "sp":
+        mesh = mesh_lib.build_seq_mesh(2, 4, devices=devices8)
+        ea = None
+    else:
+        mesh = mesh_lib.build_expert_mesh(2, 4, devices=devices8)
+        ea = mesh_lib.EXPERT_AXIS
+    pn, cn = one(mesh, ea)
+    assert abs(c1 - cn) < 1e-5
+    for k in p1:
+        np.testing.assert_allclose(pn[k], p1[k], rtol=3e-5, atol=3e-6,
+                                   err_msg=k)
 
 
 def test_moe_topk_validation():
