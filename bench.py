@@ -517,6 +517,51 @@ def bench_transformer(seq: int = 1024, batch: int = 32, repeats: int = 3,
     return row
 
 
+def bench_lm(seq: int = 1024, batch: int = 16, repeats: int = 3,
+             steps: int = 16):
+    """Autoregressive LM training throughput (--objective=lm): 256-way
+    next-token prediction over a S-token causal transformer with the
+    flash-attention kernels, bf16, whole epoch as one scan program —
+    the image-GPT-style objective the classify family cannot express.
+    Reports tokens/sec and model MFU (flops_per_step counts the
+    per-position vocab head)."""
+    import numpy as np
+
+    from distributed_tensorflow_example_tpu.config import Config
+    from distributed_tensorflow_example_tpu.models import transformer as tfm
+    from distributed_tensorflow_example_tpu.parallel import epoch as epoch_lib
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.train.loop import make_spec
+
+    peak = _chip_peak_flops()
+    mesh = mesh_lib.build_mesh(1, 1)
+    rng = np.random.RandomState(0)
+    n = batch * steps
+    images = rng.randint(0, 256, size=(n, seq)).astype(
+        np.float32) / np.float32(255.0)
+    labels = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+    img_d, lbl_d, spe = epoch_lib.shard_dataset(mesh, images, labels, batch)
+    cfg = Config(
+        model="transformer", objective="lm", input_size=seq,
+        vocab_size=256, attention="flash", d_model=256, n_heads=8,
+        num_blocks=4, d_ff=1024, compute_dtype="bfloat16",
+        optimizer="adam", learning_rate=1e-3, batch_size=batch,
+        dataset="synthetic", summaries=False,
+    )
+    spec = make_spec(cfg)
+    step_s = _steady_state_step_time(cfg, spec, mesh, img_d, lbl_d,
+                                     spe, 1, repeats)
+    flops = tfm.flops_per_step(spec, batch)
+    row = {"config": "lm_next_token",
+           "model": f"S={seq} vocab=256 d_model=256 blocks=4 bf16 "
+                    f"causal flash",
+           "global_batch": batch,
+           "step_time_ms": round(step_s * 1000, 2),
+           "tokens_per_sec": round(batch * seq / step_s, 1)}
+    row.update(_rate(flops, step_s, peak))
+    return row
+
+
 def bench_moe_dispatch(e: int = 32, seq: int = 128, batch: int = 64,
                        repeats: int = 3, steps: int = 16):
     """MoE FFN dispatch on the real training path: dense dispatch
@@ -760,6 +805,7 @@ def main(argv=None) -> int:
         guarded("ring_flash", bench_ring_flash)
         guarded("transformer_flash_long_context", bench_transformer)
         guarded("moe_dispatch", bench_moe_dispatch)
+        guarded("lm_next_token", bench_lm)
 
     # headline candidates exclude the learning-regime row: its lr=0.5
     # wall-clock must never masquerade as the reference headline when

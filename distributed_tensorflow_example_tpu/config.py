@@ -60,6 +60,12 @@ class Config:
     compute_dtype: str = "float32"  # bfloat16 puts the matmuls on the MXU native dtype
 
     # ---- transformer family (models/transformer.py) ----
+    objective: str = "classify"     # classify (reference-style labels)
+                                    # | lm (autoregressive next-token
+                                    # prediction over discretized
+                                    # inputs; transformer only, causal
+                                    # forced, seq_len = input_size)
+    vocab_size: int = 256           # lm discretization levels
     seq_len: int = 28               # input viewed as seq_len tokens
     d_model: int = 128
     n_heads: int = 4
@@ -211,6 +217,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--frequency", type=int, default=d.frequency)
     p.add_argument("--model", type=str, default=d.model,
                    choices=["mlp", "transformer"])
+    p.add_argument("--objective", type=str, default=d.objective,
+                   choices=["classify", "lm"],
+                   help="training objective: labeled classification "
+                        "(reference parity) or autoregressive "
+                        "next-token prediction (image-GPT style)")
+    p.add_argument("--vocab_size", type=int, default=d.vocab_size)
     p.add_argument("--seq_len", type=int, default=d.seq_len)
     p.add_argument("--d_model", type=int, default=d.d_model)
     p.add_argument("--n_heads", type=int, default=d.n_heads)

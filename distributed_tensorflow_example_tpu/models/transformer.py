@@ -58,6 +58,12 @@ class TransformerSpec:
     num_blocks: int = 2
     d_ff: int = 256
     activation: str = "gelu"
+    objective: str = "classify"    # classify (reference-style labels)
+                                   # | lm (autoregressive next-token
+                                   # prediction over discretized
+                                   # inputs, image-GPT style — causal,
+                                   # one token per input scalar)
+    vocab_size: int = 256          # lm only: discretization levels
     attention: str = "dense"       # dense | flash (ops/flash_attention)
     sp_impl: str = "ring"          # sequence-parallel layout: ring
                                    # (ppermute k/v orbit) | ulysses
@@ -86,6 +92,14 @@ class TransformerSpec:
 
     @property
     def d_feature(self) -> int:
+        if self.objective == "lm":
+            # one token per input scalar; values embed via W_emb lookup
+            if self.seq_len != self.input_size:
+                raise ValueError(
+                    f"objective='lm' tokenizes every input scalar: "
+                    f"seq_len ({self.seq_len}) must equal input_size "
+                    f"({self.input_size})")
+            return 1
         if self.input_size % self.seq_len:
             raise ValueError(
                 f"input_size={self.input_size} not divisible by "
@@ -114,7 +128,7 @@ def init(key: jax.Array, spec: TransformerSpec) -> Params:
     keys = dict(zip(random_names, jax.random.split(key, len(random_names))))
     p: Params = {}
     for name, shape in shapes.items():
-        if name == "pos":
+        if name in ("pos", "W_emb"):
             p[name] = (0.02 * jax.random.normal(
                 keys[name], shape, dtype=jnp.float32)).astype(pd)
         elif "W" in name:
@@ -136,11 +150,20 @@ def param_shapes(spec: TransformerSpec) -> Dict[str, tuple[int, ...]]:
     parameter tree's structure (init, pspecs and num_params derive from
     it without materializing weights)."""
     d, ff, f = spec.d_model, spec.d_ff, spec.d_feature
-    shapes: Dict[str, tuple[int, ...]] = {
-        "W_in": (f, d), "b_in": (d,), "pos": (spec.seq_len, d),
-        "lnf_g": (d,), "lnf_b": (d,),
-        "W_head": (d, spec.num_classes), "b_head": (spec.num_classes,),
-    }
+    if spec.objective == "lm":
+        # vocab embedding in, per-position vocab head out
+        shapes: Dict[str, tuple[int, ...]] = {
+            "W_emb": (spec.vocab_size, d), "pos": (spec.seq_len, d),
+            "lnf_g": (d,), "lnf_b": (d,),
+            "W_head": (d, spec.vocab_size), "b_head": (spec.vocab_size,),
+        }
+    else:
+        shapes = {
+            "W_in": (f, d), "b_in": (d,), "pos": (spec.seq_len, d),
+            "lnf_g": (d,), "lnf_b": (d,),
+            "W_head": (d, spec.num_classes),
+            "b_head": (spec.num_classes,),
+        }
     for i in range(spec.num_blocks):
         shapes.update({
             f"L{i}_ln1_g": (d,), f"L{i}_ln1_b": (d,),
@@ -440,6 +463,15 @@ def _moe_ffn_sparse(spec: TransformerSpec, params: Params, i: int, a, act,
                                                     idx[:, 0], aux_axes)
 
 
+def tokenize(spec: TransformerSpec, x: jnp.ndarray) -> jnp.ndarray:
+    """Discretize float inputs in [0, 1] to int tokens ([B, S] from
+    [B, S] or [B, S, 1]) — the lm objective's vocabulary (image-GPT
+    style: one token per input scalar)."""
+    v = spec.vocab_size
+    flat = x.reshape(x.shape[0], -1)
+    return jnp.clip(jnp.round(flat * (v - 1)), 0, v - 1).astype(jnp.int32)
+
+
 def _mm(params_or_bp, a, w_name, b_name, cdt):
     acc = jnp.dot(a.astype(cdt), params_or_bp[w_name].astype(cdt),
                   preferred_element_type=jnp.float32)
@@ -536,14 +568,19 @@ def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray,
     if seq_axis is not None:
         n_shards = jax.lax.psum(1, seq_axis)
         s = spec.seq_len // n_shards
-    h = x.reshape(b, s, f).astype(cdt)
 
     pos = params["pos"].astype(jnp.float32)
     if seq_axis is not None:
         # this shard's slice of the global positional table
         off = jax.lax.axis_index(seq_axis) * s
         pos = jax.lax.dynamic_slice_in_dim(pos, off, s, axis=0)
-    h = _mm(params, h, "W_in", "b_in", cdt) + pos[None]
+    if spec.objective == "lm":
+        # vocab-embedding lookup of the discretized tokens
+        tokens = tokenize(spec, x)                        # [B, s]
+        h = params["W_emb"].astype(jnp.float32)[tokens] + pos[None]
+    else:
+        h = x.reshape(b, s, f).astype(cdt)
+        h = _mm(params, h, "W_in", "b_in", cdt) + pos[None]
     act = _ACTIVATIONS[spec.activation]
     aux = jnp.float32(0.0)
     for i in range(spec.num_blocks):
@@ -556,11 +593,20 @@ def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray,
                                   aux_axes=aux_axes)
         aux = aux + aux_i
     h = _layer_norm(h, params["lnf_g"], params["lnf_b"])
-    pooled = jnp.mean(h, axis=1)                          # [B, D]
-    if seq_axis is not None:
-        # complete the global token mean; logits become seq-invariant
-        pooled = jax.lax.pmean(pooled, seq_axis)
-    logits = _mm(params, pooled, "W_head", "b_head", cdt).astype(jnp.float32)
+    if spec.objective == "lm":
+        # per-position vocab logits [B, s(local), V] — no pooling; the
+        # next-token loss (parallel/step._lm_loss_and_acc) consumes
+        # the full sequence
+        logits = _mm(params, h, "W_head", "b_head",
+                     cdt).astype(jnp.float32)
+    else:
+        pooled = jnp.mean(h, axis=1)                      # [B, D]
+        if seq_axis is not None:
+            # complete the global token mean; logits become
+            # seq-invariant
+            pooled = jax.lax.pmean(pooled, seq_axis)
+        logits = _mm(params, pooled, "W_head", "b_head",
+                     cdt).astype(jnp.float32)
     if with_aux:
         # per-block mean of the MoE load-balance loss
         return logits, aux / spec.num_blocks
@@ -582,6 +628,10 @@ def pipeline_stack_params(spec: TransformerSpec, params: Params) -> Params:
         raise ValueError(
             "pipeline parallelism supports the dense FFN only "
             "(num_experts=0)")
+    if spec.objective == "lm":
+        raise ValueError(
+            "pipeline parallelism supports the classify objective only "
+            "(the lm head is per-position)")
     out = {k: v for k, v in params.items() if not k.startswith("L")}
     for leaf in _BLOCK_LEAVES:
         out[f"blk_{leaf}"] = jnp.stack(
@@ -727,7 +777,9 @@ def flops_per_step(spec: TransformerSpec, batch: int) -> float:
     else:
         ffn = d * ff + ff * d
     macs_tok = f * d + spec.num_blocks * (3 * d * d + d * d + ffn)
-    macs = batch * (s * macs_tok + d * spec.num_classes)
+    head = (s * d * spec.vocab_size if spec.objective == "lm"
+            else d * spec.num_classes)
+    macs = batch * (s * macs_tok + head)
     attn = 4.0 * batch * spec.n_heads * s * s * spec.d_head \
         * spec.num_blocks * (0.5 if spec.causal else 1.0)
     # 3.5x forward for fwd+bwd attention — the same accounting as

@@ -387,38 +387,57 @@ def _build_fast_eval(cfg, mesh, spec: mlp.MLPSpec, images: np.ndarray, labels: n
     the mesh, upload once (uint8 when exact, else float32), return a
     callable params -> accuracy, with ``.dispatch`` for a non-blocking
     device-array variant (lets the host overlap metric processing with
-    the eval executing on-device) and ``.n`` the true example count."""
-    from .step import forward_local
+    the eval executing on-device) and ``.n`` the true example count.
+
+    The set is evaluated in chunks with a single ``lax.map`` inside ONE
+    executable (one dispatch, sequential chunk compute): peak
+    activation memory is one chunk's forward, sized by
+    step.eval_chunk_cap — dense attention at the lm objective's
+    S = input_size would otherwise need an [N, H, S, S] score tensor
+    for the whole set at once."""
+    from .step import eval_chunk_cap, forward_local
 
     dp = mesh.shape[DATA_AXIS]
     mp = mesh.shape[MODEL_AXIS]
     styles = mesh_lib.layer_styles(spec, mp)
     pp = mesh_lib.param_pspecs(spec, mp)
     n = images.shape[0]
-    n_pad = ((n + dp - 1) // dp) * dp
+    # baseline = the whole set in ONE batch (the r2 behavior); the
+    # memory cap splits it only when the score tensor would not fit
+    chunk = max(dp, (min(eval_chunk_cap(spec, n), n) // dp) * dp)
+    n_pad = ((n + chunk - 1) // chunk) * chunk
+    n_chunks = n_pad // chunk
     packed = _pack_images(images)
     img = np.zeros((n_pad, images.shape[1]), packed.dtype)
     img[:n] = packed
     lbl = np.zeros((n_pad, labels.shape[1]), np.float32)
     lbl[:n] = labels
     mask = (np.arange(n_pad) < n).astype(np.float32)
-    sh = NamedSharding(mesh, P(DATA_AXIS))
-    img_d = jax.device_put(img, sh)
-    lbl_d = jax.device_put(lbl, sh)
-    mask_d = jax.device_put(mask, sh)
+    sh = NamedSharding(mesh, P(None, DATA_AXIS))
+    img_d = jax.device_put(img.reshape(n_chunks, chunk, -1), sh)
+    lbl_d = jax.device_put(lbl.reshape(n_chunks, chunk, -1), sh)
+    mask_d = jax.device_put(mask.reshape(n_chunks, chunk), sh)
 
-    def shard_eval(params, img_packed, y, m):
-        x = _normalize(img_packed)
-        logits = forward_local(spec, params, x, styles, cfg.pallas,
-                               model_axis=mesh_lib.tp_axis(spec, mp))
-        correct = (jnp.argmax(logits, -1) == jnp.argmax(y, -1)).astype(jnp.float32)
-        return jax.lax.psum(jnp.sum(correct * m), DATA_AXIS)
+    def shard_eval(params, img_chunks, y_chunks, m_chunks):
+        def one_chunk(args):
+            from .step import _eval_correct
+
+            img_packed, y, m = args
+            x = _normalize(img_packed)
+            logits = forward_local(spec, params, x, styles, cfg.pallas,
+                                   model_axis=mesh_lib.tp_axis(spec, mp))
+            return jnp.sum(_eval_correct(spec, logits, x, y) * m)
+
+        per_chunk = jax.lax.map(one_chunk,
+                                (img_chunks, y_chunks, m_chunks))
+        return jax.lax.psum(jnp.sum(per_chunk), DATA_AXIS)
 
     fn = jax.jit(
         jax.shard_map(
             shard_eval,
             mesh=mesh,
-            in_specs=(pp, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            in_specs=(pp, P(None, DATA_AXIS), P(None, DATA_AXIS),
+                      P(None, DATA_AXIS)),
             out_specs=P(),
         )
     )

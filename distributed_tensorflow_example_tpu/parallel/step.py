@@ -92,6 +92,43 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
     return mlp.apply(spec, params, x, styles=styles, model_axis=MODEL_AXIS)
 
 
+def _lm_stats(spec, logits, tokens, seq_axis):
+    """Per-example next-token sums from per-position vocab logits:
+    ``(nll_sum [B], correct_sum [B], count [B])`` over the S-1 valid
+    positions (position t predicts token t+1; the global last position
+    has no target).
+
+    Under sequence parallelism each shard holds a contiguous token
+    block: its last position's target is the NEXT shard's first token
+    — fetched with one tiny ppermute — and the per-example sums are
+    psum'd over the seq axis, so every shard returns the GLOBAL
+    statistics and N-shard training/eval matches one device exactly.
+    """
+    b, sl, _ = logits.shape
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if seq_axis is None:
+        preds, targets = logp[:, :-1], tokens[:, 1:]
+        nll = -jnp.take_along_axis(preds, targets[..., None], -1)[..., 0]
+        correct = (jnp.argmax(logits[:, :-1], -1) == targets)
+        count = jnp.full((b,), nll.shape[1], jnp.float32)
+        return (jnp.sum(nll, 1), jnp.sum(correct, 1).astype(jnp.float32),
+                count)
+    n = jax.lax.psum(1, seq_axis)
+    idx = jax.lax.axis_index(seq_axis)
+    # boundary target: shard i receives shard i+1's first token
+    nxt = jax.lax.ppermute(tokens[:, 0], seq_axis,
+                           [(i + 1, i) for i in range(n - 1)])
+    targets = jnp.concatenate([tokens[:, 1:], nxt[:, None]], axis=1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+    correct = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
+    mask = jnp.ones((b, sl), jnp.float32)
+    mask = mask.at[:, -1].multiply(
+        jnp.where(jnp.equal(idx, n - 1), 0.0, 1.0))
+    return (jax.lax.psum(jnp.sum(nll * mask, 1), seq_axis),
+            jax.lax.psum(jnp.sum(correct * mask, 1), seq_axis),
+            jax.lax.psum(jnp.sum(mask, 1), seq_axis))
+
+
 def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas, remat=False,
                   seq_axis=None, expert_axis=None, pipeline=None,
                   model_axis=None, aux_axes=()):
@@ -122,6 +159,15 @@ def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas, remat=False,
         # TF 1.2 always stored every activation).
         fwd = jax.checkpoint(fwd)
     logits, aux = fwd(params, x)
+    if getattr(spec, "objective", "classify") == "lm":
+        # self-supervised: y is unused; loss = mean next-token CE
+        from ..models import transformer
+
+        tokens = transformer.tokenize(spec, x)
+        nll, correct, count = _lm_stats(spec, logits, tokens, seq_axis)
+        cost = jnp.sum(nll) / jnp.sum(count)
+        acc = jnp.sum(correct) / jnp.sum(count)
+        return cost + aux_w * aux, (cost, acc)
     cost = losses.cross_entropy(logits, y, naive=naive)
     acc = metrics.accuracy(logits, y)
     return cost + aux_w * aux, (cost, acc)
@@ -193,6 +239,39 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
         return TrainState(state.step + 1, new_params, new_opt), cost, acc
 
     return body
+
+
+def eval_chunk_cap(spec, eval_batch_size: int) -> int:
+    """Examples per eval chunk: the caller's batch size, capped for
+    dense-attention transformers so the [B, H, S, S] score tensor
+    stays within a ~2 GB activation budget (the whole-test-set eval
+    would otherwise OOM the moment S grows — e.g. the lm objective's
+    S = input_size; the flash backend materializes no score tensor
+    and needs no cap; for small S the budget quotient exceeds any
+    realistic batch, so the cap never binds)."""
+    from ..models import transformer
+
+    cap = eval_batch_size
+    if (isinstance(spec, transformer.TransformerSpec)
+            and spec.attention == "dense"):
+        budget = 2 * 1024 ** 3
+        per_example = 8 * spec.n_heads * spec.seq_len ** 2  # f32, ~2x
+        cap = min(cap, max(1, budget // per_example))
+    return cap
+
+
+def _eval_correct(spec, logits, x, y, seq_axis=None):
+    """Per-example 'correct' value for eval: the 0/1 classification
+    hit, or — lm objective — the example's mean next-token accuracy.
+    Shared by the host eval step and the fast device-resident eval so
+    the two paths cannot drift."""
+    if getattr(spec, "objective", "classify") == "lm":
+        from ..models import transformer
+
+        tokens = transformer.tokenize(spec, x)
+        _nll, c, cnt = _lm_stats(spec, logits, tokens, seq_axis)
+        return c / cnt
+    return (jnp.argmax(logits, -1) == jnp.argmax(y, -1)).astype(jnp.float32)
 
 
 def sparse_ep_mode(mesh, spec) -> bool:
@@ -291,7 +370,7 @@ def build_eval_step(cfg, mesh, spec: mlp.MLPSpec) -> Callable:
         logits = forward_local(spec, params, x, styles, cfg.pallas,
                                seq_axis, expert_axis, pipeline,
                                model_axis)
-        correct = (jnp.argmax(logits, -1) == jnp.argmax(y, -1)).astype(jnp.float32)
+        correct = _eval_correct(spec, logits, x, y, seq_axis)
         return jax.lax.psum(jnp.sum(correct * mask), batch_axes)
 
     fn = jax.shard_map(

@@ -51,10 +51,15 @@ def make_spec(cfg: Config):
     if cfg.model == "transformer":
         from ..models.transformer import TransformerSpec
 
+        lm = cfg.objective == "lm"
         return TransformerSpec(
             input_size=cfg.input_size,
             num_classes=cfg.num_classes,
-            seq_len=cfg.seq_len,
+            objective=cfg.objective,
+            vocab_size=cfg.vocab_size,
+            # lm tokenizes every input scalar and is causal by
+            # definition
+            seq_len=cfg.input_size if lm else cfg.seq_len,
             d_model=cfg.d_model,
             n_heads=cfg.n_heads,
             num_blocks=cfg.num_blocks,
@@ -64,7 +69,7 @@ def make_spec(cfg: Config):
                                        # apply to this family
             attention="flash" if cfg.pallas else cfg.attention,
             sp_impl=cfg.sp_impl,
-            causal=cfg.causal,
+            causal=True if lm else cfg.causal,
             num_experts=cfg.num_experts,
             moe_topk=cfg.moe_topk,
             moe_dispatch=cfg.moe_dispatch,
@@ -164,6 +169,14 @@ def run(cfg: Config) -> Dict[str, Any]:
                 or cfg.sequence_parallel > 1 or cfg.expert_parallel > 1):
             raise ValueError("--pipeline_parallel composes with data "
                              "and tensor parallelism only")
+    if cfg.objective == "lm":
+        if cfg.model != "transformer":
+            raise ValueError("--objective=lm requires --model=transformer")
+        if cfg.pipeline_parallel > 1:
+            raise ValueError("--objective=lm does not run on the "
+                             "pipeline path (its head is per-position)")
+        if cfg.vocab_size < 2:
+            raise ValueError(f"vocab_size={cfg.vocab_size} must be >= 2")
     if cfg.grad_accum < 1:
         raise ValueError(f"grad_accum={cfg.grad_accum} must be >= 1")
     if cfg.grad_accum > 1 and (cfg.fsdp or cfg.sync_period > 1):
@@ -662,7 +675,9 @@ def run(cfg: Config) -> Dict[str, Any]:
                          else batch_shards)
             test_acc = _eval_accuracy(
                 eval_step, params, dataset.test.images, dataset.test.labels,
-                batch_shards, chunk=max(cfg.eval_batch_size, eval_unit),
+                batch_shards,
+                chunk=max(step_lib.eval_chunk_cap(spec, cfg.eval_batch_size),
+                          eval_unit),
                 unit=eval_unit,
             )
     total_time = time.time() - begin_time

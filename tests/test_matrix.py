@@ -56,6 +56,8 @@ CELLS += [
     ("sched_accum", {"optimizer": "adam", "learning_rate": 0.001,
                      "lr_schedule": "cosine", "warmup_steps": 3,
                      "grad_accum": 2}),
+    ("tfm_lm", {**_TFM, "objective": "lm", "vocab_size": 16,
+                "optimizer": "adam", "learning_rate": 0.001}),
 ]
 
 
