@@ -403,8 +403,10 @@ def _build_fast_eval(cfg, mesh, spec: mlp.MLPSpec, images: np.ndarray, labels: n
     pp = mesh_lib.param_pspecs(spec, mp)
     n = images.shape[0]
     # baseline = the whole set in ONE batch (the r2 behavior); the
-    # memory cap splits it only when the score tensor would not fit
-    chunk = max(dp, (min(eval_chunk_cap(spec, n), n) // dp) * dp)
+    # memory cap splits it only when the score tensor would not fit.
+    # Round UP to the dp multiple: flooring would leave chunk just
+    # under n when dp doesn't divide it, nearly doubling n_pad
+    chunk = -(-min(eval_chunk_cap(spec, n), n) // dp) * dp
     n_pad = ((n + chunk - 1) // chunk) * chunk
     n_chunks = n_pad // chunk
     packed = _pack_images(images)

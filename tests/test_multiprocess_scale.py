@@ -193,3 +193,19 @@ def test_sequence_parallel_across_processes():
         assert "Test-Accuracy:" in chief and "done" in chief, \
             (impl, chief[-2000:])
         assert "Cost: nan" not in chief.lower(), (impl, chief[-2000:])
+
+
+def test_three_axis_mesh_across_processes():
+    """A 3-axis ('data','seq','model') 1x2x2 mesh split over 2
+    processes x 2 devices: the ring's ppermute hops AND the Megatron
+    row-split psums both cross the OS-process boundary in one step."""
+    outs = run_all(2, 2, [
+        "--model=transformer", "--optimizer=adam", "--learning_rate=0.003",
+        "--sequence_parallel=2", "--model_parallel=2", "--data_parallel=1",
+        "--n_heads=4",
+        "--training_epochs=1", "--batch_size=16", "--frequency=2",
+        "--synthetic_train_size=128", "--synthetic_test_size=64",
+    ])
+    chief = outs[0]
+    assert "Test-Accuracy:" in chief and "done" in chief, chief[-2000:]
+    assert "Cost: nan" not in chief.lower(), chief[-2000:]
