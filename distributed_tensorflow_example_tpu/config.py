@@ -72,6 +72,9 @@ class Config:
     num_blocks: int = 2
     d_ff: int = 256
     attention: str = "dense"        # dense | flash; --pallas also selects flash
+    dropout_rate: float = 0.0       # transformer training-only dropout
+                                    # (embedding + per-block residual
+                                    # branches; eval never drops)
     causal: bool = False            # causal (LM-style) attention mask
     num_experts: int = 0            # >0: MoE FFN (Switch/GShard style)
     moe_topk: int = 1               # experts per token (1 = Switch,
@@ -94,6 +97,8 @@ class Config:
 
     # ---- loss (example.py:92-96) ----
     naive_ce: bool = False          # reproduce the reference's unstable log(softmax) CE
+    label_smoothing: float = 0.0    # smooth one-hot targets to
+                                    # y*(1-eps) + eps/K (classify only)
 
     # ---- optimizer (example.py:98-111; BASELINE config 4) ----
     optimizer: str = "sgd"          # sgd | momentum | adam
@@ -103,6 +108,11 @@ class Config:
     schedule_steps: int = 0         # decay horizon; 0 = derived from
                                     # training_epochs x steps-per-epoch
     lr_min_factor: float = 0.0      # decay floor as a fraction of lr
+    weight_decay: float = 0.0       # decoupled (AdamW-style) decay:
+                                    # lr * wd * p subtracted outside
+                                    # the gradient step
+    grad_clip: float = 0.0          # > 0: clip gradients to this
+                                    # global norm before the update
     grad_accum: int = 1             # accumulate N microbatch gradients
                                     # per optimizer step (lax.scan inside
                                     # the compiled step)
@@ -230,6 +240,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--d_ff", type=int, default=d.d_ff)
     p.add_argument("--attention", type=str, default=d.attention,
                    choices=["dense", "flash"])
+    p.add_argument("--dropout_rate", type=float, default=d.dropout_rate,
+                   help="transformer training-only dropout (embedding "
+                        "+ per-block residual branches)")
     p.add_argument("--causal", action="store_true")
     p.add_argument("--num_experts", type=int, default=d.num_experts,
                    help="transformer FFN becomes a top-1 MoE with this "
@@ -259,6 +272,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--param_dtype", type=str, default=d.param_dtype)
     p.add_argument("--compute_dtype", type=str, default=d.compute_dtype)
     p.add_argument("--naive_ce", action="store_true")
+    p.add_argument("--label_smoothing", type=float,
+                   default=d.label_smoothing)
+    p.add_argument("--weight_decay", type=float, default=d.weight_decay,
+                   help="decoupled (AdamW-style) weight decay")
+    p.add_argument("--grad_clip", type=float, default=d.grad_clip,
+                   help="global-norm gradient clipping (0 = off)")
     p.add_argument("--optimizer", type=str, default=d.optimizer,
                    choices=["sgd", "momentum", "adam"])
     p.add_argument("--lr_schedule", type=str, default=d.lr_schedule,

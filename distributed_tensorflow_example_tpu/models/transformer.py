@@ -79,6 +79,10 @@ class TransformerSpec:
                                    # loss E*sum_e(f_e*P_e) per MoE
                                    # block to the training objective
                                    # (reported cost stays plain CE)
+    dropout_rate: float = 0.0      # training-only dropout on the
+                                   # embedded input and each block's
+                                   # attention/FFN outputs (inverted
+                                   # scaling; eval never drops)
     moe_dispatch: str = "dense"    # dense (every expert on every token,
                                    # one-hot select — exact) | alltoall
                                    # (capacity-limited token dispatch,
@@ -478,6 +482,18 @@ def _mm(params_or_bp, a, w_name, b_name, cdt):
     return acc + params_or_bp[b_name].astype(jnp.float32)
 
 
+def _dropout(h, spec: TransformerSpec, rng, salt: int):
+    """Inverted dropout: keep-mask / keep_prob, only when a training
+    rng is provided (eval passes None and never drops). ``salt``
+    decorrelates the sites within one forward."""
+    if rng is None or not spec.dropout_rate:
+        return h
+    keep = 1.0 - spec.dropout_rate
+    mask = jax.random.bernoulli(jax.random.fold_in(rng, salt), keep,
+                                h.shape)
+    return jnp.where(mask, h / keep, 0.0).astype(h.dtype)
+
+
 def _row_psum(x, w, b, cdt, model_axis):
     """Row-split projection: local [.., k_local] @ [k_local, n], psum'd
     over ``model_axis`` (the partial-sum combine of Megatron's row
@@ -492,7 +508,8 @@ def _block_forward(spec: TransformerSpec, bp: Params, h, act, cdt,
                    seq_axis: str | None = None,
                    expert_axis: str | None = None, moe_block: int = 0,
                    full_params: Params | None = None,
-                   model_axis: str | None = None, aux_axes=()):
+                   model_axis: str | None = None, aux_axes=(),
+                   dropout_rng=None):
     """One encoder block on ``h`` [B, S(local), D]. ``bp`` holds the
     block's leaves under their UNPREFIXED names (ln1_g, Wqkv, ...) so
     the same body serves the regular forward (dict views of L{i}_*)
@@ -517,8 +534,10 @@ def _block_forward(spec: TransformerSpec, bp: Params, h, act, cdt,
     shape = (b, s, local_heads, spec.d_head)
     att = _attend(spec, q.reshape(shape), k.reshape(shape),
                   v.reshape(shape), seq_axis)
-    h = h + _row_psum(att.reshape(b, s, -1).astype(cdt), bp["Wo"],
-                      bp["bo"], cdt, model_axis)
+    h = h + _dropout(
+        _row_psum(att.reshape(b, s, -1).astype(cdt), bp["Wo"],
+                  bp["bo"], cdt, model_axis),
+        spec, dropout_rng, 2 * moe_block)
     a = _layer_norm(h, bp["ln2_g"], bp["ln2_b"])
     aux = jnp.float32(0.0)
     if spec.num_experts:
@@ -532,10 +551,12 @@ def _block_forward(spec: TransformerSpec, bp: Params, h, act, cdt,
                 f"'dense' or 'alltoall'")
         ffn, aux = moe(spec, full_params, moe_block, a, act, cdt,
                        expert_axis, aux_axes)
-        h = h + ffn
+        h = h + _dropout(ffn, spec, dropout_rng, 2 * moe_block + 1)
     else:
         a = act(_mm(bp, a, "W1", "b1", cdt)).astype(cdt)
-        h = h + _row_psum(a, bp["W2"], bp["b2"], cdt, model_axis)
+        h = h + _dropout(
+            _row_psum(a, bp["W2"], bp["b2"], cdt, model_axis),
+            spec, dropout_rng, 2 * moe_block + 1)
     return h, aux
 
 
@@ -543,7 +564,8 @@ def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray,
           seq_axis: str | None = None,
           expert_axis: str | None = None,
           model_axis: str | None = None,
-          with_aux: bool = False, aux_axes=()) -> jnp.ndarray:
+          with_aux: bool = False, aux_axes=(),
+          dropout_rng=None) -> jnp.ndarray:
     """Forward to logits. ``x``: [B, input_size] (viewed as seq_len
     tokens) or already [B, S, F].
 
@@ -582,6 +604,7 @@ def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray,
         h = x.reshape(b, s, f).astype(cdt)
         h = _mm(params, h, "W_in", "b_in", cdt) + pos[None]
     act = _ACTIVATIONS[spec.activation]
+    h = _dropout(h, spec, dropout_rng, 0x9999)   # embedding dropout
     aux = jnp.float32(0.0)
     for i in range(spec.num_blocks):
         bp = {k[len(f"L{i}_"):]: v for k, v in params.items()
@@ -590,7 +613,8 @@ def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray,
                                   expert_axis, moe_block=i,
                                   full_params=params,
                                   model_axis=model_axis,
-                                  aux_axes=aux_axes)
+                                  aux_axes=aux_axes,
+                                  dropout_rng=dropout_rng)
         aux = aux + aux_i
     h = _layer_norm(h, params["lnf_g"], params["lnf_b"])
     if spec.objective == "lm":

@@ -35,8 +35,18 @@ def naive_cross_entropy(logits: jnp.ndarray, labels_onehot: jnp.ndarray) -> jnp.
 
 
 def cross_entropy(
-    logits: jnp.ndarray, labels_onehot: jnp.ndarray, naive: bool = False
+    logits: jnp.ndarray, labels_onehot: jnp.ndarray, naive: bool = False,
+    label_smoothing: float = 0.0,
 ) -> jnp.ndarray:
+    """CE with optional label smoothing: targets become
+    ``y*(1-eps) + eps/K`` (uniform mass on the off classes) — the
+    standard regularizer the reference era predates. Smoothing
+    composes with either arithmetic form (it only transforms the
+    targets)."""
+    if label_smoothing:
+        k = labels_onehot.shape[-1]
+        labels_onehot = (labels_onehot * (1.0 - label_smoothing)
+                         + label_smoothing / k)
     if naive:
         return naive_cross_entropy(logits, labels_onehot)
     return stable_cross_entropy(logits, labels_onehot)

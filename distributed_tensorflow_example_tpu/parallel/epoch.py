@@ -233,7 +233,8 @@ def _build_run_to_completion(
     styles = mesh_lib.layer_styles(spec, mp)
     sspecs = mesh_lib.state_pspecs(spec, optimizer, mp)
     step_body = make_sync_step_body(cfg, spec, styles, dp, optimizer,
-                                    model_axis=mesh_lib.tp_axis(spec, mp))
+                                    model_axis=mesh_lib.tp_axis(spec, mp),
+                                    param_pspecs=sspecs.params)
     return _build_scan_runner(mesh, sspecs, step_body, steps_per_epoch, num_epochs)
 
 

@@ -137,6 +137,7 @@ def make_fsdp_step_body(
             return _loss_and_acc(
                 spec, p, x, y, styles, cfg.naive_ce, cfg.pallas, cfg.remat,
                 aux_axes=(DATA_AXIS,),
+                label_smoothing=cfg.label_smoothing,
             )
 
         (_total, (cost, acc)), grads_full = jax.value_and_grad(
@@ -147,6 +148,13 @@ def make_fsdp_step_body(
         }
         if cfg.grad_reduce == "mean" and dp > 1:
             grads = jax.tree.map(lambda g: g / dp, grads)
+        if cfg.grad_clip > 0:
+            # each shard holds a 1/dp chunk of every (reduced) grad:
+            # psum the square-sums for the global norm
+            from ..train.optim import clip_by_global_norm
+
+            grads, _ = clip_by_global_norm(grads, cfg.grad_clip,
+                                           (DATA_AXIS,))
         local_p = jax.tree.map(_unwrap, state.params)
         local_o = jax.tree.map(_unwrap, state.opt_state)
         new_p, new_o = optimizer.update(grads, local_o, local_p)

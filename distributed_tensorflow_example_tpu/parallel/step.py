@@ -57,7 +57,8 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
                   expert_axis: str | None = None,
                   pipeline: tuple | None = None,
                   model_axis: str | None = None,
-                  with_aux: bool = False, aux_axes: tuple = ()):
+                  with_aux: bool = False, aux_axes: tuple = (),
+                  dropout_rng=None):
     """Per-shard forward to (replicated) logits; TP-aware (example.py:87-89).
 
     Model-family dispatch: TransformerSpec routes to the transformer
@@ -83,7 +84,8 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
         return transformer.apply(spec, params, x, seq_axis=seq_axis,
                                  expert_axis=expert_axis,
                                  model_axis=model_axis,
-                                 with_aux=with_aux, aux_axes=aux_axes)
+                                 with_aux=with_aux, aux_axes=aux_axes,
+                                 dropout_rng=dropout_rng)
     if use_pallas and all(s == "rep" for s in styles):
         from ..ops import pallas_fused
 
@@ -131,7 +133,8 @@ def _lm_stats(spec, logits, tokens, seq_axis):
 
 def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas, remat=False,
                   seq_axis=None, expert_axis=None, pipeline=None,
-                  model_axis=None, aux_axes=()):
+                  model_axis=None, aux_axes=(), label_smoothing=0.0,
+                  dropout_rng=None):
     """-> (objective, (reported_cost, accuracy)): the objective is what
     gradients flow from (CE plus, for a MoE spec with
     ``aux_loss_weight``, the weighted load-balance loss); the reported
@@ -147,10 +150,12 @@ def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas, remat=False,
             return forward_local(spec, p, xx, styles, use_pallas,
                                  seq_axis, expert_axis, pipeline,
                                  model_axis, with_aux=True,
-                                 aux_axes=aux_axes)
+                                 aux_axes=aux_axes,
+                                 dropout_rng=dropout_rng)
         return forward_local(spec, p, xx, styles, use_pallas,
                              seq_axis, expert_axis, pipeline,
-                             model_axis), jnp.float32(0.0)
+                             model_axis,
+                             dropout_rng=dropout_rng), jnp.float32(0.0)
 
     if remat:
         # jax.checkpoint: recompute activations in the backward pass
@@ -168,9 +173,42 @@ def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas, remat=False,
         cost = jnp.sum(nll) / jnp.sum(count)
         acc = jnp.sum(correct) / jnp.sum(count)
         return cost + aux_w * aux, (cost, acc)
-    cost = losses.cross_entropy(logits, y, naive=naive)
+    cost = losses.cross_entropy(logits, y, naive=naive,
+                                label_smoothing=label_smoothing)
     acc = metrics.accuracy(logits, y)
     return cost + aux_w * aux, (cost, acc)
+
+
+def _clip_sharded(grads, param_pspecs, max_norm: float):
+    """Global-norm clip that is exact under PARAMETER sharding: a
+    leaf's square-sum is psum'd over exactly the mesh axes its
+    PartitionSpec mentions (its shards partition the full leaf), while
+    replicated leaves contribute once — so TP/PP/EP shards all compute
+    the SAME global norm and replicated params cannot drift apart
+    under a binding clip. Leaves are grouped by their axis set to
+    batch the psums."""
+    g_leaves = jax.tree_util.tree_leaves(grads)
+    s_leaves = jax.tree_util.tree_leaves(
+        param_pspecs, is_leaf=lambda x: isinstance(x, P))
+    groups: dict = {}
+    for g, sp in zip(g_leaves, s_leaves):
+        axes = []
+        for part in (sp or ()):
+            if part is None:
+                continue
+            axes.extend(part if isinstance(part, tuple) else (part,))
+        key = tuple(sorted(set(axes)))
+        groups.setdefault(key, []).append(
+            jnp.sum(jnp.square(g.astype(jnp.float32))))
+    sq = jnp.float32(0.0)
+    for axes, sqs in groups.items():
+        part_sum = sum(sqs)
+        if axes:
+            part_sum = jax.lax.psum(part_sum, axes)
+        sq = sq + part_sum
+    norm = jnp.sqrt(sq)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
 
 
 def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
@@ -178,7 +216,8 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
                         expert_axis: str | None = None,
                         pipeline: tuple | None = None,
                         model_axis: str | None = None,
-                        batch_axes: tuple = (DATA_AXIS,)) -> Callable:
+                        batch_axes: tuple = (DATA_AXIS,),
+                        param_pspecs=None) -> Callable:
     """The per-shard synchronous step body (state, x, y) -> (state, cost,
     acc) — shared by the host-fed step (build_train_step) and the
     device-resident scan paths (parallel/epoch.py) so both train with
@@ -190,15 +229,30 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
     # token-sharding axes for the MoE balance loss: the batch axes
     # plus the sequence axis when the token dim itself is sharded
     aux_axes = tuple(batch_axes) + ((seq_axis,) if seq_axis else ())
+    dropping = getattr(spec, "dropout_rate", 0.0) > 0
 
-    def grad_of(params, x, y):
+    def grad_of(params, x, y, rng=None):
         def loss_fn(p):
             return _loss_and_acc(
                 spec, p, x, y, styles, cfg.naive_ce, cfg.pallas, cfg.remat,
                 seq_axis, expert_axis, pipeline, model_axis, aux_axes,
+                cfg.label_smoothing, rng,
             )
 
         return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+    def step_rng(state):
+        """Deterministic per-step dropout rng: seed x step, folded by
+        each token-sharding axis index so every batch/token shard draws
+        its own masks while TP shards (replicated activations) share
+        theirs. Resume-stable: step count determines the stream."""
+        if not dropping:
+            return None
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(cfg.seed ^ 0xD0C0), state.step)
+        for ax in aux_axes:
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
+        return rng
 
     def body(state: TrainState, x, y):
         n = cfg.grad_accum
@@ -212,27 +266,43 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
                     f"grad_accum={n} microbatches")
             xs = x.reshape(n, x.shape[0] // n, *x.shape[1:])
             ys = y.reshape(n, y.shape[0] // n, *y.shape[1:])
+            rng0 = step_rng(state)
 
-            def accum(carry, xy):
+            def mb_rng(i):
+                # distinct dropout masks per microbatch
+                return (jax.random.fold_in(rng0, i) if dropping else None)
+
+            def accum(carry, xy_i):
                 g_acc, c_acc, a_acc = carry
-                (_t, (c, a)), g = grad_of(state.params, *xy)
+                xc, yc, i = xy_i
+                (_t, (c, a)), g = grad_of(state.params, xc, yc, mb_rng(i))
                 return (jax.tree.map(jnp.add, g_acc, g),
                         c_acc + c, a_acc + a), None
 
             # seed the carry with microbatch 0 (a plain zero init would
             # be device-invariant while the accumulated values vary
             # over the batch axes — scan requires matching types)
-            (_t0, (c0, a0)), g0 = grad_of(state.params, xs[0], ys[0])
+            (_t0, (c0, a0)), g0 = grad_of(state.params, xs[0], ys[0],
+                                          mb_rng(0))
             (g_sum, c_sum, a_sum), _ = jax.lax.scan(
-                accum, (g0, c0, a0), (xs[1:], ys[1:]))
+                accum, (g0, c0, a0),
+                (xs[1:], ys[1:], jnp.arange(1, n)))
             grads = jax.tree.map(lambda g: g / n, g_sum)
             cost, acc = c_sum / n, a_sum / n
         else:
-            (_total, (cost, acc)), grads = grad_of(state.params, x, y)
+            (_total, (cost, acc)), grads = grad_of(state.params, x, y,
+                                                   step_rng(state))
         # shard_map's transpose has already psum'd grads over the batch
         # axes (params are batch-unvarying); rescale for mean semantics.
         if cfg.grad_reduce == "mean" and dp > 1:
             grads = jax.tree.map(lambda g: g / dp, grads)
+        if cfg.grad_clip > 0:
+            if param_pspecs is not None:
+                grads = _clip_sharded(grads, param_pspecs, cfg.grad_clip)
+            else:
+                from ..train.optim import clip_by_global_norm
+
+                grads, _ = clip_by_global_norm(grads, cfg.grad_clip)
         new_params, new_opt = optimizer.update(grads, state.opt_state, state.params)
         cost = jax.lax.pmean(cost, batch_axes)
         acc = jax.lax.pmean(acc, batch_axes)
@@ -341,7 +411,8 @@ def build_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer) -> Callable:
     batch_axes, shards, x_spec, y_spec = batch_layout(mesh, spec)
     shard_step = make_sync_step_body(cfg, spec, styles, shards, optimizer,
                                      seq_axis, expert_axis, pipeline,
-                                     model_axis, batch_axes)
+                                     model_axis, batch_axes,
+                                     param_pspecs=sspecs.params)
     fn = jax.shard_map(
         shard_step,
         mesh=mesh,
@@ -426,11 +497,16 @@ def build_local_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer, state_templa
 
         def loss_fn(p):
             return _loss_and_acc(
-                spec, p, x, y, styles, cfg.naive_ce, cfg.pallas, cfg.remat
+                spec, p, x, y, styles, cfg.naive_ce, cfg.pallas, cfg.remat,
+                label_smoothing=cfg.label_smoothing,
             )
 
         (_total, (cost, acc)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(local_p)
+        if cfg.grad_clip > 0:
+            from ..train.optim import clip_by_global_norm
+
+            grads, _ = clip_by_global_norm(grads, cfg.grad_clip)
         new_p, new_o = optimizer.update(grads, local_o, local_p)
         cost = jax.lax.pmean(cost, DATA_AXIS)
         acc = jax.lax.pmean(acc, DATA_AXIS)

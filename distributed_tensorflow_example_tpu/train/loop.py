@@ -68,6 +68,7 @@ def make_spec(cfg: Config):
                         else "gelu"),  # the reference default doesn't
                                        # apply to this family
             attention="flash" if cfg.pallas else cfg.attention,
+            dropout_rate=cfg.dropout_rate,
             sp_impl=cfg.sp_impl,
             causal=True if lm else cfg.causal,
             num_experts=cfg.num_experts,
@@ -177,6 +178,25 @@ def run(cfg: Config) -> Dict[str, Any]:
                              "pipeline path (its head is per-position)")
         if cfg.vocab_size < 2:
             raise ValueError(f"vocab_size={cfg.vocab_size} must be >= 2")
+    if cfg.dropout_rate:
+        if not 0.0 <= cfg.dropout_rate < 1.0:
+            raise ValueError(
+                f"dropout_rate={cfg.dropout_rate} must be in [0, 1)")
+        if cfg.model != "transformer":
+            raise ValueError(
+                "--dropout_rate applies to --model=transformer only")
+        if cfg.pipeline_parallel > 1 or cfg.fsdp or cfg.sync_period > 1:
+            raise ValueError("--dropout_rate runs on the synchronous "
+                             "non-pipeline step (no --fsdp, "
+                             "sync_period=1, pipeline_parallel=1)")
+    if not 0.0 <= cfg.label_smoothing < 1.0:
+        raise ValueError(
+            f"label_smoothing={cfg.label_smoothing} must be in [0, 1)")
+    if cfg.label_smoothing and cfg.objective == "lm":
+        raise ValueError("--label_smoothing applies to the classify "
+                         "objective only")
+    if cfg.weight_decay < 0 or cfg.grad_clip < 0:
+        raise ValueError("weight_decay and grad_clip must be >= 0")
     if cfg.grad_accum < 1:
         raise ValueError(f"grad_accum={cfg.grad_accum} must be >= 1")
     if cfg.grad_accum > 1 and (cfg.fsdp or cfg.sync_period > 1):

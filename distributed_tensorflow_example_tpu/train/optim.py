@@ -43,7 +43,33 @@ class Optimizer:
     state_pspecs: Callable[[PyTree], PyTree]
 
 
-def sgd(learning_rate: float) -> Optimizer:
+def _decay(params, new_params, learning_rate, weight_decay):
+    """Decoupled (AdamW-style) weight decay: subtract ``lr * wd * p``
+    from the updated params — applied OUTSIDE the gradient-derived
+    step, so adaptive scaling never touches it. A no-op at wd=0."""
+    if not weight_decay:
+        return new_params
+    return jax.tree.map(
+        lambda p, q: q - learning_rate * weight_decay * p, params,
+        new_params)
+
+
+def clip_by_global_norm(grads, max_norm: float, psum_axes=()):
+    """(clipped_grads, global_norm): scale the whole gradient pytree by
+    ``min(1, max_norm / ||g||)`` — the standard global-norm clip.
+    ``psum_axes``: mesh axes the leaves are uniformly sharded over
+    (e.g. FSDP's data axis) — the local square-sum is psum'd across
+    them before the sqrt so every shard applies the same scale."""
+    sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+             for g in jax.tree.leaves(grads))
+    if psum_axes:
+        sq = jax.lax.psum(sq, psum_axes)
+    norm = jnp.sqrt(sq)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads), norm
+
+
+def sgd(learning_rate: float, weight_decay: float = 0.0) -> Optimizer:
     """Plain SGD — ``GradientDescentOptimizer`` (example.py:101)."""
 
     def init(params):
@@ -51,12 +77,14 @@ def sgd(learning_rate: float) -> Optimizer:
 
     def update(grads, opt_state, params):
         new_params = jax.tree.map(lambda p, g: p - learning_rate * g, params, grads)
-        return new_params, opt_state
+        return _decay(params, new_params, learning_rate, weight_decay), \
+            opt_state
 
     return Optimizer("sgd", init, update, lambda pspecs: ())
 
 
-def momentum(learning_rate: float, beta: float = 0.9) -> Optimizer:
+def momentum(learning_rate: float, beta: float = 0.9,
+             weight_decay: float = 0.0) -> Optimizer:
     """Heavy-ball momentum (``tf.train.MomentumOptimizer`` analog)."""
 
     def init(params):
@@ -65,7 +93,8 @@ def momentum(learning_rate: float, beta: float = 0.9) -> Optimizer:
     def update(grads, opt_state, params):
         m = jax.tree.map(lambda m_, g: beta * m_ + g, opt_state["m"], grads)
         new_params = jax.tree.map(lambda p, m_: p - learning_rate * m_, params, m)
-        return new_params, {"m": m}
+        return _decay(params, new_params, learning_rate, weight_decay), \
+            {"m": m}
 
     return Optimizer("momentum", init, update, lambda pspecs: {"m": pspecs})
 
@@ -75,12 +104,15 @@ def adam(
     b1: float = 0.9,
     b2: float = 0.999,
     eps: float = 1e-8,
+    weight_decay: float = 0.0,
 ) -> Optimizer:
     """Adam — ``tf.train.AdamOptimizer`` (BASELINE.json config 4).
 
     TF's AdamOptimizer uses the efficient formulation
     ``lr_t = lr * sqrt(1-b2^t) / (1-b1^t)`` with eps outside the
-    bias correction; replicated here for parity.
+    bias correction; replicated here for parity. ``weight_decay`` is
+    decoupled (AdamW): ``lr * wd * p`` subtracted outside the
+    adaptive step.
     """
 
     def init(params):
@@ -99,7 +131,8 @@ def adam(
         new_params = jax.tree.map(
             lambda p, m, v: p - lr_t * m / (jnp.sqrt(v) + eps), params, mu, nu
         )
-        return new_params, {"count": count, "mu": mu, "nu": nu}
+        return _decay(params, new_params, learning_rate, weight_decay), \
+            {"count": count, "mu": mu, "nu": nu}
 
     def state_pspecs(pspecs):
         from jax.sharding import PartitionSpec
@@ -179,12 +212,14 @@ def make_optimizer(cfg, total_steps: int = 0) -> Optimizer:
     """Build the configured optimizer; with a non-constant
     ``--lr_schedule`` the decay horizon is ``--schedule_steps`` or, if
     0, ``total_steps`` (the driver passes epochs x steps-per-epoch)."""
+    wd = getattr(cfg, "weight_decay", 0.0)
     if cfg.optimizer == "sgd":
-        base = sgd(cfg.learning_rate)
+        base = sgd(cfg.learning_rate, wd)
     elif cfg.optimizer == "momentum":
-        base = momentum(cfg.learning_rate, cfg.momentum)
+        base = momentum(cfg.learning_rate, cfg.momentum, wd)
     elif cfg.optimizer == "adam":
-        base = adam(cfg.learning_rate, cfg.adam_b1, cfg.adam_b2, cfg.adam_eps)
+        base = adam(cfg.learning_rate, cfg.adam_b1, cfg.adam_b2,
+                    cfg.adam_eps, wd)
     else:
         raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
     if cfg.lr_schedule == "constant" and not cfg.warmup_steps:

@@ -159,3 +159,158 @@ def test_cli_schedule_flags():
     ])
     assert cfg.lr_schedule == "cosine" and cfg.warmup_steps == 100
     assert cfg.schedule_steps == 1000 and cfg.grad_accum == 4
+
+
+def test_weight_decay_decoupled():
+    """AdamW semantics: the decayed step equals the undecayed step
+    minus lr*wd*p — decay bypasses the adaptive scaling entirely."""
+    for name in ("sgd", "momentum", "adam"):
+        base = optim.make_optimizer(Config(optimizer=name, learning_rate=0.1))
+        wd = optim.make_optimizer(
+            Config(optimizer=name, learning_rate=0.1, weight_decay=0.01))
+        from distributed_tensorflow_example_tpu.train.state import (
+            create_train_state)
+
+        st = create_train_state(jax.random.PRNGKey(0), SPEC, base)
+        g = jax.tree.map(lambda p: jnp.ones_like(p) * 0.01, st.params)
+        p_base, _ = base.update(g, st.opt_state, st.params)
+        p_wd, _ = wd.update(g, st.opt_state, st.params)
+        for k in p_base:
+            np.testing.assert_allclose(
+                np.asarray(p_wd[k]),
+                np.asarray(p_base[k]) - 0.1 * 0.01 * np.asarray(st.params[k]),
+                rtol=1e-6, atol=1e-8, err_msg=f"{name}/{k}")
+
+
+def test_clip_by_global_norm():
+    g = {"a": jnp.full((3,), 3.0), "b": jnp.full((4,), 4.0)}
+    norm = float(np.sqrt(3 * 9 + 4 * 16))  # ~9.54
+    clipped, got_norm = optim.clip_by_global_norm(g, 1.0)
+    np.testing.assert_allclose(float(got_norm), norm, rtol=1e-6)
+    total = np.sqrt(sum(float(jnp.sum(v ** 2)) for v in clipped.values()))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+    # under the threshold: untouched
+    same, _ = optim.clip_by_global_norm(g, 100.0)
+    for k in g:
+        np.testing.assert_array_equal(np.asarray(same[k]), np.asarray(g[k]))
+
+
+def test_grad_clip_step_matches_manual(devices8):
+    """A clipped DP4 step == unclipped step whose grads were manually
+    rescaled (clip happens after the mean reduction, so the norm is
+    the global-batch gradient's)."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.state import (
+        create_train_state)
+
+    rng = np.random.RandomState(7)
+    x = rng.rand(16, 12).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 16)]
+    mesh = mesh_lib.build_mesh(4, 1, devices=devices8[:4])
+
+    def one(clip):
+        cfg = Config(learning_rate=1.0, grad_clip=clip)
+        opt = optim.make_optimizer(cfg)
+        state = create_train_state(jax.random.PRNGKey(1), SPEC, opt)
+        state = mesh_lib.place_state(
+            state, mesh, mesh_lib.state_pspecs(SPEC, opt, 1))
+        step = step_lib.build_train_step(cfg, mesh, SPEC, opt)
+        new_state, _, _ = step(state, x, y)
+        return jax.tree.map(np.asarray, new_state.params)
+
+    p_clip = one(1e-3)     # tiny threshold: definitely binds
+    p_free = one(0.0)
+    # the clipped step moved, but far less than the unclipped one
+    from distributed_tensorflow_example_tpu.train.state import (
+        create_train_state)
+    st0 = jax.tree.map(
+        np.asarray,
+        create_train_state(jax.random.PRNGKey(1), SPEC,
+                           optim.make_optimizer(Config())).params)
+    d_clip = np.sqrt(sum(np.sum((p_clip[k] - st0[k]) ** 2) for k in st0))
+    d_free = np.sqrt(sum(np.sum((p_free[k] - st0[k]) ** 2) for k in st0))
+    np.testing.assert_allclose(d_clip, 1e-3, rtol=1e-3)  # lr=1: step=norm
+    assert d_free > 10 * d_clip
+
+
+def test_label_smoothing_loss_value():
+    from distributed_tensorflow_example_tpu.ops import losses
+
+    rng = np.random.RandomState(3)
+    logits = jnp.asarray(rng.randn(8, 5).astype(np.float32))
+    y = jnp.asarray(np.eye(5, dtype=np.float32)[rng.randint(0, 5, 8)])
+    eps = 0.1
+    got = float(losses.cross_entropy(logits, y, label_smoothing=eps))
+    smooth = np.asarray(y) * (1 - eps) + eps / 5
+    logp = np.asarray(jax.nn.log_softmax(logits, -1))
+    want = float(-np.mean(np.sum(smooth * logp, axis=1)))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # eps=0 is exactly the plain CE
+    np.testing.assert_allclose(
+        float(losses.cross_entropy(logits, y)),
+        float(losses.cross_entropy(logits, y, label_smoothing=0.0)))
+
+
+def test_regularizer_driver_end_to_end(tmp_path):
+    """Full driver with all three knobs at once."""
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    res = run(Config(
+        training_epochs=1, batch_size=64, hidden_sizes=(32,),
+        activation="relu", optimizer="adam", learning_rate=0.002,
+        weight_decay=0.01, grad_clip=1.0, label_smoothing=0.1,
+        synthetic_train_size=512, synthetic_test_size=128,
+        logs_path=str(tmp_path), summaries=False, frequency=8,
+        compilation_cache="",
+    ))
+    assert np.isfinite(res["final_cost"]), res
+
+
+@pytest.mark.parametrize("flavor", ["tp", "ep_sparse"])
+def test_grad_clip_sharded_params_matches_single_device(devices8, flavor):
+    """A binding clip under parameter sharding must reproduce the
+    single-device step: the norm is assembled by psum-ing each sharded
+    leaf's square-sum over exactly the axes its PartitionSpec mentions
+    (per-shard norms would diverge and drift replicated leaves)."""
+    from distributed_tensorflow_example_tpu.models import (
+        transformer as tfm_lib)
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.state import (
+        create_train_state)
+
+    kw = dict(input_size=784, num_classes=10, seq_len=28, d_model=32,
+              n_heads=4, num_blocks=2, d_ff=64)
+    ckw = dict(model="transformer", learning_rate=0.05, grad_clip=1e-3,
+               n_heads=4)
+    if flavor == "ep_sparse":
+        kw.update(num_experts=4, moe_dispatch="alltoall",
+                  capacity_factor=4.0)
+        ckw.update(num_experts=4, moe_dispatch="alltoall",
+                   capacity_factor=4.0)
+    spec = tfm_lib.TransformerSpec(**kw)
+    cfg = Config(**ckw)
+    opt = optim.make_optimizer(cfg)
+    rng = np.random.RandomState(47)
+    x = rng.rand(8, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]
+
+    def one(mesh, mp, ea):
+        state = create_train_state(jax.random.PRNGKey(1), spec, opt)
+        state = mesh_lib.place_state(
+            state, mesh, mesh_lib.state_pspecs(spec, opt, mp, ea))
+        step = step_lib.build_train_step(cfg, mesh, spec, opt)
+        new_state, cost, _ = step(state, x, y)
+        return jax.tree.map(np.asarray, new_state.params), float(cost)
+
+    p1, c1 = one(mesh_lib.build_mesh(1, 1, devices=devices8[:1]), 1, None)
+    if flavor == "tp":
+        pn, cn = one(mesh_lib.build_mesh(2, 4, devices=devices8), 4, None)
+    else:
+        pn, cn = one(mesh_lib.build_expert_mesh(2, 2, devices=devices8[:4]),
+                     1, mesh_lib.EXPERT_AXIS)
+    assert abs(c1 - cn) < 1e-5
+    for k in p1:
+        np.testing.assert_allclose(pn[k], p1[k], rtol=3e-5, atol=3e-7,
+                                   err_msg=k)

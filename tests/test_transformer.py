@@ -672,6 +672,73 @@ def test_lm_driver_learns(devices8, tmp_path):
     assert np.isfinite(res["final_cost"])
 
 
+def test_dropout_train_vs_eval(devices8):
+    """Dropout drops in training only: a rate-0 step equals the
+    baseline exactly, a rate>0 step is deterministic per (seed, step)
+    but differs from rate-0, and the EVAL forward ignores the rate
+    entirely (no rng reaches it)."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+    from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+    rng = np.random.RandomState(41)
+    x = rng.rand(8, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]
+    mesh = mesh_lib.build_mesh(1, 1, devices=devices8[:1])
+
+    def one(rate):
+        spec = _spec(dropout_rate=rate)
+        cfg = Config(model="transformer", learning_rate=0.01,
+                     dropout_rate=rate)
+        opt = make_optimizer(cfg)
+        state = create_train_state(jax.random.PRNGKey(1), spec, opt)
+        state = mesh_lib.place_state(
+            state, mesh, mesh_lib.state_pspecs(spec, opt, 1))
+        step = step_lib.build_train_step(cfg, mesh, spec, opt)
+        new_state, cost, _ = step(state, x, y)
+        return jax.tree.map(np.asarray, new_state.params), float(cost)
+
+    p_base, c_base = one(0.0)
+    p_a, c_a = one(0.5)
+    p_b, c_b = one(0.5)
+    assert abs(c_a - c_b) < 1e-12          # deterministic per step
+    for k in p_a:
+        np.testing.assert_array_equal(p_a[k], p_b[k])
+    assert abs(c_a - c_base) > 1e-6        # masks actually dropped
+
+    # eval ignores the rate: identical logits either way
+    spec0, spec5 = _spec(), _spec(dropout_rate=0.5)
+    params = tfm.init(jax.random.PRNGKey(2), spec0)
+    out0 = np.asarray(jax.jit(
+        lambda p, xx: tfm.apply(spec0, p, xx))(params, x))
+    out5 = np.asarray(jax.jit(
+        lambda p, xx: tfm.apply(spec5, p, xx))(params, x))
+    np.testing.assert_array_equal(out0, out5)
+
+
+def test_dropout_validation():
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    with pytest.raises(ValueError, match="transformer only"):
+        run(Config(dropout_rate=0.1))
+    with pytest.raises(ValueError, match="synchronous"):
+        run(Config(model="transformer", dropout_rate=0.1, fsdp=True))
+
+
+def test_dropout_driver_trains(devices8, tmp_path):
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    res = run(Config(
+        model="transformer", dropout_rate=0.1, training_epochs=1,
+        batch_size=32, learning_rate=0.003, optimizer="adam",
+        synthetic_train_size=512, synthetic_test_size=128,
+        logs_path=str(tmp_path), summaries=False, frequency=8,
+        compilation_cache="",
+    ))
+    assert np.isfinite(res["final_cost"]), res
+
+
 def test_tp_param_pspecs_shard_blocks_only():
     from jax.sharding import PartitionSpec as P
 
