@@ -538,6 +538,17 @@ def _block_forward(spec: TransformerSpec, bp: Params, h, act, cdt,
         _row_psum(att.reshape(b, s, -1).astype(cdt), bp["Wo"],
                   bp["bo"], cdt, model_axis),
         spec, dropout_rng, 2 * moe_block)
+    return _ffn_block(spec, bp, h, act, cdt, model_axis, full_params,
+                      moe_block, expert_axis, aux_axes, dropout_rng)
+
+
+def _ffn_block(spec: TransformerSpec, bp: Params, h, act, cdt,
+               model_axis=None, full_params: Params | None = None,
+               moe_block: int = 0, expert_axis=None, aux_axes=(),
+               dropout_rng=None):
+    """The LN2 + FFN (dense or MoE) residual half of a block — shared
+    by the training forward and the KV-cached decode step so the two
+    cannot drift. ``h`` [B, S, D] -> (h, aux)."""
     a = _layer_norm(h, bp["ln2_g"], bp["ln2_b"])
     aux = jnp.float32(0.0)
     if spec.num_experts:
@@ -776,6 +787,127 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
             recv = jax.lax.ppermute(h_out, stage_axis, perm)
     logits = jax.lax.psum(collected, stage_axis)
     return logits.reshape(b, spec.num_classes).astype(jnp.float32)
+
+
+def init_decode_cache(spec: TransformerSpec, batch: int) -> Params:
+    """Per-block KV cache for autoregressive decoding:
+    ``{k{i}/v{i}: [B, S, H, Dh]}`` preallocated at the full sequence
+    length (static shapes — the decode loop writes position ``pos``
+    with a dynamic-index update)."""
+    shape = (batch, spec.seq_len, spec.n_heads, spec.d_head)
+    cache: Params = {}
+    for i in range(spec.num_blocks):
+        # compute dtype: the cache holds the same rounded k/v values
+        # the training forward feeds its attention
+        cache[f"k{i}"] = jnp.zeros(shape, spec.compute_dtype)
+        cache[f"v{i}"] = jnp.zeros(shape, spec.compute_dtype)
+    return cache
+
+
+def decode_step(spec: TransformerSpec, params: Params, cache: Params,
+                token: jnp.ndarray, pos):
+    """One KV-cached decode step for the lm objective: embed ``token``
+    [B] at position ``pos``, run every block attending to the cached
+    keys/values up to and including ``pos``, and return
+    (vocab logits [B, V], updated cache). O(S) per step instead of the
+    O(S^2) full re-forward; exactly the training forward's math
+    (verified by the greedy-vs-teacher-forcing test)."""
+    if spec.objective != "lm":
+        raise ValueError("decode_step serves the lm objective only")
+    # host-side numpy params would reject traced indices (token/pos)
+    params = {k: jnp.asarray(v) for k, v in params.items()}
+    # decode routes MoE with the exact dense dispatch: training's
+    # capacity pool spans the whole [B, S] token population, which a
+    # per-position step cannot reproduce — inference computes the
+    # no-drop routing instead (== training wherever nothing dropped)
+    if spec.moe_dispatch != "dense":
+        spec = dataclasses.replace(spec, moe_dispatch="dense")
+    cdt = spec.compute_dtype
+    b = token.shape[0]
+    d, hn, dh = spec.d_model, spec.n_heads, spec.d_head
+    h = (params["W_emb"].astype(jnp.float32)[token]
+         + params["pos"].astype(jnp.float32)[pos])        # [B, D]
+    act = _ACTIVATIONS[spec.activation]
+    # mask over cache positions: attend to <= pos only
+    valid = (jnp.arange(spec.seq_len) <= pos)             # [S]
+    new_cache = dict(cache)
+    for i in range(spec.num_blocks):
+        bp = {k[len(f"L{i}_"):]: v for k, v in params.items()
+              if k.startswith(f"L{i}_")}
+        a = _layer_norm(h[:, None], bp["ln1_g"], bp["ln1_b"])[:, 0]
+        qkv = jnp.einsum("bd,dte->bte", a.astype(cdt),
+                         bp["Wqkv"].astype(cdt),
+                         preferred_element_type=jnp.float32) \
+            + bp["bqkv"].astype(jnp.float32)              # [B, 3, D]
+        # round q/k/v to the compute dtype exactly where the training
+        # forward does (qkv.astype(cdt) before attention) — cache
+        # stores the rounded values so bf16 runs match training
+        q, kk, vv = (qkv[:, t].astype(cdt).reshape(b, hn, dh)
+                     for t in range(3))
+        ck = jax.lax.dynamic_update_index_in_dim(
+            new_cache[f"k{i}"], kk, pos, axis=1)
+        cv = jax.lax.dynamic_update_index_in_dim(
+            new_cache[f"v{i}"], vv, pos, axis=1)
+        new_cache[f"k{i}"], new_cache[f"v{i}"] = ck, cv
+        scores = jnp.einsum("bhe,bshe->bhs", q, ck,
+                            preferred_element_type=jnp.float32) \
+            / jnp.sqrt(jnp.float32(dh))                   # [B, H, S]
+        scores = jnp.where(valid[None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        att = jnp.einsum("bhs,bshe->bhe", probs.astype(cdt), cv,
+                         preferred_element_type=jnp.float32
+                         ).reshape(b, d)
+        h = h + jnp.dot(att.astype(cdt), bp["Wo"].astype(cdt),
+                        preferred_element_type=jnp.float32) \
+            + bp["bo"].astype(jnp.float32)
+        h, _aux = _ffn_block(spec, bp, h[:, None], act, cdt,
+                             full_params=params, moe_block=i)
+        h = h[:, 0]
+    hf = _layer_norm(h[:, None], params["lnf_g"], params["lnf_b"])[:, 0]
+    logits = _mm(params, hf, "W_head", "b_head", cdt).astype(jnp.float32)
+    return logits, new_cache
+
+
+def generate(spec: TransformerSpec, params: Params, prompt: jnp.ndarray,
+             rng: jax.Array = None, temperature: float = 1.0):
+    """Autoregressively complete ``prompt`` [B, P] int tokens to the
+    full ``spec.seq_len`` with KV-cached decoding (one lax.scan over
+    positions, prompt positions teacher-forced). ``rng=None`` decodes
+    greedily; otherwise samples at ``temperature``. Returns
+    [B, seq_len] int tokens."""
+    b, p = prompt.shape
+    s = spec.seq_len
+    cache = init_decode_cache(spec, b)
+    tokens0 = jnp.concatenate(
+        [prompt, jnp.zeros((b, s - p), prompt.dtype)], axis=1)
+
+    def step(carry, pos):
+        tokens, cache, key = carry
+        tok = jax.lax.dynamic_index_in_dim(tokens, pos, axis=1,
+                                           keepdims=False)   # [B]
+        logits, cache = decode_step(spec, params, cache, tok, pos)
+        if rng is None:
+            nxt = jnp.argmax(logits, -1).astype(tokens.dtype)
+        else:
+            key, sub = jax.random.split(key)
+            nxt = jax.random.categorical(
+                sub, logits / jnp.float32(temperature), -1
+            ).astype(tokens.dtype)
+        # write position pos+1 unless it is still inside the prompt
+        # (teacher forcing) or past the end
+        write = jnp.logical_and(pos + 1 >= p, pos + 1 < s)
+        cur = jax.lax.dynamic_index_in_dim(tokens, jnp.minimum(pos + 1,
+                                                               s - 1),
+                                           axis=1, keepdims=False)
+        val = jnp.where(write, nxt, cur)
+        tokens = jax.lax.dynamic_update_index_in_dim(
+            tokens, val, jnp.minimum(pos + 1, s - 1), axis=1)
+        return (tokens, cache, key), None
+
+    key0 = rng if rng is not None else jax.random.PRNGKey(0)
+    (tokens, _, _), _ = jax.lax.scan(
+        step, (tokens0, cache, key0), jnp.arange(s - 1))
+    return tokens
 
 
 def num_params(spec: TransformerSpec) -> int:

@@ -739,6 +739,66 @@ def test_dropout_driver_trains(devices8, tmp_path):
     assert np.isfinite(res["final_cost"]), res
 
 
+@pytest.mark.parametrize("variant", ["f32", "bf16", "moe"])
+def test_lm_decode_matches_teacher_forcing(variant):
+    """KV-cached decode_step computes the training forward: feeding a
+    full token sequence position by position must reproduce apply()'s
+    per-position logits (the cache IS the attention state) — in f32,
+    in bfloat16 (the cache stores the same rounded k/v the training
+    attention consumes), and with a MoE FFN (ample-capacity sparse
+    training == the dense routing decode computes)."""
+    import jax.numpy as jnp2
+
+    kw = dict(num_blocks=2)
+    tol = 2e-4
+    if variant == "bf16":
+        kw["compute_dtype"] = jnp2.bfloat16
+        tol = 3e-2   # bf16 rounding; argmax-relevant scale
+    elif variant == "moe":
+        kw.update(num_experts=4, moe_dispatch="alltoall",
+                  capacity_factor=4.0)   # ample: sparse == dense
+    spec = _lm_spec(**kw)
+    params = tfm.init(jax.random.PRNGKey(5), spec)
+    rng = np.random.RandomState(9)
+    x = rng.rand(2, 64).astype(np.float32)
+    tokens = tfm.tokenize(spec, jnp.asarray(x))           # [2, 64]
+    want = np.asarray(jax.jit(
+        lambda p, xx: tfm.apply(spec, p, xx))(params, x))  # [2, 64, V]
+
+    cache = tfm.init_decode_cache(spec, 2)
+    step = jax.jit(lambda c, t, p: tfm.decode_step(spec, params, c, t, p))
+    got = []
+    for pos in range(spec.seq_len):
+        logits, cache = step(cache, tokens[:, pos], pos)
+        got.append(np.asarray(logits))
+    got = np.stack(got, axis=1)
+    np.testing.assert_allclose(got, want, rtol=tol, atol=tol)
+
+
+def test_lm_generate_contract():
+    """generate(): prompt preserved, completions in-vocab, greedy is
+    deterministic, sampled differs across keys but not across calls
+    with the same key."""
+    spec = _lm_spec(num_blocks=1)
+    params = tfm.init(jax.random.PRNGKey(6), spec)
+    prompt = jnp.asarray(np.random.RandomState(1).randint(
+        0, 16, (2, 8)).astype(np.int32))
+    g = np.asarray(tfm.generate(spec, params, prompt))
+    assert g.shape == (2, 64)
+    np.testing.assert_array_equal(g[:, :8], np.asarray(prompt))
+    assert g.min() >= 0 and g.max() < 16
+    np.testing.assert_array_equal(
+        g, np.asarray(tfm.generate(spec, params, prompt)))
+    s1 = np.asarray(tfm.generate(spec, params, prompt,
+                                 rng=jax.random.PRNGKey(1)))
+    s2 = np.asarray(tfm.generate(spec, params, prompt,
+                                 rng=jax.random.PRNGKey(1)))
+    s3 = np.asarray(tfm.generate(spec, params, prompt,
+                                 rng=jax.random.PRNGKey(2)))
+    np.testing.assert_array_equal(s1, s2)
+    assert (s1 != s3).any()
+
+
 def test_tp_param_pspecs_shard_blocks_only():
     from jax.sharding import PartitionSpec as P
 
