@@ -849,14 +849,17 @@ def decode_step(spec: TransformerSpec, params: Params, cache: Params,
         cv = jax.lax.dynamic_update_index_in_dim(
             new_cache[f"v{i}"], vv, pos, axis=1)
         new_cache[f"k{i}"], new_cache[f"v{i}"] = ck, cv
-        scores = jnp.einsum("bhe,bshe->bhs", q, ck,
-                            preferred_element_type=jnp.float32) \
+        # mirror ops/ring_attention.attention exactly: the score
+        # einsum runs in the inputs' dtype and is cast AFTER (bf16
+        # rounding included), masked with the same NEG_INF
+        from ..ops.ring_attention import NEG_INF
+
+        scores = jnp.einsum("bhe,bshe->bhs", q, ck).astype(jnp.float32) \
             / jnp.sqrt(jnp.float32(dh))                   # [B, H, S]
-        scores = jnp.where(valid[None, None], scores, -jnp.inf)
+        scores = jnp.where(valid[None, None], scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1)
-        att = jnp.einsum("bhs,bshe->bhe", probs.astype(cdt), cv,
-                         preferred_element_type=jnp.float32
-                         ).reshape(b, d)
+        att = jnp.einsum("bhs,bshe->bhe", probs.astype(cv.dtype),
+                         cv).reshape(b, d)
         h = h + jnp.dot(att.astype(cdt), bp["Wo"].astype(cdt),
                         preferred_element_type=jnp.float32) \
             + bp["bo"].astype(jnp.float32)
@@ -886,22 +889,21 @@ def generate(spec: TransformerSpec, params: Params, prompt: jnp.ndarray,
         tok = jax.lax.dynamic_index_in_dim(tokens, pos, axis=1,
                                            keepdims=False)   # [B]
         logits, cache = decode_step(spec, params, cache, tok, pos)
-        if rng is None:
+        if rng is None or temperature <= 0:
+            # greedy (temperature 0 requests argmax, not a div-by-zero)
             nxt = jnp.argmax(logits, -1).astype(tokens.dtype)
         else:
             key, sub = jax.random.split(key)
             nxt = jax.random.categorical(
                 sub, logits / jnp.float32(temperature), -1
             ).astype(tokens.dtype)
-        # write position pos+1 unless it is still inside the prompt
-        # (teacher forcing) or past the end
-        write = jnp.logical_and(pos + 1 >= p, pos + 1 < s)
-        cur = jax.lax.dynamic_index_in_dim(tokens, jnp.minimum(pos + 1,
-                                                               s - 1),
-                                           axis=1, keepdims=False)
-        val = jnp.where(write, nxt, cur)
+        # write position pos+1 (pos stops at s-2) unless it is still
+        # inside the prompt (teacher forcing)
+        cur = jax.lax.dynamic_index_in_dim(tokens, pos + 1, axis=1,
+                                           keepdims=False)
+        val = jnp.where(pos + 1 >= p, nxt, cur)
         tokens = jax.lax.dynamic_update_index_in_dim(
-            tokens, val, jnp.minimum(pos + 1, s - 1), axis=1)
+            tokens, val, pos + 1, axis=1)
         return (tokens, cache, key), None
 
     key0 = rng if rng is not None else jax.random.PRNGKey(0)

@@ -55,3 +55,25 @@ def test_mnist_mirror_flag():
     ])
     assert cfg.mnist_mirrors == ("http://mirror.internal/mnist/", "http://b/m/")
     assert parse_config([]).mnist_mirrors == ()
+
+
+def test_r3_flag_surface_parses():
+    """Every r3 flag parses and lands on its Config field."""
+    from distributed_tensorflow_example_tpu.config import parse_config
+
+    cfg = parse_config([
+        "--model=transformer", "--model_parallel=2",
+        "--sequence_parallel=2", "--sp_impl=ulysses",
+        "--num_experts=8", "--moe_topk=2", "--moe_dispatch=alltoall",
+        "--capacity_factor=2.0", "--moe_aux_weight=0.01",
+        "--expert_parallel=2", "--objective=lm", "--vocab_size=128",
+        "--dropout_rate=0.1", "--weight_decay=0.01", "--grad_clip=1.0",
+        "--label_smoothing=0.1", "--lr_schedule=linear",
+        "--warmup_steps=10", "--grad_accum=2",
+    ])
+    assert cfg.sp_impl == "ulysses" and cfg.moe_dispatch == "alltoall"
+    assert cfg.moe_topk == 2 and cfg.moe_aux_weight == 0.01
+    assert cfg.objective == "lm" and cfg.vocab_size == 128
+    assert cfg.dropout_rate == 0.1 and cfg.weight_decay == 0.01
+    assert cfg.grad_clip == 1.0 and cfg.label_smoothing == 0.1
+    assert cfg.capacity_factor == 2.0 and cfg.grad_accum == 2
