@@ -176,6 +176,13 @@ class Config:
     profile: bool = False               # jax.profiler trace into logs_path
     debug_nans: bool = False
 
+    # ---- validation / early stopping (beyond-reference) ----
+    early_stop_patience: int = 0    # > 0: evaluate the validation split
+                                    # every epoch and stop after P
+                                    # epochs without improvement
+                                    # (prints Validation-Accuracy per
+                                    # epoch; forces the per-epoch path)
+
     # ---- checkpoint/resume (SURVEY.md §5) ----
     checkpoint_dir: str = ""
     checkpoint_every: int = 0       # steps; 0 = only at exit
@@ -331,6 +338,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "reference's per-worker final eval does")
     p.add_argument("--profile", action="store_true")
     p.add_argument("--debug_nans", action="store_true")
+    p.add_argument("--early_stop_patience", type=int,
+                   default=d.early_stop_patience,
+                   help="stop after P epochs without validation "
+                        "improvement (0 = off)")
     p.add_argument("--checkpoint_dir", type=str, default=d.checkpoint_dir)
     p.add_argument("--checkpoint_every", type=int, default=d.checkpoint_every)
     p.add_argument("--resume", action="store_true")

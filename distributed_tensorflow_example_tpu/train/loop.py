@@ -197,6 +197,9 @@ def run(cfg: Config) -> Dict[str, Any]:
                          "objective only")
     if cfg.weight_decay < 0 or cfg.grad_clip < 0:
         raise ValueError("weight_decay and grad_clip must be >= 0")
+    if cfg.early_stop_patience < 0:
+        raise ValueError(
+            f"early_stop_patience={cfg.early_stop_patience} must be >= 0")
     if cfg.grad_accum < 1:
         raise ValueError(f"grad_accum={cfg.grad_accum} must be >= 1")
     if cfg.grad_accum > 1 and (cfg.fsdp or cfg.sync_period > 1):
@@ -315,8 +318,9 @@ def run(cfg: Config) -> Dict[str, Any]:
         and cfg.sequence_parallel == 1 and cfg.expert_parallel == 1
         and cfg.pipeline_parallel == 1
         # async fast path runs the whole program on-device; periodic
-        # host-side checkpoints need the host loop
-        and not (async_mode and (cfg.checkpoint_every or cfg.model_parallel > 1))
+        # host-side checkpoints and early stopping need the host loop
+        and not (async_mode and (cfg.checkpoint_every or cfg.model_parallel > 1
+                                 or cfg.early_stop_patience))
     )
 
     # init_op equivalent (example.py:129, 74): identical seeded init on
@@ -370,6 +374,7 @@ def run(cfg: Config) -> Dict[str, Any]:
     print("Variables initialized ...")  # example.py:130
 
     start_epoch = 0
+    resumed_extras: dict = {}
     if cfg.resume and cfg.checkpoint_dir:
         path = ckpt_lib.latest_checkpoint(cfg.checkpoint_dir)
         if path:
@@ -382,6 +387,7 @@ def run(cfg: Config) -> Dict[str, Any]:
             else:
                 state, _, start_epoch = ckpt_lib.restore_checkpoint(path, state)
             state = mesh_lib.place_state(state, mesh, sspecs)
+            resumed_extras = ckpt_lib.load_extras(path)
             print(f"Resumed from {path} at epoch {start_epoch}")
 
     writer = None
@@ -425,6 +431,38 @@ def run(cfg: Config) -> Dict[str, Any]:
     # per round, so the printed step advances by dp per round.
     step_scale = dp if async_mode else 1
 
+    early = cfg.early_stop_patience > 0
+    best_val = float(resumed_extras.get("best_val", -1.0))
+    val_wait = int(resumed_extras.get("val_wait", 0))
+    val_eval_step = None   # host-path evaluator, built lazily, shared
+                           # by per-epoch validation and the final eval
+
+    def host_eval_accuracy(params, images, labels) -> float:
+        nonlocal val_eval_step
+        if val_eval_step is None:
+            val_eval_step = step_lib.build_eval_step(cfg, mesh, spec)
+        unit = (batch_shards * cfg.microbatches if pp_mode
+                else batch_shards)
+        return _eval_accuracy(
+            val_eval_step, params, images, labels, batch_shards,
+            chunk=max(step_lib.eval_chunk_cap(spec, cfg.eval_batch_size),
+                      unit),
+            unit=unit,
+        )
+
+    def note_validation(val_acc: float) -> bool:
+        """Track the per-epoch validation accuracy; True = stop now.
+        The accuracy is computed collectively (SPMD eval), so every
+        process takes the same decision."""
+        nonlocal best_val, val_wait
+        if chief or cfg.eval_all_hosts:
+            print("Validation-Accuracy: %2.2f" % val_acc)
+        if val_acc > best_val + 1e-12:
+            best_val, val_wait = val_acc, 0
+            return False
+        val_wait += 1
+        return val_wait >= cfg.early_stop_patience
+
     # Fast path: stage the dataset into HBM now — this is the data-load
     # phase, which the reference also performs before starting its timer
     # (example.py:48 precedes begin_time at :136). Upload happens once;
@@ -440,9 +478,15 @@ def run(cfg: Config) -> Dict[str, Any]:
         # device_put is async and block_until_ready can return early on
         # this backend (utils.sync), which would leak the upload into
         # the timed window below
+        fast_val = None
+        if early:
+            fast_val = epoch_lib.build_fast_eval(
+                cfg, mesh, spec, dataset.validation.images,
+                dataset.validation.labels)
         from ..utils.sync import hard_sync
 
-        hard_sync((img_d, lbl_d, fast_eval.staged))
+        hard_sync((img_d, lbl_d, fast_eval.staged)
+                  + ((fast_val.staged,) if fast_val else ()))
 
     begin_time = time.time()       # example.py:136
     frequency = cfg.frequency      # example.py:137
@@ -463,7 +507,10 @@ def run(cfg: Config) -> Dict[str, Any]:
 
             to_save = fsdp_lib.unshard_state_host(to_save, full_template)
         if chief:
-            ckpt_lib.save_checkpoint(cfg.checkpoint_dir, to_save, step, resume_epoch)
+            extras = ({"best_val": best_val, "val_wait": val_wait}
+                      if early else None)
+            ckpt_lib.save_checkpoint(cfg.checkpoint_dir, to_save, step,
+                                     resume_epoch, extras)
 
     ckpt_enabled = bool(cfg.checkpoint_dir and cfg.checkpoint_every)
     last_ckpt_step = 0
@@ -509,7 +556,7 @@ def run(cfg: Config) -> Dict[str, Any]:
             return last
 
         n_ep = cfg.training_epochs - start_epoch
-        if cfg.checkpoint_every == 0 and n_ep > 0:
+        if cfg.checkpoint_every == 0 and n_ep > 0 and not early:
             # the whole run as one device program
             if async_mode:
                 runner = epoch_lib.build_local_run_to_completion(
@@ -570,6 +617,11 @@ def run(cfg: Config) -> Dict[str, Any]:
                 avg_step_s = (time.time() - t0) / batch_count
                 cost = emit_epoch(epoch, costs, accs, avg_step_s)
                 maybe_checkpoint(epoch + 1)
+                if early:
+                    p_eval = (get_params(state) if (async_mode or fsdp_mode)
+                              else state.params)
+                    if note_validation(fast_val(p_eval)):
+                        break
     else:
         # Under multi-process SEQUENCE parallelism x shards its token
         # (column) axis, so a process's devices need rows outside its
@@ -674,6 +726,13 @@ def run(cfg: Config) -> Dict[str, Any]:
                     maybe_checkpoint(epoch)
             finally:
                 prefetcher.close()
+            if early:
+                p_eval = (get_params(state)
+                          if (async_mode or fsdp_mode) else state.params)
+                if note_validation(host_eval_accuracy(
+                        p_eval, dataset.validation.images,
+                        dataset.validation.labels)):
+                    break
 
     if cfg.profile and chief:
         jax.profiler.stop_trace()
@@ -690,16 +749,8 @@ def run(cfg: Config) -> Dict[str, Any]:
         if fast:                        # fast per-epoch path
             test_acc = fast_eval(params)
         else:                           # host path
-            eval_step = step_lib.build_eval_step(cfg, mesh, spec)
-            eval_unit = (batch_shards * cfg.microbatches if pp_mode
-                         else batch_shards)
-            test_acc = _eval_accuracy(
-                eval_step, params, dataset.test.images, dataset.test.labels,
-                batch_shards,
-                chunk=max(step_lib.eval_chunk_cap(spec, cfg.eval_batch_size),
-                          eval_unit),
-                unit=eval_unit,
-            )
+            test_acc = host_eval_accuracy(
+                params, dataset.test.images, dataset.test.labels)
     total_time = time.time() - begin_time
     cost = float(cost)
     # the reference runs + prints the final eval on EVERY worker

@@ -33,18 +33,36 @@ def _flatten(tree: Any):
     return out
 
 
-def save_checkpoint(ckpt_dir: str, state: Any, step: int, epoch: int) -> str:
-    """Atomic save: write tmp, rename. Returns the checkpoint path."""
+def save_checkpoint(ckpt_dir: str, state: Any, step: int, epoch: int,
+                    extras: dict | None = None) -> str:
+    """Atomic save: write tmp, rename. Returns the checkpoint path.
+    ``extras``: scalar driver-side counters (e.g. the early-stopping
+    best/patience state) stored as ``__x_<key>__`` entries so --resume
+    replays exactly what an uninterrupted run would do."""
     os.makedirs(ckpt_dir, exist_ok=True)
     path = os.path.join(ckpt_dir, f"ckpt-{step:08d}.npz")
     tmp = path + ".tmp.npz"
     payload = _flatten(state)
     payload["__step__"] = np.asarray(step, np.int64)
     payload["__epoch__"] = np.asarray(epoch, np.int64)
+    for k, v in (extras or {}).items():
+        payload[f"__x_{k}__"] = np.asarray(v)
     with open(tmp, "wb") as f:
         np.savez(f, **payload)
     os.replace(tmp, path)
     return path
+
+
+def load_extras(path: str) -> dict:
+    """The ``extras`` scalars a checkpoint carries (empty for
+    checkpoints written before the field existed)."""
+    out = {}
+    with np.load(path) as z:
+        for k in z.files:
+            m = re.fullmatch(r"__x_(.+)__", k)
+            if m:
+                out[m.group(1)] = z[k].item()
+    return out
 
 
 def latest_checkpoint(ckpt_dir: str) -> str | None:

@@ -314,3 +314,66 @@ def test_grad_clip_sharded_params_matches_single_device(devices8, flavor):
     for k in p1:
         np.testing.assert_allclose(pn[k], p1[k], rtol=3e-5, atol=3e-7,
                                    err_msg=k)
+
+
+@pytest.mark.parametrize("path", ["fast", "host"])
+def test_early_stopping_stops_when_flat(devices8, tmp_path, capsys, path):
+    """--early_stop_patience: with lr=0 the validation accuracy never
+    improves after epoch 1, so the run stops after 1 + patience epochs
+    (both the per-epoch fast path and the host loop), printing a
+    Validation-Accuracy line per completed epoch."""
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    res = run(Config(
+        training_epochs=10, batch_size=64, hidden_sizes=(16,),
+        learning_rate=0.0, early_stop_patience=2,
+        fast_loop=(path == "fast"),
+        synthetic_train_size=256, synthetic_test_size=64,
+        logs_path=str(tmp_path / path), summaries=False, frequency=8,
+        compilation_cache="",
+    ))
+    out = capsys.readouterr().out
+    n_val = out.count("Validation-Accuracy:")
+    # epoch 1 sets the best; epochs 2-3 fail to improve -> stop
+    assert n_val == 3, out
+    assert res["steps"] == 3 * 4, res          # 3 epochs x 4 steps
+
+
+def test_early_stopping_off_by_default(devices8, tmp_path, capsys):
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    run(Config(
+        training_epochs=2, batch_size=64, hidden_sizes=(16,),
+        synthetic_train_size=256, synthetic_test_size=64,
+        logs_path=str(tmp_path), summaries=False, frequency=8,
+        compilation_cache="",
+    ))
+    assert "Validation-Accuracy:" not in capsys.readouterr().out
+
+
+def test_early_stop_state_survives_resume(devices8, tmp_path, capsys):
+    """The patience counters ride in the checkpoint: a resumed run that
+    has already plateaued stops immediately instead of re-earning the
+    patience budget (save_checkpoint extras / load_extras)."""
+    from distributed_tensorflow_example_tpu import utils
+    from distributed_tensorflow_example_tpu.train.loop import run
+    from distributed_tensorflow_example_tpu.utils import checkpoint as C
+
+    ckpt = str(tmp_path / "ck")
+    common = dict(
+        training_epochs=2, batch_size=64, hidden_sizes=(16,),
+        learning_rate=0.0, early_stop_patience=5,
+        synthetic_train_size=256, synthetic_test_size=64,
+        logs_path=str(tmp_path), summaries=False, frequency=8,
+        compilation_cache="", checkpoint_dir=ckpt,
+    )
+    run(Config(**common))   # 2 epochs: epoch 1 best, epoch 2 wait=1
+    path = C.latest_checkpoint(ckpt)
+    extras = C.load_extras(path)
+    assert extras["val_wait"] == 1 and extras["best_val"] > 0
+    capsys.readouterr()
+    # resume with patience 2: one more flat epoch (wait -> 2) stops it
+    run(Config(**{**common, "training_epochs": 6, "resume": True,
+                  "early_stop_patience": 2}))
+    out = capsys.readouterr().out
+    assert out.count("Validation-Accuracy:") == 1, out
