@@ -1,8 +1,10 @@
 """Transformer model family tests (models/transformer.py): init/apply
-contracts, dense-vs-flash backend parity (incl. the kernel path at a
-tile-aligned length), DP equivalence on the 8-device mesh, the full
-driver end-to-end, and Megatron tensor parallelism (validation +
-2x4 and 4x2 mesh equivalence)."""
+contracts against a pure-numpy oracle, dense-vs-flash backend parity
+(incl. the kernel path at a tile-aligned length), sharded-step
+equivalences on the 8-device mesh (DP, Megatron TP, both SP layouts,
+dense/sparse/top-2 MoE incl. the aux loss, and every 2x2x2 3-axis TP
+crossing), the lm objective (training, KV-cached decode/generate),
+dropout, and the full driver end-to-end."""
 
 import numpy as np
 import pytest
@@ -1104,3 +1106,51 @@ def test_pp_checkpoint_resume(devices8, tmp_path):
     resumed = run(Config(training_epochs=2, resume=True, **kw))
     assert resumed["steps"] == 16, resumed
     assert np.isfinite(resumed["final_cost"])
+
+
+def test_forward_matches_numpy_oracle():
+    """apply() against an independent pure-numpy re-derivation of the
+    pre-LN encoder (embed+pos, LN, qkv in the [d,3,d] layout, softmax
+    attention, gelu FFN, mean-pool head) — the same style of oracle
+    that pins the MLP family to the reference math."""
+    spec = _spec(num_blocks=2, n_heads=2)
+    params = jax.tree.map(np.asarray,
+                          tfm.init(jax.random.PRNGKey(11), spec))
+    x = np.random.RandomState(3).rand(3, 784).astype(np.float32)
+
+    def ln(h, g, b):
+        mu = h.mean(-1, keepdims=True)
+        var = ((h - mu) ** 2).mean(-1, keepdims=True)
+        return (h - mu) / np.sqrt(var + 1e-6) * g + b
+
+    def gelu(v):
+        # explicit tanh-approximation formula — independent of
+        # jax.nn.gelu (which the model itself uses)
+        return 0.5 * v * (1.0 + np.tanh(
+            np.sqrt(2.0 / np.pi) * (v + 0.044715 * v ** 3)))
+
+    def softmax(v):
+        e = np.exp(v - v.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    b, s, f, d = 3, 28, 28, 32
+    h = x.reshape(b, s, f) @ params["W_in"] + params["b_in"] \
+        + params["pos"][None]
+    for i in range(2):
+        a = ln(h, params[f"L{i}_ln1_g"], params[f"L{i}_ln1_b"])
+        qkv = np.einsum("bsd,dte->bste", a, params[f"L{i}_Wqkv"]) \
+            + params[f"L{i}_bqkv"]
+        q, k, v = (qkv[:, :, t].reshape(b, s, 2, 16) for t in range(3))
+        scores = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(16.0)
+        att = np.einsum("bhqk,bkhd->bqhd", softmax(scores), v)
+        h = h + att.reshape(b, s, d) @ params[f"L{i}_Wo"] \
+            + params[f"L{i}_bo"]
+        a = ln(h, params[f"L{i}_ln2_g"], params[f"L{i}_ln2_b"])
+        a = gelu(a @ params[f"L{i}_W1"] + params[f"L{i}_b1"])
+        h = h + a @ params[f"L{i}_W2"] + params[f"L{i}_b2"]
+    h = ln(h, params["lnf_g"], params["lnf_b"])
+    want = h.mean(1) @ params["W_head"] + params["b_head"]
+
+    got = np.asarray(jax.jit(
+        lambda p, xx: tfm.apply(spec, p, xx))(params, x))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
