@@ -186,6 +186,8 @@ class Config:
     # ---- checkpoint/resume (SURVEY.md §5) ----
     checkpoint_dir: str = ""
     checkpoint_every: int = 0       # steps; 0 = only at exit
+    keep_checkpoints: int = 0       # retain only the N newest
+                                    # checkpoints (0 = keep all)
     resume: bool = False
 
     # ---- misc ----
@@ -343,6 +345,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stop after P epochs without validation "
                         "improvement (0 = off)")
     p.add_argument("--checkpoint_dir", type=str, default=d.checkpoint_dir)
+    p.add_argument("--keep_checkpoints", type=int,
+                   default=d.keep_checkpoints,
+                   help="retain only the N newest checkpoints (0 = all)")
     p.add_argument("--checkpoint_every", type=int, default=d.checkpoint_every)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--eval_batch_size", type=int, default=d.eval_batch_size)

@@ -200,6 +200,9 @@ def run(cfg: Config) -> Dict[str, Any]:
     if cfg.early_stop_patience < 0:
         raise ValueError(
             f"early_stop_patience={cfg.early_stop_patience} must be >= 0")
+    if cfg.keep_checkpoints < 0:
+        raise ValueError(
+            f"keep_checkpoints={cfg.keep_checkpoints} must be >= 0")
     if cfg.grad_accum < 1:
         raise ValueError(f"grad_accum={cfg.grad_accum} must be >= 1")
     if cfg.grad_accum > 1 and (cfg.fsdp or cfg.sync_period > 1):
@@ -488,6 +491,7 @@ def run(cfg: Config) -> Dict[str, Any]:
         hard_sync((img_d, lbl_d, fast_eval.staged)
                   + ((fast_val.staged,) if fast_val else ()))
 
+    epochs_done = start_epoch
     begin_time = time.time()       # example.py:136
     frequency = cfg.frequency      # example.py:137
     cost = float("nan")
@@ -511,6 +515,9 @@ def run(cfg: Config) -> Dict[str, Any]:
                       if early else None)
             ckpt_lib.save_checkpoint(cfg.checkpoint_dir, to_save, step,
                                      resume_epoch, extras)
+            if cfg.keep_checkpoints:
+                ckpt_lib.prune_checkpoints(cfg.checkpoint_dir,
+                                           cfg.keep_checkpoints)
 
     ckpt_enabled = bool(cfg.checkpoint_dir and cfg.checkpoint_every)
     last_ckpt_step = 0
@@ -589,6 +596,7 @@ def run(cfg: Config) -> Dict[str, Any]:
                 (costs2d, accs2d, eval_pending)
             )
             avg_step_s = (time.time() - t0) / (n_ep * batch_count)
+            epochs_done = start_epoch + n_ep
             for e_off in range(n_ep):
                 cost = emit_epoch(start_epoch + e_off, costs2d[e_off],
                                   accs2d[e_off], avg_step_s)
@@ -616,6 +624,7 @@ def run(cfg: Config) -> Dict[str, Any]:
                 costs, accs = jax.device_get((costs, accs))
                 avg_step_s = (time.time() - t0) / batch_count
                 cost = emit_epoch(epoch, costs, accs, avg_step_s)
+                epochs_done = epoch + 1
                 maybe_checkpoint(epoch + 1)
                 if early:
                     p_eval = (get_params(state) if (async_mode or fsdp_mode)
@@ -726,6 +735,7 @@ def run(cfg: Config) -> Dict[str, Any]:
                     maybe_checkpoint(epoch)
             finally:
                 prefetcher.close()
+            epochs_done = epoch + 1
             if early:
                 p_eval = (get_params(state)
                           if (async_mode or fsdp_mode) else state.params)
@@ -786,4 +796,7 @@ def run(cfg: Config) -> Dict[str, Any]:
         * mesh.shape.get(mesh_lib.STAGE_AXIS, 1),
         "global_batch": global_batch,
         "fast_loop": fast,
+        "epochs_completed": epochs_done,
+        "stopped_early": bool(early
+                              and val_wait >= cfg.early_stop_patience),
     }

@@ -65,15 +65,36 @@ def load_extras(path: str) -> dict:
     return out
 
 
-def latest_checkpoint(ckpt_dir: str) -> str | None:
+def _list_checkpoints(ckpt_dir: str) -> list[tuple[int, str]]:
+    """(step, filename) for every completed checkpoint, step-sorted —
+    the one filename-format scan prune and resume share (atomic-rename
+    temp files never match)."""
     if not os.path.isdir(ckpt_dir):
-        return None
-    best = None
+        return []
+    found = []
     for name in os.listdir(ckpt_dir):
         m = re.fullmatch(r"ckpt-(\d+)\.npz", name)
-        if m and (best is None or int(m.group(1)) > best[0]):
-            best = (int(m.group(1)), name)
-    return os.path.join(ckpt_dir, best[1]) if best else None
+        if m:
+            found.append((int(m.group(1)), name))
+    return sorted(found)
+
+
+def prune_checkpoints(ckpt_dir: str, keep: int) -> list[str]:
+    """Delete all but the ``keep`` highest-step checkpoints (0 = keep
+    everything). Returns the deleted paths."""
+    if keep <= 0:
+        return []
+    deleted = []
+    for _, name in _list_checkpoints(ckpt_dir)[:-keep]:
+        path = os.path.join(ckpt_dir, name)
+        os.remove(path)
+        deleted.append(path)
+    return deleted
+
+
+def latest_checkpoint(ckpt_dir: str) -> str | None:
+    found = _list_checkpoints(ckpt_dir)
+    return os.path.join(ckpt_dir, found[-1][1]) if found else None
 
 
 def restore_checkpoint(path: str, state_template: Any) -> Tuple[Any, int, int]:

@@ -95,3 +95,37 @@ def test_qkv_layout_migration(tmp_path):
             jax.tree_util.tree_flatten_with_path(state)[0],
             jax.tree_util.tree_flatten_with_path(restored)[0]):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_prune_checkpoints(tmp_path):
+    opt = make_optimizer(Config())
+    state = create_train_state(jax.random.PRNGKey(0), SPEC, opt)
+    for step in (5, 10, 15, 20):
+        C.save_checkpoint(str(tmp_path), state, step=step, epoch=0)
+    deleted = C.prune_checkpoints(str(tmp_path), keep=2)
+    import os
+
+    assert sorted(os.path.basename(d) for d in deleted) == [
+        "ckpt-00000005.npz", "ckpt-00000010.npz"]
+    assert C.latest_checkpoint(str(tmp_path)).endswith("ckpt-00000020.npz")
+    # keep >= count and keep=0 are no-ops
+    assert C.prune_checkpoints(str(tmp_path), keep=5) == []
+    assert C.prune_checkpoints(str(tmp_path), keep=0) == []
+
+
+def test_driver_keeps_last_n(tmp_path):
+    from distributed_tensorflow_example_tpu.train.loop import run
+    import os
+
+    ckpt = str(tmp_path / "ck")
+    run(Config(
+        training_epochs=3, batch_size=64, hidden_sizes=(16,),
+        synthetic_train_size=256, synthetic_test_size=64,
+        summaries=False, frequency=8, compilation_cache="",
+        checkpoint_dir=ckpt, checkpoint_every=4, keep_checkpoints=2,
+    ))
+    import re
+
+    names = sorted(n for n in os.listdir(ckpt)
+                   if re.fullmatch(r"ckpt-\d+\.npz", n))
+    assert len(names) == 2, names

@@ -377,3 +377,17 @@ def test_early_stop_state_survives_resume(devices8, tmp_path, capsys):
                   "early_stop_patience": 2}))
     out = capsys.readouterr().out
     assert out.count("Validation-Accuracy:") == 1, out
+
+
+def test_run_metrics_epochs_and_stop_flag(devices8, tmp_path):
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    base = dict(batch_size=64, hidden_sizes=(16,),
+                synthetic_train_size=256, synthetic_test_size=64,
+                logs_path=str(tmp_path), summaries=False, frequency=8,
+                compilation_cache="")
+    full = run(Config(training_epochs=2, **base))
+    assert full["epochs_completed"] == 2 and not full["stopped_early"]
+    stopped = run(Config(training_epochs=10, learning_rate=0.0,
+                         early_stop_patience=2, **base))
+    assert stopped["epochs_completed"] == 3 and stopped["stopped_early"]
