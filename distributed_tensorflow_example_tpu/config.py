@@ -75,6 +75,11 @@ class Config:
     dropout_rate: float = 0.0       # transformer training-only dropout
                                     # (embedding + per-block residual
                                     # branches; eval never drops)
+    sample_after: int = 0           # lm only: generate N samples after
+                                    # training (KV-cached decoding,
+                                    # chief-only; saved to
+                                    # logs_path/samples.npz)
+    sample_temperature: float = 1.0 # sampling temperature (0 = greedy)
     causal: bool = False            # causal (LM-style) attention mask
     num_experts: int = 0            # >0: MoE FFN (Switch/GShard style)
     moe_topk: int = 1               # experts per token (1 = Switch,
@@ -252,6 +257,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dropout_rate", type=float, default=d.dropout_rate,
                    help="transformer training-only dropout (embedding "
                         "+ per-block residual branches)")
+    p.add_argument("--sample_after", type=int, default=d.sample_after,
+                   help="lm only: generate N samples after training "
+                        "(saved to logs_path/samples.npz)")
+    p.add_argument("--sample_temperature", type=float,
+                   default=d.sample_temperature)
     p.add_argument("--causal", action="store_true")
     p.add_argument("--num_experts", type=int, default=d.num_experts,
                    help="transformer FFN becomes a top-1 MoE with this "

@@ -178,6 +178,13 @@ def run(cfg: Config) -> Dict[str, Any]:
                              "pipeline path (its head is per-position)")
         if cfg.vocab_size < 2:
             raise ValueError(f"vocab_size={cfg.vocab_size} must be >= 2")
+    if cfg.sample_after:
+        if cfg.sample_after < 0:
+            raise ValueError(
+                f"sample_after={cfg.sample_after} must be >= 0")
+        if cfg.objective != "lm":
+            raise ValueError("--sample_after requires --objective=lm "
+                             "(nothing to sample from a classifier)")
     if cfg.dropout_rate:
         if not 0.0 <= cfg.dropout_rate < 1.0:
             raise ValueError(
@@ -750,10 +757,11 @@ def run(cfg: Config) -> Dict[str, Any]:
     # Final eval (example.py:177-179): chief-only in spirit; every
     # process computes (cheap, collective-free divergence is impossible
     # under SPMD) but only chief prints.
+    eval_params = None
     if eval_pending is not None:        # fast path, eval count already fetched
         test_acc = float(eval_pending) / fast_eval.n
     else:
-        params = (
+        params = eval_params = (
             get_params(state) if (async_mode or fsdp_mode) else state.params
         )
         if fast:                        # fast per-epoch path
@@ -772,6 +780,43 @@ def run(cfg: Config) -> Dict[str, Any]:
     if chief:
         print("Total Time: %3.2fs" % float(total_time))   # example.py:178
         print("Final Cost: %.4f" % cost)                  # example.py:179
+
+    if cfg.sample_after > 0 and cfg.objective == "lm":
+        # complete the train->generate story: KV-cached decoding from
+        # the first test examples' opening tokens (beyond-reference;
+        # the classify objective has nothing to sample). EVERY process
+        # joins the collective param fetch/gather — only the write is
+        # chief-only (gating the collective would deadlock the others).
+        import os
+
+        from ..models import transformer as tfm_lib
+
+        sample_params = (
+            eval_params if eval_params is not None
+            else get_params(state) if (async_mode or fsdp_mode)
+            else state.params
+        )
+        if proc_cnt > 1:
+            from jax.experimental import multihost_utils
+
+            sample_params = multihost_utils.process_allgather(
+                sample_params, tiled=True)
+        n_s = min(cfg.sample_after, dataset.test.images.shape[0])
+        if chief and n_s:
+            host_params = jax.tree.map(np.asarray, sample_params)
+            prompt_len = max(1, spec.seq_len // 8)
+            prompts = tfm_lib.tokenize(
+                spec, dataset.test.images[:n_s])[:, :prompt_len]
+            samples = np.asarray(tfm_lib.generate(
+                spec, host_params, prompts,
+                rng=(jax.random.PRNGKey(cfg.seed)
+                     if cfg.sample_temperature > 0 else None),
+                temperature=cfg.sample_temperature))
+            os.makedirs(cfg.logs_path, exist_ok=True)
+            sample_path = os.path.join(cfg.logs_path, "samples.npz")
+            np.savez(sample_path, samples=samples, prompt_len=prompt_len,
+                     vocab_size=spec.vocab_size)
+            print(f"Sampled {n_s} sequences -> {sample_path}")
 
     if cfg.checkpoint_dir:
         save_state(int(state.step), cfg.training_epochs)

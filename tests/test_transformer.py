@@ -1186,3 +1186,33 @@ def test_tp_checkpoint_resume(devices8, tmp_path, capsys):
     assert "Resumed from" in capsys.readouterr().out
     assert r2["steps"] == 16       # continued, not restarted
     assert np.isfinite(r2["final_cost"])
+
+
+def test_lm_sample_after_driver(devices8, tmp_path, capsys):
+    """--sample_after: the driver generates prompt-conditioned samples
+    after LM training and saves them next to the logs."""
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    res = run(Config(
+        model="transformer", objective="lm", input_size=64,
+        d_model=32, n_heads=4, num_blocks=1, d_ff=64, vocab_size=16,
+        training_epochs=1, batch_size=32, learning_rate=0.003,
+        optimizer="adam", synthetic_train_size=256,
+        synthetic_test_size=64, logs_path=str(tmp_path),
+        summaries=False, frequency=8, compilation_cache="",
+        sample_after=3, sample_temperature=0.8,
+    ))
+    assert np.isfinite(res["final_cost"])
+    assert "Sampled 3 sequences" in capsys.readouterr().out
+    with np.load(str(tmp_path / "samples.npz")) as z:
+        s = z["samples"]
+        assert s.shape == (3, 64)
+        assert s.min() >= 0 and s.max() < 16
+        assert int(z["prompt_len"]) == 8
+
+
+def test_sample_after_requires_lm():
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    with pytest.raises(ValueError, match="objective=lm"):
+        run(Config(model="transformer", sample_after=2))
