@@ -185,6 +185,10 @@ def run(cfg: Config) -> Dict[str, Any]:
         if cfg.objective != "lm":
             raise ValueError("--sample_after requires --objective=lm "
                              "(nothing to sample from a classifier)")
+        if cfg.sample_temperature < 0:
+            raise ValueError(
+                f"sample_temperature={cfg.sample_temperature} must be "
+                f">= 0 (0 = greedy)")
     if cfg.dropout_rate:
         if not 0.0 <= cfg.dropout_rate < 1.0:
             raise ValueError(

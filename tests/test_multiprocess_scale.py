@@ -209,3 +209,28 @@ def test_three_axis_mesh_across_processes():
     chief = outs[0]
     assert "Test-Accuracy:" in chief and "done" in chief, chief[-2000:]
     assert "Cost: nan" not in chief.lower(), chief[-2000:]
+
+
+def test_lm_sampling_across_processes(tmp_path):
+    """--sample_after in a 2-process FSDP LM run: every process joins
+    the collective parameter gather (a chief-gated collective would
+    deadlock here) and the chief writes the samples file."""
+    logs = str(tmp_path / "logs")
+    outs = run_all(2, 2, [
+        "--model=transformer", "--objective=lm", "--input_size=64",
+        "--d_model=32", "--n_heads=4", "--num_blocks=1", "--d_ff=64",
+        "--vocab_size=16", "--optimizer=adam", "--learning_rate=0.003",
+        "--fsdp", "--sample_after=2",
+        "--training_epochs=1", "--batch_size=32", "--frequency=4",
+        "--synthetic_train_size=128", "--synthetic_test_size=64",
+        f"--logs_path={logs}", "--no_summaries",
+    ])
+    chief, worker = outs
+    assert "Sampled 2 sequences" in chief, chief[-2000:]
+    assert "done" in chief, chief[-2000:]
+    assert "Sampled" not in worker
+    import numpy as np
+    import os
+
+    with np.load(os.path.join(logs, "samples.npz")) as z:
+        assert z["samples"].shape == (2, 64)

@@ -1216,3 +1216,11 @@ def test_sample_after_requires_lm():
 
     with pytest.raises(ValueError, match="objective=lm"):
         run(Config(model="transformer", sample_after=2))
+
+
+def test_sample_temperature_validation():
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    with pytest.raises(ValueError, match="sample_temperature"):
+        run(Config(model="transformer", objective="lm", input_size=64,
+                   sample_after=2, sample_temperature=-1.0))
