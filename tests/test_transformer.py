@@ -1224,3 +1224,38 @@ def test_sample_temperature_validation():
     with pytest.raises(ValueError, match="sample_temperature"):
         run(Config(model="transformer", objective="lm", input_size=64,
                    sample_after=2, sample_temperature=-1.0))
+
+
+def test_lm_grad_accum_matches_full_batch(devices8):
+    """--grad_accum under the lm objective: the accumulated step must
+    equal the plain step on the same batch (mean of equal-chunk
+    next-token losses == the full-batch loss; gradients likewise)."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+    from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+    spec = _lm_spec()
+    rng = np.random.RandomState(53)
+    x = rng.rand(8, 64).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]  # unused
+    mesh = mesh_lib.build_mesh(1, 1, devices=devices8[:1])
+
+    def one(accum):
+        cfg = Config(model="transformer", objective="lm", input_size=64,
+                     vocab_size=16, learning_rate=0.01, n_heads=4,
+                     grad_accum=accum)
+        opt = make_optimizer(cfg)
+        state = create_train_state(jax.random.PRNGKey(1), spec, opt)
+        state = mesh_lib.place_state(
+            state, mesh, mesh_lib.state_pspecs(spec, opt, 1))
+        step = step_lib.build_train_step(cfg, mesh, spec, opt)
+        new_state, cost, _ = step(state, x, y)
+        return jax.tree.map(np.asarray, new_state.params), float(cost)
+
+    p1, c1 = one(1)
+    p2, c2 = one(2)
+    assert abs(c1 - c2) < 5e-6   # chunk-mean reassociation
+    for k in p1:
+        np.testing.assert_allclose(p2[k], p1[k], rtol=1e-5, atol=1e-7,
+                                   err_msg=k)
