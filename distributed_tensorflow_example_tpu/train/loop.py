@@ -254,9 +254,12 @@ def run(cfg: Config) -> Dict[str, Any]:
             raise ValueError("--sequence_parallel composes with data "
                              "and tensor parallelism only (no fsdp, "
                              "sync_period=1)")
-        if cfg.seq_len % cfg.sequence_parallel:
+        # validate the EFFECTIVE sequence length: --objective=lm derives
+        # it from input_size (make_spec), not from --seq_len
+        if spec.seq_len % cfg.sequence_parallel:
             raise ValueError(
-                f"seq_len={cfg.seq_len} must divide evenly over "
+                f"seq_len={spec.seq_len} (from --input_size under "
+                f"--objective=lm, else --seq_len) must divide evenly over "
                 f"sequence_parallel={cfg.sequence_parallel}")
         local_heads = cfg.n_heads // max(cfg.model_parallel, 1)
         if cfg.sp_impl == "ulysses" and local_heads % cfg.sequence_parallel:
@@ -636,12 +639,17 @@ def run(cfg: Config) -> Dict[str, Any]:
                 avg_step_s = (time.time() - t0) / batch_count
                 cost = emit_epoch(epoch, costs, accs, avg_step_s)
                 epochs_done = epoch + 1
-                maybe_checkpoint(epoch + 1)
+                # validation BEFORE the checkpoint so the saved
+                # best_val/val_wait include this epoch — a --resume run
+                # then replays the same early-stop trajectory
+                stop_now = False
                 if early:
                     p_eval = (get_params(state) if (async_mode or fsdp_mode)
                               else state.params)
-                    if note_validation(fast_val(p_eval)):
-                        break
+                    stop_now = note_validation(fast_val(p_eval))
+                maybe_checkpoint(epoch + 1)
+                if stop_now:
+                    break
     else:
         # Under multi-process SEQUENCE parallelism x shards its token
         # (column) axis, so a process's devices need rows outside its

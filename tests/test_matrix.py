@@ -58,6 +58,11 @@ CELLS += [
                      "grad_accum": 2}),
     ("tfm_lm", {**_TFM, "objective": "lm", "vocab_size": 16,
                 "optimizer": "adam", "learning_rate": 0.001}),
+    # lm derives seq_len from input_size (784): SP must validate the
+    # EFFECTIVE length (784 % 8 == 0), not --seq_len's default 28
+    # (28 % 8 != 0, which the r3 validator wrongly rejected)
+    ("tfm_lm_sp8", {**_TFM, "objective": "lm", "vocab_size": 16,
+                    "sequence_parallel": 8}),
 ]
 
 

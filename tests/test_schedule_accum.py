@@ -379,6 +379,31 @@ def test_early_stop_state_survives_resume(devices8, tmp_path, capsys):
     assert out.count("Validation-Accuracy:") == 1, out
 
 
+def test_epoch_boundary_ckpt_includes_validation(devices8, tmp_path):
+    """An epoch-boundary checkpoint carries THAT epoch's validation in
+    its early-stop extras (note_validation runs before maybe_checkpoint
+    in the per-epoch fast path): a mid-run kill + --resume then replays
+    the uninterrupted early-stop trajectory."""
+    import os
+
+    from distributed_tensorflow_example_tpu.train.loop import run
+    from distributed_tensorflow_example_tpu.utils import checkpoint as C
+
+    ckpt = str(tmp_path / "ck")
+    run(Config(
+        training_epochs=3, batch_size=64, hidden_sizes=(16,),
+        learning_rate=0.0, early_stop_patience=10,
+        synthetic_train_size=256, synthetic_test_size=64,
+        logs_path=str(tmp_path), summaries=False, frequency=8,
+        compilation_cache="", checkpoint_dir=ckpt, checkpoint_every=1,
+    ))
+    # 4 steps/epoch -> boundary saves at steps 4, 8, 12. With lr=0 the
+    # epoch-1 validation sets best (wait=0) and epoch 2 is flat: the
+    # step-8 checkpoint must already show wait=1.
+    extras = C.load_extras(os.path.join(ckpt, "ckpt-00000008.npz"))
+    assert extras["val_wait"] == 1 and extras["best_val"] > 0, extras
+
+
 def test_run_metrics_epochs_and_stop_flag(devices8, tmp_path):
     from distributed_tensorflow_example_tpu.train.loop import run
 
