@@ -15,12 +15,32 @@ only (tested to S=16384 where dense scores would need 17 GB).
 Beyond-reference capability (the reference has no attention at all,
 /root/reference/example.py:84-90; SURVEY.md §5).
 
-Causal masking is by global position. Fully-masked (future) k tiles
-are skipped outright with ``pl.when`` (their online update would be an
-arithmetic no-op — ``m_blk = NEG_INF`` leaves every accumulator
-unchanged — so skipping is purely a ~2x MXU saving, not a correctness
-requirement); the backward kernels skip their off-frontier tiles the
-same way.
+Throughput design (tuned on a v5e chip, measured by in-program
+dispatch chains so tunnel round-trips cancel):
+- **Tile size**: 1024x1024 q/k tiles (``_pick_tiles``) — the dominant
+  lever. The kernel is bounded by per-grid-step overhead and VPU
+  softmax passes, both of which amortize with tile area: 256-tiles run
+  ~11 TF/s, 1024-tiles ~41 TF/s f32 / ~55-85 TF/s bf16 on
+  ``[4,4096,8,64]`` causal (the bundled production kernel measures
+  ~48 TF/s bf16 at its best block size on the same chip and method;
+  the d=64 head-dim caps the MXU at ~98 TF/s of the 197 bf16 peak).
+  Tiles shrink to keep dividing the padded sequence, and cap at 512
+  when D > 128 (VMEM working set).
+- **exp2 scores**: q is pre-scaled ONCE by ``log2(e)/sqrt(d)``
+  (O(S·D)), so the kernel's scores live in the log2 domain and every
+  transcendental is a raw ``exp2`` — the per-tile O(blk²) scale
+  multiply and the exp→exp2 argument conversion both disappear. All
+  saved/returned softmax statistics are converted back to the natural
+  domain at the tile boundary (O(blk) per tile), so the ring's
+  ``_merge_partials`` and every downstream consumer are unchanged.
+- **Causal tile classes**: strictly-below-diagonal tiles run a
+  mask-free body (no iota/compare/select passes); only
+  diagonal-crossing tiles mask; above-diagonal tiles are skipped
+  outright with ``pl.when``. Fully-masked ROWS never occur in a
+  computed tile (every diagonal row keeps its self position), so the
+  fully-masked-row guard the XLA paths need is omitted in the kernels:
+  masked entries hold NEG_INF and ``exp2(NEG_INF - m)`` underflows to
+  exactly 0.0 against any finite row max.
 
 Training: ``flash_attention`` carries a ``jax.custom_vjp`` whose
 backward is ALSO tiled Pallas (``_make_dq_kernel`` /
@@ -31,9 +51,11 @@ applies the softmax VJP ``ds = p * (dp - rowsum(do*o))``, and
 accumulates dq (streaming k tiles past each q tile) and dk/dv
 (streaming q tiles past each k tile) in VMEM scratch. Forward AND
 backward are O(S·blk) — long-context training memory is bounded by
-HBM, not by an [S, S] score tensor.
+HBM, not by an [S, S] score tensor. The backward kernels consume the
+same pre-scaled-q / exp2 form (constant factors fold into the
+finalize writes: dq scales by 1/sqrt(d), dk by 1/log2(e)).
 
-Ragged shapes (S not a multiple of the 256 tile) by direction:
+Ragged shapes (S not a multiple of the 256 alignment) by direction:
 non-causal ragged runs exact dense XLA in BOTH directions (padded keys
 would corrupt real rows); causal ragged keeps the O(S·blk) kernels in
 BOTH directions — the VJP pads q/k/v/do to the tile multiple, where
@@ -59,14 +81,59 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .ring_attention import NEG_INF, attention as dense_attention
 
-_BLK = 256  # q and k tile length (sequence is padded to a multiple)
+_BLK = 256   # sequence ALIGNMENT: pad unit and minimum tile length
+             # (ring_attention gates its kernel path on S % _BLK)
+_BLK_PREF = 1024   # preferred tile length (VPU/grid overhead amortizer)
+_LOG2E = float(np.log2(np.e))
+_LN2 = float(np.log(2.0))
 
 
 def _interpret() -> bool:
     return jax.default_backend() == "cpu"
 
 
-def _make_kernel(blk: int, causal: bool, compute_dtype,
+def _pick_tiles(s: int, d: int) -> tuple[int, int]:
+    """(blk_q, blk_k) for a padded length ``s`` (s % _BLK == 0): the
+    largest power-of-two tile in [_BLK, _BLK_PREF] dividing s, capped
+    at 512 when D > 128 to keep the backward kernels' [blk, blk]
+    intermediates inside scoped VMEM."""
+    cap = _BLK_PREF if d <= 128 else 512
+    blk = _BLK
+    while blk * 2 <= cap and s % (blk * 2) == 0:
+        blk *= 2
+    return blk, blk
+
+
+def _compiler_params():
+    # bh and the q-tile grid dims are independent programs; only the
+    # k-tile dim carries the scratch recurrence. The raised VMEM cap
+    # covers the backward kernels' three [blk, blk] f32 intermediates
+    # at the 1024 tile (p/dp/ds = 12 MB + operand tiles).
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+        vmem_limit_bytes=100 * 1024 * 1024,
+    )
+
+
+def _prescale(q):
+    """Fold softmax scale AND the exp->exp2 conversion into q once:
+    scores computed from the returned q are (q·kᵀ)/sqrt(d)·log2(e) —
+    natural-domain scores in log2 units."""
+    c = _LOG2E / np.sqrt(q.shape[-1])
+    return (q.astype(jnp.float32) * c).astype(q.dtype)
+
+
+def _causal_tile_classes(iq, blk_q, j, blk_k):
+    """(interior, crossing) predicates for a (q tile, k tile) pair
+    under the global-position causal mask. Interior tiles are fully
+    visible (no mask work); crossing tiles straddle the diagonal
+    (masked); everything else is fully masked (skipped)."""
+    interior = (j + 1) * blk_k - 1 <= iq * blk_q
+    visible = j * blk_k <= iq * blk_q + blk_q - 1
+    return interior, jnp.logical_and(visible, jnp.logical_not(interior))
+
+
+def _make_kernel(blk_q: int, blk_k: int, causal: bool, compute_dtype,
                  return_stats: bool = False):
     def kernel(q_ref, k_ref, v_ref, o_ref, *rest):
         if return_stats:
@@ -83,21 +150,19 @@ def _make_kernel(blk: int, causal: bool, compute_dtype,
             l_scr[...] = jnp.zeros_like(l_scr[...])
             acc_scr[...] = jnp.zeros_like(acc_scr[...])
 
-        # under causal masking, k tiles past the q tile's frontier are
-        # arithmetic no-ops — skip their matmuls outright (`causal` is
-        # Python-static: non-causal kernels get no conditional at all)
-        def _compute():
-            q = q_ref[0].astype(compute_dtype)     # [blk, d]
+        def _compute(masked: bool):
+            q = q_ref[0].astype(compute_dtype)     # [blk_q, d], prescaled
             k = k_ref[0].astype(compute_dtype)
             v = v_ref[0].astype(compute_dtype)
-            s = _tile_scores(q, k, iq, j, blk, causal)
+            s = _tile_scores(q, k, iq, j, blk_q, blk_k, masked)
             m = m_scr[...]
             m_blk = jnp.max(s, axis=-1, keepdims=True)
             m_new = jnp.maximum(m, m_blk)
-            p = jnp.exp(s - m_new)
-            # fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
-            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
-            alpha = jnp.exp(m - m_new)
+            # log2-domain online softmax: masked entries are NEG_INF
+            # and exp2(NEG_INF - finite) == 0.0 exactly, and computed
+            # tiles never contain a fully-masked row (module docstring)
+            p = jnp.exp2(s - m_new)
+            alpha = jnp.exp2(m - m_new)
             m_scr[...] = m_new
             l_scr[...] = l_scr[...] * alpha + jnp.sum(
                 p, axis=-1, keepdims=True)
@@ -107,17 +172,22 @@ def _make_kernel(blk: int, causal: bool, compute_dtype,
             )
 
         if causal:
-            pl.when(j <= iq)(_compute)
+            interior, crossing = _causal_tile_classes(iq, blk_q, j, blk_k)
+            pl.when(interior)(lambda: _compute(False))
+            pl.when(crossing)(lambda: _compute(True))
         else:
-            _compute()
+            _compute(False)
 
         @pl.when(j == nk - 1)
         def _finalize():
             if return_stats:
                 # raw partials for cross-block merging (ring SP): the
-                # un-normalized accumulator plus its (m, l) statistics
+                # un-normalized accumulator plus its (m, l) statistics —
+                # m converted to the NATURAL log domain so downstream
+                # consumers (_merge_partials, the backward) are
+                # exp2-agnostic
                 o_ref[0] = acc_scr[...].astype(o_ref.dtype)
-                m_out[0] = m_scr[...]
+                m_out[0] = m_scr[...] * _LN2
                 l_out[0] = l_scr[...]
             else:
                 o_ref[0] = (
@@ -127,41 +197,44 @@ def _make_kernel(blk: int, causal: bool, compute_dtype,
     return kernel
 
 
-def _tile_scores(q, k, q_tile, k_tile, blk: int, causal: bool):
-    """Scaled q·kᵀ for one tile pair with the global-position causal
-    mask — shared by the forward and both backward kernels."""
-    scale = 1.0 / np.sqrt(q.shape[-1])
+def _tile_scores(q, k, q_tile, k_tile, blk_q: int, blk_k: int,
+                 masked: bool):
+    """log2-domain scores q·kᵀ for one tile pair (q arrives pre-scaled
+    by log2(e)/sqrt(d)) with the global-position causal mask when
+    ``masked`` — shared by the forward and both backward kernels."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    ) * scale
-    if causal:
-        q_pos = q_tile * blk + jax.lax.broadcasted_iota(
-            jnp.int32, (blk, blk), 0)
-        k_pos = k_tile * blk + jax.lax.broadcasted_iota(
-            jnp.int32, (blk, blk), 1)
+    )
+    if masked:
+        q_pos = q_tile * blk_q + jax.lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_k), 0)
+        k_pos = k_tile * blk_k + jax.lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_k), 1)
         s = jnp.where(k_pos <= q_pos, s, NEG_INF)
     return s
 
 
-def _bwd_tile(q, k, v, do, m, l, dlt, q_tile, k_tile, blk: int,
-              causal: bool):
+def _bwd_tile(q2, k, v, do, m, l, dlt, q_tile, k_tile, blk_q: int,
+              blk_k: int, masked: bool):
     """Shared backward tile math: recompute this tile's normalized
-    probabilities from the saved (m, l) stats and apply the softmax VJP.
-    Returns (p, ds, scale)."""
-    s = _tile_scores(q, k, q_tile, k_tile, blk, causal)
-    p = jnp.exp(s - m) / jnp.maximum(l, 1e-30)
-    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    probabilities from the saved (m, l) stats — ``q2`` is pre-scaled so
+    scores are log2-domain and ``m`` (natural) converts with one O(blk)
+    multiply — and apply the softmax VJP. Returns (p, ds)."""
+    s = _tile_scores(q2, k, q_tile, k_tile, blk_q, blk_k, masked)
+    p = jnp.exp2(s - m * _LOG2E) / jnp.maximum(l, 1e-30)
     dp = jax.lax.dot_general(                     # do @ v^T
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
     ds = p * (dp - dlt)
-    return p, ds, 1.0 / np.sqrt(q.shape[-1])
+    return p, ds
 
 
-def _make_dq_kernel(blk: int, causal: bool, compute_dtype):
-    """dq accumulation: grid (bh, iq, jk), jk innermost sequential."""
+def _make_dq_kernel(blk_q: int, blk_k: int, causal: bool, compute_dtype,
+                    scale: float):
+    """dq accumulation: grid (bh, iq, jk), jk innermost sequential.
+    The softmax scale folds into the single finalize write."""
 
     def kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, dlt_ref,
                dq_ref, dq_scr):
@@ -173,34 +246,39 @@ def _make_dq_kernel(blk: int, causal: bool, compute_dtype):
         def _init():
             dq_scr[...] = jnp.zeros_like(dq_scr[...])
 
-        def _compute():
+        def _compute(masked: bool):
             k = k_ref[0].astype(compute_dtype)
-            _, ds, scale = _bwd_tile(
+            _, ds = _bwd_tile(
                 q_ref[0].astype(compute_dtype), k,
                 v_ref[0].astype(compute_dtype),
                 do_ref[0].astype(compute_dtype),
-                m_ref[0], l_ref[0], dlt_ref[0], iq, j, blk, causal,
+                m_ref[0], l_ref[0], dlt_ref[0], iq, j, blk_q, blk_k,
+                masked,
             )
             dq_scr[...] += jax.lax.dot_general(   # ds @ k
                 ds.astype(compute_dtype), k, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            ) * scale
+            )
 
         if causal:  # skip k tiles past the causal frontier
-            pl.when(j <= iq)(_compute)
+            interior, crossing = _causal_tile_classes(iq, blk_q, j, blk_k)
+            pl.when(interior)(lambda: _compute(False))
+            pl.when(crossing)(lambda: _compute(True))
         else:
-            _compute()
+            _compute(False)
 
         @pl.when(j == nk - 1)
         def _finalize():
-            dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+            dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
     return kernel
 
 
-def _make_dkv_kernel(blk: int, causal: bool, compute_dtype):
+def _make_dkv_kernel(blk_q: int, blk_k: int, causal: bool, compute_dtype):
     """dk/dv accumulation: grid (bh, jk, iq), iq innermost sequential
-    (each program owns one k tile and streams q tiles through it)."""
+    (each program owns one k tile and streams q tiles through it). The
+    pre-scaled q folds log2(e)·scale into dk; the finalize write
+    divides the log2(e) back out, leaving the wanted ds·scale @ q."""
 
     def kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, dlt_ref,
                dk_ref, dv_ref, dk_scr, dv_scr):
@@ -213,31 +291,38 @@ def _make_dkv_kernel(blk: int, causal: bool, compute_dtype):
             dk_scr[...] = jnp.zeros_like(dk_scr[...])
             dv_scr[...] = jnp.zeros_like(dv_scr[...])
 
-        def _compute():
-            q = q_ref[0].astype(compute_dtype)
+        def _compute(masked: bool):
+            q2 = q_ref[0].astype(compute_dtype)
             do = do_ref[0].astype(compute_dtype)
-            p, ds, scale = _bwd_tile(
-                q, k_ref[0].astype(compute_dtype),
+            p, ds = _bwd_tile(
+                q2, k_ref[0].astype(compute_dtype),
                 v_ref[0].astype(compute_dtype), do,
-                m_ref[0], l_ref[0], dlt_ref[0], i, j, blk, causal,
+                m_ref[0], l_ref[0], dlt_ref[0], i, j, blk_q, blk_k,
+                masked,
             )
             dv_scr[...] += jax.lax.dot_general(   # p^T @ do
                 p.astype(compute_dtype), do, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            dk_scr[...] += jax.lax.dot_general(   # ds^T @ q
-                ds.astype(compute_dtype), q, (((0,), (0,)), ((), ())),
+            dk_scr[...] += jax.lax.dot_general(   # ds^T @ q2
+                ds.astype(compute_dtype), q2, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            ) * scale
+            )
 
         if causal:  # q tiles before this k tile see none of its keys
-            pl.when(i >= j)(_compute)
+            # roles swap vs the dq kernel: tile (i, j) is fully visible
+            # iff every q pos >= every k pos
+            interior = i * blk_q >= (j + 1) * blk_k - 1
+            visible = i * blk_q + blk_q - 1 >= j * blk_k
+            crossing = jnp.logical_and(visible, jnp.logical_not(interior))
+            pl.when(interior)(lambda: _compute(False))
+            pl.when(crossing)(lambda: _compute(True))
         else:
-            _compute()
+            _compute(False)
 
         @pl.when(i == nq - 1)
         def _finalize():
-            dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+            dk_ref[0] = (dk_scr[...] * (1.0 / _LOG2E)).astype(dk_ref.dtype)
             dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
     return kernel
@@ -245,9 +330,12 @@ def _make_dkv_kernel(blk: int, causal: bool, compute_dtype):
 
 def _flash_call(qf, kf, vf, causal: bool, blk: int, return_stats: bool):
     """Shared forward launcher on pre-flattened [BH, S, D] arrays with
-    S % blk == 0. return_stats=False -> normalized output [BH, S, D];
-    True -> (acc f32, m, l) raw partials."""
+    S % blk == 0 (``blk`` is the alignment; actual tiles come from
+    _pick_tiles). return_stats=False -> normalized output [BH, S, D];
+    True -> (acc f32, m, l) raw partials (natural-domain m)."""
     bh, s, d = qf.shape
+    qf = _prescale(qf)
+    blk_q, blk_k = _pick_tiles(s, d)
     try:
         vma = jax.typeof(qf).vma
     except (AttributeError, TypeError):
@@ -258,29 +346,29 @@ def _flash_call(qf, kf, vf, causal: bool, blk: int, return_stats: bool):
             return jax.ShapeDtypeStruct(shape, dt, vma=vma)
         return jax.ShapeDtypeStruct(shape, dt)
 
-    nt = s // blk
-    tile_d = pl.BlockSpec((1, blk, d), lambda b, i, j: (b, i, 0))
-    kv_spec = pl.BlockSpec((1, blk, d), lambda b, i, j: (b, j, 0))
-    tile_1 = pl.BlockSpec((1, blk, 1), lambda b, i, j: (b, i, 0))
+    tile_q = pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0))
+    tile_1 = pl.BlockSpec((1, blk_q, 1), lambda b, i, j: (b, i, 0))
     if return_stats:
-        out_specs = [tile_d, tile_1, tile_1]
+        out_specs = [tile_q, tile_1, tile_1]
         out_shape = [sds((bh, s, d), jnp.float32),
                      sds((bh, s, 1), jnp.float32),
                      sds((bh, s, 1), jnp.float32)]
     else:
-        out_specs = tile_d
+        out_specs = tile_q
         out_shape = sds((bh, s, d), qf.dtype)
     return pl.pallas_call(
-        _make_kernel(blk, causal, qf.dtype, return_stats),
-        grid=(bh, nt, nt),
-        in_specs=[tile_d, kv_spec, kv_spec],
+        _make_kernel(blk_q, blk_k, causal, qf.dtype, return_stats),
+        grid=(bh, s // blk_q, s // blk_k),
+        in_specs=[tile_q, kv_spec, kv_spec],
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
-            pltpu.VMEM((blk, 1), jnp.float32),   # running max m
-            pltpu.VMEM((blk, 1), jnp.float32),   # normalizer l
-            pltpu.VMEM((blk, d), jnp.float32),   # un-normalized output
+            pltpu.VMEM((blk_q, 1), jnp.float32),   # running max m (log2)
+            pltpu.VMEM((blk_q, 1), jnp.float32),   # normalizer l
+            pltpu.VMEM((blk_q, d), jnp.float32),   # un-normalized output
         ],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(qf, kf, vf)
 
@@ -316,8 +404,8 @@ def _flash_stats(q, k, v, causal: bool, blk: int):
     """Raw softmax partials for cross-block merging (the ring SP
     composition, ring_attention.ring_flash_attention) and for the
     backward's O(S) residuals: returns (acc [B,S,H,D] un-normalized
-    f32, m [B,S,H,1], l [B,S,H,1]). Requires S % blk == 0 (callers
-    fall back to XLA paths otherwise)."""
+    f32, m [B,S,H,1] natural-log domain, l [B,S,H,1]). Requires
+    S % blk == 0 (callers fall back to XLA paths otherwise)."""
     b, s, h, d = q.shape
     if s % blk or k.shape[1] != s:
         raise ValueError(f"_flash_stats needs S % {blk} == 0, got {s}")
@@ -341,6 +429,9 @@ def _flash_backward_flat(qf, kf, vf, dof, mf, lf, dlt, causal: bool,
     so callers that accumulate across blocks (the ring VJP) never
     quantize partials to the input dtype."""
     bh, s, d = qf.shape
+    scale = 1.0 / np.sqrt(d)
+    qf = _prescale(qf)
+    blk_q, blk_k = _pick_tiles(s, d)
     try:
         vma = jax.typeof(qf).vma
     except (AttributeError, TypeError):
@@ -351,36 +442,37 @@ def _flash_backward_flat(qf, kf, vf, dof, mf, lf, dlt, causal: bool,
             return jax.ShapeDtypeStruct((bh, s, d), jnp.float32, vma=vma)
         return jax.ShapeDtypeStruct((bh, s, d), jnp.float32)
 
-    nt = s // blk
-    tile_d = lambda: pl.BlockSpec((1, blk, d), lambda b_h, a, b_: (b_h, a, 0))
-    tile_d_b = lambda: pl.BlockSpec((1, blk, d), lambda b_h, a, b_: (b_h, b_, 0))
-    tile_1 = lambda: pl.BlockSpec((1, blk, 1), lambda b_h, a, b_: (b_h, a, 0))
-    tile_1_b = lambda: pl.BlockSpec((1, blk, 1), lambda b_h, a, b_: (b_h, b_, 0))
-    scr = lambda w: pltpu.VMEM((blk, w), jnp.float32)
+    tq = lambda: pl.BlockSpec((1, blk_q, d), lambda b_h, a, b_: (b_h, a, 0))
+    tq_b = lambda: pl.BlockSpec((1, blk_q, d), lambda b_h, a, b_: (b_h, b_, 0))
+    tk = lambda: pl.BlockSpec((1, blk_k, d), lambda b_h, a, b_: (b_h, a, 0))
+    tk_b = lambda: pl.BlockSpec((1, blk_k, d), lambda b_h, a, b_: (b_h, b_, 0))
+    t1 = lambda: pl.BlockSpec((1, blk_q, 1), lambda b_h, a, b_: (b_h, a, 0))
+    t1_b = lambda: pl.BlockSpec((1, blk_q, 1), lambda b_h, a, b_: (b_h, b_, 0))
+    scr = lambda blk, w: pltpu.VMEM((blk, w), jnp.float32)
 
     dq = pl.pallas_call(
-        _make_dq_kernel(blk, causal, compute_dtype),
-        grid=(bh, nt, nt),
+        _make_dq_kernel(blk_q, blk_k, causal, compute_dtype, scale),
+        grid=(bh, s // blk_q, s // blk_k),
         # q/do/m/l/dlt indexed by the q-tile (2nd grid dim); k/v by
         # the inner jk dim
-        in_specs=[tile_d(), tile_d_b(), tile_d_b(), tile_d(),
-                  tile_1(), tile_1(), tile_1()],
-        out_specs=tile_d(),
+        in_specs=[tq(), tk_b(), tk_b(), tq(), t1(), t1(), t1()],
+        out_specs=tq(),
         out_shape=sds(),
-        scratch_shapes=[scr(d)],
+        scratch_shapes=[scr(blk_q, d)],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(qf, kf, vf, dof, mf, lf, dlt)
 
     dk, dv = pl.pallas_call(
-        _make_dkv_kernel(blk, causal, compute_dtype),
-        grid=(bh, nt, nt),
+        _make_dkv_kernel(blk_q, blk_k, causal, compute_dtype),
+        grid=(bh, s // blk_k, s // blk_q),
         # k/v indexed by the k-tile (2nd grid dim); q/do/m/l/dlt by
         # the inner iq dim
-        in_specs=[tile_d_b(), tile_d(), tile_d(), tile_d_b(),
-                  tile_1_b(), tile_1_b(), tile_1_b()],
-        out_specs=[tile_d(), tile_d()],
+        in_specs=[tq_b(), tk(), tk(), tq_b(), t1_b(), t1_b(), t1_b()],
+        out_specs=[tk(), tk()],
         out_shape=[sds(), sds()],
-        scratch_shapes=[scr(d), scr(d)],
+        scratch_shapes=[scr(blk_k, d), scr(blk_k, d)],
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(qf, kf, vf, dof, mf, lf, dlt)
     return dq, dk, dv
