@@ -310,11 +310,7 @@ def _make_dkv_kernel(blk_q: int, blk_k: int, causal: bool, compute_dtype):
             )
 
         if causal:  # q tiles before this k tile see none of its keys
-            # roles swap vs the dq kernel: tile (i, j) is fully visible
-            # iff every q pos >= every k pos
-            interior = i * blk_q >= (j + 1) * blk_k - 1
-            visible = i * blk_q + blk_q - 1 >= j * blk_k
-            crossing = jnp.logical_and(visible, jnp.logical_not(interior))
+            interior, crossing = _causal_tile_classes(i, blk_q, j, blk_k)
             pl.when(interior)(lambda: _compute(False))
             pl.when(crossing)(lambda: _compute(True))
         else:

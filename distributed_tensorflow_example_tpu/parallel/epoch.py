@@ -393,9 +393,10 @@ def _build_fast_eval(cfg, mesh, spec: mlp.MLPSpec, images: np.ndarray, labels: n
     The set is evaluated in chunks with a single ``lax.map`` inside ONE
     executable (one dispatch, sequential chunk compute): peak
     activation memory is one chunk's forward, sized by
-    step.eval_chunk_cap — dense attention at the lm objective's
-    S = input_size would otherwise need an [N, H, S, S] score tensor
-    for the whole set at once."""
+    step.eval_chunk_cap — the whole set at once would otherwise
+    materialize every transformer backend's O(N·S) activations (lane-
+    padded 4x when d_head < 128), plus dense attention's [N, H, S, S]
+    score tensor."""
     from .step import eval_chunk_cap, forward_local
 
     dp = mesh.shape[DATA_AXIS]
@@ -404,7 +405,7 @@ def _build_fast_eval(cfg, mesh, spec: mlp.MLPSpec, images: np.ndarray, labels: n
     pp = mesh_lib.param_pspecs(spec, mp)
     n = images.shape[0]
     # baseline = the whole set in ONE batch (the r2 behavior); the
-    # memory cap splits it only when the score tensor would not fit.
+    # memory cap splits it when one chunk's forward would not fit.
     # Round UP to the dp multiple: flooring would leave chunk just
     # under n when dp doesn't divide it, nearly doubling n_pad
     chunk = -(-min(eval_chunk_cap(spec, n), n) // dp) * dp

@@ -313,19 +313,30 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
 
 def eval_chunk_cap(spec, eval_batch_size: int) -> int:
     """Examples per eval chunk: the caller's batch size, capped for
-    dense-attention transformers so the [B, H, S, S] score tensor
-    stays within a ~2 GB activation budget (the whole-test-set eval
-    would otherwise OOM the moment S grows — e.g. the lm objective's
-    S = input_size; the flash backend materializes no score tensor
-    and needs no cap; for small S the budget quotient exceeds any
-    realistic batch, so the cap never binds)."""
+    transformers so one chunk's forward stays within a ~2 GB
+    activation budget. Two per-example terms: (1) the O(S) per-token
+    activations every backend materializes — counted at the TPU's
+    128-lane tile, because a head dim below 128 pads each [B, S, H,
+    Dh] tensor up to [.., 128] in HBM (measured 4x expansion at
+    Dh=32, the allocation that OOM'd the whole-test-set flash eval) —
+    plus the FFN hidden and, for the lm objective, the [S, vocab]
+    logits; (2) dense attention adds its [B, H, S, S] score tensor.
+    For small models the budget quotient exceeds any realistic test
+    set, so the cap never binds."""
     from ..models import transformer
 
     cap = eval_batch_size
-    if (isinstance(spec, transformer.TransformerSpec)
-            and spec.attention == "dense"):
+    if isinstance(spec, transformer.TransformerSpec):
         budget = 2 * 1024 ** 3
-        per_example = 8 * spec.n_heads * spec.seq_len ** 2  # f32, ~2x
+        dh_pad = max(spec.d_head, 128)
+        # ~8 live f32 [S, H, dh_pad] tensors (qkv, q/k/v, att, two
+        # residual streams) + the two FFN hiddens, per example
+        per_example = 4 * spec.seq_len * (
+            8 * spec.n_heads * dh_pad + 2 * spec.d_ff)
+        if spec.objective == "lm":
+            per_example += 4 * spec.seq_len * spec.vocab_size
+        if spec.attention == "dense":
+            per_example += 8 * spec.n_heads * spec.seq_len ** 2  # f32, ~2x
         cap = min(cap, max(1, budget // per_example))
     return cap
 
