@@ -109,7 +109,11 @@ def _timed_chain(f, args, fetch, repeats: int = 3, n_disp: int = 8,
     backend a per-dispatch fetch would swamp the device time being
     measured (utils.sync rationale). ``fetch`` picks the array to
     block on. ``warm=True`` absorbs compile with one untimed call
-    first; pass False when the caller already dispatched+fetched."""
+    first; pass False when the caller already dispatched+fetched.
+
+    NOTE: each dispatch still pays the tunnel's fixed per-program cost
+    (~100 ms measured on this link), so per-call times for sub-100ms
+    kernels are dominated by it — use ``_delta_chain`` for those."""
     import numpy as np
 
     if warm:
@@ -121,6 +125,119 @@ def _timed_chain(f, args, fetch, repeats: int = 3, n_disp: int = 8,
         np.asarray(fetch(outs[-1]))
         walls.append((time.time() - t0) / n_disp)
     return round(statistics.median(walls), 4)
+
+
+def _delta_chain(step, args, n1: int = 8, n2: int = 40,
+                 reps: int = 5) -> float:
+    """Steady-state per-iteration device seconds for ``step(carry,
+    *rest) -> carry``: build jit(scan(step, length=n)) for two chain
+    lengths, time each with ONE trailing fetch, and return the
+    per-iteration slope (wall(n2) - wall(n1)) / (n2 - n1). The
+    subtraction cancels the tunnel's fixed per-dispatch cost (~100 ms
+    measured: a single [4,4096,8,64] attention call walls 101 ms while
+    64 chained iterations wall 215 ms) AND the fetch round-trip — the
+    quantity left is what a training step actually pays for the op.
+    The carry feedback serializes iterations so nothing overlaps away.
+    Single-target convenience wrapper over _delta_many."""
+    best, _rounds, errors = _delta_many({"x": (step, args)}, n1=n1,
+                                        n2=n2, reps=reps)
+    if "x" in errors:
+        raise RuntimeError(errors["x"])
+    if best["x"] is None:
+        raise RuntimeError("every round's delta collapsed (congestion)")
+    return best["x"]
+
+
+def _fwd_carry_step(fn):
+    """carry -> carry step for _delta_chain/_delta_many: the op's
+    output (cast back to the carry dtype) feeds the next iteration."""
+    return lambda c, k_, v_: fn(c, k_, v_).astype(c.dtype)
+
+
+def _grad_carry_step(fn):
+    """As _fwd_carry_step but through jax.grad: the carry is dq scaled
+    down so 40 chained iterations cannot overflow the carry."""
+    import jax
+    import jax.numpy as jnp
+
+    g = jax.grad(lambda a, b_, c: jnp.sum(fn(a, b_, c) ** 2),
+                 argnums=(0, 1, 2))
+
+    def step(c, k_, v_):
+        dq, _dk, _dv = g(c, k_, v_)
+        return (dq * 1e-3).astype(c.dtype)
+
+    return step
+
+
+def _delta_many(targets, n1: int = 8, n2: int = 40, reps: int = 5):
+    """_delta_chain over several competitors with the measurements
+    INTERLEAVED: each round times every target's two chain lengths
+    back-to-back, so targets share each round's congestion state
+    (windows last minutes — sequential per-target measurement lets one
+    competitor eat a whole window and fabricates 5-80x ratios).
+    ``targets`` is {name: (step, args)}; returns ({name: best_delta},
+    {name: [per-round deltas]}, {name: error}) — absolute rates from
+    the best (min) round, ratios from same-round pairs via _ratio_of.
+    A target that fails to compile/warm (e.g. the bundled anchor
+    kernel rejecting a block config under a newer jax) lands in the
+    errors dict instead of killing every other measurement; a target
+    whose every round collapsed under congestion maps to None in
+    ``best``."""
+    import jax
+    import numpy as np
+    from jax import lax
+
+    chains, errors = {}, {}
+    for name, (step, args) in targets.items():
+        def make(n, step=step):
+            @jax.jit
+            def chain(*a):
+                def body(c, _):
+                    return step(c, *a[1:]), None
+                out, _ = lax.scan(body, a[0], None, length=n)
+                return out
+
+            return chain
+
+        try:
+            c1, c2 = make(n1), make(n2)
+            for c in (c1, c2):
+                np.asarray(jax.tree.leaves(c(*args))[0].ravel()[0])
+            chains[name] = (c1, c2, args)
+        except Exception as e:
+            errors[name] = str(e)[:120]
+    rounds = {name: [] for name in chains}
+    for _ in range(max(1, reps)):
+        for name, (c1, c2, args) in chains.items():
+            t0 = time.time()
+            np.asarray(jax.tree.leaves(c1(*args))[0].ravel()[0])
+            w1 = time.time() - t0
+            t0 = time.time()
+            np.asarray(jax.tree.leaves(c2(*args))[0].ravel()[0])
+            w2 = time.time() - t0
+            rounds[name].append((w2 - w1) / (n2 - n1))
+
+    def _best(ds):
+        # a congestion spike on the SHORT chain can produce a negative
+        # round delta; only positive rounds estimate the true slope —
+        # None (not a garbage value) when no round survived
+        pos = [d for d in ds if d > 0]
+        return min(pos) if pos else None
+
+    best = {name: _best(ds) for name, ds in rounds.items()}
+    return best, rounds, errors
+
+
+def _ratio_of(rounds, a: str, b: str):
+    """Median over rounds of delta(a)/delta(b), skipping rounds where
+    either delta collapsed (<=0, a congestion artifact). None — JSON
+    null, never NaN — when no round survives."""
+    pairs = [(x, y) for x, y in zip(rounds[a], rounds[b])
+             if x > 0 and y > 0]
+    if not pairs:
+        return None
+    return round(statistics.median(x / y for x, y in pairs), 2)
 
 
 def _rate(flops: float, wall: float, peak) -> dict:
@@ -379,90 +496,161 @@ def _attn_flops(b: int, s: int, h: int, d: int, causal: bool,
 
 
 def bench_flash_attention(s: int = 4096, b: int = 4, h: int = 8,
-                          d: int = 64, repeats: int = 3):
-    """Long-context kernel artifact: the Pallas flash-attention forward
-    vs XLA dense attention at S=4096 (causal, f32), plus a max-context
-    probe at S=16384 where dense would need a 17 GB score tensor."""
+                          d: int = 64, repeats: int = 5):
+    """Long-context kernel artifact, measured by ``_delta_chain`` so
+    the tunnel's ~100 ms fixed per-dispatch cost cancels (the r3
+    numbers were dominated by it — every contender "measured"
+    0.4-0.7 TFLOP/s; the same kernels delta-measure 40-85 TFLOP/s).
+
+    Per dtype (f32 AND bf16): this repo's Pallas kernel forward and
+    fused-backward vs (a) XLA dense attention and (b) the bundled
+    production kernel (jax.experimental.pallas.ops.tpu.flash_attention)
+    at BOTH its default 128 blocks and tuned 512 blocks —
+    ``vs_ref_kernel`` compares against whichever of the two is faster,
+    so the claim holds against the anchor's best self. Plus the
+    S=16384 max-context probe (dense would need a 17 GB score
+    tensor). head_dim=64 caps the MXU at half its 197 TF/s bf16 peak
+    (contraction/output width 64 of the 128 systolic lanes)."""
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
     from distributed_tensorflow_example_tpu.ops import flash_attention as fa
     from distributed_tensorflow_example_tpu.ops import ring_attention as ra
 
-    rng = np.random.RandomState(0)
-    q, k, v = [jax.device_put(rng.randn(b, s, h, d).astype(np.float32))
-               for _ in range(3)]  # stage once: ~100 MB of inputs must
-                                   # not re-cross the tunnel every call
-    f_flash = jax.jit(lambda a, b_, c: fa.flash_attention(a, b_, c, True))
-    f_dense = jax.jit(lambda a, b_, c: ra.attention(a, b_, c, causal=True))
-    row = {"config": "flash_attention", "shape": f"[{b},{s},{h},{d}] causal f32"}
+    row = {"config": "flash_attention",
+           "shape": f"[{b},{s},{h},{d}] causal",
+           "method": "delta dispatch chains (per-call = d(wall)/d(n); "
+                     "fixed tunnel cost cancels)"}
     peak = _chip_peak_flops()
-
-    def timed(f, fetch):
-        return _timed_chain(f, (q, k, v), fetch, repeats=repeats)
-
     fwd_flops = _attn_flops(b, s, h, d, causal=True)
     grad_flops = _attn_flops(b, s, h, d, causal=True, grad=True)
-    row["flash_wall_s"] = timed(f_flash, lambda o: o)
-    row["dense_wall_s"] = timed(f_dense, lambda o: o)
-    row["speedup"] = round(row["dense_wall_s"] / row["flash_wall_s"], 2)
-    row.update({"flash_" + k: v
-                for k, v in _rate(fwd_flops, row["flash_wall_s"],
-                                  peak).items()})
-    row["max_abs_diff"] = float(np.max(np.abs(
-        np.asarray(f_flash(q, k, v)) - np.asarray(f_dense(q, k, v)))))
-    # backward (training) path: the O(S) Pallas backward vs dense VJP
-    import jax.numpy as jnp
 
-    g_flash = jax.jit(jax.grad(
-        lambda a, b_, c: jnp.sum(fa.flash_attention(a, b_, c, True) ** 2),
-        argnums=(0, 1, 2)))
-    g_dense = jax.jit(jax.grad(
-        lambda a, b_, c: jnp.sum(ra.attention(a, b_, c, causal=True) ** 2),
-        argnums=(0, 1, 2)))
-    row["flash_grad_wall_s"] = timed(g_flash, lambda o: o[0])
-    row["dense_grad_wall_s"] = timed(g_dense, lambda o: o[0])
-    row["grad_speedup"] = round(
-        row["dense_grad_wall_s"] / row["flash_grad_wall_s"], 2)
-    row.update({"flash_grad_" + k: v
-                for k, v in _rate(grad_flops, row["flash_grad_wall_s"],
-                                  peak).items()})
-    # production-kernel anchor: jax's bundled TPU flash kernel on the
-    # same shape and scale — a RELATIVE number, so tunnel congestion
-    # cancels (measured on this chip: both sit at ~0.6-0.7 TFLOP/s
-    # while a 4096^3 matmul varies 16-156 TFLOP/s with the window;
-    # vs_ref_kernel > 1 means this repo's kernel is faster)
-    try:
+    flash_fn = lambda q_, k_, v_: fa.flash_attention(q_, k_, v_, True)
+    dense_fn = lambda q_, k_, v_: ra.attention(q_, k_, v_, causal=True)
+    fwd_step, grad_step = _fwd_carry_step, _grad_carry_step
+
+    def ref_kernels():
+        """(name, fn) for the bundled kernel at default and tuned
+        block sizes; import failures surface as a row note."""
         from jax.experimental.pallas.ops.tpu.flash_attention import (
-            flash_attention as jax_flash)
+            BlockSizes, flash_attention as jax_flash)
 
-        qh, kh, vh = (jnp.transpose(t_, (0, 2, 1, 3)) for t_ in (q, k, v))
-        f_ref = jax.jit(lambda a, b_, c: jax_flash(
-            a, b_, c, causal=True, sm_scale=1.0 / float(np.sqrt(d))))
-        row["ref_kernel_wall_s"] = _timed_chain(
-            f_ref, (qh, kh, vh), lambda o: o, repeats=repeats)
-        row["vs_ref_kernel"] = round(
-            row["ref_kernel_wall_s"] / row["flash_wall_s"], 2)
-    except Exception as e:  # bundled kernel absent/changed: not our row
-        row["ref_kernel_error"] = str(e)[:120]
+        sm = 1.0 / float(np.sqrt(d))
+        tuned = BlockSizes(
+            block_q=512, block_k_major=512, block_k=512, block_b=1,
+            block_q_major_dkv=512, block_k_major_dkv=512, block_k_dkv=512,
+            block_q_dkv=512, block_k_major_dq=512, block_k_dq=512,
+            block_q_dq=512)
+        yield "ref128", lambda q_, k_, v_: jax_flash(
+            q_, k_, v_, causal=True, sm_scale=sm)
+        yield "ref512", lambda q_, k_, v_: jax_flash(
+            q_, k_, v_, causal=True, sm_scale=sm, block_sizes=tuned)
+
+    rng = np.random.RandomState(0)
+    base = [(rng.randn(b, s, h, d) * 0.3).astype(np.float32)
+            for _ in range(3)]
+    for dt, tag in ((np.float32, "f32"), (jnp.bfloat16, "bf16")):
+        q, k, v = [jax.device_put(x.astype(dt)) for x in base]
+        # every competitor interleaved per round: ratios come from
+        # same-round deltas so minute-scale congestion windows cancel.
+        # The anchor runs each kernel on its NATIVE layout (bundled
+        # takes [B, H, S, D]; ours flat [BH, S, D] — the public
+        # wrapper's transposes are an API convenience both sides would
+        # equally pay), at BOTH the bundled default 128 blocks and its
+        # tuned 512 blocks; vs_ref_kernel* uses the tuned one.
+        qh, kh, vh = (jnp.transpose(t_, (0, 2, 1, 3))
+                      for t_ in (q, k, v))
+        # native layout for OUR kernel = [BH, S, 1, D]: the wrapper's
+        # head transpose degenerates to a bitcast, so both forward and
+        # the full custom-VJP backward run transpose-free
+        qn, kn, vn = (jnp.reshape(t_, (b * h, s, 1, d))
+                      for t_ in (qh, kh, vh))
+        targets = {
+            "flash": (fwd_step(flash_fn), (q, k, v)),
+            "dense": (fwd_step(dense_fn), (q, k, v)),
+            "flash_grad": (grad_step(flash_fn), (q, k, v)),
+            "dense_grad": (grad_step(dense_fn), (q, k, v)),
+            "flash_native": (fwd_step(flash_fn), (qn, kn, vn)),
+            "flash_native_grad": (grad_step(flash_fn), (qn, kn, vn)),
+        }
+        try:
+            for name, fn in ref_kernels():
+                targets[name] = (fwd_step(fn), (qh, kh, vh))
+                targets[name + "_grad"] = (grad_step(fn), (qh, kh, vh))
+        except Exception as e:  # bundled kernel absent/changed
+            row["ref_kernel_error"] = str(e)[:120]
+        best, rounds, errors = _delta_many(targets, reps=repeats)
+        if errors:
+            row.setdefault("target_errors", {}).update(
+                {f"{tag}_{n}": e for n, e in errors.items()})
+
+        def put_wall(key, name):
+            if best.get(name) is not None:
+                row[key] = round(best[name], 5)
+
+        def put_rate(prefix, flops, name):
+            if best.get(name) is not None:
+                row.update({f"{prefix}_{kk}": vv for kk, vv in
+                            _rate(flops, best[name], peak).items()})
+
+        put_wall(f"{tag}_flash_wall_s", "flash")
+        put_wall(f"{tag}_dense_wall_s", "dense")
+        row[f"{tag}_speedup"] = _ratio_of(rounds, "dense", "flash")
+        row[f"{tag}_grad_speedup"] = _ratio_of(rounds, "dense_grad",
+                                               "flash_grad")
+        put_rate(f"{tag}_flash", fwd_flops, "flash")
+        put_rate(f"{tag}_flash_grad", grad_flops, "flash_grad")
+        put_rate(f"{tag}_dense", fwd_flops, "dense")
+        if best.get("ref512") is not None and best.get("ref128") is not None:
+            put_wall(f"{tag}_flash_native_wall_s", "flash_native")
+            put_wall(f"{tag}_ref128_wall_s", "ref128")
+            put_wall(f"{tag}_ref512_wall_s", "ref512")
+            # ratio vs the anchor's best block size per round
+            ref_best = "ref512" if best["ref512"] <= best["ref128"] \
+                else "ref128"
+            row[f"{tag}_vs_ref_kernel"] = _ratio_of(
+                rounds, ref_best, "flash_native")
+            row[f"{tag}_vs_ref_kernel_grad"] = _ratio_of(
+                rounds, ref_best + "_grad", "flash_native_grad")
+            # what a training step pays: one forward + one backward
+            train = [(rf + rg) / (f_ + g_) for rf, rg, f_, g_ in zip(
+                rounds[ref_best], rounds[ref_best + "_grad"],
+                rounds["flash_native"], rounds["flash_native_grad"])
+                if min(rf, rg, f_, g_) > 0]
+            if train:
+                row[f"{tag}_vs_ref_kernel_train"] = round(
+                    statistics.median(train), 2)
+        row[f"max_abs_diff_{tag}"] = float(np.max(np.abs(
+            np.asarray(jax.jit(flash_fn)(q, k, v)).astype(np.float32)
+            - np.asarray(jax.jit(dense_fn)(q, k, v)).astype(np.float32))))
     # max-context probe: S=16384, [2,S,8,64] (distinct random q/k/v —
     # identical tensors would make the softmax degenerately peaked),
     # where dense would need a 17 GB score tensor — reported as an
     # achieved-TFLOP/s number, not a boolean (VERDICT r2 next #4)
     rng2 = np.random.RandomState(1)
     s2, b2 = 16384, 2
-    q2, k2, v2 = [jax.device_put(rng2.randn(b2, s2, h, d).astype(np.float32))
-                  for _ in range(3)]
-    f16k = jax.jit(lambda a, b_, c: fa.flash_attention(a, b_, c, True))
-    # the finiteness probe's ~67 MB fetch doubles as the warm call
-    out = np.asarray(f16k(q2, k2, v2))
-    row["s16384_ok"] = bool(np.isfinite(out).all())
-    row["s16384_wall_s"] = _timed_chain(
-        f16k, (q2, k2, v2), lambda o: o, repeats=repeats, n_disp=4,
-        warm=False)
-    row.update({"s16384_" + k: v
-                for k, v in _rate(_attn_flops(b2, s2, h, d, causal=True),
-                                  row["s16384_wall_s"], peak).items()})
+    probe_flops = _attn_flops(b2, s2, h, d, causal=True)
+    for dt, tag in ((np.float32, "f32"), (jnp.bfloat16, "bf16")):
+        try:
+            q2, k2, v2 = [jax.device_put(
+                (rng2.randn(b2, s2, h, d) * 0.3).astype(
+                    np.float32).astype(dt))
+                for _ in range(3)]
+            out = np.asarray(jax.jit(flash_fn)(q2, k2, v2)).astype(
+                np.float32)
+            row[f"s16384_{tag}_ok"] = bool(np.isfinite(out).all())
+            t16 = _delta_chain(fwd_step(flash_fn), (q2, k2, v2), n1=4,
+                               n2=20, reps=repeats)
+            row.update({f"s16384_{tag}_{kk}": vv for kk, vv in
+                        _rate(probe_flops, t16, peak).items()})
+            g16 = _delta_chain(grad_step(flash_fn), (q2, k2, v2), n1=4,
+                               n2=20, reps=repeats)
+            row.update({f"s16384_{tag}_grad_{kk}": vv for kk, vv in
+                        _rate(_attn_flops(b2, s2, h, d, True, grad=True),
+                              g16, peak).items()})
+        except Exception as e:  # a failed probe must not lose the row
+            row[f"s16384_{tag}_error"] = str(e)[:120]
     return row
 
 
@@ -514,6 +702,61 @@ def bench_transformer(seq: int = 1024, batch: int = 32, repeats: int = 3,
                     for kk, v in _rate(flops, step_s, peak).items()})
     row["speedup_flash_vs_dense"] = round(
         row["dense_step_time_ms"] / row["flash_step_time_ms"], 2)
+    return row
+
+
+def bench_transformer_wide(repeats: int = 3, d_model: int = 2048,
+                           n_heads: int = 16, blocks: int = 4,
+                           d_ff: int = 8192, seq: int = 512,
+                           batch: int = 64, spe: int = 4,
+                           epochs: int = 4):
+    """MXU-saturation evidence for the transformer FAMILY (VERDICT r3
+    next #1): a chip-filling configuration — d_model 2048, d_ff 8192,
+    heads at the full 128 systolic width, bf16 — through the real
+    training pipeline (optimizer step included), whole run compiled as
+    one executable and steady-state timed exactly like the mxu_wide
+    MLP row. Reports both attention backends; attention is ~1% of the
+    model FLOPs at S=512, so this row isolates 'can the family's
+    matmuls feed the MXU' from the kernel rows above."""
+    import numpy as np
+
+    from distributed_tensorflow_example_tpu.config import Config
+    from distributed_tensorflow_example_tpu.models import transformer as tfm
+    from distributed_tensorflow_example_tpu.parallel import epoch as epoch_lib
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.train.loop import make_spec
+
+    row = {"config": "transformer_wide",
+           "model": f"S={seq} d_model={d_model} blocks={blocks} "
+                    f"heads={n_heads} d_ff={d_ff} bf16",
+           "global_batch": batch}
+    peak = _chip_peak_flops()
+    mesh = mesh_lib.build_mesh(1, 1)
+    rng = np.random.RandomState(0)
+    n = batch * spe
+    images = rng.randint(0, 256, size=(n, 4 * seq)).astype(
+        np.float32) / np.float32(255.0)
+    labels = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
+    img_d, lbl_d, spe_ = epoch_lib.shard_dataset(mesh, images, labels, batch)
+    for backend in ("dense", "flash"):
+        cfg = Config(
+            model="transformer", attention=backend,
+            input_size=4 * seq, seq_len=seq, d_model=d_model,
+            n_heads=n_heads, num_blocks=blocks, d_ff=d_ff,
+            compute_dtype="bfloat16", optimizer="adam",
+            learning_rate=1e-3, batch_size=batch, dataset="synthetic",
+            summaries=False,
+        )
+        spec = make_spec(cfg)
+        step_s = _steady_state_step_time(cfg, spec, mesh, img_d, lbl_d,
+                                         spe_, epochs, repeats)
+        flops = tfm.flops_per_step(spec, batch)
+        row[f"{backend}_step_time_ms"] = round(step_s * 1000, 2)
+        row[f"{backend}_examples_per_sec"] = round(batch / step_s, 1)
+        row.update({f"{backend}_{kk}": v
+                    for kk, v in _rate(flops, step_s, peak).items()})
+    # the row's headline mfu = the better backend (feeds best_mfu)
+    row["mfu"] = max(row.get("dense_mfu", 0), row.get("flash_mfu", 0))
     return row
 
 
@@ -664,15 +907,14 @@ def bench_ring_flash(s: int = 4096, b: int = 2, h: int = 8, d: int = 64,
         for a, b_ in zip(gr, gf)))
 
     peak = _chip_peak_flops()
-    row["ring_wall_s"] = _timed_chain(
-        ring, (q, k, v), lambda o: o, repeats=repeats)
-    row["ring_grad_wall_s"] = _timed_chain(
-        ring_grad, (q, k, v), lambda o: o[0], repeats=repeats)
+    t_r = _delta_chain(_fwd_carry_step(smap), (q, k, v), reps=repeats)
+    t_g = _delta_chain(_grad_carry_step(smap), (q, k, v), reps=repeats)
+    row["ring_wall_s"] = round(t_r, 5)
+    row["ring_grad_wall_s"] = round(t_g, 5)
     row.update({"ring_" + kk: v for kk, v in _rate(
-        _attn_flops(b, s, h, d, True), row["ring_wall_s"], peak).items()})
+        _attn_flops(b, s, h, d, True), t_r, peak).items()})
     row.update({"ring_grad_" + kk: v for kk, v in _rate(
-        _attn_flops(b, s, h, d, True, grad=True),
-        row["ring_grad_wall_s"], peak).items()})
+        _attn_flops(b, s, h, d, True, grad=True), t_g, peak).items()})
     return row
 
 
@@ -803,6 +1045,7 @@ def main(argv=None) -> int:
         guarded("pallas_parity", bench_pallas_parity)
         guarded("flash_attention", bench_flash_attention)
         guarded("ring_flash", bench_ring_flash)
+        guarded("transformer_wide", bench_transformer_wide)
         guarded("transformer_flash_long_context", bench_transformer)
         guarded("moe_dispatch", bench_moe_dispatch)
         guarded("lm_next_token", bench_lm)
@@ -848,9 +1091,16 @@ def main(argv=None) -> int:
         extra["best_mfu_config"] = best["config"]
     flash_row = next(
         (r for r in rows if r.get("config") == "flash_attention"
-         and "s16384_tflops" in r), None)
+         and "s16384_bf16_tflops" in r), None)
     if flash_row:
-        extra["flash_s16384_tflops"] = flash_row["s16384_tflops"]
+        extra["flash_s16384_tflops"] = flash_row["s16384_bf16_tflops"]
+        if flash_row.get("bf16_vs_ref_kernel") is not None:
+            extra["flash_vs_ref_kernel"] = flash_row["bf16_vs_ref_kernel"]
+    wide_row = next(
+        (r for r in rows if r.get("config") == "transformer_wide"
+         and "mfu" in r), None)
+    if wide_row:
+        extra["transformer_wide_mfu"] = wide_row["mfu"]
 
     print(json.dumps({
         "metric": "mnist_20epoch_wall_clock",
