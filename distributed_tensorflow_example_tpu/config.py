@@ -133,6 +133,10 @@ class Config:
                                     # ('data','stage') mesh; each stage holds
                                     # num_blocks/N consecutive encoder blocks
     microbatches: int = 4           # GPipe microbatches per local batch
+    virtual_stages: int = 1         # >1: Megatron interleaved virtual
+                                    # stages — each pipeline stage holds
+                                    # this many non-contiguous block
+                                    # chunks; bubble shrinks ~v-fold
                                     # (pipeline_parallel > 1 only)
     expert_parallel: int = 1        # MoE transformer only: shard the expert
                                     # stacks over a ('data','expert') mesh
@@ -320,6 +324,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "('data','stage') mesh")
     p.add_argument("--microbatches", type=int, default=d.microbatches,
                    help="GPipe microbatches per local batch")
+    p.add_argument("--virtual_stages", type=int, default=d.virtual_stages,
+                   help="interleaved virtual stages per pipeline stage "
+                        "(>1 shrinks the pipeline bubble ~v-fold)")
     p.add_argument("--sequence_parallel", type=int, default=d.sequence_parallel,
                    help="transformer only: shard the token axis over a "
                         "('data','seq') mesh (--sp_impl selects the layout)")

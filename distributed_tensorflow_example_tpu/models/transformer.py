@@ -652,47 +652,74 @@ _BLOCK_LEAVES = ("ln1_g", "ln1_b", "Wqkv", "bqkv", "Wo", "bo",
                  "ln2_g", "ln2_b", "W1", "b1", "W2", "b2")
 
 
-def pipeline_stack_params(spec: TransformerSpec, params: Params) -> Params:
+def _pipeline_block_order(num_blocks: int, n_stages: int,
+                          virtual: int) -> list:
+    """Stacked-position -> logical-block map. virtual == 1: identity
+    (each stage's contiguous shard = its contiguous blocks, any stage
+    count dividing num_blocks). virtual > 1 (Megatron interleaved
+    stages): stage ``s`` executes chunks ``c*p + s`` (each chunk =
+    num_blocks/(p*v) consecutive logical blocks), so stacked position
+    ``s*K + c*k + i`` must hold logical block ``(c*p + s)*k + i`` —
+    the contiguous per-stage shard then contains stage s's v chunks in
+    execution order."""
+    if virtual <= 1:
+        return list(range(num_blocks))
+    k = num_blocks // (n_stages * virtual)
+    order = []
+    for s in range(n_stages):
+        for c in range(virtual):
+            j0 = (c * n_stages + s) * k
+            order.extend(range(j0, j0 + k))
+    return order
+
+
+def pipeline_stack_params(spec: TransformerSpec, params: Params,
+                          n_stages: int = 1, virtual: int = 1) -> Params:
     """Regroup the flat ``L{i}_*`` block leaves into stacked
     ``blk_*`` arrays with a leading ``[num_blocks, ...]`` dim — the
     layout pipeline parallelism shards ``P('stage')`` on (each stage
     holds its contiguous num_blocks/n_stages slice). Embed/head/final-
-    LN leaves stay replicated under their own names. Dense FFN only
+    LN leaves stay replicated under their own names. With
+    ``virtual > 1`` the stacking order is the interleaved permutation
+    (_pipeline_block_order), so checkpoints of interleaved runs are
+    restorable only at the same (n_stages, virtual). Dense FFN only
     (the driver guards MoE+PP; this guard covers library callers)."""
     if spec.num_experts:
         raise ValueError(
             "pipeline parallelism supports the dense FFN only "
             "(num_experts=0)")
-    if spec.objective == "lm":
-        raise ValueError(
-            "pipeline parallelism supports the classify objective only "
-            "(the lm head is per-position)")
     out = {k: v for k, v in params.items() if not k.startswith("L")}
+    order = _pipeline_block_order(spec.num_blocks, n_stages, virtual)
     for leaf in _BLOCK_LEAVES:
         out[f"blk_{leaf}"] = jnp.stack(
-            [params[f"L{i}_{leaf}"] for i in range(spec.num_blocks)])
+            [params[f"L{j}_{leaf}"] for j in order])
     return out
 
 
-def pipeline_unstack_params(spec: TransformerSpec, stacked: Params) -> Params:
-    """Inverse of pipeline_stack_params. Note checkpoints of PP runs
-    store the STACKED layout (stage-count-agnostic — any stage count
-    dividing num_blocks restores it — but NOT interchangeable with the
-    flat non-PP layout); this inverse serves tests and conversions."""
+def pipeline_unstack_params(spec: TransformerSpec, stacked: Params,
+                            n_stages: int = 1, virtual: int = 1) -> Params:
+    """Inverse of pipeline_stack_params (same (n_stages, virtual)).
+    Note checkpoints of PP runs store the STACKED layout — with
+    virtual == 1 stage-count-agnostic (any stage count dividing
+    num_blocks restores it), with virtual > 1 pinned to the run's
+    (n_stages, virtual) — and NOT interchangeable with the flat non-PP
+    layout; this inverse serves tests, sampling and conversions."""
     out = {k: v for k, v in stacked.items() if not k.startswith("blk_")}
+    order = _pipeline_block_order(spec.num_blocks, n_stages, virtual)
     for leaf in _BLOCK_LEAVES:
-        for i in range(spec.num_blocks):
-            out[f"L{i}_{leaf}"] = stacked[f"blk_{leaf}"][i]
+        for pos, j in enumerate(order):
+            out[f"L{j}_{leaf}"] = stacked[f"blk_{leaf}"][pos]
     return out
 
 
-def pipeline_train_state(spec: TransformerSpec, optimizer, state):
+def pipeline_train_state(spec: TransformerSpec, optimizer, state,
+                         n_stages: int = 1, virtual: int = 1):
     """Re-layout a freshly created TrainState for pipeline parallelism:
     stacked block params with optimizer slots initialized on the
     stacked layout — the one place the PP state shape is defined."""
     from ..train.state import TrainState
 
-    stacked = pipeline_stack_params(spec, state.params)
+    stacked = pipeline_stack_params(spec, state.params, n_stages, virtual)
     return TrainState(step=state.step, params=stacked,
                       opt_state=optimizer.init(stacked))
 
@@ -723,70 +750,153 @@ def pipeline_param_pspecs(spec: TransformerSpec, stage_axis: str,
 def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
                    stage_axis: str, n_stages: int,
                    num_microbatches: int,
-                   model_axis: str | None = None) -> jnp.ndarray:
-    """GPipe-style pipeline-parallel forward inside shard_map.
+                   model_axis: str | None = None,
+                   virtual: int = 1,
+                   head_fn=None, head_width: int | None = None
+                   ) -> jnp.ndarray:
+    """Pipeline-parallel forward inside shard_map: GPipe microbatch
+    schedule at ``virtual == 1``, Megatron interleaved virtual stages
+    at ``virtual > 1``.
 
-    ``params`` is the stacked layout (pipeline_stack_params) with the
-    block dim sharded over ``stage_axis`` — each stage holds
-    num_blocks/n_stages consecutive blocks, applied by a lax.scan.
-    The local batch splits into ``num_microbatches``; at tick t stage s
-    processes microbatch t-s, then hands its activations to stage s+1
-    with a single ppermute (neighbor ICI traffic on real slices; the
-    schedule runs M + n_stages - 1 ticks, the standard GPipe bubble).
-    Stage 0 embeds incoming microbatches; the LAST stage computes the
-    head, and the collected logits are shared with a psum so every
-    stage returns identical [B, num_classes] logits — the surrounding
-    loss/eval plumbing is unchanged. The backward pass is jax.grad
+    ``params`` is the stacked layout (pipeline_stack_params with the
+    same (n_stages, virtual)) with the block dim sharded over
+    ``stage_axis``: each stage holds ``virtual`` chunks of
+    num_blocks/(n_stages*virtual) consecutive logical blocks (stage s
+    owns chunks ``c*p + s``), applied per-tick by a lax.scan over the
+    chunk's blocks. The local batch splits into ``num_microbatches``;
+    at tick t stage s runs work-slot ``ts = t - s`` — chunk
+    ``c = (ts//p) % v``, microbatch ``m = (ts//(p*v))*p + ts%p`` — and
+    hands its activations to stage s+1 mod p with a single ppermute
+    (the wrap hop carries chunk c's output of the last stage into
+    chunk c+1 on stage 0 exactly one tick later, so one uniform
+    schedule covers both modes; at v=1 it degenerates to GPipe's
+    ``m = t - s``). Ticks = v*M + p - 1 of 1/v the per-stage work:
+    relative bubble = (p-1)/(v*M + p - 1), the interleaved schedule's
+    v-fold bubble shrink over GPipe at the price of v times the
+    ppermute traffic.
+
+    Stage 0 embeds microbatches entering chunk 0 (classify W_in or the
+    lm vocab-embedding lookup); the LAST stage of the LAST chunk runs
+    ``head_fn(params, h_out [mb, S, D], m) -> [mb, head_width]``
+    (default: pooled classify logits, head_width = num_classes — the
+    lm path passes its loss-statistics head from parallel/step so the
+    per-position [mb, S, V] logits are reduced to per-example numbers
+    ON the last stage instead of psum-broadcasting a vocab-wide
+    tensor). Collected values are psum-shared so every stage returns
+    an identical [B, head_width] array. The backward pass is jax.grad
     through this forward: shard_map transposes each ppermute into the
     reverse hop, which IS the reverse pipeline schedule.
     """
     cdt = spec.compute_dtype
     b = x.shape[0]
-    s, f, d = spec.seq_len, spec.d_feature, spec.d_model
-    m_cnt = num_microbatches
+    s, d = spec.seq_len, spec.d_model
+    p, v, m_cnt = n_stages, virtual, num_microbatches
     if b % m_cnt:
         raise ValueError(
             f"local batch {b} must divide into microbatches={m_cnt}")
+    if v < 1:
+        raise ValueError(f"virtual={v} must be >= 1")
+    if v > 1 and m_cnt % p:
+        raise ValueError(
+            f"interleaved stages need microbatches ({m_cnt}) divisible "
+            f"by n_stages ({p})")
+    if spec.num_blocks % (p * v):
+        raise ValueError(
+            f"num_blocks={spec.num_blocks} must divide over "
+            f"n_stages*virtual={p * v}")
     mb = b // m_cnt
     sidx = jax.lax.axis_index(stage_axis)
     act = _ACTIVATIONS[spec.activation]
-    micro = x.reshape(m_cnt, mb, s, f)
-    local_blocks = {k[len("blk_"):]: v for k, v in params.items()
-                    if k.startswith("blk_")}       # leaves [K, ...]
+    pos = params["pos"].astype(jnp.float32)
 
-    def run_local(h):
+    if spec.objective == "lm":
+        micro_t = tokenize(spec, x).reshape(m_cnt, mb, s)
+
+        def embed(m):
+            tok = jax.lax.dynamic_index_in_dim(micro_t, m, 0,
+                                               keepdims=False)
+            return params["W_emb"].astype(jnp.float32)[tok] + pos[None]
+    else:
+        micro = x.reshape(m_cnt, mb, s, spec.d_feature)
+
+        def embed(m):
+            x_t = jax.lax.dynamic_index_in_dim(
+                micro, m, 0, keepdims=False).astype(cdt)
+            return _mm(params, x_t, "W_in", "b_in", cdt) + pos[None]
+
+    if head_fn is None:
+        head_width = spec.num_classes
+
+        def head_fn(params_, h, m):
+            hl = _layer_norm(h, params_["lnf_g"], params_["lnf_b"])
+            return _mm(params_, jnp.mean(hl, axis=1), "W_head",
+                       "b_head", cdt)
+    elif head_width is None:
+        raise ValueError("custom head_fn needs an explicit head_width")
+
+    # local block leaves [K, ...] -> [v, K/v, ...]: chunk-major is the
+    # stacking order _pipeline_block_order fixed at conversion time
+    local_v = {k[len("blk_"):]: a.reshape(v, a.shape[0] // v,
+                                          *a.shape[1:])
+               for k, a in params.items() if k.startswith("blk_")}
+
+    def run_chunk(c, h):
+        bp_c = {k: jax.lax.dynamic_index_in_dim(a, c, 0, keepdims=False)
+                for k, a in local_v.items()}
+
         def body(h_, bp):
             h2_, _aux = _block_forward(spec, bp, h_, act, cdt,
                                        model_axis=model_axis)
             return h2_, None   # PP is dense-FFN only: aux always 0
 
-        h_, _ = jax.lax.scan(body, h, local_blocks)
+        h_, _ = jax.lax.scan(body, h, bp_c)
         return h_
 
-    pos = params["pos"].astype(jnp.float32)
-    perm = [(j, j + 1) for j in range(n_stages - 1)]
+    # full-circle ppermute only when the wrap hop is live (v > 1)
+    perm = ([(j, (j + 1) % p) for j in range(p)] if v > 1
+            else [(j, j + 1) for j in range(p - 1)])
     recv = jnp.zeros((mb, s, d), jnp.float32)
-    collected = jnp.zeros((m_cnt, mb, spec.num_classes), jnp.float32)
-    last = n_stages - 1
-    for t in range(m_cnt + n_stages - 1):
-        # stage 0 ingests microbatch t (t >= m_cnt re-embeds the final
-        # microbatch; those outputs can never reach the last stage
-        # within the schedule, so they are dead by construction)
-        x_t = micro[min(t, m_cnt - 1)].astype(cdt)
-        emb = _mm(params, x_t, "W_in", "b_in", cdt) + pos[None]
-        h_in = jnp.where(jnp.equal(sidx, 0), emb, recv)
-        h_out = run_local(h_in)
-        m = t - (n_stages - 1)
-        if 0 <= m < m_cnt:   # static schedule index
-            hl = _layer_norm(h_out, params["lnf_g"], params["lnf_b"])
-            logits_t = _mm(params, jnp.mean(hl, axis=1), "W_head",
-                           "b_head", cdt)
-            collected = collected.at[m].set(
-                jnp.where(jnp.equal(sidx, last), logits_t, 0.0))
-        if n_stages > 1 and t < m_cnt + n_stages - 2:
+    # the last stage's final-chunk activations, by microbatch; the
+    # head runs ONCE per microbatch after the tick loop rather than
+    # per tick — at the price of an [B, S, D] collection buffer, the
+    # lm head's [mb, S, V] vocab projection is never computed for a
+    # dead or masked slot (a per-tick lax.cond can't express the skip:
+    # its branches' manual-axes types differ under shard_map)
+    collected_h = jnp.zeros((m_cnt, mb, s, d), jnp.float32)
+    total = v * m_cnt
+    ticks = total + p - 1
+    for t in range(ticks):
+        ts = t - sidx
+        live = jnp.logical_and(ts >= 0, ts < total)
+        tsc = jnp.clip(ts, 0, total - 1)
+        g, r = tsc // p, tsc % p
+        c = (g % v).astype(jnp.int32)
+        m = ((g // v) * p + r).astype(jnp.int32)
+        # stage 0 ingests microbatch m into chunk 0; every other
+        # (stage, chunk) consumes the ppermuted activations (dead
+        # slots compute on stale values and are discarded by `live`)
+        h_in = jnp.where(
+            jnp.logical_and(jnp.equal(sidx, 0), jnp.equal(c, 0)),
+            embed(m), recv)
+        h_out = run_chunk(c, h_in)
+        live_head = jnp.logical_and(live, jnp.logical_and(
+            jnp.equal(sidx, p - 1), jnp.equal(c, v - 1)))
+        prev = jax.lax.dynamic_index_in_dim(collected_h, m, 0,
+                                            keepdims=False)
+        collected_h = jax.lax.dynamic_update_index_in_dim(
+            collected_h, jnp.where(live_head, h_out, prev), m, 0)
+        if p > 1 and t < ticks - 1:
             recv = jax.lax.ppermute(h_out, stage_axis, perm)
-    logits = jax.lax.psum(collected, stage_axis)
-    return logits.reshape(b, spec.num_classes).astype(jnp.float32)
+
+    def head_m(_, h_and_m):
+        h_m, m_i = h_and_m
+        return None, head_fn(params, h_m, m_i).astype(jnp.float32)
+
+    _, vals = jax.lax.scan(head_m, None,
+                           (collected_h, jnp.arange(m_cnt)))
+    vals = jnp.where(jnp.equal(sidx, p - 1), vals, 0.0)
+    out = jax.lax.psum(vals, stage_axis)
+    return out.reshape(b, head_width).astype(jnp.float32)
 
 
 def init_decode_cache(spec: TransformerSpec, batch: int) -> Params:

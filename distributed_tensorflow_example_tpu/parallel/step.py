@@ -77,10 +77,35 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
                 raise ValueError(
                     "MoE aux loss is not available on the pipeline "
                     "path (PP is dense-FFN only)")
-            stage_axis, n_stages, microbatches = pipeline
+            stage_axis, n_stages, microbatches, virtual = pipeline
+            if getattr(spec, "objective", "classify") == "lm":
+                # next-token loss statistics computed ON the last
+                # stage: two numbers per example ride the collective,
+                # never the [mb, S, V] logits (count is the static
+                # S-1). Returns [B, 2] = (nll_sum, correct_sum).
+                mb = x.shape[0] // microbatches
+                micro_t = transformer.tokenize(spec, x).reshape(
+                    microbatches, mb, spec.seq_len)
+
+                def lm_head(params_, h, m):
+                    hl = transformer._layer_norm(
+                        h, params_["lnf_g"], params_["lnf_b"])
+                    logits = transformer._mm(
+                        params_, hl, "W_head", "b_head",
+                        spec.compute_dtype).astype(jnp.float32)
+                    tok = jax.lax.dynamic_index_in_dim(
+                        micro_t, m, 0, keepdims=False)
+                    nll, correct, _cnt = _lm_stats(spec, logits, tok,
+                                                   None)
+                    return jnp.stack([nll, correct], axis=-1)
+
+                return transformer.apply_pipeline(
+                    spec, params, x, stage_axis, n_stages, microbatches,
+                    model_axis=model_axis, virtual=virtual,
+                    head_fn=lm_head, head_width=2)
             return transformer.apply_pipeline(
                 spec, params, x, stage_axis, n_stages, microbatches,
-                model_axis=model_axis)
+                model_axis=model_axis, virtual=virtual)
         return transformer.apply(spec, params, x, seq_axis=seq_axis,
                                  expert_axis=expert_axis,
                                  model_axis=model_axis,
@@ -168,6 +193,14 @@ def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas, remat=False,
         # self-supervised: y is unused; loss = mean next-token CE
         from ..models import transformer
 
+        if pipeline is not None:
+            # the pipeline forward already reduced the last stage's
+            # logits to per-example (nll_sum, correct_sum) [B, 2];
+            # every example counts its S-1 valid positions
+            count = jnp.float32(x.shape[0] * (spec.seq_len - 1))
+            cost = jnp.sum(logits[:, 0]) / count
+            acc = jnp.sum(logits[:, 1]) / count
+            return cost + aux_w * aux, (cost, acc)
         tokens = transformer.tokenize(spec, x)
         nll, correct, count = _lm_stats(spec, logits, tokens, seq_axis)
         cost = jnp.sum(nll) / jnp.sum(count)
@@ -391,7 +424,8 @@ def _pipeline_info(mesh, cfg, spec, optimizer=None):
     if not stage_axis:
         return None, None
     model_axis = mesh_lib.tp_axis(spec, mesh.shape.get(MODEL_AXIS, 1))
-    pipeline = (stage_axis, mesh.shape[stage_axis], cfg.microbatches)
+    pipeline = (stage_axis, mesh.shape[stage_axis], cfg.microbatches,
+                cfg.virtual_stages)
     if optimizer is not None:
         return pipeline, mesh_lib.pipeline_state_pspecs(
             spec, optimizer, stage_axis, model_axis)
@@ -449,10 +483,16 @@ def build_eval_step(cfg, mesh, spec: mlp.MLPSpec) -> Callable:
     batch_axes, _, x_spec, y_spec = batch_layout(mesh, spec)
 
     def shard_eval(params, x, y, mask):
-        logits = forward_local(spec, params, x, styles, cfg.pallas,
-                               seq_axis, expert_axis, pipeline,
-                               model_axis)
-        correct = _eval_correct(spec, logits, x, y, seq_axis)
+        out = forward_local(spec, params, x, styles, cfg.pallas,
+                            seq_axis, expert_axis, pipeline,
+                            model_axis)
+        if (pipeline is not None
+                and getattr(spec, "objective", "classify") == "lm"):
+            # out = per-example (nll_sum, correct_sum): the example's
+            # mean next-token accuracy over its S-1 positions
+            correct = out[:, 1] / jnp.float32(spec.seq_len - 1)
+        else:
+            correct = _eval_correct(spec, out, x, y, seq_axis)
         return jax.lax.psum(jnp.sum(correct * mask), batch_axes)
 
     fn = jax.shard_map(

@@ -170,12 +170,27 @@ def run(cfg: Config) -> Dict[str, Any]:
                 or cfg.sequence_parallel > 1 or cfg.expert_parallel > 1):
             raise ValueError("--pipeline_parallel composes with data "
                              "and tensor parallelism only")
+    if cfg.virtual_stages < 1:
+        raise ValueError(
+            f"virtual_stages={cfg.virtual_stages} must be >= 1")
+    if cfg.virtual_stages > 1:
+        if cfg.pipeline_parallel < 2:
+            raise ValueError("--virtual_stages > 1 needs "
+                             "--pipeline_parallel > 1 (nothing to "
+                             "interleave on one stage)")
+        if cfg.num_blocks % (cfg.pipeline_parallel * cfg.virtual_stages):
+            raise ValueError(
+                f"num_blocks={cfg.num_blocks} must divide evenly over "
+                f"pipeline_parallel*virtual_stages="
+                f"{cfg.pipeline_parallel * cfg.virtual_stages}")
+        if cfg.microbatches % cfg.pipeline_parallel:
+            raise ValueError(
+                f"interleaved stages need microbatches "
+                f"({cfg.microbatches}) divisible by pipeline_parallel "
+                f"({cfg.pipeline_parallel})")
     if cfg.objective == "lm":
         if cfg.model != "transformer":
             raise ValueError("--objective=lm requires --model=transformer")
-        if cfg.pipeline_parallel > 1:
-            raise ValueError("--objective=lm does not run on the "
-                             "pipeline path (its head is per-position)")
         if cfg.vocab_size < 2:
             raise ValueError(f"vocab_size={cfg.vocab_size} must be >= 2")
     if cfg.sample_after:
@@ -375,11 +390,17 @@ def run(cfg: Config) -> Dict[str, Any]:
         if pp_mode:
             # pipeline layout: block leaves stacked [num_blocks, ...]
             # and sharded over 'stage' (checkpoints keep this stacked
-            # layout — restorable at any stage count dividing
-            # num_blocks, but not interchangeable with non-PP runs)
+            # layout — with virtual_stages=1 restorable at any stage
+            # count dividing num_blocks; virtual_stages>1 permutes the
+            # stacking order, pinning the checkpoint to the same
+            # (stages, virtual) — validated on resume via the saved
+            # pp_stages/pp_virtual extras; never interchangeable with
+            # non-PP runs)
             from ..models import transformer as tfm_lib
 
-            state = tfm_lib.pipeline_train_state(spec, optimizer, state)
+            state = tfm_lib.pipeline_train_state(
+                spec, optimizer, state, cfg.pipeline_parallel,
+                cfg.virtual_stages)
             sspecs = mesh_lib.pipeline_state_pspecs(
                 spec, optimizer, mesh_lib.STAGE_AXIS,
                 mesh_lib.tp_axis(spec, cfg.model_parallel))
@@ -395,6 +416,23 @@ def run(cfg: Config) -> Dict[str, Any]:
     if cfg.resume and cfg.checkpoint_dir:
         path = ckpt_lib.latest_checkpoint(cfg.checkpoint_dir)
         if path:
+            if pp_mode:
+                # the stacked block ORDER is (stages, virtual)-pinned
+                # once virtual > 1 (pipeline_stack_params); shapes
+                # match across layouts, so a mismatch would restore
+                # silently permuted blocks — reject it instead
+                saved = ckpt_lib.load_extras(path)
+                sv = int(saved.get("pp_virtual", 1))
+                sp = int(saved.get("pp_stages", cfg.pipeline_parallel))
+                if (sv != cfg.virtual_stages
+                        or (sv > 1 and sp != cfg.pipeline_parallel)):
+                    raise ValueError(
+                        f"checkpoint {path} was written with pipeline "
+                        f"layout (stages={sp}, virtual={sv}): resuming "
+                        f"needs the same --virtual_stages (and the "
+                        f"same --pipeline_parallel when virtual > 1) — "
+                        f"the stacked block order is pinned to that "
+                        f"layout")
             if fsdp_mode:
                 # checkpoints keep the portable unsharded layout
                 full, _, start_epoch = ckpt_lib.restore_checkpoint(
@@ -525,10 +563,15 @@ def run(cfg: Config) -> Dict[str, Any]:
 
             to_save = fsdp_lib.unshard_state_host(to_save, full_template)
         if chief:
-            extras = ({"best_val": best_val, "val_wait": val_wait}
-                      if early else None)
+            extras = dict({"best_val": best_val, "val_wait": val_wait}
+                          if early else {})
+            if pp_mode:
+                # pin the stacked block order's layout (see the resume
+                # validation above)
+                extras.update(pp_stages=cfg.pipeline_parallel,
+                              pp_virtual=cfg.virtual_stages)
             ckpt_lib.save_checkpoint(cfg.checkpoint_dir, to_save, step,
-                                     resume_epoch, extras)
+                                     resume_epoch, extras or None)
             if cfg.keep_checkpoints:
                 ckpt_lib.prune_checkpoints(cfg.checkpoint_dir,
                                            cfg.keep_checkpoints)
@@ -816,6 +859,12 @@ def run(cfg: Config) -> Dict[str, Any]:
         n_s = min(cfg.sample_after, dataset.test.images.shape[0])
         if chief and n_s:
             host_params = jax.tree.map(np.asarray, sample_params)
+            if pp_mode:
+                # decode_step walks flat L{i}_* leaves: un-stack the
+                # pipeline layout (same (stages, virtual) as training)
+                host_params = tfm_lib.pipeline_unstack_params(
+                    spec, host_params, cfg.pipeline_parallel,
+                    cfg.virtual_stages)
             prompt_len = max(1, spec.seq_len // 8)
             prompts = tfm_lib.tokenize(
                 spec, dataset.test.images[:n_s])[:, :prompt_len]
