@@ -824,7 +824,8 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
                 micro, m, 0, keepdims=False).astype(cdt)
             return _mm(params, x_t, "W_in", "b_in", cdt) + pos[None]
 
-    if head_fn is None:
+    custom_head = head_fn is not None
+    if not custom_head:
         head_width = spec.num_classes
 
         def head_fn(params_, h, m):
@@ -856,13 +857,18 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
     perm = ([(j, (j + 1) % p) for j in range(p)] if v > 1
             else [(j, j + 1) for j in range(p - 1)])
     recv = jnp.zeros((mb, s, d), jnp.float32)
-    # the last stage's final-chunk activations, by microbatch; the
-    # head runs ONCE per microbatch after the tick loop rather than
-    # per tick — at the price of an [B, S, D] collection buffer, the
-    # lm head's [mb, S, V] vocab projection is never computed for a
-    # dead or masked slot (a per-tick lax.cond can't express the skip:
-    # its branches' manual-axes types differ under shard_map)
-    collected_h = jnp.zeros((m_cnt, mb, s, d), jnp.float32)
+    # Collection strategy by head kind: the cheap default classify
+    # head runs per tick into a tiny [M, mb, C] buffer; a CUSTOM head
+    # (the lm loss statistics, with an [mb, S, V] vocab projection
+    # inside) collects the last stage's final-chunk activations
+    # ([M, mb, S, D]) and runs ONCE per microbatch after the tick
+    # loop, so the expensive head is never computed for a dead or
+    # masked slot (a per-tick lax.cond can't express the skip: its
+    # branches' manual-axes types differ under shard_map).
+    if custom_head:
+        collected = jnp.zeros((m_cnt, mb, s, d), jnp.float32)
+    else:
+        collected = jnp.zeros((m_cnt, mb, head_width), jnp.float32)
     total = v * m_cnt
     ticks = total + p - 1
     for t in range(ticks):
@@ -881,20 +887,26 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
         h_out = run_chunk(c, h_in)
         live_head = jnp.logical_and(live, jnp.logical_and(
             jnp.equal(sidx, p - 1), jnp.equal(c, v - 1)))
-        prev = jax.lax.dynamic_index_in_dim(collected_h, m, 0,
+        val = (h_out if custom_head
+               else head_fn(params, h_out, m).astype(jnp.float32))
+        prev = jax.lax.dynamic_index_in_dim(collected, m, 0,
                                             keepdims=False)
-        collected_h = jax.lax.dynamic_update_index_in_dim(
-            collected_h, jnp.where(live_head, h_out, prev), m, 0)
+        collected = jax.lax.dynamic_update_index_in_dim(
+            collected, jnp.where(live_head, val, prev), m, 0)
         if p > 1 and t < ticks - 1:
             recv = jax.lax.ppermute(h_out, stage_axis, perm)
 
-    def head_m(_, h_and_m):
-        h_m, m_i = h_and_m
-        return None, head_fn(params, h_m, m_i).astype(jnp.float32)
+    if custom_head:
+        def head_m(_, h_and_m):
+            h_m, m_i = h_and_m
+            return None, head_fn(params, h_m, m_i).astype(jnp.float32)
 
-    _, vals = jax.lax.scan(head_m, None,
-                           (collected_h, jnp.arange(m_cnt)))
-    vals = jnp.where(jnp.equal(sidx, p - 1), vals, 0.0)
+        _, vals = jax.lax.scan(head_m, None,
+                               (collected, jnp.arange(m_cnt)))
+        # non-last stages ran the head on garbage zeros: mask them
+        vals = jnp.where(jnp.equal(sidx, p - 1), vals, 0.0)
+    else:
+        vals = collected   # live_head already zeroed other stages
     out = jax.lax.psum(vals, stage_axis)
     return out.reshape(b, head_width).astype(jnp.float32)
 

@@ -257,17 +257,16 @@ def build_fsdp_run_to_completion(
     shard update — parallel/fsdp.py) in the hot loop."""
     from . import fsdp as fsdp_lib
 
-    if mesh.shape[MODEL_AXIS] != 1:
-        raise ValueError("FSDP composes over the data axis; set model_parallel=1")
     key = ("fsdp_run", cfg, mesh, spec, optimizer.name, steps_per_epoch,
            num_epochs)
 
     def build():
         dp = mesh.shape[DATA_AXIS]
+        mp = mesh.shape.get(MODEL_AXIS, 1)
         step_body = fsdp_lib.make_fsdp_step_body(
-            cfg, spec, dp, optimizer, full_template
+            cfg, spec, dp, optimizer, full_template, mp
         )
-        sspecs = fsdp_lib.fsdp_specs(full_template)
+        sspecs = fsdp_lib.fsdp_specs(full_template, mp)
         return _build_scan_runner(
             mesh, sspecs, step_body, steps_per_epoch, num_epochs
         )

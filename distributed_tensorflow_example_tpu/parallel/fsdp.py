@@ -26,6 +26,21 @@ all-gather). Elementwise optimizers (SGD/momentum/Adam) commute with
 the flat partitioning, so the update each shard applies is exactly the
 full update restricted to its slice — verified against the 1-device
 step in tests/test_fsdp.py.
+
+FSDP x TP (``model_parallel > 1``, the standard 2D recipe): each leaf
+is FIRST Megatron-sharded over 'model' (the same PartitionSpecs the
+plain TP step uses), and each TP shard is then flattened to
+``[dp, chunk]`` — the stored layout is ``[mp, dp, chunk]`` sharded
+``P('model', 'data')``, every device holding 1/(dp*mp) of the
+TP-sharded leaves. The step's data-axis all-gather reconstructs the
+TP-LOCAL params, the forward runs with the ordinary Megatron
+``model_axis`` psums, and the backward needs NO model-axis gradient
+collective: TP-sharded leaves' grads are shard-local by construction,
+and TP-replicated leaves see replicated activations, so every model
+shard computes the identical gradient (the data-axis reduce-scatter
+then partitions it). TP-replicated leaves are stored once per model
+shard (duplicated content) — a few biases/norms, noise next to the
+sharded matrices.
 """
 
 from __future__ import annotations
@@ -41,7 +56,7 @@ from ..models import mlp
 from ..train.state import TrainState
 from . import mesh as mesh_lib
 from .mesh import DATA_AXIS, MODEL_AXIS
-from .step import _loss_and_acc
+from .step import _clip_sharded, _loss_and_acc
 
 
 def _is_sharded_leaf(a) -> bool:
@@ -51,12 +66,37 @@ def _is_sharded_leaf(a) -> bool:
     return np.ndim(a) >= 1 and jnp.issubdtype(jnp.result_type(a), jnp.floating)
 
 
-def shard_state_host(state: TrainState, dp: int) -> TrainState:
-    """Flatten + zero-pad + reshape every float leaf to [dp, chunk]."""
+def _tp_dim(sp) -> int | None:
+    """The dimension a PartitionSpec shards over 'model', or None."""
+    for i, part in enumerate(sp or ()):
+        parts = (part if isinstance(part, tuple)
+                 else (part,) if part is not None else ())
+        if MODEL_AXIS in parts:
+            return i
+    return None
 
-    def conv(a):
-        if not _is_sharded_leaf(a):
-            return a
+
+def _zip_specs(state, tp_specs):
+    """(leaves, matching spec leaves, treedef) — specs flattened with
+    P treated as a leaf (P is a tuple subclass, so a naive tree.map
+    would descend into it)."""
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    if tp_specs is None:
+        return leaves, [None] * len(leaves), treedef
+    sp_leaves = jax.tree_util.tree_leaves(
+        tp_specs, is_leaf=lambda x: isinstance(x, P))
+    return leaves, sp_leaves, treedef
+
+
+def shard_state_host(state: TrainState, dp: int, mp: int = 1,
+                     tp_specs=None) -> TrainState:
+    """Flatten + zero-pad + reshape every float leaf to [dp, chunk]
+    (mp == 1), or — FSDP x TP — split each leaf into its ``mp``
+    Megatron shards per ``tp_specs`` (replicated leaves duplicate) and
+    stack the per-shard flats to [mp, dp, chunk]."""
+    leaves, sp_leaves, treedef = _zip_specs(state, tp_specs)
+
+    def flat_chunks(a):
         flat = np.asarray(a).reshape(-1)
         chunk = -(-flat.size // dp)
         pad = chunk * dp - flat.size
@@ -64,36 +104,68 @@ def shard_state_host(state: TrainState, dp: int) -> TrainState:
             flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
         return flat.reshape(dp, chunk)
 
-    return jax.tree.map(conv, state)
+    def conv(a, sp):
+        if not _is_sharded_leaf(a):
+            return a
+        if mp <= 1:
+            return flat_chunks(a)
+        d = _tp_dim(sp)
+        shards = (np.split(np.asarray(a), mp, axis=d)
+                  if d is not None else [np.asarray(a)] * mp)
+        return np.stack([flat_chunks(s) for s in shards])
+
+    return jax.tree_util.tree_unflatten(
+        treedef, [conv(a, sp) for a, sp in zip(leaves, sp_leaves)])
 
 
-def unshard_state_host(state, template: TrainState) -> TrainState:
+def unshard_state_host(state, template: TrainState, mp: int = 1,
+                       tp_specs=None) -> TrainState:
     """Inverse of shard_state_host (host-side; used for checkpoints so
     the on-disk layout stays the portable unsharded one)."""
     state = jax.device_get(state)
+    s_leaves, _, _ = _zip_specs(state, None)
+    t_leaves, sp_leaves, treedef = _zip_specs(template, tp_specs)
 
-    def conv(s, t):
+    def conv(s, t, sp):
         if not _is_sharded_leaf(t):
             return np.asarray(s)
         t = np.asarray(t)
-        return np.asarray(s).reshape(-1)[: t.size].reshape(t.shape)
+        if mp <= 1:
+            return np.asarray(s).reshape(-1)[: t.size].reshape(t.shape)
+        s = np.asarray(s)                     # [mp, dp, chunk]
+        d = _tp_dim(sp)
+        if d is None:
+            # replicated under TP: every model shard holds the leaf
+            return s[0].reshape(-1)[: t.size].reshape(t.shape)
+        shard_shape = list(t.shape)
+        shard_shape[d] //= mp
+        size = int(np.prod(shard_shape))
+        return np.concatenate(
+            [s[i].reshape(-1)[:size].reshape(shard_shape)
+             for i in range(mp)], axis=d)
 
-    return jax.tree.map(conv, state, template)
+    return jax.tree_util.tree_unflatten(
+        treedef,
+        [conv(s, t, sp) for s, t, sp in zip(s_leaves, t_leaves, sp_leaves)])
 
 
-def fsdp_specs(template: TrainState) -> TrainState:
+def fsdp_specs(template: TrainState, mp: int = 1) -> TrainState:
     """PartitionSpec tree for the state: P('data') on the leading
-    [dp, chunk] dim of every float leaf, replicated otherwise. The
+    [dp, chunk] dim of every float leaf — P('model', 'data') on the
+    [mp, dp, chunk] FSDP x TP layout — replicated otherwise. The
     predicate depends only on dtype/ndim-class, so the template may be
     in either layout (full or sharded) — no copy is made."""
+    sharded = P(MODEL_AXIS, DATA_AXIS) if mp > 1 else P(DATA_AXIS)
     return jax.tree.map(
-        lambda a: P(DATA_AXIS) if _is_sharded_leaf(a) else P(), template
+        lambda a: sharded if _is_sharded_leaf(a) else P(), template
     )
 
 
-def _gather_full(leaf2d, shape):
-    """Inside shard_map: [1, chunk] local shard -> full [shape] params."""
-    flat = jax.lax.all_gather(leaf2d[0], DATA_AXIS, tiled=True)
+def _gather_full(leaf, shape):
+    """Inside shard_map: local [1, chunk] (or [1, 1, chunk]) shard ->
+    full [shape] (TP-local under FSDP x TP) params via one data-axis
+    all-gather."""
+    flat = jax.lax.all_gather(leaf.reshape(-1), DATA_AXIS, tiled=True)
     size = int(np.prod(shape))
     return flat[:size].reshape(shape)
 
@@ -108,34 +180,92 @@ def _scatter_grad(g, chunk: int, dp: int):
 
 
 def _unwrap(a):
-    """[1, chunk] local block -> [chunk] flat shard (pass ints through)."""
-    return a[0] if _is_sharded_leaf(a) else a
+    """Local [1, (1,) chunk] block -> [chunk] flat shard (ints pass)."""
+    return a.reshape(-1) if _is_sharded_leaf(a) else a
 
 
-def _rewrap(a):
-    return a[None] if _is_sharded_leaf(a) else a
+def _rewrap(a, like):
+    """[chunk] -> the local block's original rank ([1, chunk] or
+    [1, 1, chunk])."""
+    if not _is_sharded_leaf(a):
+        return a
+    return a.reshape((1,) * (np.ndim(like) - 1) + (-1,))
+
+
+def _tp_local_shapes(full_template: TrainState, mp: int, tp_specs):
+    """{param name: TP-local shape} — the full shape with the
+    model-sharded dim divided by mp."""
+    p_leaves, sp_leaves, _ = _zip_specs(
+        full_template.params, tp_specs.params if mp > 1 else None)
+    names = list(full_template.params)
+    out = {}
+    for k, a, sp in zip(names, p_leaves, sp_leaves):
+        shape = list(np.shape(a))
+        d = _tp_dim(sp) if mp > 1 else None
+        if d is not None:
+            shape[d] //= mp
+        out[k] = tuple(shape)
+    return out
 
 
 def make_fsdp_step_body(
-    cfg, spec: mlp.MLPSpec, dp: int, optimizer, full_template: TrainState
+    cfg, spec: mlp.MLPSpec, dp: int, optimizer, full_template: TrainState,
+    mp: int = 1,
 ) -> Callable:
     """The per-shard FSDP step body (state, x, y) -> (state, cost, acc)
     — shared by the host-fed step (build_fsdp_train_step) and the
     device-resident scan runner (parallel/epoch.py) so both train with
-    identical semantics. State leaves arrive as [1, chunk] local blocks."""
-    styles = mesh_lib.layer_styles(spec, 1)
-    shapes = {k: tuple(np.shape(v)) for k, v in full_template.params.items()}
+    identical semantics. State leaves arrive as [1, chunk] local blocks
+    ([1, 1, chunk] under FSDP x TP, where the gathered params are the
+    TP-local Megatron shards and the forward runs with model-axis
+    psums)."""
+    styles = mesh_lib.layer_styles(spec, mp)
+    model_axis = mesh_lib.tp_axis(spec, mp)
+    tp_specs = mesh_lib.state_pspecs(spec, optimizer, mp) if mp > 1 else None
+    shapes = _tp_local_shapes(full_template, mp, tp_specs)
+    # clip needs each leaf's square-sum psum'd over exactly the axes
+    # its shards partition: 'data' always (the [chunk] shards), plus
+    # 'model' for TP-sharded leaves (TP-replicated leaves hold the
+    # same values on every model shard — summing them would
+    # double-count)
+    if mp > 1:
+        p_sp = jax.tree_util.tree_leaves(
+            tp_specs.params, is_leaf=lambda x: isinstance(x, P))
+        tp_sharded_names = {
+            k for k, sp in zip(full_template.params, p_sp)
+            if _tp_dim(sp) is not None}
+        clip_specs = {
+            k: (P((DATA_AXIS, MODEL_AXIS)) if k in tp_sharded_names
+                else P(DATA_AXIS))
+            for k in full_template.params}
+    else:
+        tp_sharded_names = set()
+        clip_specs = {k: P(DATA_AXIS) for k in full_template.params}
 
     def shard_step(state: TrainState, x, y):
         params_full = {
             k: _gather_full(state.params[k], shapes[k]) for k in state.params
         }
+        if mp > 1:
+            # TP-replicated leaves arrive from model-VARYING storage
+            # (one stored copy per model shard). Re-establish their
+            # model-invariance with a pmean over bitwise-identical
+            # values: without it every activation — and the loss —
+            # would formally be mp independent per-shard copies, and
+            # the psum transposes would hand mixed 1x/mp-x cotangents
+            # down the residual stream (observed as exactly-2x grads
+            # on sharded leaves in the pure-chain MLP). With one
+            # provably-shared loss, autodiff is exactly the plain TP
+            # step's.
+            params_full = {
+                k: (v if k in tp_sharded_names
+                    else jax.lax.pmean(v, MODEL_AXIS))
+                for k, v in params_full.items()}
 
         def loss_fn(p):
-            from .mesh import DATA_AXIS
-
             return _loss_and_acc(
                 spec, p, x, y, styles, cfg.naive_ce, cfg.pallas, cfg.remat,
+                model_axis=model_axis,
                 aux_axes=(DATA_AXIS,),
                 label_smoothing=cfg.label_smoothing,
             )
@@ -143,28 +273,25 @@ def make_fsdp_step_body(
         (_total, (cost, acc)), grads_full = jax.value_and_grad(
             loss_fn, has_aux=True)(params_full)
         grads = {
-            k: _scatter_grad(grads_full[k], state.params[k].shape[1], dp)
+            k: _scatter_grad(grads_full[k], state.params[k].shape[-1], dp)
             for k in grads_full
         }
         if cfg.grad_reduce == "mean" and dp > 1:
             grads = jax.tree.map(lambda g: g / dp, grads)
         if cfg.grad_clip > 0:
-            # each shard holds a 1/dp chunk of every (reduced) grad:
-            # psum the square-sums for the global norm
-            from ..train.optim import clip_by_global_norm
-
-            grads, _ = clip_by_global_norm(grads, cfg.grad_clip,
-                                           (DATA_AXIS,))
+            grads = _clip_sharded(grads, clip_specs, cfg.grad_clip)
         local_p = jax.tree.map(_unwrap, state.params)
         local_o = jax.tree.map(_unwrap, state.opt_state)
         new_p, new_o = optimizer.update(grads, local_o, local_p)
+        # model-invariance of cost/acc is provable: the replicated-leaf
+        # pmean above made the loss one shared value per data shard
         cost = jax.lax.pmean(cost, DATA_AXIS)
         acc = jax.lax.pmean(acc, DATA_AXIS)
         return (
             TrainState(
                 state.step + 1,
-                jax.tree.map(_rewrap, new_p),
-                jax.tree.map(_rewrap, new_o),
+                jax.tree.map(_rewrap, new_p, state.params),
+                jax.tree.map(_rewrap, new_o, state.opt_state),
             ),
             cost,
             acc,
@@ -180,13 +307,13 @@ def build_fsdp_train_step(
 
     ``full_template`` supplies the unsharded leaf shapes (host arrays or
     ShapeDtypeStructs). State is donated; params never materialize
-    outside the step.
-    """
-    if mesh.shape[MODEL_AXIS] != 1:
-        raise ValueError("FSDP composes over the data axis; set model_parallel=1")
+    outside the step. On a ('data', 'model') mesh this is the 2D
+    FSDP x TP step (module docstring)."""
     dp = mesh.shape[DATA_AXIS]
-    sspecs = fsdp_specs(full_template)
-    shard_step = make_fsdp_step_body(cfg, spec, dp, optimizer, full_template)
+    mp = mesh.shape.get(MODEL_AXIS, 1)
+    sspecs = fsdp_specs(full_template, mp)
+    shard_step = make_fsdp_step_body(cfg, spec, dp, optimizer,
+                                     full_template, mp)
 
     fn = jax.shard_map(
         shard_step,
@@ -197,15 +324,42 @@ def build_fsdp_train_step(
     return jax.jit(fn, donate_argnums=0)
 
 
-def build_gather_params(mesh, full_template: TrainState) -> Callable:
+def build_gather_params(mesh, full_template: TrainState,
+                        spec=None) -> Callable:
     """jit'd (sharded_state) -> full replicated param pytree — one
-    all-gather per leaf; used for eval and checkpointing."""
+    data-axis all-gather per leaf (plus, under FSDP x TP, a model-axis
+    all-gather along each TP-sharded dim); used for eval and
+    checkpointing. ``spec`` (the model spec) is required when the mesh
+    carries a model axis, to derive the TP PartitionSpecs."""
+    mp = mesh.shape.get(MODEL_AXIS, 1)
     shapes = {k: tuple(np.shape(v)) for k, v in full_template.params.items()}
-    sspecs = fsdp_specs(full_template)
+    sspecs = fsdp_specs(full_template, mp)
     out_specs = {k: P() for k in shapes}
+    if mp > 1:
+        if spec is None:
+            raise ValueError("FSDP x TP gather needs the model spec to "
+                             "derive the TP PartitionSpecs")
+        p_sp = mesh_lib.param_pspecs(spec, mp)
+        tp_dims = {k: _tp_dim(p_sp[k]) for k in shapes}
+        local_shapes = {}
+        for k, shape in shapes.items():
+            shape = list(shape)
+            if tp_dims[k] is not None:
+                shape[tp_dims[k]] //= mp
+            local_shapes[k] = tuple(shape)
+    else:
+        tp_dims = {k: None for k in shapes}
+        local_shapes = shapes
 
     def shard_gather(state: TrainState):
-        return {k: _gather_full(state.params[k], shapes[k]) for k in state.params}
+        out = {}
+        for k in state.params:
+            loc = _gather_full(state.params[k], local_shapes[k])
+            if tp_dims[k] is not None:
+                loc = jax.lax.all_gather(loc, MODEL_AXIS,
+                                         axis=tp_dims[k], tiled=True)
+            out[k] = loc
+        return out
 
     # all_gather output is bitwise-identical on every shard, but the
     # varying-manual-axes checker cannot prove replication — disable it

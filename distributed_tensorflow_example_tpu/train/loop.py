@@ -138,8 +138,6 @@ def run(cfg: Config) -> Dict[str, Any]:
     # bad flag combination fails fast and never strands peer processes.
     if cfg.fsdp and cfg.sync_period > 1:
         raise ValueError("--fsdp requires the synchronous step (sync_period=1)")
-    if cfg.fsdp and cfg.model_parallel > 1:
-        raise ValueError("--fsdp composes over the data axis; set model_parallel=1")
     if cfg.sequence_parallel < 1:
         raise ValueError(
             f"sequence_parallel={cfg.sequence_parallel} must be >= 1")
@@ -364,7 +362,13 @@ def run(cfg: Config) -> Dict[str, Any]:
         from ..parallel import fsdp as fsdp_lib
 
         full_template = jax.tree.map(np.asarray, state)
-        state = fsdp_lib.shard_state_host(full_template, dp)
+        # FSDP x TP: each leaf Megatron-shards over 'model' first,
+        # then flattens over 'data' (fsdp_lib module docstring)
+        mp_f = mesh.shape.get(mesh_lib.MODEL_AXIS, 1)
+        fsdp_tp_specs = (mesh_lib.state_pspecs(spec, optimizer, mp_f)
+                         if mp_f > 1 else None)
+        state = fsdp_lib.shard_state_host(full_template, dp, mp_f,
+                                          fsdp_tp_specs)
         train_step = (
             None if fast
             else fsdp_lib.build_fsdp_train_step(
@@ -372,8 +376,9 @@ def run(cfg: Config) -> Dict[str, Any]:
             )
         )
         param_sync = None
-        get_params = fsdp_lib.build_gather_params(mesh, full_template)
-        sspecs = fsdp_lib.fsdp_specs(state)
+        get_params = fsdp_lib.build_gather_params(mesh, full_template,
+                                                  spec)
+        sspecs = fsdp_lib.fsdp_specs(state, mp_f)
     elif async_mode:
         state = step_lib.stack_state(state, dp)
         train_step = (
@@ -416,12 +421,13 @@ def run(cfg: Config) -> Dict[str, Any]:
     if cfg.resume and cfg.checkpoint_dir:
         path = ckpt_lib.latest_checkpoint(cfg.checkpoint_dir)
         if path:
+            resumed_extras = ckpt_lib.load_extras(path)
             if pp_mode:
                 # the stacked block ORDER is (stages, virtual)-pinned
                 # once virtual > 1 (pipeline_stack_params); shapes
                 # match across layouts, so a mismatch would restore
                 # silently permuted blocks — reject it instead
-                saved = ckpt_lib.load_extras(path)
+                saved = resumed_extras
                 sv = int(saved.get("pp_virtual", 1))
                 sp = int(saved.get("pp_stages", cfg.pipeline_parallel))
                 if (sv != cfg.virtual_stages
@@ -438,11 +444,11 @@ def run(cfg: Config) -> Dict[str, Any]:
                 full, _, start_epoch = ckpt_lib.restore_checkpoint(
                     path, full_template
                 )
-                state = fsdp_lib.shard_state_host(full, dp)
+                state = fsdp_lib.shard_state_host(full, dp, mp_f,
+                                                  fsdp_tp_specs)
             else:
                 state, _, start_epoch = ckpt_lib.restore_checkpoint(path, state)
             state = mesh_lib.place_state(state, mesh, sspecs)
-            resumed_extras = ckpt_lib.load_extras(path)
             print(f"Resumed from {path} at epoch {start_epoch}")
 
     writer = None
@@ -561,7 +567,8 @@ def run(cfg: Config) -> Dict[str, Any]:
         if fsdp_mode:
             from ..parallel import fsdp as fsdp_lib
 
-            to_save = fsdp_lib.unshard_state_host(to_save, full_template)
+            to_save = fsdp_lib.unshard_state_host(to_save, full_template,
+                                                  mp_f, fsdp_tp_specs)
         if chief:
             extras = dict({"best_val": best_val, "val_wait": val_wait}
                           if early else {})

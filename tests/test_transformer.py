@@ -1193,9 +1193,13 @@ def test_pp_interleaved_resume_layout_guard(devices8, tmp_path):
                    **kw))
 
 
-def test_pp_lm_driver_end_to_end(devices8):
+def test_pp_lm_driver_end_to_end(devices8, tmp_path):
     """--objective=lm x --pipeline_parallel x --virtual_stages through
-    the full driver: trains, evals next-token accuracy, and samples."""
+    the full driver: trains, evals next-token accuracy, and samples
+    (the sampling path un-stacks the pipeline layout at the run's
+    (stages, virtual))."""
+    import os
+
     from distributed_tensorflow_example_tpu.train.loop import run
 
     res = run(Config(
@@ -1206,11 +1210,18 @@ def test_pp_lm_driver_end_to_end(devices8):
         batch_size=32, learning_rate=0.003, optimizer="adam",
         synthetic_train_size=256, synthetic_test_size=64,
         summaries=False, compilation_cache="", frequency=4,
+        sample_after=2, logs_path=str(tmp_path / "logs"),
     ))
     assert res["devices"] == 8
     assert np.isfinite(res["final_cost"])
     # next-token accuracy above the 1/16 chance floor
     assert res["test_accuracy"] > 1.0 / 16
+    # the samples exist and are valid tokens of the run's vocab
+    with np.load(os.path.join(str(tmp_path / "logs"),
+                              "samples.npz")) as z:
+        samples = z["samples"]
+    assert samples.shape == (2, 32)
+    assert samples.min() >= 0 and samples.max() < 16
 
 
 def test_pp_checkpoint_resume(devices8, tmp_path):
