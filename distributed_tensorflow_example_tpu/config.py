@@ -197,6 +197,15 @@ class Config:
     checkpoint_every: int = 0       # steps; 0 = only at exit
     keep_checkpoints: int = 0       # retain only the N newest
                                     # checkpoints (0 = keep all)
+    sharded_checkpoints: bool = False  # each process writes only its
+                                    # addressable shards + a chief
+                                    # manifest (no allgather); restore
+                                    # reassembles, so the format is
+                                    # topology-agnostic
+    async_checkpoints: bool = False  # write shard files from a
+                                    # background thread (device->host
+                                    # fetches stay synchronous);
+                                    # requires --sharded_checkpoints
     resume: bool = False
 
     # ---- misc ----
@@ -365,6 +374,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--keep_checkpoints", type=int,
                    default=d.keep_checkpoints,
                    help="retain only the N newest checkpoints (0 = all)")
+    p.add_argument("--sharded_checkpoints", action="store_true",
+                   help="per-process shard files + chief manifest "
+                        "instead of the allgather-to-chief single .npz")
+    p.add_argument("--async_checkpoints", action="store_true",
+                   help="write checkpoint shard files from a "
+                        "background thread")
     p.add_argument("--checkpoint_every", type=int, default=d.checkpoint_every)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--eval_batch_size", type=int, default=d.eval_batch_size)

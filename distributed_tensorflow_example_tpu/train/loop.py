@@ -26,6 +26,7 @@ process (barrier-free, SURVEY.md §3.2). Two execution paths:
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict
 
@@ -227,6 +228,10 @@ def run(cfg: Config) -> Dict[str, Any]:
     if cfg.keep_checkpoints < 0:
         raise ValueError(
             f"keep_checkpoints={cfg.keep_checkpoints} must be >= 0")
+    if cfg.async_checkpoints and not cfg.sharded_checkpoints:
+        raise ValueError("--async_checkpoints requires "
+                         "--sharded_checkpoints (the portable single "
+                         "file is written by the chief synchronously)")
     if cfg.grad_accum < 1:
         raise ValueError(f"grad_accum={cfg.grad_accum} must be >= 1")
     if cfg.grad_accum > 1 and (cfg.fsdp or cfg.sync_period > 1):
@@ -439,7 +444,23 @@ def run(cfg: Config) -> Dict[str, Any]:
                         f"same --pipeline_parallel when virtual > 1) — "
                         f"the stacked block order is pinned to that "
                         f"layout")
-            if fsdp_mode:
+            if fsdp_mode and os.path.isdir(path):
+                # sharded-FSDP checkpoint: leaves are the SAVED run's
+                # flat [.., dp_old, chunk] layout — reassemble,
+                # un-flatten at the saved model-parallel degree, and
+                # re-lay-out for this run's (dp, mp)
+                raw, _, start_epoch = ckpt_lib.restore_sharded_arrays(
+                    path)
+                mp_old = int(resumed_extras.get("fsdp_mp", 1))
+                old_specs = (mesh_lib.state_pspecs(spec, optimizer,
+                                                   mp_old)
+                             if mp_old > 1 else None)
+                raw_state = ckpt_lib.rebuild_tree(raw, state)
+                full = fsdp_lib.unshard_state_host(
+                    raw_state, full_template, mp_old, old_specs)
+                state = fsdp_lib.shard_state_host(full, dp, mp_f,
+                                                  fsdp_tp_specs)
+            elif fsdp_mode:
                 # checkpoints keep the portable unsharded layout
                 full, _, start_epoch = ckpt_lib.restore_checkpoint(
                     path, full_template
@@ -555,10 +576,42 @@ def run(cfg: Config) -> Dict[str, Any]:
     cost = float("nan")
     examples_seen = 0
 
+    def _ckpt_extras() -> dict:
+        extras = dict({"best_val": best_val, "val_wait": val_wait}
+                      if early else {})
+        if pp_mode:
+            # pin the stacked block order's layout (see the resume
+            # validation above)
+            extras.update(pp_stages=cfg.pipeline_parallel,
+                          pp_virtual=cfg.virtual_stages)
+        if fsdp_mode and cfg.sharded_checkpoints:
+            # a sharded-FSDP checkpoint stores the flat [.., dp, chunk]
+            # layout; resume needs the model-parallel degree it was
+            # written at to un-flatten (dp itself is leaf-shape-evident)
+            extras.update(fsdp_mp=mp_f)
+        return extras
+
     def save_state(step: int, resume_epoch: int) -> None:
-        """Write a checkpoint. In multi-process runs state leaves may
-        span non-addressable devices; every process joins the allgather,
-        only the chief writes."""
+        """Write a checkpoint. Sharded mode: every process writes only
+        its addressable shards, the chief adds the manifest — no
+        cross-process gather anywhere, O(state/processes) host memory.
+        Portable single-file mode: in multi-process runs state leaves
+        may span non-addressable devices; every process joins the
+        allgather, only the chief writes."""
+        if cfg.sharded_checkpoints:
+            # FSDP saves its flat sharded layout AS IS (no host
+            # unshard): restore reassembles + re-lays-out. Pruning
+            # rides the completion callback so an async in-flight
+            # (still invisible) checkpoint is never miscounted.
+            prune = (
+                (lambda: ckpt_lib.prune_checkpoints(
+                    cfg.checkpoint_dir, cfg.keep_checkpoints))
+                if chief and cfg.keep_checkpoints else None)
+            ckpt_lib.save_checkpoint_sharded(
+                cfg.checkpoint_dir, state, step, resume_epoch,
+                _ckpt_extras() or None, async_=cfg.async_checkpoints,
+                on_complete=prune)
+            return
         to_save = state
         if proc_cnt > 1:
             from jax.experimental import multihost_utils
@@ -570,15 +623,8 @@ def run(cfg: Config) -> Dict[str, Any]:
             to_save = fsdp_lib.unshard_state_host(to_save, full_template,
                                                   mp_f, fsdp_tp_specs)
         if chief:
-            extras = dict({"best_val": best_val, "val_wait": val_wait}
-                          if early else {})
-            if pp_mode:
-                # pin the stacked block order's layout (see the resume
-                # validation above)
-                extras.update(pp_stages=cfg.pipeline_parallel,
-                              pp_virtual=cfg.virtual_stages)
             ckpt_lib.save_checkpoint(cfg.checkpoint_dir, to_save, step,
-                                     resume_epoch, extras or None)
+                                     resume_epoch, _ckpt_extras() or None)
             if cfg.keep_checkpoints:
                 ckpt_lib.prune_checkpoints(cfg.checkpoint_dir,
                                            cfg.keep_checkpoints)
@@ -849,8 +895,6 @@ def run(cfg: Config) -> Dict[str, Any]:
         # the classify objective has nothing to sample). EVERY process
         # joins the collective param fetch/gather — only the write is
         # chief-only (gating the collective would deadlock the others).
-        import os
-
         from ..models import transformer as tfm_lib
 
         sample_params = (
@@ -888,6 +932,8 @@ def run(cfg: Config) -> Dict[str, Any]:
 
     if cfg.checkpoint_dir:
         save_state(int(state.step), cfg.training_epochs)
+        # a background checkpoint writer must finish before exit
+        ckpt_lib.wait_for_pending_saves()
     if writer is not None:
         writer.close()
 

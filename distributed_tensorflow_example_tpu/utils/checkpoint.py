@@ -12,25 +12,56 @@ the chief saves the full train-state pytree + step + epoch every
 ``--checkpoint_every`` steps and at exit; ``--resume`` restores and
 continues. Format: a single ``.npz`` holding each leaf under its
 tree-path name — readable anywhere numpy is.
+
+Two on-disk formats:
+
+- **Portable single file** ``ckpt-N.npz`` (default): the full
+  unsharded tree, written by the chief. In multi-process runs this
+  costs a ``process_allgather`` of the whole state onto every host —
+  fine at MNIST scale, the wrong shape once params outgrow a host.
+- **Sharded directory** ``ckpt-N.shards/`` (``--sharded_checkpoints``):
+  every process writes ONLY its addressable replica-0 device shards to
+  ``proc-NNNNN.npz`` (each entry = the shard's data plus its global
+  index), the chief writes ``manifest.json`` naming the expected shard
+  files — no cross-process gather anywhere. A checkpoint is complete
+  iff the manifest AND every file it names exist (all writes are
+  atomic tmp+rename), so a SIGKILL mid-save leaves an ignorable
+  partial directory, never a corrupt resumable one. Restore
+  reassembles full leaves host-side from the shard indices — which
+  makes the on-disk format topology-agnostic: a run saved at one
+  (dp, mp, ...) resumes at another, because reassembly recovers the
+  logical arrays and placement re-shards them. With
+  ``--async_checkpoints`` the device->host fetches stay synchronous
+  but the file writes move to a background thread
+  (``wait_for_pending_saves`` joins it).
 """
 
 from __future__ import annotations
 
+import json
 import os
 import re
+import shutil
+import threading
 from typing import Any, Tuple
 
 import jax
 import numpy as np
 
 
+def _tree_key(path) -> str:
+    """The one tree-path -> key-string rule every reader/writer shares."""
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                    for p in path)
+
+
+def _flatten_with_keys(tree: Any):
+    return [(_tree_key(path), leaf)
+            for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]]
+
+
 def _flatten(tree: Any):
-    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
-    out = {}
-    for path, leaf in leaves_with_paths:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
-        out[key] = np.asarray(leaf)
-    return out
+    return {k: np.asarray(v) for k, v in _flatten_with_keys(tree)}
 
 
 def save_checkpoint(ckpt_dir: str, state: Any, step: int, epoch: int,
@@ -55,7 +86,11 @@ def save_checkpoint(ckpt_dir: str, state: Any, step: int, epoch: int,
 
 def load_extras(path: str) -> dict:
     """The ``extras`` scalars a checkpoint carries (empty for
-    checkpoints written before the field existed)."""
+    checkpoints written before the field existed). Works on both
+    formats."""
+    if os.path.isdir(path):
+        with open(os.path.join(path, "manifest.json")) as f:
+            return dict(json.load(f).get("extras", {}))
     out = {}
     with np.load(path) as z:
         for k in z.files:
@@ -65,16 +100,36 @@ def load_extras(path: str) -> dict:
     return out
 
 
+def _sharded_complete(path: str) -> bool:
+    """A sharded checkpoint dir is complete iff its manifest exists and
+    names only files that exist."""
+    man = os.path.join(path, "manifest.json")
+    if not os.path.isfile(man):
+        return False
+    try:
+        with open(man) as f:
+            manifest = json.load(f)
+        return all(os.path.isfile(os.path.join(path, name))
+                   for name in manifest["files"])
+    except (OSError, ValueError, KeyError):
+        return False
+
+
 def _list_checkpoints(ckpt_dir: str) -> list[tuple[int, str]]:
-    """(step, filename) for every completed checkpoint, step-sorted —
-    the one filename-format scan prune and resume share (atomic-rename
-    temp files never match)."""
+    """(step, filename) for every completed checkpoint (single-file or
+    complete sharded dir), step-sorted — the one filename-format scan
+    prune and resume share (atomic-rename temp files never match;
+    incomplete sharded dirs — killed mid-save — never list)."""
     if not os.path.isdir(ckpt_dir):
         return []
     found = []
     for name in os.listdir(ckpt_dir):
         m = re.fullmatch(r"ckpt-(\d+)\.npz", name)
         if m:
+            found.append((int(m.group(1)), name))
+            continue
+        m = re.fullmatch(r"ckpt-(\d+)\.shards", name)
+        if m and _sharded_complete(os.path.join(ckpt_dir, name)):
             found.append((int(m.group(1)), name))
     return sorted(found)
 
@@ -87,7 +142,10 @@ def prune_checkpoints(ckpt_dir: str, keep: int) -> list[str]:
     deleted = []
     for _, name in _list_checkpoints(ckpt_dir)[:-keep]:
         path = os.path.join(ckpt_dir, name)
-        os.remove(path)
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        else:
+            os.remove(path)
         deleted.append(path)
     return deleted
 
@@ -97,35 +155,208 @@ def latest_checkpoint(ckpt_dir: str) -> str | None:
     return os.path.join(ckpt_dir, found[-1][1]) if found else None
 
 
+# ---------------------------------------------------------------------------
+# Sharded format (see module docstring)
+# ---------------------------------------------------------------------------
+
+_PENDING_SAVES: list[threading.Thread] = []
+
+
+def wait_for_pending_saves() -> None:
+    """Join any background checkpoint writers (--async_checkpoints).
+    Called before starting the next save and at run exit, so at most
+    one write is ever in flight and the process never exits with a
+    half-written shard file pending. A writer that FAILED re-raises
+    here — a checkpoint that silently failed to write must not look
+    like a durable one."""
+    while _PENDING_SAVES:
+        t = _PENDING_SAVES.pop()
+        t.join()
+        err = getattr(t, "error", None)
+        if err is not None:
+            raise RuntimeError(
+                f"background checkpoint write failed: {err!r}") from err
+
+
+def _local_shards(leaf):
+    """[(index_bounds, host_array)] for this process's replica-0 device
+    shards of ``leaf`` (host/numpy leaves: one full shard on the chief
+    only — they are replicated by construction). index_bounds is an
+    int array [[start, stop] per dim] resolved against the global
+    shape; the device->host copy happens HERE (synchronously), so an
+    async writer thread touches only host memory."""
+    if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+        out = []
+        for sh in leaf.addressable_shards:
+            if sh.replica_id != 0:
+                continue  # another device holds the identical copy
+            bounds = np.asarray(
+                [[0 if sl.start is None else sl.start,
+                  dim if sl.stop is None else sl.stop]
+                 for sl, dim in zip(sh.index, leaf.shape)], np.int64)
+            if bounds.size == 0:  # scalar leaf
+                bounds = np.zeros((0, 2), np.int64)
+            out.append((bounds, np.asarray(sh.data)))
+        return out
+    if jax.process_index() != 0:
+        return []
+    a = np.asarray(leaf)
+    bounds = np.asarray([[0, d] for d in a.shape], np.int64)
+    if bounds.size == 0:
+        bounds = np.zeros((0, 2), np.int64)
+    return [(bounds, a)]
+
+
+def save_checkpoint_sharded(ckpt_dir: str, state: Any, step: int,
+                            epoch: int, extras: dict | None = None,
+                            async_: bool = False,
+                            on_complete=None) -> str:
+    """Every process calls this; no cross-process collective runs.
+    Each process writes its shard file atomically; the chief also
+    writes the manifest (naming every expected shard file, so the
+    checkpoint only becomes visible to ``latest_checkpoint`` once all
+    processes have finished). ``on_complete`` (e.g. retention pruning)
+    runs after this process's write lands — in the writer thread under
+    ``async_``, so pruning never counts a checkpoint that is still
+    invisible. Returns the checkpoint directory."""
+    wait_for_pending_saves()
+    path = os.path.join(ckpt_dir, f"ckpt-{step:08d}.shards")
+    os.makedirs(path, exist_ok=True)
+    proc = jax.process_index()
+    nprocs = jax.process_count()
+
+    import jax.numpy as jnp
+
+    payload = {}
+    leaves = _flatten_with_keys(state)
+    shapes = {}
+    for key, leaf in leaves:
+        shapes[key] = (list(np.shape(leaf)),
+                       np.dtype(jnp.result_type(leaf)).name)
+        for j, (bounds, data) in enumerate(_local_shards(leaf)):
+            payload[f"{key}§{j}"] = data
+            payload[f"{key}§{j}§idx"] = bounds
+
+    fname = f"proc-{proc:05d}.npz"
+
+    def write():
+        tmp = os.path.join(path, fname + f".tmp{os.getpid()}.npz")
+        with open(tmp, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, os.path.join(path, fname))
+        if proc == 0:
+            manifest = {
+                "step": int(step), "epoch": int(epoch),
+                "extras": {k: float(v) for k, v in (extras or {}).items()},
+                "nprocs": int(nprocs),
+                "files": [f"proc-{i:05d}.npz" for i in range(nprocs)],
+                "leaves": {k: {"shape": s, "dtype": d}
+                           for k, (s, d) in shapes.items()},
+            }
+            mtmp = os.path.join(path, f"manifest.tmp{os.getpid()}.json")
+            with open(mtmp, "w") as f:
+                json.dump(manifest, f)
+            os.replace(mtmp, os.path.join(path, "manifest.json"))
+        if on_complete is not None:
+            on_complete()
+
+    if async_:
+        def guarded():
+            try:
+                write()
+            except BaseException as e:  # surfaced by wait_for_pending
+                t.error = e
+
+        t = threading.Thread(target=guarded, daemon=False,
+                             name=f"ckpt-writer-{step}")
+        t.error = None
+        t.start()
+        _PENDING_SAVES.append(t)
+    else:
+        write()
+    return path
+
+
+def restore_sharded_arrays(path: str) -> Tuple[dict, int, int]:
+    """Reassemble a sharded checkpoint into full host arrays:
+    ({tree-path key: np.ndarray}, step, epoch). Topology-agnostic —
+    shard indices recorded at save time place each piece regardless of
+    how many processes/devices wrote them."""
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    data = {k: np.zeros(tuple(v["shape"]), np.dtype(v["dtype"]))
+            for k, v in manifest["leaves"].items()}
+    filled = {k: 0 for k in data}
+    for name in manifest["files"]:
+        with np.load(os.path.join(path, name)) as z:
+            for entry in z.files:
+                if entry.endswith("§idx"):
+                    continue
+                key, _j = entry.rsplit("§", 1)
+                bounds = z[entry + "§idx"]
+                idx = tuple(slice(int(a), int(b)) for a, b in bounds)
+                data[key][idx] = z[entry]
+                filled[key] += int(z[entry].size)
+    missing = [k for k, n in filled.items() if n < data[k].size]
+    if missing:
+        raise ValueError(
+            f"sharded checkpoint {path} does not cover leaves "
+            f"{missing[:5]} — saved by an incompatible writer?")
+    return data, int(manifest["step"]), int(manifest["epoch"])
+
+
+def _rebuild(data: dict, template: Any, validate: bool,
+             ckpt_path: str = "<data>"):
+    """Key-matched unflatten of ``data`` into the template's tree
+    structure. ``validate=False`` skips shape checks — the
+    sharded-FSDP resume path, where the saved flat layout's shapes
+    (old dp/mp) legitimately differ from the new run's template."""
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(
+        template)
+    new_leaves = []
+    for path_, leaf in leaves_with_paths:
+        key = _tree_key(path_)
+        if key not in data:
+            raise KeyError(f"checkpoint {ckpt_path} missing leaf {key!r}")
+        arr = data[key]
+        want = tuple(np.shape(leaf))
+        if validate:
+            if arr.shape != want and arr.size == np.size(leaf) \
+                    and key.endswith("qkv"):
+                # migration: transformer qkv leaves changed layout from
+                # (d, 3d)/(3d,) to (d, 3, d)/(3, d) when Megatron TP
+                # landed; the flat row-major order is identical (q|k|v
+                # column blocks), so old checkpoints restore by reshape
+                arr = arr.reshape(want)
+            if arr.shape != want:
+                raise ValueError(
+                    f"checkpoint leaf {key!r} shape {arr.shape} != "
+                    f"expected {want}")
+        new_leaves.append(arr.astype(np.asarray(leaf).dtype))
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def rebuild_tree(data: dict, template: Any):
+    """Key-matched unflatten WITHOUT shape validation (see _rebuild)."""
+    return _rebuild(data, template, validate=False)
+
+
 def restore_checkpoint(path: str, state_template: Any) -> Tuple[Any, int, int]:
     """Restore into the template's tree structure; returns (state, step, epoch).
 
     Leaves are matched by tree path, so the checkpoint survives
     refactors that keep param names stable (W1/b1/..., SURVEY.md §5).
+    Dispatches on the on-disk format: a ``.shards`` directory is
+    reassembled to full leaves first (restore_sharded_arrays), so both
+    formats restore into the same template — and a sharded checkpoint
+    written at one process/device topology restores at any other.
     """
-    with np.load(path) as z:
-        data = {k: z[k] for k in z.files}
-    step = int(data.pop("__step__"))
-    epoch = int(data.pop("__epoch__"))
-    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(state_template)
-    new_leaves = []
-    for path_, leaf in leaves_with_paths:
-        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path_)
-        if key not in data:
-            raise KeyError(f"checkpoint {path} missing leaf {key!r}")
-        arr = data[key]
-        want = tuple(np.shape(leaf))
-        if arr.shape != want and arr.size == np.size(leaf) \
-                and key.endswith("qkv"):
-            # migration: transformer qkv leaves changed layout from
-            # (d, 3d)/(3d,) to (d, 3, d)/(3, d) when Megatron TP
-            # landed; the flat row-major order is identical (q|k|v
-            # column blocks), so old checkpoints restore by reshape
-            arr = arr.reshape(want)
-        if arr.shape != want:
-            raise ValueError(
-                f"checkpoint leaf {key!r} shape {arr.shape} != expected {want}"
-            )
-        new_leaves.append(arr.astype(np.asarray(leaf).dtype))
-    state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    if os.path.isdir(path):
+        data, step, epoch = restore_sharded_arrays(path)
+    else:
+        with np.load(path) as z:
+            data = {k: z[k] for k in z.files}
+        step = int(data.pop("__step__"))
+        epoch = int(data.pop("__epoch__"))
+    state = _rebuild(data, state_template, validate=True, ckpt_path=path)
     return state, step, epoch

@@ -129,3 +129,131 @@ def test_driver_keeps_last_n(tmp_path):
     names = sorted(n for n in os.listdir(ckpt)
                    if re.fullmatch(r"ckpt-\d+\.npz", n))
     assert len(names) == 2, names
+
+
+# ---------------------------------------------------------------------------
+# Sharded format (--sharded_checkpoints)
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_roundtrip_tp_mesh(devices8, tmp_path):
+    """Save from a DP4xTP2-placed state with NO gather (each process
+    writes its replica-0 device shards), reassemble on restore, and be
+    invisible to latest_checkpoint until the manifest names only
+    existing files."""
+    import os
+
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+
+    spec = MLPSpec(input_size=16, hidden_sizes=(12, 8), num_classes=4)
+    opt = make_optimizer(Config(optimizer="adam"))
+    state = create_train_state(jax.random.PRNGKey(5), spec, opt)
+    host = jax.tree.map(np.asarray, state)
+    mesh = mesh_lib.build_mesh(4, 2)
+    placed = mesh_lib.place_state(state, mesh,
+                                  mesh_lib.state_pspecs(spec, opt, 2))
+    path = C.save_checkpoint_sharded(str(tmp_path), placed, step=7,
+                                     epoch=1, extras={"best_val": 0.5})
+    assert path.endswith("ckpt-00000007.shards")
+    assert C.latest_checkpoint(str(tmp_path)) == path
+    assert C.load_extras(path) == {"best_val": 0.5}
+    restored, step, epoch = C.restore_checkpoint(path, host)
+    assert (step, epoch) == (7, 1)
+    for k in host.params:
+        np.testing.assert_array_equal(np.asarray(host.params[k]),
+                                      np.asarray(restored.params[k]))
+    # an incomplete checkpoint (manifest naming a missing file) is
+    # skipped by latest_checkpoint
+    import json
+
+    man = os.path.join(path, "manifest.json")
+    with open(man) as f:
+        m = json.load(f)
+    m["files"].append("proc-00099.npz")
+    with open(man, "w") as f:
+        json.dump(m, f)
+    assert C.latest_checkpoint(str(tmp_path)) is None
+
+
+def test_sharded_prune_removes_dirs(devices8, tmp_path):
+    opt = make_optimizer(Config())
+    state = create_train_state(jax.random.PRNGKey(0), SPEC, opt)
+    for s in (10, 20, 30):
+        C.save_checkpoint_sharded(str(tmp_path), state, step=s, epoch=0)
+    C.prune_checkpoints(str(tmp_path), keep=1)
+    import os
+
+    assert sorted(os.listdir(str(tmp_path))) == ["ckpt-00000030.shards"]
+
+
+def test_sharded_resume_across_dp_change(devices8, tmp_path):
+    """A run saved at dp=8 resumes at dp=4: restore reassembles the
+    logical arrays, placement re-shards them (VERDICT r3 next #6)."""
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    kw = dict(
+        batch_size=64, learning_rate=0.05, optimizer="adam",
+        hidden_sizes=(16,), dataset="synthetic",
+        synthetic_train_size=512, synthetic_test_size=128,
+        summaries=False, compilation_cache="", frequency=4,
+        checkpoint_dir=str(tmp_path), sharded_checkpoints=True,
+    )
+    res = run(Config(training_epochs=1, data_parallel=8, **kw))
+    assert res["steps"] == 8
+    import os
+
+    assert any(n.endswith(".shards") for n in os.listdir(str(tmp_path)))
+    assert not any(n.endswith(".npz") for n in os.listdir(str(tmp_path)))
+    res2 = run(Config(training_epochs=2, data_parallel=4, resume=True,
+                      **kw))
+    assert res2["steps"] == 16
+
+
+def test_sharded_fsdp_resume_across_dp_change(devices8, tmp_path):
+    """FSDP + sharded checkpoints: the flat [dp, chunk] layout is saved
+    as-is (no host unshard in the save path) and re-laid-out on resume
+    at a DIFFERENT dp."""
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    kw = dict(
+        batch_size=64, learning_rate=0.05, optimizer="adam",
+        hidden_sizes=(16,), fsdp=True, dataset="synthetic",
+        synthetic_train_size=512, synthetic_test_size=128,
+        summaries=False, compilation_cache="", frequency=4,
+        checkpoint_dir=str(tmp_path), sharded_checkpoints=True,
+    )
+    res = run(Config(training_epochs=1, data_parallel=8, **kw))
+    assert res["steps"] == 8
+    res2 = run(Config(training_epochs=2, data_parallel=4, resume=True,
+                      **kw))
+    assert res2["steps"] == 16
+    assert np.isfinite(res2["final_cost"])
+
+
+def test_async_sharded_save(devices8, tmp_path):
+    """--async_checkpoints: the write happens on a background thread;
+    wait_for_pending_saves makes it durable before the run returns."""
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    kw = dict(
+        batch_size=64, learning_rate=0.05, optimizer="adam",
+        hidden_sizes=(16,), dataset="synthetic",
+        synthetic_train_size=512, synthetic_test_size=128,
+        summaries=False, compilation_cache="", frequency=4,
+        checkpoint_dir=str(tmp_path), sharded_checkpoints=True,
+        async_checkpoints=True, data_parallel=8,
+    )
+    res = run(Config(training_epochs=1, **kw))
+    assert res["steps"] == 8
+    assert C.latest_checkpoint(str(tmp_path)) is not None
+    res2 = run(Config(training_epochs=2, resume=True, **kw))
+    assert res2["steps"] == 16
+
+
+def test_async_requires_sharded():
+    import pytest
+
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    with pytest.raises(ValueError, match="sharded_checkpoints"):
+        run(Config(async_checkpoints=True, checkpoint_dir="/tmp/x"))
