@@ -152,7 +152,16 @@ class Config:
                                     # ops/ulysses_attention; needs
                                     # n_heads % sequence_parallel == 0)
     sync_period: int = 1            # 1 = fully synchronous psum every step;
-                                    # K>1 = local SGD, params averaged every K
+                                    # K>1 = local SGD, params averaged every K.
+                                    # PER-UPDATE BATCH: each divergent
+                                    # replica steps on its 1/dp slice of
+                                    # --batch_size, while each reference
+                                    # async worker stepped on a FULL
+                                    # batch (example.py:157) — set
+                                    # --batch_size = dp * 100 for the
+                                    # reference's per-update semantics
+                                    # (oracle-pinned in tests/
+                                    # test_oracle.py's staleness test)
                                     # steps (TPU-native async-staleness analog,
                                     # SURVEY.md §7 semantic mapping)
     grad_reduce: str = "mean"       # mean | sum over the data axis
@@ -343,7 +352,13 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["ring", "ulysses"],
                    help="sequence-parallel attention: ppermute ring vs "
                         "head<->seq all_to_all (DeepSpeed-Ulysses style)")
-    p.add_argument("--sync_period", type=int, default=d.sync_period)
+    p.add_argument("--sync_period", type=int, default=d.sync_period,
+                   help="K>1 = local-SGD async analog: divergent "
+                        "replicas averaged every K steps; each "
+                        "replica's per-update batch is batch_size/dp "
+                        "(the reference gave each async worker a FULL "
+                        "batch per update — use batch_size = dp*100 "
+                        "to match)")
     p.add_argument("--grad_reduce", type=str, default=d.grad_reduce,
                    choices=["mean", "sum"])
     p.add_argument("--fsdp", action="store_true",

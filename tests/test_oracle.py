@@ -153,3 +153,56 @@ def test_oracle_reproduces_reference_instability():
     with np.errstate(divide="ignore", invalid="ignore", over="ignore"):
         loss = oracle.loss(x, y)
     assert not np.isfinite(loss)
+
+
+def test_local_sgd_staleness_matches_numpy_async_oracle(devices8):
+    """The async analog's K-step trajectory against a from-scratch
+    numpy simulation of dp stale workers (VERDICT r3 missing #2 /
+    next #7): each replica runs K sequential SGD applies on ITS 1/dp
+    slice of every global batch — the DOCUMENTED per-update batch
+    semantics (per-update batch = batch_size/dp; set
+    --batch_size = dp * 100 to reproduce the reference's full
+    batch-100 per worker update, example.py:157) — then the replicas
+    reconcile by parameter averaging. Pins both the staleness mapping
+    and the per-update batch size, loss values included."""
+    dp, K, rounds, b = 4, 3, 2, 32          # per-replica batch = 8
+    lr = 0.1
+    spec = mlp.MLPSpec(input_size=16, hidden_sizes=(8,), num_classes=4)
+    cfg = Config(learning_rate=lr, naive_ce=True, sync_period=K)
+    opt = make_optimizer(cfg)
+    state0 = create_train_state(jax.random.PRNGKey(1), spec, opt)
+    init_np = {k: np.asarray(v) for k, v in state0.params.items()}
+    mesh = mesh_lib.build_mesh(dp, 1)
+    state = step_lib.stack_state(state0, dp)
+    state = mesh_lib.place_state(state, mesh,
+                                 step_lib._stacked_specs(state))
+    step = step_lib.build_local_train_step(cfg, mesh, spec, opt, state)
+    sync = step_lib.build_param_sync(mesh, state)
+
+    oracles = [ReferenceOracle(init_np, learning_rate=lr)
+               for _ in range(dp)]
+    rng = np.random.RandomState(7)
+    sl = b // dp
+    for _round in range(rounds):
+        for _k in range(K):
+            x = rng.rand(b, 16).astype(np.float32)
+            y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, b)]
+            state, cost, _acc = step(state, x, y)
+            o_costs = [o.step([(x[r * sl:(r + 1) * sl],
+                                y[r * sl:(r + 1) * sl])])
+                       for r, o in enumerate(oracles)]
+            np.testing.assert_allclose(float(cost), np.mean(o_costs),
+                                       rtol=2e-5, atol=1e-6)
+        state = sync(state)
+        avg = {k: np.mean([o.params[k] for o in oracles], axis=0)
+               for k in init_np}
+        for o in oracles:
+            o.params = {k: v.copy() for k, v in avg.items()}
+        got = {k: np.asarray(v) for k, v in
+               jax.device_get(state.params).items()}
+        for k in init_np:
+            # every replica row holds the reconciled average
+            np.testing.assert_allclose(got[k][0], avg[k], rtol=2e-5,
+                                       atol=2e-6, err_msg=k)
+            np.testing.assert_allclose(got[k][-1], got[k][0], rtol=1e-6,
+                                       err_msg=k)
