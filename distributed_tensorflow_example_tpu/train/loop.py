@@ -893,22 +893,36 @@ def run(cfg: Config) -> Dict[str, Any]:
         # complete the train->generate story: KV-cached decoding from
         # the first test examples' opening tokens (beyond-reference;
         # the classify objective has nothing to sample). EVERY process
-        # joins the collective param fetch/gather — only the write is
-        # chief-only (gating the collective would deadlock the others).
+        # joins the collectives — only the write is chief-only (gating
+        # them would deadlock the others).
         from ..models import transformer as tfm_lib
 
-        sample_params = (
-            eval_params if eval_params is not None
-            else get_params(state) if (async_mode or fsdp_mode)
-            else state.params
-        )
-        if proc_cnt > 1:
-            from jax.experimental import multihost_utils
-
-            sample_params = multihost_utils.process_allgather(
-                sample_params, tiled=True)
         n_s = min(cfg.sample_after, dataset.test.images.shape[0])
-        if chief and n_s:
+        prompt_len = max(1, spec.seq_len // 8)
+        prompts = tfm_lib.tokenize(
+            spec, dataset.test.images[:n_s])[:, :prompt_len]
+        sample_rng = (jax.random.PRNGKey(cfg.seed)
+                      if cfg.sample_temperature > 0 else None)
+        tp_axis = mesh_lib.tp_axis(spec, cfg.model_parallel)
+        samples = None
+        if n_s and tp_axis and not (pp_mode or fsdp_mode or async_mode):
+            # Megatron TP is live: decode ON the mesh — params stay in
+            # their training placement (heads split over 'model', Wo/W2
+            # psums), never fetched to a host
+            samples = np.asarray(tfm_lib.generate_sharded(
+                spec, state.params, prompts, mesh, tp_axis,
+                rng=sample_rng, temperature=cfg.sample_temperature))
+        elif n_s:
+            sample_params = (
+                eval_params if eval_params is not None
+                else get_params(state) if (async_mode or fsdp_mode)
+                else state.params
+            )
+            if proc_cnt > 1:
+                from jax.experimental import multihost_utils
+
+                sample_params = multihost_utils.process_allgather(
+                    sample_params, tiled=True)
             host_params = jax.tree.map(np.asarray, sample_params)
             if pp_mode:
                 # decode_step walks flat L{i}_* leaves: un-stack the
@@ -916,14 +930,11 @@ def run(cfg: Config) -> Dict[str, Any]:
                 host_params = tfm_lib.pipeline_unstack_params(
                     spec, host_params, cfg.pipeline_parallel,
                     cfg.virtual_stages)
-            prompt_len = max(1, spec.seq_len // 8)
-            prompts = tfm_lib.tokenize(
-                spec, dataset.test.images[:n_s])[:, :prompt_len]
-            samples = np.asarray(tfm_lib.generate(
-                spec, host_params, prompts,
-                rng=(jax.random.PRNGKey(cfg.seed)
-                     if cfg.sample_temperature > 0 else None),
-                temperature=cfg.sample_temperature))
+            if chief:
+                samples = np.asarray(tfm_lib.generate(
+                    spec, host_params, prompts, rng=sample_rng,
+                    temperature=cfg.sample_temperature))
+        if chief and samples is not None:
             os.makedirs(cfg.logs_path, exist_ok=True)
             sample_path = os.path.join(cfg.logs_path, "samples.npz")
             np.savez(sample_path, samples=samples, prompt_len=prompt_len,
