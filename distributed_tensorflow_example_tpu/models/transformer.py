@@ -1020,11 +1020,10 @@ def generate(spec: TransformerSpec, params: Params, prompt: jnp.ndarray,
         # the cache holds THIS shard's heads: its zeros-init must be
         # declared model-varying or the scan carry types mismatch
         # after the first (genuinely varying) update
-        lift = (
-            (lambda a: jax.lax.pcast(a, (model_axis,), to="varying"))
-            if hasattr(jax.lax, "pcast")
-            else (lambda a: jax.lax.pvary(a, (model_axis,))))  # older jax
-        cache = jax.tree.map(lift, cache)
+        from ..ops.ring_attention import pvary_axes
+
+        cache = jax.tree.map(
+            lambda a: pvary_axes(a, (model_axis,)), cache)
     tokens0 = jnp.concatenate(
         [prompt, jnp.zeros((b, s - p), prompt.dtype)], axis=1)
 
@@ -1057,6 +1056,9 @@ def generate(spec: TransformerSpec, params: Params, prompt: jnp.ndarray,
     return tokens
 
 
+_GEN_SHARDED_CACHE: Dict = {}
+
+
 def generate_sharded(spec: TransformerSpec, params: Params,
                      prompt: jnp.ndarray, mesh, model_axis: str,
                      rng: jax.Array = None, temperature: float = 1.0):
@@ -1066,18 +1068,28 @@ def generate_sharded(spec: TransformerSpec, params: Params,
     decodes its heads with a shard-local KV cache, and the row-split
     psums make the logits — and therefore the sampled tokens, every
     shard drawing with the same key — identical everywhere. The prompt
-    and returned [B, seq_len] tokens are replicated."""
+    and returned [B, seq_len] tokens are replicated. The jitted
+    program is memoized (rng rides as a traced argument), so periodic
+    sampling never re-compiles."""
     from jax.sharding import PartitionSpec as P
 
-    pspecs = param_pspecs(spec, model_axis=model_axis)
+    sampled = rng is not None
+    key = (spec, mesh, model_axis, float(temperature), sampled)
+    fn = _GEN_SHARDED_CACHE.get(key)
+    if fn is None:
+        pspecs = param_pspecs(spec, model_axis=model_axis)
 
-    def run(p, t):
-        return generate(spec, p, t, rng=rng, temperature=temperature,
-                        model_axis=model_axis)
+        def run(p, t, k):
+            return generate(spec, p, t, rng=(k if sampled else None),
+                            temperature=temperature,
+                            model_axis=model_axis)
 
-    fn = jax.shard_map(run, mesh=mesh, in_specs=(pspecs, P()),
-                       out_specs=P())
-    return jax.jit(fn)(params, prompt)
+        fn = jax.jit(jax.shard_map(run, mesh=mesh,
+                                   in_specs=(pspecs, P(), P()),
+                                   out_specs=P()))
+        _GEN_SHARDED_CACHE[key] = fn
+    return fn(params, prompt,
+              rng if sampled else jax.random.PRNGKey(0))
 
 
 def num_params(spec: TransformerSpec) -> int:

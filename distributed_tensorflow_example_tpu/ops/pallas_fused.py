@@ -132,9 +132,9 @@ def _forward_pallas(spec: mlp.MLPSpec, params, x):
             missing = tuple(sorted(set(vma) - have))
             if not missing:
                 return p
-            if hasattr(jax.lax, "pcast"):
-                return jax.lax.pcast(p, missing, to="varying")
-            return jax.lax.pvary(p, missing)  # older JAX
+            from .ring_attention import pvary_axes
+
+            return pvary_axes(p, missing)
 
         flat_params = [lift(p) for p in flat_params]
     _sds = (

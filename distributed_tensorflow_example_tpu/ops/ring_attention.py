@@ -50,6 +50,17 @@ NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() and the
                  # running-max recurrence NaN-free for fully-masked rows
 
 
+def pvary_axes(x, axes):
+    """Declare ``x`` varying over mesh ``axes`` — the one
+    pcast-with-pvary-fallback compatibility shim (jax renamed
+    pvary -> pcast(..., to='varying'); older releases lack pcast).
+    Shared by every site that lifts an axis-invariant value into a
+    varying carry/branch type."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to="varying")
+    return jax.lax.pvary(x, axes)  # older JAX
+
+
 def attention(q, k, v, causal: bool = False):
     """Plain softmax attention, single device. [B, S, H, D] layout.
 
@@ -121,9 +132,7 @@ def _lift_varying(x, ref):
     missing = tuple(sorted(want - have))
     if not missing:
         return x
-    if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(x, missing, to="varying")
-    return jax.lax.pvary(x, missing)  # older JAX
+    return pvary_axes(x, missing)
 
 
 def _rotate_unless_last(kv, t, n, axis_name: str):

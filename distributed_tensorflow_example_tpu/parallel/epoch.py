@@ -321,9 +321,9 @@ def _build_local_run_to_completion(
         m = jax.lax.pmean(a, DATA_AXIS)
         # pmean's output is axis-invariant; lift it back to varying so the
         # lax.cond reconcile branch type-matches the identity branch
-        if hasattr(jax.lax, "pcast"):
-            return jax.lax.pcast(m, DATA_AXIS, to="varying")
-        return jax.lax.pvary(m, DATA_AXIS)
+        from ..ops.ring_attention import pvary_axes
+
+        return pvary_axes(m, DATA_AXIS)
 
     def step_body(state: TrainState, x, y):
         local_p = jax.tree.map(lambda a: a[0], state.params)
