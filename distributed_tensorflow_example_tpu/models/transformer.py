@@ -38,6 +38,7 @@ block total, the textbook Megatron count.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Dict
 
 import jax
@@ -1056,7 +1057,23 @@ def generate(spec: TransformerSpec, params: Params, prompt: jnp.ndarray,
     return tokens
 
 
-_GEN_SHARDED_CACHE: Dict = {}
+@functools.lru_cache(maxsize=8)
+def _gen_sharded_fn(spec, mesh, model_axis: str, temperature: float,
+                    sampled: bool):
+    """Compiled TP-decode program, LRU-bounded so long-lived processes
+    sweeping meshes/specs/temperatures cannot accumulate dead
+    executables and their device handles."""
+    from jax.sharding import PartitionSpec as P
+
+    pspecs = param_pspecs(spec, model_axis=model_axis)
+
+    def run(p, t, k):
+        return generate(spec, p, t, rng=(k if sampled else None),
+                        temperature=temperature, model_axis=model_axis)
+
+    return jax.jit(jax.shard_map(run, mesh=mesh,
+                                 in_specs=(pspecs, P(), P()),
+                                 out_specs=P()))
 
 
 def generate_sharded(spec: TransformerSpec, params: Params,
@@ -1071,23 +1088,9 @@ def generate_sharded(spec: TransformerSpec, params: Params,
     and returned [B, seq_len] tokens are replicated. The jitted
     program is memoized (rng rides as a traced argument), so periodic
     sampling never re-compiles."""
-    from jax.sharding import PartitionSpec as P
-
     sampled = rng is not None
-    key = (spec, mesh, model_axis, float(temperature), sampled)
-    fn = _GEN_SHARDED_CACHE.get(key)
-    if fn is None:
-        pspecs = param_pspecs(spec, model_axis=model_axis)
-
-        def run(p, t, k):
-            return generate(spec, p, t, rng=(k if sampled else None),
-                            temperature=temperature,
-                            model_axis=model_axis)
-
-        fn = jax.jit(jax.shard_map(run, mesh=mesh,
-                                   in_specs=(pspecs, P(), P()),
-                                   out_specs=P()))
-        _GEN_SHARDED_CACHE[key] = fn
+    fn = _gen_sharded_fn(spec, mesh, model_axis, float(temperature),
+                         sampled)
     return fn(params, prompt,
               rng if sampled else jax.random.PRNGKey(0))
 
