@@ -1212,8 +1212,12 @@ def main(argv=None) -> int:
          and "s16384_bf16_tflops" in r), None)
     if flash_row:
         extra["flash_s16384_tflops"] = flash_row["s16384_bf16_tflops"]
-        if flash_row.get("bf16_vs_ref_kernel") is not None:
-            extra["flash_vs_ref_kernel"] = flash_row["bf16_vs_ref_kernel"]
+        # the TRAIN ratio (fwd + bwd, each kernel on its native
+        # layout): what a training step actually pays, and far less
+        # window-sensitive than the forward-only ratio
+        if flash_row.get("bf16_vs_ref_kernel_train") is not None:
+            extra["flash_vs_ref_kernel_train"] = \
+                flash_row["bf16_vs_ref_kernel_train"]
     wide_row = next(
         (r for r in rows if r.get("config") == "transformer_wide"
          and "mfu" in r), None)
