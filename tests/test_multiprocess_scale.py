@@ -90,6 +90,23 @@ def test_fsdp_across_processes():
     assert "Test-Accuracy:" not in worker
 
 
+def test_fsdp_tp_across_processes():
+    """--fsdp --model_parallel=2 over 2 processes x 2 devices (r4):
+    the ('data','model') 2x2 mesh spans the process boundary, so the
+    data-axis all-gather/reduce-scatter AND the Megatron psums are
+    real cross-process collectives."""
+    outs = run_all(2, 2, [
+        "--training_epochs=1", "--batch_size=32", "--frequency=2",
+        "--fsdp", "--model_parallel=2", "--data_parallel=2",
+        "--hidden_sizes=16,8",
+        "--synthetic_train_size=256", "--synthetic_test_size=64",
+    ])
+    chief, worker = outs
+    assert "Test-Accuracy:" in chief and "done" in chief, chief[-2000:]
+    assert "Cost: nan" not in chief.lower(), chief[-2000:]
+    assert "Test-Accuracy:" not in worker
+
+
 def test_fsdp_checkpoint_resume_multiprocess(tmp_path):
     """--fsdp + checkpointing across 2 processes: the save allgathers
     the [dp, chunk]-sharded state from non-addressable devices and
