@@ -63,6 +63,23 @@ CELLS += [
     # (28 % 8 != 0, which the r3 validator wrongly rejected)
     ("tfm_lm_sp8", {**_TFM, "objective": "lm", "vocab_size": 16,
                     "sequence_parallel": 8}),
+    # r4 additions: lm through the pipeline, interleaved virtual
+    # stages (incl. x TP), FSDP x TP, sharded checkpoints on the fast
+    # path (checkpoint_dir is injected by the runner when set here)
+    ("tfm_pp_lm", {**_TFM, "objective": "lm", "vocab_size": 16,
+                   "pipeline_parallel": 2, "data_parallel": 4,
+                   "microbatches": 2}),
+    ("tfm_pp_interleaved", {**_TFM, "num_blocks": 4,
+                            "pipeline_parallel": 2, "data_parallel": 4,
+                            "microbatches": 2, "virtual_stages": 2}),
+    ("tfm_pp_interleaved_tp", {**_TFM, "num_blocks": 4,
+                               "pipeline_parallel": 2,
+                               "model_parallel": 2, "data_parallel": 2,
+                               "microbatches": 2, "virtual_stages": 2}),
+    ("tfm_fsdp_tp", {**_TFM, "fsdp": True, "model_parallel": 2,
+                     "data_parallel": 4}),
+    ("fsdp_tp_mlp", {"fsdp": True, "model_parallel": 2,
+                     "data_parallel": 4, "activation": "relu"}),
 ]
 
 
