@@ -753,8 +753,8 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
                    num_microbatches: int,
                    model_axis: str | None = None,
                    virtual: int = 1,
-                   head_fn=None, head_width: int | None = None
-                   ) -> jnp.ndarray:
+                   head_fn=None, head_width: int | None = None,
+                   seq_axis: str | None = None) -> jnp.ndarray:
     """Pipeline-parallel forward inside shard_map: GPipe microbatch
     schedule at ``virtual == 1``, Megatron interleaved virtual stages
     at ``virtual > 1``.
@@ -787,10 +787,21 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
     an identical [B, head_width] array. The backward pass is jax.grad
     through this forward: shard_map transposes each ppermute into the
     reverse hop, which IS the reverse pipeline schedule.
+
+    ``seq_axis`` (r4): PP x SP — ``x`` arrives with its token axis
+    sharded over the inner seq axis; every pipeline chunk runs
+    ring/Ulysses attention across the seq shards (via _block_forward's
+    seq_axis plumbing), positional embeddings slice by the shard's
+    global offset, the stage-hop ppermutes carry [mb, S/n_seq, D]
+    blocks, and the classify pool completes with a seq pmean.
     """
     cdt = spec.compute_dtype
     b = x.shape[0]
     s, d = spec.seq_len, spec.d_model
+    if seq_axis is not None:
+        # psum(1, axis) of a mesh axis is a compile-time constant, so
+        # the local length is static and usable in reshape shapes
+        s = s // jax.lax.psum(1, seq_axis)
     p, v, m_cnt = n_stages, virtual, num_microbatches
     if b % m_cnt:
         raise ValueError(
@@ -809,6 +820,10 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
     sidx = jax.lax.axis_index(stage_axis)
     act = _ACTIVATIONS[spec.activation]
     pos = params["pos"].astype(jnp.float32)
+    if seq_axis is not None:
+        # this seq shard's slice of the global positional table
+        off = jax.lax.axis_index(seq_axis) * s
+        pos = jax.lax.dynamic_slice_in_dim(pos, off, s, axis=0)
 
     if spec.objective == "lm":
         micro_t = tokenize(spec, x).reshape(m_cnt, mb, s)
@@ -831,8 +846,11 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
 
         def head_fn(params_, h, m):
             hl = _layer_norm(h, params_["lnf_g"], params_["lnf_b"])
-            return _mm(params_, jnp.mean(hl, axis=1), "W_head",
-                       "b_head", cdt)
+            pooled = jnp.mean(hl, axis=1)
+            if seq_axis is not None:
+                # complete the global token mean across seq shards
+                pooled = jax.lax.pmean(pooled, seq_axis)
+            return _mm(params_, pooled, "W_head", "b_head", cdt)
     elif head_width is None:
         raise ValueError("custom head_fn needs an explicit head_width")
 
@@ -848,6 +866,7 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
 
         def body(h_, bp):
             h2_, _aux = _block_forward(spec, bp, h_, act, cdt,
+                                       seq_axis=seq_axis,
                                        model_axis=model_axis)
             return h2_, None   # PP is dense-FFN only: aux always 0
 

@@ -64,29 +64,33 @@ def build_mesh(data_parallel: int = -1, model_parallel: int = 1, devices=None) -
 
 
 def _build_2d_mesh(data_parallel: int, n: int, axis_name: str,
-                   devices=None, model_parallel: int = 1) -> Mesh:
-    """('data', axis_name[, 'model']) mesh shared by the sequence-,
+                   devices=None, model_parallel: int = 1,
+                   inner_axis: str = None, inner: int = 1) -> Mesh:
+    """('data', axis_name[, inner_axis]) mesh shared by the sequence-,
     expert- and stage-parallel layouts; validates sizes against the
     device pool. ``model_parallel > 1`` appends a third (innermost —
     fastest ICI links on real slices, where the per-block TP psums
-    live) Megatron axis, composing tensor parallelism with the
-    layout's own axis."""
+    live) Megatron axis; a generic ``inner_axis``/``inner`` pair
+    expresses the other three-axis layouts (e.g. PP x SP's inner
+    'seq')."""
+    if model_parallel > 1:
+        inner_axis, inner = MODEL_AXIS, model_parallel
     devices = list(devices if devices is not None else jax.devices())
-    if data_parallel < 1 or n < 1 or model_parallel < 1:
+    if data_parallel < 1 or n < 1 or inner < 1:
         raise ValueError(
             f"mesh axes must be >= 1, got data_parallel={data_parallel}, "
-            f"{axis_name}={n}, model_parallel={model_parallel}")
-    need = data_parallel * n * model_parallel
+            f"{axis_name}={n}, inner={inner}")
+    need = data_parallel * n * inner
     if need > len(devices):
         raise ValueError(
-            f"mesh {data_parallel}x{n}x{model_parallel} needs {need} "
+            f"mesh {data_parallel}x{n}x{inner} needs {need} "
             f"devices, have {len(devices)}")
     import numpy as np
 
-    if model_parallel > 1:
+    if inner > 1:
         dev_array = np.array(devices[:need]).reshape(
-            data_parallel, n, model_parallel)
-        return Mesh(dev_array, (DATA_AXIS, axis_name, MODEL_AXIS),
+            data_parallel, n, inner)
+        return Mesh(dev_array, (DATA_AXIS, axis_name, inner_axis),
                     axis_types=(AxisType.Auto,) * 3)
     dev_array = np.array(devices[:need]).reshape(data_parallel, n)
     return Mesh(dev_array, (DATA_AXIS, axis_name),
@@ -94,13 +98,27 @@ def _build_2d_mesh(data_parallel: int, n: int, axis_name: str,
 
 
 def build_stage_mesh(data_parallel: int, pipeline_parallel: int,
-                     devices=None, model_parallel: int = 1) -> Mesh:
-    """('data', 'stage'[, 'model']) mesh for pipeline-parallel
+                     devices=None, model_parallel: int = 1,
+                     sequence_parallel: int = 1) -> Mesh:
+    """('data', 'stage'[, 'model' | 'seq']) mesh for pipeline-parallel
     transformer training: each stage holds a contiguous slice of the
     encoder blocks; activations hop stage->stage+1 via ppermute on the
     GPipe microbatch schedule (models/transformer.apply_pipeline).
     With ``model_parallel`` each stage's blocks are additionally
-    Megatron-sharded over the inner 'model' axis."""
+    Megatron-sharded over the inner 'model' axis; with
+    ``sequence_parallel`` (r4, exclusive with model_parallel) each
+    microbatch's token axis shards over an inner 'seq' axis and
+    attention runs the ring/Ulysses layout INSIDE every pipeline
+    chunk."""
+    if sequence_parallel > 1:
+        if model_parallel > 1:
+            raise ValueError(
+                "PP x SP x TP is not supported; pick model_parallel=1 "
+                "or sequence_parallel=1")
+        return _build_2d_mesh(data_parallel, pipeline_parallel,
+                              STAGE_AXIS, devices,
+                              inner_axis=SEQ_AXIS,
+                              inner=sequence_parallel)
     return _build_2d_mesh(data_parallel, pipeline_parallel, STAGE_AXIS,
                           devices, model_parallel)
 

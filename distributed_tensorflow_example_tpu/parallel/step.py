@@ -83,9 +83,13 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
                 # stage: two numbers per example ride the collective,
                 # never the [mb, S, V] logits (count is the static
                 # S-1). Returns [B, 2] = (nll_sum, correct_sum).
+                # Under PP x SP the tokens arrive seq-sharded;
+                # _lm_stats handles the shard-boundary target ppermute
+                # and psums the sums over 'seq', so the collected
+                # per-example numbers are already GLOBAL.
                 mb = x.shape[0] // microbatches
                 micro_t = transformer.tokenize(spec, x).reshape(
-                    microbatches, mb, spec.seq_len)
+                    microbatches, mb, -1)
 
                 def lm_head(params_, h, m):
                     hl = transformer._layer_norm(
@@ -96,16 +100,17 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
                     tok = jax.lax.dynamic_index_in_dim(
                         micro_t, m, 0, keepdims=False)
                     nll, correct, _cnt = _lm_stats(spec, logits, tok,
-                                                   None)
+                                                   seq_axis)
                     return jnp.stack([nll, correct], axis=-1)
 
                 return transformer.apply_pipeline(
                     spec, params, x, stage_axis, n_stages, microbatches,
                     model_axis=model_axis, virtual=virtual,
-                    head_fn=lm_head, head_width=2)
+                    head_fn=lm_head, head_width=2, seq_axis=seq_axis)
             return transformer.apply_pipeline(
                 spec, params, x, stage_axis, n_stages, microbatches,
-                model_axis=model_axis, virtual=virtual)
+                model_axis=model_axis, virtual=virtual,
+                seq_axis=seq_axis)
         return transformer.apply(spec, params, x, seq_axis=seq_axis,
                                  expert_axis=expert_axis,
                                  model_axis=model_axis,

@@ -165,10 +165,12 @@ def run(cfg: Config) -> Dict[str, Any]:
         if cfg.num_experts:
             raise ValueError("--pipeline_parallel supports the dense FFN "
                              "only (no --num_experts)")
-        if (cfg.fsdp or cfg.sync_period > 1
-                or cfg.sequence_parallel > 1 or cfg.expert_parallel > 1):
-            raise ValueError("--pipeline_parallel composes with data "
-                             "and tensor parallelism only")
+        if cfg.fsdp or cfg.sync_period > 1 or cfg.expert_parallel > 1:
+            raise ValueError("--pipeline_parallel composes with data, "
+                             "tensor and sequence parallelism only")
+        if cfg.sequence_parallel > 1 and cfg.model_parallel > 1:
+            raise ValueError("PP x SP x TP is not supported; pick "
+                             "model_parallel=1 or sequence_parallel=1")
     if cfg.virtual_stages < 1:
         raise ValueError(
             f"virtual_stages={cfg.virtual_stages} must be >= 1")
@@ -303,7 +305,17 @@ def run(cfg: Config) -> Dict[str, Any]:
         mirrors=cfg.mnist_mirrors,
         input_size=cfg.input_size,
     )
-    if (cfg.sequence_parallel > 1 or cfg.expert_parallel > 1
+    if cfg.pipeline_parallel > 1 and cfg.sequence_parallel > 1:
+        # PP x SP (r4): ('data', 'stage', 'seq') — microbatch token
+        # axes shard over the inner seq axis, ring/Ulysses attention
+        # runs inside every pipeline chunk
+        units = cfg.pipeline_parallel * cfg.sequence_parallel
+        dp_req = (len(jax.devices()) // units
+                  if cfg.data_parallel == -1 else cfg.data_parallel)
+        mesh = mesh_lib.build_stage_mesh(
+            max(dp_req, 1), cfg.pipeline_parallel,
+            sequence_parallel=cfg.sequence_parallel)
+    elif (cfg.sequence_parallel > 1 or cfg.expert_parallel > 1
             or cfg.pipeline_parallel > 1):
         n_axis = max(cfg.sequence_parallel, cfg.expert_parallel,
                      cfg.pipeline_parallel)

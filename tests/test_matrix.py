@@ -78,6 +78,9 @@ CELLS += [
                                "microbatches": 2, "virtual_stages": 2}),
     ("tfm_fsdp_tp", {**_TFM, "fsdp": True, "model_parallel": 2,
                      "data_parallel": 4}),
+    ("tfm_pp_sp", {**_TFM, "pipeline_parallel": 2,
+                   "sequence_parallel": 2, "data_parallel": 2,
+                   "microbatches": 2}),
     ("fsdp_tp_mlp", {"fsdp": True, "model_parallel": 2,
                      "data_parallel": 4, "activation": "relu"}),
 ]

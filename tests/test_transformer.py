@@ -1223,6 +1223,88 @@ def test_pp_lm_and_interleaved_match_single_device(devices8, objective,
                                    err_msg=k)
 
 
+@pytest.mark.parametrize("objective", ["classify", "lm"])
+def test_pp_sp_matches_single_device(devices8, objective):
+    """PP x SP (r4): a ('data','stage','seq') 2x2x2 mesh — microbatch
+    token axes sharded over 'seq' with ring attention inside every
+    pipeline chunk, stage hops carrying [mb, S/n_seq, D] blocks — must
+    match the single-device step (for lm, the shard-boundary target
+    ppermute and seq psums run inside the last stage's head)."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+    from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+    kw = dict(num_blocks=2)
+    if objective == "lm":
+        kw.update(objective="lm", input_size=32, seq_len=32,
+                  vocab_size=16, causal=True)
+    spec = _spec(**kw)
+    cfg = Config(model="transformer", learning_rate=0.01,
+                 pipeline_parallel=2, sequence_parallel=2,
+                 num_blocks=2, microbatches=2)
+    opt = make_optimizer(cfg)
+    rng = np.random.RandomState(23)
+    x = rng.rand(8, spec.input_size).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]
+
+    cfg1 = Config(model="transformer", learning_rate=0.01)
+    mesh1 = mesh_lib.build_mesh(1, 1, devices=devices8[:1])
+    st1 = create_train_state(jax.random.PRNGKey(1), spec, opt)
+    st1 = mesh_lib.place_state(st1, mesh1,
+                               mesh_lib.state_pspecs(spec, opt, 1))
+    step1 = step_lib.build_train_step(cfg1, mesh1, spec, opt)
+    new1, c1, a1 = step1(st1, x, y)
+    p1 = jax.tree.map(np.asarray, new1.params)
+
+    meshp = mesh_lib.build_stage_mesh(2, 2, devices=devices8,
+                                      sequence_parallel=2)
+    st = create_train_state(jax.random.PRNGKey(1), spec, opt)
+    st = tfm.pipeline_train_state(spec, opt, st, 2, 1)
+    st = mesh_lib.place_state(
+        st, meshp,
+        mesh_lib.pipeline_state_pspecs(spec, opt, mesh_lib.STAGE_AXIS))
+    stepp = step_lib.build_train_step(cfg, meshp, spec, opt)
+    newp, cp, ap = stepp(st, x, y)
+    pp_un = tfm.pipeline_unstack_params(
+        spec, jax.tree.map(np.asarray, newp.params), 2, 1)
+
+    assert abs(c1 - float(cp)) < 2e-5
+    assert abs(a1 - float(ap)) < 2e-5
+    for k in p1:
+        np.testing.assert_allclose(pp_un[k], p1[k], rtol=3e-5, atol=3e-6,
+                                   err_msg=k)
+
+
+def test_pp_sp_driver_end_to_end(devices8):
+    """--pipeline_parallel x --sequence_parallel through the full
+    driver (the 'composes with data and tensor parallelism only' gate
+    is gone)."""
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    res = run(Config(
+        model="transformer", objective="lm", input_size=32,
+        vocab_size=16, d_model=32, n_heads=2, num_blocks=2, d_ff=64,
+        causal=True, pipeline_parallel=2, sequence_parallel=2,
+        data_parallel=2, microbatches=2, training_epochs=1,
+        batch_size=32, learning_rate=0.003, optimizer="adam",
+        dataset="synthetic", synthetic_train_size=256,
+        synthetic_test_size=64, summaries=False, compilation_cache="",
+        frequency=4,
+    ))
+    assert res["devices"] == 8
+    assert np.isfinite(res["final_cost"])
+    assert res["test_accuracy"] > 1.0 / 16
+
+
+def test_pp_sp_tp_rejected():
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    with pytest.raises(ValueError, match="PP x SP x TP"):
+        run(Config(model="transformer", pipeline_parallel=2,
+                   num_blocks=2, sequence_parallel=2, model_parallel=2))
+
+
 def test_pp_interleaved_resume_layout_guard(devices8, tmp_path):
     """virtual_stages>1 permutes the stacked block order, so resuming
     under a different pipeline layout must be rejected (the shapes
