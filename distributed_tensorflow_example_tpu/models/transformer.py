@@ -337,9 +337,13 @@ def _route_topk(spec: TransformerSpec, probs):
     return gates, idx
 
 
-def _moe_ffn(spec: TransformerSpec, params: Params, i: int, a, act, cdt,
+def _moe_ffn(spec: TransformerSpec, bp: Params, a, act, cdt,
              expert_axis: str | None, aux_axes=()):
-    """Top-k mixture-of-experts FFN for block ``i`` (dense dispatch).
+    """Top-k mixture-of-experts FFN for one block (dense dispatch).
+    ``bp`` holds the block's UNPREFIXED leaves (Wr, We1, be1, We2,
+    be2) — the same view _block_forward passes for attention, so the
+    flat forward, the KV-cached decode and the pipeline's scan-carried
+    stacked leaves all feed the identical body.
 
     Exact "dense dispatch": every (local) expert runs on every token
     and the router's gate-weighted selection combines — no capacity
@@ -354,7 +358,7 @@ def _moe_ffn(spec: TransformerSpec, params: Params, i: int, a, act, cdt,
     compute/bandwidth savings for exactness.)
     """
     gate_logits = jnp.dot(
-        a.astype(cdt), params[f"L{i}_Wr"].astype(cdt),
+        a.astype(cdt), bp["Wr"].astype(cdt),
         preferred_element_type=jnp.float32)               # [B, S, E]
     probs = jax.nn.softmax(gate_logits, axis=-1)
     gates, idx = _route_topk(spec, probs)                 # [B, S, k]
@@ -362,8 +366,8 @@ def _moe_ffn(spec: TransformerSpec, params: Params, i: int, a, act, cdt,
     sel = jnp.sum(
         jax.nn.one_hot(idx, spec.num_experts, dtype=jnp.float32)
         * gates[..., None], axis=-2)                      # [B, S, E]
-    we1, be1 = params[f"L{i}_We1"], params[f"L{i}_be1"]
-    we2, be2 = params[f"L{i}_We2"], params[f"L{i}_be2"]
+    we1, be1 = bp["We1"], bp["be1"]
+    we2, be2 = bp["We2"], bp["be2"]
     if expert_axis is not None:
         off = jax.lax.axis_index(expert_axis) * we1.shape[0]
         sel = jax.lax.dynamic_slice_in_dim(sel, off, we1.shape[0],
@@ -381,7 +385,7 @@ def _moe_ffn(spec: TransformerSpec, params: Params, i: int, a, act, cdt,
     return out, _load_balance_loss(spec, probs, idx[..., 0], aux_axes)
 
 
-def _moe_ffn_sparse(spec: TransformerSpec, params: Params, i: int, a, act,
+def _moe_ffn_sparse(spec: TransformerSpec, bp: Params, a, act,
                     cdt, expert_axis: str | None, aux_axes=()):
     """Capacity-limited token dispatch for the top-k MoE FFN — the
     sparse (Switch/GShard-style) realization of the same math as
@@ -410,7 +414,7 @@ def _moe_ffn_sparse(spec: TransformerSpec, params: Params, i: int, a, act,
     cap = max(1, math.ceil(spec.capacity_factor * t * k / e))
     x = a.reshape(t, d)
     gate_logits = jnp.dot(
-        x.astype(cdt), params[f"L{i}_Wr"].astype(cdt),
+        x.astype(cdt), bp["Wr"].astype(cdt),
         preferred_element_type=jnp.float32)                 # [T, E]
     probs = jax.nn.softmax(gate_logits, axis=-1)
     gates, idx = _route_topk(spec, probs)                   # [T, k]
@@ -435,8 +439,8 @@ def _moe_ffn_sparse(spec: TransformerSpec, params: Params, i: int, a, act,
     buf = jnp.zeros((e * cap + 1, d), jnp.float32)
     buf = buf.at[slot].add(xk)[:-1].reshape(e, cap, d)
 
-    we1, be1 = params[f"L{i}_We1"], params[f"L{i}_be1"]     # [El, d, ff]
-    we2, be2 = params[f"L{i}_We2"], params[f"L{i}_be2"]
+    we1, be1 = bp["We1"], bp["be1"]                         # [El, d, ff]
+    we2, be2 = bp["We2"], bp["be2"]
     el = we1.shape[0]
     if expert_axis is not None and el != e:
         ep = e // el
@@ -508,7 +512,6 @@ def _row_psum(x, w, b, cdt, model_axis):
 def _block_forward(spec: TransformerSpec, bp: Params, h, act, cdt,
                    seq_axis: str | None = None,
                    expert_axis: str | None = None, moe_block: int = 0,
-                   full_params: Params | None = None,
                    model_axis: str | None = None, aux_axes=(),
                    dropout_rng=None):
     """One encoder block on ``h`` [B, S(local), D]. ``bp`` holds the
@@ -539,12 +542,12 @@ def _block_forward(spec: TransformerSpec, bp: Params, h, act, cdt,
         _row_psum(att.reshape(b, s, -1).astype(cdt), bp["Wo"],
                   bp["bo"], cdt, model_axis),
         spec, dropout_rng, 2 * moe_block)
-    return _ffn_block(spec, bp, h, act, cdt, model_axis, full_params,
+    return _ffn_block(spec, bp, h, act, cdt, model_axis,
                       moe_block, expert_axis, aux_axes, dropout_rng)
 
 
 def _ffn_block(spec: TransformerSpec, bp: Params, h, act, cdt,
-               model_axis=None, full_params: Params | None = None,
+               model_axis=None,
                moe_block: int = 0, expert_axis=None, aux_axes=(),
                dropout_rng=None):
     """The LN2 + FFN (dense or MoE) residual half of a block — shared
@@ -561,8 +564,7 @@ def _ffn_block(spec: TransformerSpec, bp: Params, h, act, cdt,
             raise ValueError(
                 f"unknown moe_dispatch {spec.moe_dispatch!r}: expected "
                 f"'dense' or 'alltoall'")
-        ffn, aux = moe(spec, full_params, moe_block, a, act, cdt,
-                       expert_axis, aux_axes)
+        ffn, aux = moe(spec, bp, a, act, cdt, expert_axis, aux_axes)
         h = h + _dropout(ffn, spec, dropout_rng, 2 * moe_block + 1)
     else:
         a = act(_mm(bp, a, "W1", "b1", cdt)).astype(cdt)
@@ -623,7 +625,6 @@ def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray,
               if k.startswith(f"L{i}_")}
         h, aux_i = _block_forward(spec, bp, h, act, cdt, seq_axis,
                                   expert_axis, moe_block=i,
-                                  full_params=params,
                                   model_axis=model_axis,
                                   aux_axes=aux_axes,
                                   dropout_rng=dropout_rng)
@@ -651,6 +652,13 @@ def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray,
 
 _BLOCK_LEAVES = ("ln1_g", "ln1_b", "Wqkv", "bqkv", "Wo", "bo",
                  "ln2_g", "ln2_b", "W1", "b1", "W2", "b2")
+_BLOCK_LEAVES_MOE = ("ln1_g", "ln1_b", "Wqkv", "bqkv", "Wo", "bo",
+                     "ln2_g", "ln2_b", "Wr", "We1", "be1", "We2", "be2")
+
+
+def _block_leaf_names(spec: TransformerSpec) -> tuple:
+    """The per-block leaf set the pipeline stacks — dense FFN or MoE."""
+    return _BLOCK_LEAVES_MOE if spec.num_experts else _BLOCK_LEAVES
 
 
 def _pipeline_block_order(num_blocks: int, n_stages: int,
@@ -683,15 +691,11 @@ def pipeline_stack_params(spec: TransformerSpec, params: Params,
     LN leaves stay replicated under their own names. With
     ``virtual > 1`` the stacking order is the interleaved permutation
     (_pipeline_block_order), so checkpoints of interleaved runs are
-    restorable only at the same (n_stages, virtual). Dense FFN only
-    (the driver guards MoE+PP; this guard covers library callers)."""
-    if spec.num_experts:
-        raise ValueError(
-            "pipeline parallelism supports the dense FFN only "
-            "(num_experts=0)")
+    restorable only at the same (n_stages, virtual). MoE blocks (r4)
+    stack their router/expert leaves the same way."""
     out = {k: v for k, v in params.items() if not k.startswith("L")}
     order = _pipeline_block_order(spec.num_blocks, n_stages, virtual)
-    for leaf in _BLOCK_LEAVES:
+    for leaf in _block_leaf_names(spec):
         out[f"blk_{leaf}"] = jnp.stack(
             [params[f"L{j}_{leaf}"] for j in order])
     return out
@@ -707,7 +711,7 @@ def pipeline_unstack_params(spec: TransformerSpec, stacked: Params,
     layout; this inverse serves tests, sampling and conversions."""
     out = {k: v for k, v in stacked.items() if not k.startswith("blk_")}
     order = _pipeline_block_order(spec.num_blocks, n_stages, virtual)
-    for leaf in _BLOCK_LEAVES:
+    for leaf in _block_leaf_names(spec):
         for pos, j in enumerate(order):
             out[f"L{j}_{leaf}"] = stacked[f"blk_{leaf}"][pos]
     return out
@@ -727,21 +731,23 @@ def pipeline_train_state(spec: TransformerSpec, optimizer, state,
 
 def pipeline_param_pspecs(spec: TransformerSpec, stage_axis: str,
                           model_axis: str | None = None,
+                          expert_axis: str | None = None,
                           ) -> Dict[str, "jax.sharding.PartitionSpec"]:
     """Specs for the stacked layout: blk_* shard their block dim over
-    ``stage_axis`` — and, under PPxTP (``model_axis``), their
-    head/hidden dim over the inner Megatron axis too (the stage spec
-    prepended to the per-leaf TP spec); everything else replicated."""
+    ``stage_axis``, with the per-leaf INNER spec taken from the
+    canonical flat-layout param_pspecs — so PPxTP shards the Megatron
+    head/hidden dims and (r4) PPxEP shards the stacked expert leaves'
+    E dim over the expert axis; everything else replicated."""
     from jax.sharding import PartitionSpec as P
 
-    tp_specs = _tp_leaf_specs(model_axis) if model_axis else {}
+    base = param_pspecs(spec, expert_axis=expert_axis,
+                        model_axis=model_axis)
     shapes = param_shapes(spec)
     out = {}
     for name in shapes:
         if name.startswith("L0_"):
             leaf = name[len("L0_"):]
-            inner = tuple(tp_specs.get(leaf, P())) or (None,) * len(
-                shapes[name])
+            inner = tuple(base[name]) or (None,) * len(shapes[name])
             out[f"blk_{leaf}"] = P(stage_axis, *inner)
         elif not name.startswith("L"):
             out[name] = P()
@@ -754,7 +760,8 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
                    model_axis: str | None = None,
                    virtual: int = 1,
                    head_fn=None, head_width: int | None = None,
-                   seq_axis: str | None = None) -> jnp.ndarray:
+                   seq_axis: str | None = None,
+                   expert_axis: str | None = None) -> jnp.ndarray:
     """Pipeline-parallel forward inside shard_map: GPipe microbatch
     schedule at ``virtual == 1``, Megatron interleaved virtual stages
     at ``virtual > 1``.
@@ -865,10 +872,13 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
                 for k, a in local_v.items()}
 
         def body(h_, bp):
+            # the MoE aux (balance) loss is unavailable under PP
+            # (aux_loss_weight is rejected by validation): discarded
             h2_, _aux = _block_forward(spec, bp, h_, act, cdt,
                                        seq_axis=seq_axis,
+                                       expert_axis=expert_axis,
                                        model_axis=model_axis)
-            return h2_, None   # PP is dense-FFN only: aux always 0
+            return h2_, None
 
         h_, _ = jax.lax.scan(body, h, bp_c)
         return h_
@@ -1013,8 +1023,7 @@ def decode_step(spec: TransformerSpec, params: Params, cache: Params,
         h = h + _row_psum(att.astype(cdt), bp["Wo"], bp["bo"], cdt,
                           model_axis)
         h, _aux = _ffn_block(spec, bp, h[:, None], act, cdt,
-                             model_axis=model_axis,
-                             full_params=params, moe_block=i)
+                             model_axis=model_axis, moe_block=i)
         h = h[:, 0]
     hf = _layer_norm(h[:, None], params["lnf_g"], params["lnf_b"])[:, 0]
     logits = _mm(params, hf, "W_head", "b_head", cdt).astype(jnp.float32)

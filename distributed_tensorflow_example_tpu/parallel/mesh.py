@@ -99,7 +99,8 @@ def _build_2d_mesh(data_parallel: int, n: int, axis_name: str,
 
 def build_stage_mesh(data_parallel: int, pipeline_parallel: int,
                      devices=None, model_parallel: int = 1,
-                     sequence_parallel: int = 1) -> Mesh:
+                     sequence_parallel: int = 1,
+                     expert_parallel: int = 1) -> Mesh:
     """('data', 'stage'[, 'model' | 'seq']) mesh for pipeline-parallel
     transformer training: each stage holds a contiguous slice of the
     encoder blocks; activations hop stage->stage+1 via ppermute on the
@@ -110,27 +111,38 @@ def build_stage_mesh(data_parallel: int, pipeline_parallel: int,
     microbatch's token axis shards over an inner 'seq' axis and
     attention runs the ring/Ulysses layout INSIDE every pipeline
     chunk."""
+    inners = {"model_parallel": model_parallel,
+              "sequence_parallel": sequence_parallel,
+              "expert_parallel": expert_parallel}
+    live = [k for k, v in inners.items() if v > 1]
+    if len(live) > 1:
+        raise ValueError(
+            f"pipeline parallelism composes with ONE inner axis at a "
+            f"time; got {live}")
     if sequence_parallel > 1:
-        if model_parallel > 1:
-            raise ValueError(
-                "PP x SP x TP is not supported; pick model_parallel=1 "
-                "or sequence_parallel=1")
         return _build_2d_mesh(data_parallel, pipeline_parallel,
                               STAGE_AXIS, devices,
                               inner_axis=SEQ_AXIS,
                               inner=sequence_parallel)
+    if expert_parallel > 1:
+        return _build_2d_mesh(data_parallel, pipeline_parallel,
+                              STAGE_AXIS, devices,
+                              inner_axis=EXPERT_AXIS,
+                              inner=expert_parallel)
     return _build_2d_mesh(data_parallel, pipeline_parallel, STAGE_AXIS,
                           devices, model_parallel)
 
 
 def pipeline_state_pspecs(spec, optimizer, stage_axis: str,
-                          model_axis: str | None = None):
+                          model_axis: str | None = None,
+                          expert_axis: str | None = None):
     """Spec tree for the PP-stacked TrainState layout (PPxTP when
-    ``model_axis`` is set)."""
+    ``model_axis`` is set; PPxEP when ``expert_axis`` is)."""
     from ..models import transformer
     from ..train.state import TrainState
 
-    pp = transformer.pipeline_param_pspecs(spec, stage_axis, model_axis)
+    pp = transformer.pipeline_param_pspecs(spec, stage_axis, model_axis,
+                                           expert_axis)
     return TrainState(step=P(), params=pp,
                       opt_state=optimizer.state_pspecs(pp))
 

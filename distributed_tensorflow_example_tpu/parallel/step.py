@@ -75,8 +75,9 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
         if pipeline is not None:
             if with_aux:
                 raise ValueError(
-                    "MoE aux loss is not available on the pipeline "
-                    "path (PP is dense-FFN only)")
+                    "the MoE balance loss is not available on the "
+                    "pipeline path (per-chunk aux values cannot ride "
+                    "the schedule's collected output)")
             stage_axis, n_stages, microbatches, virtual = pipeline
             if getattr(spec, "objective", "classify") == "lm":
                 # next-token loss statistics computed ON the last
@@ -106,11 +107,12 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
                 return transformer.apply_pipeline(
                     spec, params, x, stage_axis, n_stages, microbatches,
                     model_axis=model_axis, virtual=virtual,
-                    head_fn=lm_head, head_width=2, seq_axis=seq_axis)
+                    head_fn=lm_head, head_width=2, seq_axis=seq_axis,
+                    expert_axis=expert_axis)
             return transformer.apply_pipeline(
                 spec, params, x, stage_axis, n_stages, microbatches,
                 model_axis=model_axis, virtual=virtual,
-                seq_axis=seq_axis)
+                seq_axis=seq_axis, expert_axis=expert_axis)
         return transformer.apply(spec, params, x, seq_axis=seq_axis,
                                  expert_axis=expert_axis,
                                  model_axis=model_axis,
@@ -429,15 +431,16 @@ def _pipeline_info(mesh, cfg, spec, optimizer=None):
     if not stage_axis:
         return None, None
     model_axis = mesh_lib.tp_axis(spec, mesh.shape.get(MODEL_AXIS, 1))
+    expert_axis = mesh_lib.axis_if_present(mesh, mesh_lib.EXPERT_AXIS)
     pipeline = (stage_axis, mesh.shape[stage_axis], cfg.microbatches,
                 cfg.virtual_stages)
     if optimizer is not None:
         return pipeline, mesh_lib.pipeline_state_pspecs(
-            spec, optimizer, stage_axis, model_axis)
+            spec, optimizer, stage_axis, model_axis, expert_axis)
     from ..models import transformer
 
     return pipeline, transformer.pipeline_param_pspecs(
-        spec, stage_axis, model_axis)
+        spec, stage_axis, model_axis, expert_axis)
 
 
 def build_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer) -> Callable:

@@ -162,15 +162,24 @@ def run(cfg: Config) -> Dict[str, Any]:
                 f"pipeline_parallel={cfg.pipeline_parallel}")
         if cfg.microbatches < 1:
             raise ValueError(f"microbatches={cfg.microbatches} must be >= 1")
-        if cfg.num_experts:
-            raise ValueError("--pipeline_parallel supports the dense FFN "
-                             "only (no --num_experts)")
-        if cfg.fsdp or cfg.sync_period > 1 or cfg.expert_parallel > 1:
+        if cfg.fsdp or cfg.sync_period > 1:
             raise ValueError("--pipeline_parallel composes with data, "
-                             "tensor and sequence parallelism only")
-        if cfg.sequence_parallel > 1 and cfg.model_parallel > 1:
-            raise ValueError("PP x SP x TP is not supported; pick "
-                             "model_parallel=1 or sequence_parallel=1")
+                             "tensor, sequence and expert parallelism "
+                             "only (no fsdp, sync_period=1)")
+        inner = [n for n, v in (("model_parallel", cfg.model_parallel),
+                                ("sequence_parallel",
+                                 cfg.sequence_parallel),
+                                ("expert_parallel", cfg.expert_parallel))
+                 if v > 1]
+        if len(inner) > 1:
+            raise ValueError(
+                f"PP x SP x TP / PP x EP crossings compose with ONE "
+                f"inner axis at a time; got {inner}")
+        if cfg.num_experts and cfg.moe_aux_weight > 0:
+            raise ValueError("the MoE balance loss is not available "
+                             "on the pipeline path; set "
+                             "--moe_aux_weight=0 with "
+                             "--pipeline_parallel")
     if cfg.virtual_stages < 1:
         raise ValueError(
             f"virtual_stages={cfg.virtual_stages} must be >= 1")
@@ -305,16 +314,19 @@ def run(cfg: Config) -> Dict[str, Any]:
         mirrors=cfg.mnist_mirrors,
         input_size=cfg.input_size,
     )
-    if cfg.pipeline_parallel > 1 and cfg.sequence_parallel > 1:
-        # PP x SP (r4): ('data', 'stage', 'seq') — microbatch token
-        # axes shard over the inner seq axis, ring/Ulysses attention
-        # runs inside every pipeline chunk
-        units = cfg.pipeline_parallel * cfg.sequence_parallel
+    if cfg.pipeline_parallel > 1 and (cfg.sequence_parallel > 1
+                                      or cfg.expert_parallel > 1):
+        # PP x SP / PP x EP (r4): ('data', 'stage', 'seq'|'expert') —
+        # ring/Ulysses attention or the MoE expert exchange runs
+        # inside every pipeline chunk
+        inner_deg = max(cfg.sequence_parallel, cfg.expert_parallel)
+        units = cfg.pipeline_parallel * inner_deg
         dp_req = (len(jax.devices()) // units
                   if cfg.data_parallel == -1 else cfg.data_parallel)
         mesh = mesh_lib.build_stage_mesh(
             max(dp_req, 1), cfg.pipeline_parallel,
-            sequence_parallel=cfg.sequence_parallel)
+            sequence_parallel=cfg.sequence_parallel,
+            expert_parallel=cfg.expert_parallel)
     elif (cfg.sequence_parallel > 1 or cfg.expert_parallel > 1
             or cfg.pipeline_parallel > 1):
         n_axis = max(cfg.sequence_parallel, cfg.expert_parallel,
@@ -343,8 +355,10 @@ def run(cfg: Config) -> Dict[str, Any]:
     optimizer = make_optimizer(cfg, total_steps)
     pp_mode = cfg.pipeline_parallel > 1
     if pp_mode:
-        # the pipeline schedule sees one grad-accum chunk at a time
-        per_shard = global_batch // dp
+        # the pipeline schedule sees one grad-accum chunk at a time;
+        # batch_shards counts EVERY batch-sharding axis (dp, plus
+        # 'expert' under sparse-dispatch PP x EP)
+        per_shard = global_batch // batch_shards
         if per_shard % cfg.grad_accum:
             raise ValueError(
                 f"per-shard batch {per_shard} must divide into "
@@ -425,7 +439,8 @@ def run(cfg: Config) -> Dict[str, Any]:
                 cfg.virtual_stages)
             sspecs = mesh_lib.pipeline_state_pspecs(
                 spec, optimizer, mesh_lib.STAGE_AXIS,
-                mesh_lib.tp_axis(spec, cfg.model_parallel))
+                mesh_lib.tp_axis(spec, cfg.model_parallel),
+                mesh_lib.axis_if_present(mesh, mesh_lib.EXPERT_AXIS))
         else:
             sspecs = mesh_lib.state_pspecs(
                 spec, optimizer, cfg.model_parallel,

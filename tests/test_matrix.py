@@ -81,6 +81,9 @@ CELLS += [
     ("tfm_pp_sp", {**_TFM, "pipeline_parallel": 2,
                    "sequence_parallel": 2, "data_parallel": 2,
                    "microbatches": 2}),
+    ("tfm_pp_ep", {**_TFM, "num_experts": 4, "pipeline_parallel": 2,
+                   "expert_parallel": 2, "data_parallel": 2,
+                   "microbatches": 2, "moe_dispatch": "alltoall"}),
     ("fsdp_tp_mlp", {"fsdp": True, "model_parallel": 2,
                      "data_parallel": 4, "activation": "relu"}),
 ]

@@ -392,8 +392,8 @@ def test_moe_top2_first_choices_win_under_overflow():
         "L0_be2": jnp.zeros((e, d), jnp.float32),
     }
     act = jax.nn.gelu
-    out, _aux = tfm._moe_ffn_sparse(spec, params, 0, a, act,
-                                    jnp.float32, None)
+    bp = {k[len("L0_"):]: v for k, v in params.items()}
+    out, _aux = tfm._moe_ffn_sparse(spec, bp, a, act, jnp.float32, None)
     got = np.asarray(out)
 
     # oracle: first choices only, renormalized top gate
@@ -1133,9 +1133,14 @@ def test_pp_validation():
         run(Config(pipeline_parallel=2))
     with pytest.raises(ValueError, match="divide evenly"):
         run(Config(model="transformer", pipeline_parallel=3, num_blocks=2))
-    with pytest.raises(ValueError, match="dense FFN"):
+    # PP x MoE is SUPPORTED since r4; only the balance loss is not
+    with pytest.raises(ValueError, match="balance loss"):
         run(Config(model="transformer", pipeline_parallel=2,
-                   num_blocks=2, num_experts=4))
+                   num_blocks=2, num_experts=4, moe_aux_weight=0.01))
+    with pytest.raises(ValueError, match="ONE inner axis"):
+        run(Config(model="transformer", pipeline_parallel=2,
+                   num_blocks=2, num_experts=4, expert_parallel=2,
+                   model_parallel=2))
     with pytest.raises(ValueError, match="pipeline_parallel > 1"):
         run(Config(model="transformer", virtual_stages=2))
     with pytest.raises(ValueError, match="virtual_stages"):
@@ -1274,6 +1279,82 @@ def test_pp_sp_matches_single_device(devices8, objective):
     for k in p1:
         np.testing.assert_allclose(pp_un[k], p1[k], rtol=3e-5, atol=3e-6,
                                    err_msg=k)
+
+
+@pytest.mark.parametrize("dispatch", ["dense", "alltoall"])
+def test_pp_ep_matches_single_device(devices8, dispatch):
+    """PP x EP (r4): MoE blocks pipeline with their router/expert
+    leaves stacked and the expert stacks sharded over the inner
+    'expert' axis — the per-chunk expert psum (dense dispatch) or
+    all_to_all exchange (sparse, ample capacity so nothing drops)
+    must reproduce the single-device step."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+    from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+    kw = dict(num_blocks=2, num_experts=4, moe_dispatch=dispatch)
+    if dispatch == "alltoall":
+        kw["capacity_factor"] = 4.0   # no drops -> exact equivalence
+    spec = _spec(**kw)
+    cfg = Config(model="transformer", learning_rate=0.01,
+                 pipeline_parallel=2, expert_parallel=2, num_blocks=2,
+                 num_experts=4, moe_dispatch=dispatch, microbatches=2,
+                 **({"capacity_factor": 4.0}
+                    if dispatch == "alltoall" else {}))
+    opt = make_optimizer(cfg)
+    rng = np.random.RandomState(29)
+    x = rng.rand(8, spec.input_size).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]
+
+    cfg1 = Config(model="transformer", learning_rate=0.01,
+                  num_experts=4, moe_dispatch=dispatch,
+                  **({"capacity_factor": 4.0}
+                     if dispatch == "alltoall" else {}))
+    mesh1 = mesh_lib.build_mesh(1, 1, devices=devices8[:1])
+    st1 = create_train_state(jax.random.PRNGKey(1), spec, opt)
+    st1 = mesh_lib.place_state(st1, mesh1,
+                               mesh_lib.state_pspecs(spec, opt, 1))
+    step1 = step_lib.build_train_step(cfg1, mesh1, spec, opt)
+    new1, c1, a1 = step1(st1, x, y)
+    p1 = jax.tree.map(np.asarray, new1.params)
+
+    meshp = mesh_lib.build_stage_mesh(2, 2, devices=devices8,
+                                      expert_parallel=2)
+    st = create_train_state(jax.random.PRNGKey(1), spec, opt)
+    st = tfm.pipeline_train_state(spec, opt, st, 2, 1)
+    st = mesh_lib.place_state(
+        st, meshp,
+        mesh_lib.pipeline_state_pspecs(
+            spec, opt, mesh_lib.STAGE_AXIS, None, mesh_lib.EXPERT_AXIS))
+    stepp = step_lib.build_train_step(cfg, meshp, spec, opt)
+    newp, cp, ap = stepp(st, x, y)
+    pp_un = tfm.pipeline_unstack_params(
+        spec, jax.tree.map(np.asarray, newp.params), 2, 1)
+
+    assert abs(c1 - float(cp)) < 2e-5
+    for k in p1:
+        np.testing.assert_allclose(pp_un[k], p1[k], rtol=3e-5, atol=3e-6,
+                                   err_msg=k)
+
+
+def test_pp_ep_driver_end_to_end(devices8):
+    """--pipeline_parallel x --expert_parallel through the full driver
+    (sparse dispatch: tokens shard over 'expert' too)."""
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    res = run(Config(
+        model="transformer", num_experts=4, moe_dispatch="alltoall",
+        d_model=16, n_heads=2, num_blocks=2, d_ff=32,
+        pipeline_parallel=2, expert_parallel=2, data_parallel=2,
+        microbatches=2, training_epochs=1, batch_size=32,
+        learning_rate=0.003, optimizer="adam", dataset="synthetic",
+        synthetic_train_size=256, synthetic_test_size=64,
+        summaries=False, compilation_cache="", frequency=4,
+    ))
+    assert res["devices"] == 8
+    assert np.isfinite(res["final_cost"])
+    assert res["test_accuracy"] > 0.1
 
 
 def test_pp_sp_driver_end_to_end(devices8):
