@@ -815,6 +815,14 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
             f"local batch {b} must divide into microbatches={m_cnt}")
     if v < 1:
         raise ValueError(f"virtual={v} must be >= 1")
+    if v > 1 and p < 2:
+        # the chunk wrap hop is a ppermute, gated on p > 1: with one
+        # stage, chunks beyond the first would silently consume stale
+        # zero activations (the driver validates this; library callers
+        # must hit the same wall)
+        raise ValueError(
+            f"virtual={v} needs n_stages >= 2 (nothing to interleave "
+            f"on one stage)")
     if v > 1 and m_cnt % p:
         raise ValueError(
             f"interleaved stages need microbatches ({m_cnt}) divisible "
@@ -895,6 +903,12 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
     # loop, so the expensive head is never computed for a dead or
     # masked slot (a per-tick lax.cond can't express the skip: its
     # branches' manual-axes types differ under shard_map).
+    # Memory note: the custom-head buffer is [M, mb, S, D] f32 = the
+    # full local batch's final-chunk activations ON EVERY stage, though
+    # non-last stages only ever write zeros — O(B*S*D) f32 per device
+    # of dead memory on p-1 of p stages, accepted at current scales
+    # (a last-stage-only collect needs shape-varying buffers shard_map
+    # cannot express).
     if custom_head:
         collected = jnp.zeros((m_cnt, mb, s, d), jnp.float32)
     else:

@@ -135,8 +135,15 @@ def _list_checkpoints(ckpt_dir: str) -> list[tuple[int, str]]:
 
 
 def prune_checkpoints(ckpt_dir: str, keep: int) -> list[str]:
-    """Delete all but the ``keep`` highest-step checkpoints (0 = keep
-    everything). Returns the deleted paths."""
+    """Delete all but the ``keep`` highest-step COMPLETE checkpoints
+    (0 = keep everything). Returns the deleted paths.
+
+    An in-flight sharded checkpoint (its peers' shard files still
+    landing) is invisible to the scan and deliberately does NOT count
+    toward ``keep``: deleting a durable checkpoint before its
+    replacement is durable would silently drop the configured
+    redundancy, so the disk transiently holds keep+1 entries until the
+    next save's prune — over-retention is the safe direction."""
     if keep <= 0:
         return []
     deleted = []
@@ -218,7 +225,17 @@ def save_checkpoint_sharded(ckpt_dir: str, state: Any, step: int,
     processes have finished). ``on_complete`` (e.g. retention pruning)
     runs after this process's write lands — in the writer thread under
     ``async_``, so pruning never counts a checkpoint that is still
-    invisible. Returns the checkpoint directory."""
+    invisible. Returns the checkpoint directory.
+
+    Multi-process runs REQUIRE ``ckpt_dir`` on a filesystem shared by
+    every process (NFS/GCS-fuse/...): there is deliberately no
+    cross-process barrier, so the chief's manifest can land before
+    peer shard files — harmless on a shared FS (`_sharded_complete`
+    keeps the checkpoint invisible until every named file exists), but
+    on per-host local disks the format would yield permanently
+    incomplete checkpoints. Retention callers should pass the step
+    just written to ``prune_checkpoints(before_step=...)`` so the
+    possibly-still-landing checkpoint is counted, not skipped."""
     wait_for_pending_saves()
     path = os.path.join(ckpt_dir, f"ckpt-{step:08d}.shards")
     os.makedirs(path, exist_ok=True)
@@ -286,7 +303,14 @@ def restore_sharded_arrays(path: str) -> Tuple[dict, int, int]:
         manifest = json.load(f)
     data = {k: np.zeros(tuple(v["shape"]), np.dtype(v["dtype"]))
             for k, v in manifest["leaves"].items()}
-    filled = {k: 0 for k in data}
+    # positional coverage from the shard bounds (no per-element mask —
+    # a multi-GB state must not pay +25% host memory to restore):
+    # shards must tile the leaf exactly, i.e. pairwise-disjoint boxes
+    # whose sizes sum to the leaf size — overlapping shards from a
+    # hypothetical buggy writer can then never mask a gap. Near-linear
+    # for the dim-0-sharded layouts the writers emit (the dim-0 sweep
+    # prunes the pair loop); quadratic only in degenerate worst cases
+    boxes: dict[str, list] = {k: [] for k in data}
     for name in manifest["files"]:
         with np.load(os.path.join(path, name)) as z:
             for entry in z.files:
@@ -296,8 +320,29 @@ def restore_sharded_arrays(path: str) -> Tuple[dict, int, int]:
                 bounds = z[entry + "§idx"]
                 idx = tuple(slice(int(a), int(b)) for a, b in bounds)
                 data[key][idx] = z[entry]
-                filled[key] += int(z[entry].size)
-    missing = [k for k, n in filled.items() if n < data[k].size]
+                boxes[key].append(np.asarray(bounds, np.int64))
+
+    def _covers(bs, shape) -> bool:
+        if any(len(b) != len(shape) for b in bs):
+            return False                     # rank-mismatched writer
+        total = sum(int(np.prod(b[:, 1] - b[:, 0])) if b.size else 1
+                    for b in bs)
+        if total != int(np.prod(shape, dtype=np.int64)):
+            return False
+        if not shape:                        # scalar: exactly one box
+            return len(bs) == 1
+        bs = sorted(bs, key=lambda b: int(b[0, 0]))
+        for i, a in enumerate(bs):           # pairwise disjoint
+            for b in bs[i + 1:]:
+                if b[0, 0] >= a[0, 1]:
+                    break                    # sorted: no later overlap
+                if all((a[d, 1] > b[d, 0]) and (b[d, 1] > a[d, 0])
+                       for d in range(len(a))):
+                    return False
+        return True
+
+    missing = [k for k, bs in boxes.items()
+               if not _covers(bs, data[k].shape)]
     if missing:
         raise ValueError(
             f"sharded checkpoint {path} does not cover leaves "

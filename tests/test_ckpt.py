@@ -113,6 +113,36 @@ def test_prune_checkpoints(tmp_path):
     assert C.prune_checkpoints(str(tmp_path), keep=0) == []
 
 
+def test_prune_ignores_in_flight_checkpoint(tmp_path):
+    """An incomplete (in-flight) sharded checkpoint is invisible to
+    retention: pruning counts only DURABLE checkpoints, so a peer
+    crash mid-save can never cost the configured redundancy — the safe
+    direction is transient keep+1 over-retention, never early
+    deletion."""
+    import json
+    import os
+
+    opt = make_optimizer(Config())
+    state = create_train_state(jax.random.PRNGKey(0), SPEC, opt)
+    for step in (5, 10, 15):
+        C.save_checkpoint(str(tmp_path), state, step=step, epoch=0)
+    # simulate a mid-save sharded checkpoint: manifest names a peer
+    # shard file that has not landed yet
+    inflight = tmp_path / "ckpt-00000020.shards"
+    os.makedirs(inflight)
+    with open(inflight / "manifest.json", "w") as f:
+        json.dump({"files": ["proc-00000.npz", "proc-00001.npz"],
+                   "step": 20, "epoch": 0, "nprocs": 2, "leaves": {}},
+                  f)
+    (inflight / "proc-00000.npz").write_bytes(b"")
+    deleted = C.prune_checkpoints(str(tmp_path), keep=2)
+    # keep=2 durable (10, 15) + the invisible in-flight dir survive
+    assert sorted(os.path.basename(d) for d in deleted) == [
+        "ckpt-00000005.npz"]
+    assert C.latest_checkpoint(str(tmp_path)).endswith("ckpt-00000015.npz")
+    assert os.path.isdir(inflight)
+
+
 def test_driver_keeps_last_n(tmp_path):
     from distributed_tensorflow_example_tpu.train.loop import run
     import os

@@ -1378,6 +1378,21 @@ def test_pp_sp_driver_end_to_end(devices8):
     assert res["test_accuracy"] > 1.0 / 16
 
 
+def test_apply_pipeline_rejects_virtual_on_one_stage():
+    """Library-level guard (ADVICE r4): virtual > 1 with n_stages == 1
+    must raise in apply_pipeline itself — the wrap ppermute is gated on
+    p > 1, so chunks beyond the first would silently consume stale
+    zeros for callers that bypass the driver's validation."""
+    spec = tfm.TransformerSpec(input_size=32, seq_len=8, d_model=16,
+                               n_heads=2, num_blocks=2, d_ff=32)
+    params = tfm.init(jax.random.PRNGKey(0), spec)
+    stacked = tfm.pipeline_stack_params(spec, params, 1, 1)
+    x = np.zeros((4, 32), np.float32)
+    with pytest.raises(ValueError, match="virtual=2 needs n_stages"):
+        tfm.apply_pipeline(spec, stacked, x, "stage", n_stages=1,
+                           num_microbatches=2, virtual=2)
+
+
 def test_pp_sp_tp_rejected():
     from distributed_tensorflow_example_tpu.train.loop import run
 
