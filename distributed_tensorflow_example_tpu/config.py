@@ -125,6 +125,11 @@ class Config:
     adam_b1: float = 0.9
     adam_b2: float = 0.999
     adam_eps: float = 1e-8
+    adam_moments_dtype: str = "float32"  # bfloat16 halves the m/v slot
+                                    # HBM (storage only: the update
+                                    # math stays f32 with f32 master
+                                    # params; bf16's f32-equal exponent
+                                    # range keeps v's dynamics intact)
 
     # ---- parallelism (SURVEY.md §7; replaces replica_device_setter) ----
     data_parallel: int = -1         # -1: all devices on the data axis
@@ -335,6 +340,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--adam_b1", type=float, default=d.adam_b1)
     p.add_argument("--adam_b2", type=float, default=d.adam_b2)
     p.add_argument("--adam_eps", type=float, default=d.adam_eps)
+    p.add_argument("--adam_moments_dtype", type=str,
+                   default=d.adam_moments_dtype,
+                   choices=["float32", "bfloat16"],
+                   help="storage dtype for Adam's m/v slots (bfloat16 "
+                        "halves optimizer-state HBM traffic; update "
+                        "math stays f32 with f32 master params)")
     p.add_argument("--data_parallel", type=int, default=d.data_parallel)
     p.add_argument("--model_parallel", type=int, default=d.model_parallel)
     p.add_argument("--pipeline_parallel", type=int, default=d.pipeline_parallel,

@@ -324,6 +324,22 @@ def _load_balance_loss(spec: TransformerSpec, probs, top1_idx, axes=()):
     return e * jnp.sum(f * p)
 
 
+def _balance_stats(spec: TransformerSpec, probs, top1_idx):
+    """Raw per-block load-balance statistics ``[2, E]`` = (f, P): the
+    top-1 routing fraction and the mean router probability, as LOCAL
+    token means with no pmean — the pipeline path accumulates these
+    across microbatch ticks and combines once at the end
+    (_load_balance_loss is the combine-now form the flat path uses;
+    both are means over equal token populations, so
+    mean-over-microbatches-then-pmean equals the flat global mean
+    exactly)."""
+    e = spec.num_experts
+    f = jnp.mean(jax.nn.one_hot(top1_idx.reshape(-1), e,
+                                dtype=jnp.float32), axis=0)
+    p = jnp.mean(probs.reshape(-1, e), axis=0)
+    return jnp.stack([f, p])
+
+
 def _route_topk(spec: TransformerSpec, probs):
     """(gates [..., k], idx [..., k]) — the router's top-k choices.
     Top-1 keeps the raw winning probability as the gate (Switch
@@ -338,7 +354,8 @@ def _route_topk(spec: TransformerSpec, probs):
 
 
 def _moe_ffn(spec: TransformerSpec, bp: Params, a, act, cdt,
-             expert_axis: str | None, aux_axes=()):
+             expert_axis: str | None, aux_axes=(),
+             aux_stats: bool = False):
     """Top-k mixture-of-experts FFN for one block (dense dispatch).
     ``bp`` holds the block's UNPREFIXED leaves (Wr, We1, be1, We2,
     be2) — the same view _block_forward passes for attention, so the
@@ -382,11 +399,14 @@ def _moe_ffn(spec: TransformerSpec, bp: Params, a, act, cdt,
     out = jnp.einsum("bsed,bse->bsd", h2, sel)
     if expert_axis is not None:
         out = jax.lax.psum(out, expert_axis)
-    return out, _load_balance_loss(spec, probs, idx[..., 0], aux_axes)
+    aux = (_balance_stats(spec, probs, idx[..., 0]) if aux_stats
+           else _load_balance_loss(spec, probs, idx[..., 0], aux_axes))
+    return out, aux
 
 
 def _moe_ffn_sparse(spec: TransformerSpec, bp: Params, a, act,
-                    cdt, expert_axis: str | None, aux_axes=()):
+                    cdt, expert_axis: str | None, aux_axes=(),
+                    aux_stats: bool = False):
     """Capacity-limited token dispatch for the top-k MoE FFN — the
     sparse (Switch/GShard-style) realization of the same math as
     ``_moe_ffn``'s dense dispatch.
@@ -468,8 +488,9 @@ def _moe_ffn_sparse(spec: TransformerSpec, bp: Params, a, act,
     picked = h2_flat[slot].reshape(k, t, d)
     w = gates.T * keep.astype(jnp.float32).reshape(k, t)
     out = jnp.sum(picked * w[..., None], axis=0)
-    return out.reshape(b, s, d), _load_balance_loss(spec, probs,
-                                                    idx[:, 0], aux_axes)
+    aux = (_balance_stats(spec, probs, idx[:, 0]) if aux_stats
+           else _load_balance_loss(spec, probs, idx[:, 0], aux_axes))
+    return out.reshape(b, s, d), aux
 
 
 def tokenize(spec: TransformerSpec, x: jnp.ndarray) -> jnp.ndarray:
@@ -513,7 +534,7 @@ def _block_forward(spec: TransformerSpec, bp: Params, h, act, cdt,
                    seq_axis: str | None = None,
                    expert_axis: str | None = None, moe_block: int = 0,
                    model_axis: str | None = None, aux_axes=(),
-                   dropout_rng=None):
+                   dropout_rng=None, aux_stats: bool = False):
     """One encoder block on ``h`` [B, S(local), D]. ``bp`` holds the
     block's leaves under their UNPREFIXED names (ln1_g, Wqkv, ...) so
     the same body serves the regular forward (dict views of L{i}_*)
@@ -543,18 +564,20 @@ def _block_forward(spec: TransformerSpec, bp: Params, h, act, cdt,
                   bp["bo"], cdt, model_axis),
         spec, dropout_rng, 2 * moe_block)
     return _ffn_block(spec, bp, h, act, cdt, model_axis,
-                      moe_block, expert_axis, aux_axes, dropout_rng)
+                      moe_block, expert_axis, aux_axes, dropout_rng,
+                      aux_stats)
 
 
 def _ffn_block(spec: TransformerSpec, bp: Params, h, act, cdt,
                model_axis=None,
                moe_block: int = 0, expert_axis=None, aux_axes=(),
-               dropout_rng=None):
+               dropout_rng=None, aux_stats: bool = False):
     """The LN2 + FFN (dense or MoE) residual half of a block — shared
     by the training forward and the KV-cached decode step so the two
     cannot drift. ``h`` [B, S, D] -> (h, aux)."""
     a = _layer_norm(h, bp["ln2_g"], bp["ln2_b"])
-    aux = jnp.float32(0.0)
+    aux = (jnp.zeros((2, spec.num_experts), jnp.float32) if aux_stats
+           else jnp.float32(0.0))
     if spec.num_experts:
         if spec.moe_dispatch == "alltoall":
             moe = _moe_ffn_sparse
@@ -564,7 +587,8 @@ def _ffn_block(spec: TransformerSpec, bp: Params, h, act, cdt,
             raise ValueError(
                 f"unknown moe_dispatch {spec.moe_dispatch!r}: expected "
                 f"'dense' or 'alltoall'")
-        ffn, aux = moe(spec, bp, a, act, cdt, expert_axis, aux_axes)
+        ffn, aux = moe(spec, bp, a, act, cdt, expert_axis, aux_axes,
+                       aux_stats)
         h = h + _dropout(ffn, spec, dropout_rng, 2 * moe_block + 1)
     else:
         a = act(_mm(bp, a, "W1", "b1", cdt)).astype(cdt)
@@ -761,7 +785,9 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
                    virtual: int = 1,
                    head_fn=None, head_width: int | None = None,
                    seq_axis: str | None = None,
-                   expert_axis: str | None = None) -> jnp.ndarray:
+                   expert_axis: str | None = None,
+                   with_aux: bool = False, aux_axes=(),
+                   dropout_rng=None) -> jnp.ndarray:
     """Pipeline-parallel forward inside shard_map: GPipe microbatch
     schedule at ``virtual == 1``, Megatron interleaved virtual stages
     at ``virtual > 1``.
@@ -801,6 +827,17 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
     seq_axis plumbing), positional embeddings slice by the shard's
     global offset, the stage-hop ppermutes carry [mb, S/n_seq, D]
     blocks, and the classify pool completes with a seq pmean.
+
+    ``with_aux`` (r5): returns ``(out, aux)`` with aux the per-block
+    MEAN MoE load-balance loss, exactly the flat forward's objective:
+    each live tick accumulates its chunk's raw (f, P) router
+    statistics (_balance_stats) into a [v, K, 2, E] buffer; after the
+    tick loop the microbatch means are pmean'd over ``aux_axes`` (the
+    token-sharding axes) and combined E*sum(f*P) per block, summed
+    over this stage's blocks and psum'd over ``stage_axis`` — f and P
+    are token means over equal microbatches, so
+    mean-over-microbatches == the flat full-batch mean exactly, and
+    the value is identical on every shard.
     """
     cdt = spec.compute_dtype
     b = x.shape[0]
@@ -875,21 +912,30 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
                                           *a.shape[1:])
                for k, a in params.items() if k.startswith("blk_")}
 
-    def run_chunk(c, h):
+    want_aux = bool(with_aux and spec.num_experts)
+    kc = spec.num_blocks // (p * v)   # blocks per chunk
+
+    def run_chunk(c, h, rng_m):
         bp_c = {k: jax.lax.dynamic_index_in_dim(a, c, 0, keepdims=False)
                 for k, a in local_v.items()}
+        # globally-distinct dropout salts: this stage's stacked slice
+        # starts at sidx*K; chunk c's blocks occupy positions
+        # base..base+kc-1 (traced ints — fold_in takes them fine)
+        base = sidx * (spec.num_blocks // p) + c * kc
 
-        def body(h_, bp):
-            # the MoE aux (balance) loss is unavailable under PP
-            # (aux_loss_weight is rejected by validation): discarded
-            h2_, _aux = _block_forward(spec, bp, h_, act, cdt,
-                                       seq_axis=seq_axis,
-                                       expert_axis=expert_axis,
-                                       model_axis=model_axis)
-            return h2_, None
+        def body(h_, bp_i):
+            bp, i = bp_i
+            h2_, aux_b = _block_forward(spec, bp, h_, act, cdt,
+                                        seq_axis=seq_axis,
+                                        expert_axis=expert_axis,
+                                        moe_block=base + i,
+                                        model_axis=model_axis,
+                                        aux_stats=want_aux,
+                                        dropout_rng=rng_m)
+            return h2_, (aux_b if want_aux else None)
 
-        h_, _ = jax.lax.scan(body, h, bp_c)
-        return h_
+        h_, aux_c = jax.lax.scan(body, h, (bp_c, jnp.arange(kc)))
+        return h_, aux_c   # aux_c: [K/v, 2, E] raw stats, or None
 
     # full-circle ppermute only when the wrap hop is live (v > 1)
     perm = ([(j, (j + 1) % p) for j in range(p)] if v > 1
@@ -913,6 +959,8 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
         collected = jnp.zeros((m_cnt, mb, s, d), jnp.float32)
     else:
         collected = jnp.zeros((m_cnt, mb, head_width), jnp.float32)
+    aux_buf = (jnp.zeros((v, kc, 2, spec.num_experts), jnp.float32)
+               if want_aux else None)
     total = v * m_cnt
     ticks = total + p - 1
     for t in range(ticks):
@@ -922,13 +970,25 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
         g, r = tsc // p, tsc % p
         c = (g % v).astype(jnp.int32)
         m = ((g // v) * p + r).astype(jnp.int32)
+        # per-microbatch dropout stream (distinct masks per microbatch,
+        # block salts distinct per stacked position)
+        rng_m = (jax.random.fold_in(dropout_rng, m)
+                 if dropout_rng is not None else None)
         # stage 0 ingests microbatch m into chunk 0; every other
         # (stage, chunk) consumes the ppermuted activations (dead
         # slots compute on stale values and are discarded by `live`)
         h_in = jnp.where(
             jnp.logical_and(jnp.equal(sidx, 0), jnp.equal(c, 0)),
-            embed(m), recv)
-        h_out = run_chunk(c, h_in)
+            _dropout(embed(m), spec, rng_m, 0x9999), recv)
+        h_out, aux_c = run_chunk(c, h_in, rng_m)
+        if want_aux:
+            # accumulate this live slot's chunk stats (dead slots
+            # computed on stale values: masked to zero)
+            prev_a = jax.lax.dynamic_index_in_dim(aux_buf, c, 0,
+                                                  keepdims=False)
+            aux_buf = jax.lax.dynamic_update_index_in_dim(
+                aux_buf, prev_a + jnp.where(live, 1.0, 0.0) * aux_c,
+                c, 0)
         live_head = jnp.logical_and(live, jnp.logical_and(
             jnp.equal(sidx, p - 1), jnp.equal(c, v - 1)))
         val = (h_out if custom_head
@@ -952,7 +1012,19 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
     else:
         vals = collected   # live_head already zeroed other stages
     out = jax.lax.psum(vals, stage_axis)
-    return out.reshape(b, head_width).astype(jnp.float32)
+    out = out.reshape(b, head_width).astype(jnp.float32)
+    if not with_aux:
+        return out
+    aux = jnp.float32(0.0)
+    if want_aux:
+        stats = aux_buf / m_cnt              # microbatch means
+        f, pr = stats[:, :, 0], stats[:, :, 1]
+        if aux_axes:
+            f = jax.lax.pmean(f, aux_axes)
+            pr = jax.lax.pmean(pr, aux_axes)
+        local = spec.num_experts * jnp.sum(f * pr)
+        aux = jax.lax.psum(local, stage_axis) / spec.num_blocks
+    return out, aux
 
 
 def init_decode_cache(spec: TransformerSpec, batch: int,

@@ -56,7 +56,7 @@ from ..models import mlp
 from ..train.state import TrainState
 from . import mesh as mesh_lib
 from .mesh import DATA_AXIS, MODEL_AXIS
-from .step import _clip_sharded, _loss_and_acc
+from .step import _clip_sharded, _loss_and_acc, make_step_rng
 
 
 def _is_sharded_leaf(a) -> bool:
@@ -242,6 +242,8 @@ def make_fsdp_step_body(
         tp_sharded_names = set()
         clip_specs = {k: P(DATA_AXIS) for k in full_template.params}
 
+    step_rng = make_step_rng(cfg, spec, (DATA_AXIS,))
+
     def shard_step(state: TrainState, x, y):
         params_full = {
             k: _gather_full(state.params[k], shapes[k]) for k in state.params
@@ -268,6 +270,7 @@ def make_fsdp_step_body(
                 model_axis=model_axis,
                 aux_axes=(DATA_AXIS,),
                 label_smoothing=cfg.label_smoothing,
+                dropout_rng=step_rng(state),
             )
 
         (_total, (cost, acc)), grads_full = jax.value_and_grad(

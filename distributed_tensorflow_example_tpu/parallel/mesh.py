@@ -75,62 +75,66 @@ def _build_2d_mesh(data_parallel: int, n: int, axis_name: str,
     'seq')."""
     if model_parallel > 1:
         inner_axis, inner = MODEL_AXIS, model_parallel
-    devices = list(devices if devices is not None else jax.devices())
-    if data_parallel < 1 or n < 1 or inner < 1:
-        raise ValueError(
-            f"mesh axes must be >= 1, got data_parallel={data_parallel}, "
-            f"{axis_name}={n}, inner={inner}")
-    need = data_parallel * n * inner
-    if need > len(devices):
-        raise ValueError(
-            f"mesh {data_parallel}x{n}x{inner} needs {need} "
-            f"devices, have {len(devices)}")
-    import numpy as np
-
+    axes = {DATA_AXIS: data_parallel, axis_name: n}
     if inner > 1:
-        dev_array = np.array(devices[:need]).reshape(
-            data_parallel, n, inner)
-        return Mesh(dev_array, (DATA_AXIS, axis_name, inner_axis),
-                    axis_types=(AxisType.Auto,) * 3)
-    dev_array = np.array(devices[:need]).reshape(data_parallel, n)
-    return Mesh(dev_array, (DATA_AXIS, axis_name),
-                axis_types=(AxisType.Auto, AxisType.Auto))
+        axes[inner_axis] = inner
+    return build_nd_mesh(axes, devices)
 
 
 def build_stage_mesh(data_parallel: int, pipeline_parallel: int,
                      devices=None, model_parallel: int = 1,
                      sequence_parallel: int = 1,
                      expert_parallel: int = 1) -> Mesh:
-    """('data', 'stage'[, 'model' | 'seq']) mesh for pipeline-parallel
-    transformer training: each stage holds a contiguous slice of the
-    encoder blocks; activations hop stage->stage+1 via ppermute on the
-    GPipe microbatch schedule (models/transformer.apply_pipeline).
-    With ``model_parallel`` each stage's blocks are additionally
-    Megatron-sharded over the inner 'model' axis; with
-    ``sequence_parallel`` (r4, exclusive with model_parallel) each
-    microbatch's token axis shards over an inner 'seq' axis and
-    attention runs the ring/Ulysses layout INSIDE every pipeline
-    chunk."""
-    inners = {"model_parallel": model_parallel,
-              "sequence_parallel": sequence_parallel,
-              "expert_parallel": expert_parallel}
-    live = [k for k, v in inners.items() if v > 1]
-    if len(live) > 1:
+    """('data', 'stage'[, 'seq' | 'expert'][, 'model']) mesh for
+    pipeline-parallel transformer training: each stage holds a
+    contiguous slice of the encoder blocks; activations hop
+    stage->stage+1 via ppermute on the GPipe microbatch schedule
+    (models/transformer.apply_pipeline).
+
+    Inner axes compose (r5 — the standard 3D/4D recipes): with
+    ``sequence_parallel`` each microbatch's token axis shards over an
+    inner 'seq' axis and attention runs the ring/Ulysses layout INSIDE
+    every pipeline chunk; with ``expert_parallel`` the stacked expert
+    leaves shard over an inner 'expert' axis; ``model_parallel``
+    additionally Megatron-shards each stage's blocks over the
+    INNERMOST 'model' axis (fastest ICI links on real slices, where
+    the two per-block psums live) — DP x PP x SP x TP in one mesh.
+    'seq' and 'expert' stay mutually exclusive (token-sharded sparse
+    MoE capacity pools are not defined here)."""
+    if sequence_parallel > 1 and expert_parallel > 1:
         raise ValueError(
-            f"pipeline parallelism composes with ONE inner axis at a "
-            f"time; got {live}")
+            "pipeline parallelism composes with EITHER sequence_parallel "
+            "OR expert_parallel (token-sharded expert capacity pools "
+            "are not defined), not both")
+    axes = {DATA_AXIS: data_parallel, STAGE_AXIS: pipeline_parallel}
     if sequence_parallel > 1:
-        return _build_2d_mesh(data_parallel, pipeline_parallel,
-                              STAGE_AXIS, devices,
-                              inner_axis=SEQ_AXIS,
-                              inner=sequence_parallel)
+        axes[SEQ_AXIS] = sequence_parallel
     if expert_parallel > 1:
-        return _build_2d_mesh(data_parallel, pipeline_parallel,
-                              STAGE_AXIS, devices,
-                              inner_axis=EXPERT_AXIS,
-                              inner=expert_parallel)
-    return _build_2d_mesh(data_parallel, pipeline_parallel, STAGE_AXIS,
-                          devices, model_parallel)
+        axes[EXPERT_AXIS] = expert_parallel
+    if model_parallel > 1:
+        axes[MODEL_AXIS] = model_parallel
+    return build_nd_mesh(axes, devices)
+
+
+def build_nd_mesh(axes: Dict[str, int], devices=None) -> Mesh:
+    """Mesh over the ordered ``{axis: size}`` dict (sizes >= 1; listed
+    order = device-array order, so the LAST axis gets the
+    fastest-varying device stride — put the chattiest collectives
+    there on real slices)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if any(v < 1 for v in axes.values()):
+        raise ValueError(f"mesh axes must be >= 1, got {axes}")
+    import numpy as np
+
+    sizes = tuple(axes.values())
+    need = int(np.prod(sizes))
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {'x'.join(map(str, sizes))} over {tuple(axes)} needs "
+            f"{need} devices, have {len(devices)}")
+    dev_array = np.array(devices[:need]).reshape(sizes)
+    return Mesh(dev_array, tuple(axes),
+                axis_types=(AxisType.Auto,) * len(axes))
 
 
 def pipeline_state_pspecs(spec, optimizer, stage_axis: str,

@@ -73,11 +73,6 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
 
     if isinstance(spec, transformer.TransformerSpec):
         if pipeline is not None:
-            if with_aux:
-                raise ValueError(
-                    "the MoE balance loss is not available on the "
-                    "pipeline path (per-chunk aux values cannot ride "
-                    "the schedule's collected output)")
             stage_axis, n_stages, microbatches, virtual = pipeline
             if getattr(spec, "objective", "classify") == "lm":
                 # next-token loss statistics computed ON the last
@@ -108,11 +103,14 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
                     spec, params, x, stage_axis, n_stages, microbatches,
                     model_axis=model_axis, virtual=virtual,
                     head_fn=lm_head, head_width=2, seq_axis=seq_axis,
-                    expert_axis=expert_axis)
+                    expert_axis=expert_axis, with_aux=with_aux,
+                    aux_axes=aux_axes, dropout_rng=dropout_rng)
             return transformer.apply_pipeline(
                 spec, params, x, stage_axis, n_stages, microbatches,
                 model_axis=model_axis, virtual=virtual,
-                seq_axis=seq_axis, expert_axis=expert_axis)
+                seq_axis=seq_axis, expert_axis=expert_axis,
+                with_aux=with_aux, aux_axes=aux_axes,
+                dropout_rng=dropout_rng)
         return transformer.apply(spec, params, x, seq_axis=seq_axis,
                                  expert_axis=expert_axis,
                                  model_axis=model_axis,
@@ -175,7 +173,7 @@ def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas, remat=False,
     balance loss pmean's its statistics across them so N-shard
     training optimizes the same global objective as one device."""
     aux_w = float(getattr(spec, "aux_loss_weight", 0.0))
-    want_aux = aux_w > 0.0 and pipeline is None
+    want_aux = aux_w > 0.0
 
     def fwd(p, xx):
         if want_aux:
@@ -251,6 +249,27 @@ def _clip_sharded(grads, param_pspecs, max_norm: float):
     return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
 
 
+def make_step_rng(cfg, spec, axes):
+    """Deterministic per-step dropout rng factory: seed x step, folded
+    by each token-sharding axis index so every batch/token shard draws
+    its own masks while TP shards (replicated activations) share
+    theirs. Resume-stable: the step count determines the stream.
+    Shared by the sync and FSDP step bodies so FSDP-with-dropout is
+    bitwise the sync step's masks."""
+    dropping = getattr(spec, "dropout_rate", 0.0) > 0
+
+    def step_rng(state):
+        if not dropping:
+            return None
+        rng = jax.random.fold_in(
+            jax.random.PRNGKey(cfg.seed ^ 0xD0C0), state.step)
+        for ax in axes:
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
+        return rng
+
+    return step_rng
+
+
 def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
                         seq_axis: str | None = None,
                         expert_axis: str | None = None,
@@ -281,18 +300,7 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
 
         return jax.value_and_grad(loss_fn, has_aux=True)(params)
 
-    def step_rng(state):
-        """Deterministic per-step dropout rng: seed x step, folded by
-        each token-sharding axis index so every batch/token shard draws
-        its own masks while TP shards (replicated activations) share
-        theirs. Resume-stable: step count determines the stream."""
-        if not dropping:
-            return None
-        rng = jax.random.fold_in(
-            jax.random.PRNGKey(cfg.seed ^ 0xD0C0), state.step)
-        for ax in aux_axes:
-            rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
-        return rng
+    step_rng = make_step_rng(cfg, spec, aux_axes)
 
     def body(state: TrainState, x, y):
         n = cfg.grad_accum

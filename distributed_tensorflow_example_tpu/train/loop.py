@@ -166,20 +166,11 @@ def run(cfg: Config) -> Dict[str, Any]:
             raise ValueError("--pipeline_parallel composes with data, "
                              "tensor, sequence and expert parallelism "
                              "only (no fsdp, sync_period=1)")
-        inner = [n for n, v in (("model_parallel", cfg.model_parallel),
-                                ("sequence_parallel",
-                                 cfg.sequence_parallel),
-                                ("expert_parallel", cfg.expert_parallel))
-                 if v > 1]
-        if len(inner) > 1:
+        if cfg.sequence_parallel > 1 and cfg.expert_parallel > 1:
             raise ValueError(
-                f"PP x SP x TP / PP x EP crossings compose with ONE "
-                f"inner axis at a time; got {inner}")
-        if cfg.num_experts and cfg.moe_aux_weight > 0:
-            raise ValueError("the MoE balance loss is not available "
-                             "on the pipeline path; set "
-                             "--moe_aux_weight=0 with "
-                             "--pipeline_parallel")
+                "--pipeline_parallel composes with EITHER "
+                "--sequence_parallel OR --expert_parallel (plus "
+                "--model_parallel and data), not both at once")
     if cfg.virtual_stages < 1:
         raise ValueError(
             f"virtual_stages={cfg.virtual_stages} must be >= 1")
@@ -221,10 +212,10 @@ def run(cfg: Config) -> Dict[str, Any]:
         if cfg.model != "transformer":
             raise ValueError(
                 "--dropout_rate applies to --model=transformer only")
-        if cfg.pipeline_parallel > 1 or cfg.fsdp or cfg.sync_period > 1:
+        if cfg.sync_period > 1:
             raise ValueError("--dropout_rate runs on the synchronous "
-                             "non-pipeline step (no --fsdp, "
-                             "sync_period=1, pipeline_parallel=1)")
+                             "step (sync_period=1); the local-SGD "
+                             "replicas keep their own objectives")
     if not 0.0 <= cfg.label_smoothing < 1.0:
         raise ValueError(
             f"label_smoothing={cfg.label_smoothing} must be in [0, 1)")
@@ -314,29 +305,26 @@ def run(cfg: Config) -> Dict[str, Any]:
         mirrors=cfg.mnist_mirrors,
         input_size=cfg.input_size,
     )
-    if cfg.pipeline_parallel > 1 and (cfg.sequence_parallel > 1
-                                      or cfg.expert_parallel > 1):
-        # PP x SP / PP x EP (r4): ('data', 'stage', 'seq'|'expert') —
-        # ring/Ulysses attention or the MoE expert exchange runs
-        # inside every pipeline chunk
-        inner_deg = max(cfg.sequence_parallel, cfg.expert_parallel)
-        units = cfg.pipeline_parallel * inner_deg
+    if cfg.pipeline_parallel > 1:
+        # ('data', 'stage'[, 'seq' | 'expert'][, 'model']) — r5: every
+        # inner axis composes (DP x PP x SP x TP / DP x PP x EP x TP);
+        # ring/Ulysses attention, the MoE expert exchange and the
+        # Megatron psums all run inside every pipeline chunk
+        units = (cfg.pipeline_parallel * cfg.model_parallel
+                 * cfg.sequence_parallel * cfg.expert_parallel)
         dp_req = (len(jax.devices()) // units
                   if cfg.data_parallel == -1 else cfg.data_parallel)
         mesh = mesh_lib.build_stage_mesh(
             max(dp_req, 1), cfg.pipeline_parallel,
+            model_parallel=cfg.model_parallel,
             sequence_parallel=cfg.sequence_parallel,
             expert_parallel=cfg.expert_parallel)
-    elif (cfg.sequence_parallel > 1 or cfg.expert_parallel > 1
-            or cfg.pipeline_parallel > 1):
-        n_axis = max(cfg.sequence_parallel, cfg.expert_parallel,
-                     cfg.pipeline_parallel)
+    elif cfg.sequence_parallel > 1 or cfg.expert_parallel > 1:
+        n_axis = max(cfg.sequence_parallel, cfg.expert_parallel)
         dp_req = (len(jax.devices()) // (n_axis * cfg.model_parallel)
                   if cfg.data_parallel == -1 else cfg.data_parallel)
         builder = (mesh_lib.build_seq_mesh if cfg.sequence_parallel > 1
-                   else mesh_lib.build_expert_mesh
-                   if cfg.expert_parallel > 1
-                   else mesh_lib.build_stage_mesh)
+                   else mesh_lib.build_expert_mesh)
         mesh = builder(max(dp_req, 1), n_axis,
                        model_parallel=cfg.model_parallel)
     else:

@@ -105,6 +105,7 @@ def adam(
     b2: float = 0.999,
     eps: float = 1e-8,
     weight_decay: float = 0.0,
+    moments_dtype=None,
 ) -> Optimizer:
     """Adam — ``tf.train.AdamOptimizer`` (BASELINE.json config 4).
 
@@ -113,24 +114,49 @@ def adam(
     bias correction; replicated here for parity. ``weight_decay`` is
     decoupled (AdamW): ``lr * wd * p`` subtracted outside the
     adaptive step.
-    """
+
+    ``moments_dtype`` (r5): storage dtype for the m/v slots —
+    ``jnp.bfloat16`` halves the optimizer state's HBM footprint AND
+    its per-step read+write traffic (Adam streams 2 slots in and out
+    every step; on a wide model that traffic is a measured ~10% of
+    step time, BASELINE.md r4 §transformer_wide). The update math is
+    unchanged f32 — slots are cast up on read, the freshly computed
+    f32 moment drives the param step, and only the STORE rounds to
+    bf16; params stay in their own (f32 master) dtype. bf16 shares
+    f32's exponent range, so v's many-decade dynamic range survives;
+    the mantissa rounding perturbs the step direction by ~0.4%
+    relative, pinned exactly by the numpy oracle
+    (tests/test_oracle.py)."""
 
     def init(params):
+        z = (jnp.zeros_like if moments_dtype is None
+             else (lambda p: jnp.zeros(jnp.shape(p), moments_dtype)))
         return {
             "count": jnp.zeros((), jnp.int32),
-            "mu": jax.tree.map(jnp.zeros_like, params),
-            "nu": jax.tree.map(jnp.zeros_like, params),
+            "mu": jax.tree.map(z, params),
+            "nu": jax.tree.map(z, params),
         }
 
     def update(grads, opt_state, params):
         count = opt_state["count"] + 1
         t = count.astype(jnp.float32)
-        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, opt_state["mu"], grads)
-        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g, opt_state["nu"], grads)
+        # moments_dtype set: cast slots up to f32 for the math, store
+        # rounded. None: native-dtype arithmetic, exactly as before.
+        up = ((lambda a: a.astype(jnp.float32))
+              if moments_dtype is not None else (lambda a: a))
+        mu = jax.tree.map(
+            lambda m, g: b1 * up(m) + (1 - b1) * up(g),
+            opt_state["mu"], grads)
+        nu = jax.tree.map(
+            lambda v, g: b2 * up(v) + (1 - b2) * up(g) * up(g),
+            opt_state["nu"], grads)
         lr_t = learning_rate * jnp.sqrt(1 - b2**t) / (1 - b1**t)
         new_params = jax.tree.map(
             lambda p, m, v: p - lr_t * m / (jnp.sqrt(v) + eps), params, mu, nu
         )
+        if moments_dtype is not None:
+            mu = jax.tree.map(lambda m: m.astype(moments_dtype), mu)
+            nu = jax.tree.map(lambda v: v.astype(moments_dtype), nu)
         return _decay(params, new_params, learning_rate, weight_decay), \
             {"count": count, "mu": mu, "nu": nu}
 
@@ -218,8 +244,11 @@ def make_optimizer(cfg, total_steps: int = 0) -> Optimizer:
     elif cfg.optimizer == "momentum":
         base = momentum(cfg.learning_rate, cfg.momentum, wd)
     elif cfg.optimizer == "adam":
+        md = getattr(cfg, "adam_moments_dtype", "float32")
         base = adam(cfg.learning_rate, cfg.adam_b1, cfg.adam_b2,
-                    cfg.adam_eps, wd)
+                    cfg.adam_eps, wd,
+                    moments_dtype=(jnp.bfloat16 if md == "bfloat16"
+                                   else None))
     else:
         raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
     if cfg.lr_schedule == "constant" and not cfg.warmup_steps:

@@ -55,6 +55,31 @@ def _tree_key(path) -> str:
                     for p in path)
 
 
+def _bit_dtype(dt) -> np.dtype | None:
+    """The same-width unsigned-int container for dtypes ``np.savez``
+    cannot round-trip (ml_dtypes' bfloat16/float8 register as numpy
+    kind 'V' and come back as raw void arrays that nothing can cast),
+    or None for native dtypes. Writers store the BITS in the
+    container; readers ``view`` them back."""
+    dt = np.dtype(dt)
+    if dt.kind in "biufcSU":
+        return None
+    return np.dtype(f"u{dt.itemsize}")
+
+
+def _encode_leaf(a) -> tuple[np.ndarray, str | None]:
+    """(savable array, original dtype name when bit-encoded)."""
+    a = np.asarray(a)
+    bit = _bit_dtype(a.dtype)
+    return (a.view(bit), a.dtype.name) if bit else (a, None)
+
+
+def _decode_leaf(a: np.ndarray, dtype_name: str) -> np.ndarray:
+    """Reinterpret a bit-container array back to its recorded dtype
+    (np.dtype resolves 'bfloat16' etc. because jax imports ml_dtypes)."""
+    return a.view(np.dtype(dtype_name))
+
+
 def _flatten_with_keys(tree: Any):
     return [(_tree_key(path), leaf)
             for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]]
@@ -73,7 +98,12 @@ def save_checkpoint(ckpt_dir: str, state: Any, step: int, epoch: int,
     os.makedirs(ckpt_dir, exist_ok=True)
     path = os.path.join(ckpt_dir, f"ckpt-{step:08d}.npz")
     tmp = path + ".tmp.npz"
-    payload = _flatten(state)
+    payload = {}
+    for k, v in _flatten(state).items():
+        enc, name = _encode_leaf(v)
+        payload[k] = enc
+        if name:   # bf16-family leaf: record the dtype to view back
+            payload[f"__dt_{k}__"] = np.asarray(name)
     payload["__step__"] = np.asarray(step, np.int64)
     payload["__epoch__"] = np.asarray(epoch, np.int64)
     for k, v in (extras or {}).items():
@@ -251,7 +281,9 @@ def save_checkpoint_sharded(ckpt_dir: str, state: Any, step: int,
         shapes[key] = (list(np.shape(leaf)),
                        np.dtype(jnp.result_type(leaf)).name)
         for j, (bounds, data) in enumerate(_local_shards(leaf)):
-            payload[f"{key}§{j}"] = data
+            # bf16-family shards bit-encode (savez round-trip); the
+            # manifest's recorded leaf dtype drives the view-back
+            payload[f"{key}§{j}"], _ = _encode_leaf(data)
             payload[f"{key}§{j}§idx"] = bounds
 
     fname = f"proc-{proc:05d}.npz"
@@ -319,7 +351,10 @@ def restore_sharded_arrays(path: str) -> Tuple[dict, int, int]:
                 key, _j = entry.rsplit("§", 1)
                 bounds = z[entry + "§idx"]
                 idx = tuple(slice(int(a), int(b)) for a, b in bounds)
-                data[key][idx] = z[entry]
+                val = z[entry]
+                if _bit_dtype(data[key].dtype) is not None:
+                    val = _decode_leaf(val, data[key].dtype.name)
+                data[key][idx] = val
                 boxes[key].append(np.asarray(bounds, np.int64))
 
     def _covers(bs, shape) -> bool:
@@ -403,5 +438,10 @@ def restore_checkpoint(path: str, state_template: Any) -> Tuple[Any, int, int]:
             data = {k: z[k] for k in z.files}
         step = int(data.pop("__step__"))
         epoch = int(data.pop("__epoch__"))
+        # bit-encoded leaves (bf16-family, _encode_leaf): view back
+        for dk in [k for k in data if k.startswith("__dt_")]:
+            name = str(data.pop(dk))
+            data[dk[len("__dt_"):-2]] = _decode_leaf(
+                data[dk[len("__dt_"):-2]], name)
     state = _rebuild(data, state_template, validate=True, ckpt_path=path)
     return state, step, epoch

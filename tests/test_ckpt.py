@@ -97,6 +97,40 @@ def test_qkv_layout_migration(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_bf16_leaves_roundtrip_both_formats(tmp_path):
+    """bf16-family leaves (e.g. --adam_moments_dtype=bfloat16 slots)
+    survive both on-disk formats bit-for-bit: np.savez cannot
+    round-trip ml_dtypes arrays (they come back as raw void), so
+    writers bit-encode into uint containers and readers view back."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_example_tpu.train.optim import adam
+
+    opt = adam(0.01, moments_dtype=jnp.bfloat16)
+    state = create_train_state(jax.random.PRNGKey(0), SPEC, opt)
+    # non-trivial moment values
+    g = jax.tree.map(lambda p: jnp.ones_like(p, jnp.float32) * 0.3,
+                     state.params)
+    new_p, new_o = opt.update(g, state.opt_state, state.params)
+    state = state.replace(params=new_p, opt_state=new_o) \
+        if hasattr(state, "replace") else type(state)(
+            state.step, new_p, new_o)
+    assert state.opt_state["mu"]["W1"].dtype == jnp.bfloat16
+
+    path = C.save_checkpoint(str(tmp_path / "single"), state, 3, 1)
+    restored, step, _ = C.restore_checkpoint(path, state)
+    assert step == 3
+    spath = C.save_checkpoint_sharded(str(tmp_path / "shard"), state, 3, 1)
+    restored_s, _, _ = C.restore_checkpoint(spath, state)
+    for got in (restored, restored_s):
+        for k in state.opt_state["mu"]:
+            a = np.asarray(got.opt_state["mu"][k])
+            b = np.asarray(state.opt_state["mu"][k])
+            assert a.dtype == b.dtype
+            np.testing.assert_array_equal(
+                a.view(np.uint16), b.view(np.uint16))
+
+
 def test_prune_checkpoints(tmp_path):
     opt = make_optimizer(Config())
     state = create_train_state(jax.random.PRNGKey(0), SPEC, opt)

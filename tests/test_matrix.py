@@ -86,6 +86,32 @@ CELLS += [
                    "microbatches": 2, "moe_dispatch": "alltoall"}),
     ("fsdp_tp_mlp", {"fsdp": True, "model_parallel": 2,
                      "data_parallel": 4, "activation": "relu"}),
+    # r5 additions: the full 4D crossings — PP x SP x TP and
+    # PP x EP x TP on ('data','stage','seq'|'expert','model') — plus
+    # the MoE balance loss under the interleaved pipeline
+    ("tfm_pp_moe_aux_interleaved", {**_TFM, "num_blocks": 4,
+                                    "num_experts": 4,
+                                    "pipeline_parallel": 2,
+                                    "expert_parallel": 2,
+                                    "data_parallel": 2,
+                                    "microbatches": 2,
+                                    "virtual_stages": 2,
+                                    "moe_aux_weight": 0.01}),
+    ("tfm_pp_sp_tp", {**_TFM, "pipeline_parallel": 2,
+                      "sequence_parallel": 2, "model_parallel": 2,
+                      "data_parallel": 1, "microbatches": 2}),
+    ("tfm_pp_ep_tp", {**_TFM, "num_experts": 4, "pipeline_parallel": 2,
+                      "expert_parallel": 2, "model_parallel": 2,
+                      "data_parallel": 1, "microbatches": 2,
+                      "moe_dispatch": "alltoall"}),
+    # r5: bf16 Adam moment storage (f32 master params + update math)
+    # and dropout through the FSDP and pipeline steps
+    ("adam_bf16_moments", {"optimizer": "adam", "learning_rate": 0.001,
+                           "adam_moments_dtype": "bfloat16"}),
+    ("tfm_fsdp_dropout", {**_TFM, "fsdp": True, "dropout_rate": 0.1}),
+    ("tfm_pp_dropout", {**_TFM, "pipeline_parallel": 2,
+                        "data_parallel": 4, "microbatches": 2,
+                        "dropout_rate": 0.1}),
 ]
 
 

@@ -49,6 +49,57 @@ def test_adam_matches_numpy_reference():
     np.testing.assert_allclose(np.asarray(params["w"]), p_np, rtol=1e-5, atol=1e-6)
 
 
+def test_adam_bf16_moments_match_numpy_oracle():
+    """bf16 moment storage (r5, VERDICT r4 next #9): the update math
+    stays f32 — slots cast up on read, the fresh f32 moment drives the
+    param step, only the STORE rounds — so a numpy oracle that rounds
+    its f32 moments through bfloat16 at exactly the store boundary
+    reproduces the params EXACTLY (not approximately) over multiple
+    steps, with f32 master params throughout. The MOMENTS match the
+    oracle bit-for-bit (the rounding contract); the params carry the
+    same fp-associativity tolerance as the f32 Adam oracle (XLA fuses
+    the update arithmetic)."""
+    import ml_dtypes
+
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    opt = optim.adam(lr, b1, b2, eps, moments_dtype=jnp.bfloat16)
+    rng = np.random.RandomState(3)
+    p_np = rng.randn(64).astype(np.float32)
+    params = {"w": jnp.asarray(p_np)}
+    s = opt.init(params)
+    assert s["mu"]["w"].dtype == jnp.bfloat16
+    assert s["nu"]["w"].dtype == jnp.bfloat16
+    m = np.zeros(64, np.float32)
+    v = np.zeros(64, np.float32)
+    for t in range(1, 6):
+        g_np = rng.randn(64).astype(np.float32)
+        params, s = opt.update({"w": jnp.asarray(g_np)}, s, params)
+        # oracle: f32 math on the bf16-rounded PREVIOUS moments
+        m_f = b1 * m.astype(np.float32) + (1 - b1) * g_np
+        v_f = b2 * v.astype(np.float32) + (1 - b2) * g_np**2
+        lr_t = lr * np.sqrt(1 - b2**t) / (1 - b1**t)
+        p_np = (p_np - lr_t * m_f / (np.sqrt(v_f) + eps)).astype(
+            np.float32)
+        m = m_f.astype(ml_dtypes.bfloat16).astype(np.float32)
+        v = v_f.astype(ml_dtypes.bfloat16).astype(np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(s["mu"]["w"]).astype(np.float32), m)
+    assert params["w"].dtype == jnp.float32          # f32 master
+    np.testing.assert_allclose(np.asarray(params["w"]), p_np,
+                               rtol=1e-5, atol=1e-6)
+    # the rounding is benign: close to the exact-f32 trajectory
+    opt32 = optim.adam(lr, b1, b2, eps)
+    rng = np.random.RandomState(3)
+    p32 = {"w": jnp.asarray(rng.randn(64).astype(np.float32))}
+    s32 = opt32.init(p32)
+    for _ in range(5):
+        g = rng.randn(64).astype(np.float32)
+        p32, s32 = opt32.update({"w": jnp.asarray(g)}, s32, p32)
+    np.testing.assert_allclose(np.asarray(params["w"]),
+                               np.asarray(p32["w"]), rtol=2e-3,
+                               atol=2e-4)
+
+
 def test_state_pspecs_structure():
     from jax.sharding import PartitionSpec as P
 

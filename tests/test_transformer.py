@@ -724,8 +724,93 @@ def test_dropout_validation():
 
     with pytest.raises(ValueError, match="transformer only"):
         run(Config(dropout_rate=0.1))
+    # r5: fsdp + dropout is supported; async local-SGD stays gated
     with pytest.raises(ValueError, match="synchronous"):
-        run(Config(model="transformer", dropout_rate=0.1, fsdp=True))
+        run(Config(model="transformer", dropout_rate=0.1,
+                   sync_period=3))
+
+
+def test_dropout_fsdp_matches_sync_step(devices8):
+    """Dropout under FSDP (r5, VERDICT r4 next #2): the FSDP step
+    derives its per-shard dropout rng from the same (seed, step,
+    data-index) stream as the sync step, so an FSDP-with-dropout step
+    over dp=8 must reproduce the plain sync dropout step's update."""
+    from distributed_tensorflow_example_tpu.parallel import fsdp as fsdp_lib
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+    from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+    spec = _spec(dropout_rate=0.3)
+    cfg = Config(model="transformer", learning_rate=0.01,
+                 dropout_rate=0.3, data_parallel=8)
+    opt = make_optimizer(cfg)
+    rng = np.random.RandomState(43)
+    x = rng.rand(16, spec.input_size).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 16)]
+    mesh = mesh_lib.build_mesh(8, 1, devices=devices8)
+
+    st_s = create_train_state(jax.random.PRNGKey(1), spec, opt)
+    st_s = mesh_lib.place_state(st_s, mesh,
+                                mesh_lib.state_pspecs(spec, opt, 1))
+    sync = step_lib.build_train_step(cfg, mesh, spec, opt)
+    new_s, c_s, _ = sync(st_s, x, y)
+    p_s = jax.tree.map(np.asarray, new_s.params)
+
+    cfg_f = cfg.replace(fsdp=True)
+    full = jax.tree.map(
+        np.asarray, create_train_state(jax.random.PRNGKey(1), spec, opt))
+    st_f = fsdp_lib.shard_state_host(full, 8)
+    st_f = mesh_lib.place_state(st_f, mesh, fsdp_lib.fsdp_specs(st_f))
+    fstep = fsdp_lib.build_fsdp_train_step(cfg_f, mesh, spec, opt, full)
+    new_f, c_f, _ = fstep(st_f, x, y)
+    gather = fsdp_lib.build_gather_params(mesh, full)
+    p_f = jax.tree.map(np.asarray, gather(new_f))
+
+    assert abs(float(c_s) - float(c_f)) < 1e-6
+    for k in p_s:
+        np.testing.assert_allclose(p_f[k], p_s[k], rtol=2e-6, atol=2e-7,
+                                   err_msg=k)
+
+
+def test_dropout_pp_deterministic_and_distinct(devices8):
+    """Dropout under PP (r5): the pipelined step is deterministic per
+    (seed, step), drops (differs from rate-0), decorrelates masks
+    across microbatches (differs from a 1-microbatch run), and trains
+    through the driver."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+    from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+    rng = np.random.RandomState(47)
+    x = rng.rand(8, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]
+
+    def one(rate, microbatches):
+        spec = _spec(dropout_rate=rate, num_blocks=2)
+        cfg = Config(model="transformer", learning_rate=0.01,
+                     dropout_rate=rate, pipeline_parallel=2,
+                     num_blocks=2, microbatches=microbatches)
+        opt = make_optimizer(cfg)
+        mesh = mesh_lib.build_stage_mesh(2, 2, devices=devices8[:4])
+        st = create_train_state(jax.random.PRNGKey(1), spec, opt)
+        st = tfm.pipeline_train_state(spec, opt, st, 2, 1)
+        st = mesh_lib.place_state(
+            st, mesh,
+            mesh_lib.pipeline_state_pspecs(spec, opt,
+                                           mesh_lib.STAGE_AXIS))
+        step = step_lib.build_train_step(cfg, mesh, spec, opt)
+        _, cost, _ = step(st, x, y)
+        return float(cost)
+
+    c_a = one(0.5, 2)
+    c_b = one(0.5, 2)
+    assert abs(c_a - c_b) < 1e-12          # deterministic per step
+    c_0 = one(0.0, 2)
+    assert abs(c_a - c_0) > 1e-6           # masks actually dropped
+    c_m1 = one(0.5, 1)
+    assert abs(c_a - c_m1) > 1e-9          # per-microbatch streams
 
 
 def test_dropout_driver_trains(devices8, tmp_path):
@@ -1133,14 +1218,12 @@ def test_pp_validation():
         run(Config(pipeline_parallel=2))
     with pytest.raises(ValueError, match="divide evenly"):
         run(Config(model="transformer", pipeline_parallel=3, num_blocks=2))
-    # PP x MoE is SUPPORTED since r4; only the balance loss is not
-    with pytest.raises(ValueError, match="balance loss"):
-        run(Config(model="transformer", pipeline_parallel=2,
-                   num_blocks=2, num_experts=4, moe_aux_weight=0.01))
-    with pytest.raises(ValueError, match="ONE inner axis"):
+    # r5: PP x MoE incl. the balance loss AND every TP crossing are
+    # supported; only seq x expert under PP stays rejected
+    with pytest.raises(ValueError, match="not both"):
         run(Config(model="transformer", pipeline_parallel=2,
                    num_blocks=2, num_experts=4, expert_parallel=2,
-                   model_parallel=2))
+                   sequence_parallel=2))
     with pytest.raises(ValueError, match="pipeline_parallel > 1"):
         run(Config(model="transformer", virtual_stages=2))
     with pytest.raises(ValueError, match="virtual_stages"):
@@ -1338,6 +1421,63 @@ def test_pp_ep_matches_single_device(devices8, dispatch):
                                    err_msg=k)
 
 
+@pytest.mark.parametrize("dispatch", ["dense", "alltoall"])
+def test_pp_moe_aux_matches_single_device(devices8, dispatch):
+    """The MoE balance loss under PP (r5, VERDICT r4 next #2): per-tick
+    (f, P) router statistics accumulated across microbatches and
+    combined after the schedule must optimize the exact single-device
+    objective — the updated params (whose gradients flow through the
+    aux term) match the flat step's."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+    from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+    kw = dict(num_blocks=2, num_experts=4, moe_dispatch=dispatch,
+              aux_loss_weight=0.05)
+    if dispatch == "alltoall":
+        kw["capacity_factor"] = 4.0   # no drops -> exact equivalence
+    spec = _spec(**kw)
+    moe_cfg = dict(num_experts=4, moe_dispatch=dispatch,
+                   moe_aux_weight=0.05,
+                   **({"capacity_factor": 4.0}
+                      if dispatch == "alltoall" else {}))
+    cfg = Config(model="transformer", learning_rate=0.01,
+                 pipeline_parallel=2, expert_parallel=2, num_blocks=2,
+                 microbatches=2, **moe_cfg)
+    opt = make_optimizer(cfg)
+    rng = np.random.RandomState(41)
+    x = rng.rand(8, spec.input_size).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]
+
+    cfg1 = Config(model="transformer", learning_rate=0.01, **moe_cfg)
+    mesh1 = mesh_lib.build_mesh(1, 1, devices=devices8[:1])
+    st1 = create_train_state(jax.random.PRNGKey(1), spec, opt)
+    st1 = mesh_lib.place_state(st1, mesh1,
+                               mesh_lib.state_pspecs(spec, opt, 1))
+    step1 = step_lib.build_train_step(cfg1, mesh1, spec, opt)
+    new1, c1, _ = step1(st1, x, y)
+    p1 = jax.tree.map(np.asarray, new1.params)
+
+    meshp = mesh_lib.build_stage_mesh(2, 2, devices=devices8,
+                                      expert_parallel=2)
+    st = create_train_state(jax.random.PRNGKey(1), spec, opt)
+    st = tfm.pipeline_train_state(spec, opt, st, 2, 1)
+    st = mesh_lib.place_state(
+        st, meshp,
+        mesh_lib.pipeline_state_pspecs(
+            spec, opt, mesh_lib.STAGE_AXIS, None, mesh_lib.EXPERT_AXIS))
+    stepp = step_lib.build_train_step(cfg, meshp, spec, opt)
+    newp, cp, _ = stepp(st, x, y)
+    pp_un = tfm.pipeline_unstack_params(
+        spec, jax.tree.map(np.asarray, newp.params), 2, 1)
+
+    assert abs(c1 - float(cp)) < 2e-5   # reported cost stays plain CE
+    for k in p1:
+        np.testing.assert_allclose(pp_un[k], p1[k], rtol=3e-5, atol=3e-6,
+                                   err_msg=k)
+
+
 def test_pp_ep_driver_end_to_end(devices8):
     """--pipeline_parallel x --expert_parallel through the full driver
     (sparse dispatch: tokens shard over 'expert' too)."""
@@ -1393,12 +1533,139 @@ def test_apply_pipeline_rejects_virtual_on_one_stage():
                            num_microbatches=2, virtual=2)
 
 
-def test_pp_sp_tp_rejected():
+@pytest.mark.parametrize("objective", ["classify", "lm"])
+def test_pp_sp_tp_matches_single_device(devices8, objective):
+    """The standard 4D recipe (r5, VERDICT r4 next #2): PP x SP x TP
+    on a ('data','stage','seq','model') 1x2x2x2 mesh — ring attention
+    across seq shards of TP-local heads inside every pipeline chunk,
+    Megatron psums over 'model', stage hops over 'stage' — must match
+    the single-device step."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+    from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+    kw = dict(num_blocks=2)
+    if objective == "lm":
+        kw.update(objective="lm", input_size=32, seq_len=32,
+                  vocab_size=16, causal=True)
+    spec = _spec(**kw)
+    cfg = Config(model="transformer", learning_rate=0.01,
+                 pipeline_parallel=2, sequence_parallel=2,
+                 model_parallel=2, num_blocks=2, microbatches=2)
+    opt = make_optimizer(cfg)
+    rng = np.random.RandomState(31)
+    x = rng.rand(4, spec.input_size).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 4)]
+
+    cfg1 = Config(model="transformer", learning_rate=0.01)
+    mesh1 = mesh_lib.build_mesh(1, 1, devices=devices8[:1])
+    st1 = create_train_state(jax.random.PRNGKey(1), spec, opt)
+    st1 = mesh_lib.place_state(st1, mesh1,
+                               mesh_lib.state_pspecs(spec, opt, 1))
+    step1 = step_lib.build_train_step(cfg1, mesh1, spec, opt)
+    new1, c1, a1 = step1(st1, x, y)
+    p1 = jax.tree.map(np.asarray, new1.params)
+
+    meshp = mesh_lib.build_stage_mesh(1, 2, devices=devices8,
+                                      sequence_parallel=2,
+                                      model_parallel=2)
+    st = create_train_state(jax.random.PRNGKey(1), spec, opt)
+    st = tfm.pipeline_train_state(spec, opt, st, 2, 1)
+    st = mesh_lib.place_state(
+        st, meshp,
+        mesh_lib.pipeline_state_pspecs(
+            spec, opt, mesh_lib.STAGE_AXIS, mesh_lib.MODEL_AXIS))
+    stepp = step_lib.build_train_step(cfg, meshp, spec, opt)
+    newp, cp, ap = stepp(st, x, y)
+    pp_un = tfm.pipeline_unstack_params(
+        spec, jax.tree.map(np.asarray, newp.params), 2, 1)
+
+    assert abs(c1 - float(cp)) < 2e-5
+    assert abs(a1 - float(ap)) < 2e-5
+    for k in p1:
+        np.testing.assert_allclose(pp_un[k], p1[k], rtol=3e-5, atol=3e-6,
+                                   err_msg=k)
+
+
+def test_pp_ep_tp_matches_single_device(devices8):
+    """PP x EP x TP (r5): ('data','stage','expert','model') 1x2x2x2 —
+    expert stacks shard over 'expert' while the attention side of
+    every pipelined block Megatron-shards over 'model'."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+    from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+    spec = _spec(num_blocks=2, num_experts=4)
+    cfg = Config(model="transformer", learning_rate=0.01,
+                 pipeline_parallel=2, expert_parallel=2,
+                 model_parallel=2, num_blocks=2, num_experts=4,
+                 microbatches=2)
+    opt = make_optimizer(cfg)
+    rng = np.random.RandomState(37)
+    x = rng.rand(4, spec.input_size).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 4)]
+
+    cfg1 = Config(model="transformer", learning_rate=0.01, num_experts=4)
+    mesh1 = mesh_lib.build_mesh(1, 1, devices=devices8[:1])
+    st1 = create_train_state(jax.random.PRNGKey(1), spec, opt)
+    st1 = mesh_lib.place_state(st1, mesh1,
+                               mesh_lib.state_pspecs(spec, opt, 1))
+    step1 = step_lib.build_train_step(cfg1, mesh1, spec, opt)
+    new1, c1, a1 = step1(st1, x, y)
+    p1 = jax.tree.map(np.asarray, new1.params)
+
+    meshp = mesh_lib.build_stage_mesh(1, 2, devices=devices8,
+                                      expert_parallel=2,
+                                      model_parallel=2)
+    st = create_train_state(jax.random.PRNGKey(1), spec, opt)
+    st = tfm.pipeline_train_state(spec, opt, st, 2, 1)
+    st = mesh_lib.place_state(
+        st, meshp,
+        mesh_lib.pipeline_state_pspecs(
+            spec, opt, mesh_lib.STAGE_AXIS, mesh_lib.MODEL_AXIS,
+            mesh_lib.EXPERT_AXIS))
+    stepp = step_lib.build_train_step(cfg, meshp, spec, opt)
+    newp, cp, ap = stepp(st, x, y)
+    pp_un = tfm.pipeline_unstack_params(
+        spec, jax.tree.map(np.asarray, newp.params), 2, 1)
+
+    assert abs(c1 - float(cp)) < 2e-5
+    for k in p1:
+        np.testing.assert_allclose(pp_un[k], p1[k], rtol=3e-5, atol=3e-6,
+                                   err_msg=k)
+
+
+def test_pp_sp_tp_driver_end_to_end(devices8):
+    """The 4D crossing through the full driver: --pipeline_parallel x
+    --sequence_parallel x --model_parallel (x data) in one run."""
     from distributed_tensorflow_example_tpu.train.loop import run
 
-    with pytest.raises(ValueError, match="PP x SP x TP"):
+    res = run(Config(
+        model="transformer", objective="lm", input_size=32,
+        vocab_size=16, d_model=32, n_heads=2, num_blocks=2, d_ff=64,
+        causal=True, pipeline_parallel=2, sequence_parallel=2,
+        model_parallel=2, data_parallel=1, microbatches=2,
+        training_epochs=1, batch_size=32, learning_rate=0.003,
+        optimizer="adam", dataset="synthetic",
+        synthetic_train_size=128, synthetic_test_size=32,
+        summaries=False, compilation_cache="", frequency=4,
+    ))
+    assert res["devices"] == 8
+    assert np.isfinite(res["final_cost"])
+    assert res["test_accuracy"] > 1.0 / 16
+
+
+def test_pp_sp_ep_rejected():
+    """seq- and expert-sharding together under PP stays rejected
+    (token-sharded sparse capacity pools are not defined)."""
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    with pytest.raises(ValueError, match="not both"):
         run(Config(model="transformer", pipeline_parallel=2,
-                   num_blocks=2, sequence_parallel=2, model_parallel=2))
+                   num_blocks=2, num_experts=4, sequence_parallel=2,
+                   expert_parallel=2))
 
 
 def test_pp_interleaved_resume_layout_guard(devices8, tmp_path):
