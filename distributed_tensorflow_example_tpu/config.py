@@ -174,6 +174,10 @@ class Config:
                                     # state split 1/dp per device, gathered
                                     # at use, grads reduce-scattered
                                     # (parallel/fsdp.py)
+    zero_opt: bool = False          # ZeRO-1: OPTIMIZER state split 1/dp
+                                    # per data rank (params keep their
+                                    # layout — composes with the
+                                    # pipeline); parallel/zero.py
     remat: bool = False             # jax.checkpoint the forward: recompute
                                     # activations in backward (HBM<->FLOPs)
 
@@ -374,6 +378,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["mean", "sum"])
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO-3: shard params+optimizer state 1/dp per device")
+    p.add_argument("--zero_opt", action="store_true",
+                   help="ZeRO-1: shard OPTIMIZER state 1/dp over the "
+                        "data axis (params keep their layout; composes "
+                        "with --pipeline_parallel and TP/EP)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize activations in the backward pass")
     p.add_argument("--data_dir", type=str, default=d.data_dir)

@@ -276,14 +276,17 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
                         pipeline: tuple | None = None,
                         model_axis: str | None = None,
                         batch_axes: tuple = (DATA_AXIS,),
-                        param_pspecs=None) -> Callable:
+                        param_pspecs=None,
+                        zero_dp: int = 0) -> Callable:
     """The per-shard synchronous step body (state, x, y) -> (state, cost,
     acc) — shared by the host-fed step (build_train_step) and the
     device-resident scan paths (parallel/epoch.py) so both train with
     identical semantics. ``dp`` is the total number of batch shards
     (the product of the ``batch_axes`` sizes — more than one axis under
     sparse-dispatch expert parallelism, where tokens shard over
-    'expert' too)."""
+    'expert' too). ``zero_dp`` > 0 swaps the optimizer apply for the
+    ZeRO-1 chunked update (parallel/zero.py): slots arrive as flat
+    1/zero_dp shards over 'data' and the updated params all-gather."""
 
     # token-sharding axes for the MoE balance loss: the batch axes
     # plus the sequence axis when the token dim itself is sharded
@@ -351,7 +354,14 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
                 from ..train.optim import clip_by_global_norm
 
                 grads, _ = clip_by_global_norm(grads, cfg.grad_clip)
-        new_params, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        if zero_dp:
+            from .zero import zero_update
+
+            new_params, new_opt = zero_update(
+                optimizer, grads, state.opt_state, state.params, zero_dp)
+        else:
+            new_params, new_opt = optimizer.update(
+                grads, state.opt_state, state.params)
         cost = jax.lax.pmean(cost, batch_axes)
         acc = jax.lax.pmean(acc, batch_axes)
         return TrainState(state.step + 1, new_params, new_opt), cost, acc
@@ -470,10 +480,19 @@ def build_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer) -> Callable:
     # axis under sequence parallelism, plus 'expert' under
     # sparse-dispatch EP where tokens shard over the expert axis too)
     batch_axes, shards, x_spec, y_spec = batch_layout(mesh, spec)
+    zero_dp = 0
+    if getattr(cfg, "zero_opt", False):
+        from ..train.state import TrainState as TS
+        from .zero import zero_state_pspecs
+
+        zero_dp = mesh.shape[DATA_AXIS]
+        sspecs = TS(step=P(), params=sspecs.params,
+                    opt_state=zero_state_pspecs(optimizer, sspecs.params))
     shard_step = make_sync_step_body(cfg, spec, styles, shards, optimizer,
                                      seq_axis, expert_axis, pipeline,
                                      model_axis, batch_axes,
-                                     param_pspecs=sspecs.params)
+                                     param_pspecs=sspecs.params,
+                                     zero_dp=zero_dp)
     fn = jax.shard_map(
         shard_step,
         mesh=mesh,

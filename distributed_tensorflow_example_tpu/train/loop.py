@@ -139,6 +139,13 @@ def run(cfg: Config) -> Dict[str, Any]:
     # bad flag combination fails fast and never strands peer processes.
     if cfg.fsdp and cfg.sync_period > 1:
         raise ValueError("--fsdp requires the synchronous step (sync_period=1)")
+    if cfg.zero_opt:
+        if cfg.fsdp:
+            raise ValueError("--zero_opt is redundant under --fsdp "
+                             "(ZeRO-3 already shards optimizer state)")
+        if cfg.sync_period > 1:
+            raise ValueError("--zero_opt requires the synchronous step "
+                             "(sync_period=1)")
     if cfg.sequence_parallel < 1:
         raise ValueError(
             f"sequence_parallel={cfg.sequence_parallel} must be >= 1")
@@ -363,9 +370,10 @@ def run(cfg: Config) -> Dict[str, Any]:
         and (cfg.shard_data or dp == 1)
         # sequence-parallel steps shard x over ('data','seq'), which the
         # scan runners' P('data') dataset layout doesn't express yet;
-        # expert-parallel state pspecs likewise
+        # expert-parallel state pspecs likewise; the ZeRO-1 flat slot
+        # layout is a host-path feature
         and cfg.sequence_parallel == 1 and cfg.expert_parallel == 1
-        and cfg.pipeline_parallel == 1
+        and cfg.pipeline_parallel == 1 and not cfg.zero_opt
         # async fast path runs the whole program on-device; periodic
         # host-side checkpoints and early stopping need the host loop
         and not (async_mode and (cfg.checkpoint_every or cfg.model_parallel > 1
@@ -433,6 +441,21 @@ def run(cfg: Config) -> Dict[str, Any]:
             sspecs = mesh_lib.state_pspecs(
                 spec, optimizer, cfg.model_parallel,
                 mesh_lib.axis_if_present(mesh, mesh_lib.EXPERT_AXIS))
+        if cfg.zero_opt:
+            # ZeRO-1 (r5): re-lay the optimizer slots as flat
+            # [.., dp, chunk] shards over 'data' — composes with the
+            # PP-stacked params above (slot memory: state/(p*dp))
+            from jax.sharding import PartitionSpec as P_
+
+            from ..parallel import zero as zero_lib
+            from .state import TrainState
+
+            z_state, z_specs = zero_lib.zero_opt_state(
+                optimizer, state.params, sspecs.params, mesh, dp)
+            state = TrainState(step=state.step, params=state.params,
+                               opt_state=z_state)
+            sspecs = TrainState(step=P_(), params=sspecs.params,
+                                opt_state=z_specs)
     state = mesh_lib.place_state(state, mesh, sspecs)
     print("Variables initialized ...")  # example.py:130
 
@@ -442,6 +465,14 @@ def run(cfg: Config) -> Dict[str, Any]:
         path = ckpt_lib.latest_checkpoint(cfg.checkpoint_dir)
         if path:
             resumed_extras = ckpt_lib.load_extras(path)
+            saved_zdp = int(resumed_extras.get("zero_dp", 0))
+            if saved_zdp != (dp if cfg.zero_opt else 0):
+                raise ValueError(
+                    f"checkpoint {path} was written with "
+                    f"zero_dp={saved_zdp} (ZeRO-1 flat slots are "
+                    f"dp-shaped): resume needs the same --zero_opt "
+                    f"setting and data-parallel degree (this run: "
+                    f"{dp if cfg.zero_opt else 0})")
             if pp_mode:
                 # the stacked block ORDER is (stages, virtual)-pinned
                 # once virtual > 1 (pipeline_stack_params); shapes
@@ -599,6 +630,9 @@ def run(cfg: Config) -> Dict[str, Any]:
             # validation above)
             extras.update(pp_stages=cfg.pipeline_parallel,
                           pp_virtual=cfg.virtual_stages)
+        if cfg.zero_opt:
+            # flat slot chunking is dp-shaped; resume validates it
+            extras.update(zero_dp=dp)
         if fsdp_mode and cfg.sharded_checkpoints:
             # a sharded-FSDP checkpoint stores the flat [.., dp, chunk]
             # layout; resume needs the model-parallel degree it was

@@ -263,9 +263,10 @@ def save_checkpoint_sharded(ckpt_dir: str, state: Any, step: int,
     peer shard files — harmless on a shared FS (`_sharded_complete`
     keeps the checkpoint invisible until every named file exists), but
     on per-host local disks the format would yield permanently
-    incomplete checkpoints. Retention callers should pass the step
-    just written to ``prune_checkpoints(before_step=...)`` so the
-    possibly-still-landing checkpoint is counted, not skipped."""
+    incomplete checkpoints. Retention: the possibly-still-landing
+    checkpoint is deliberately NOT counted by ``prune_checkpoints``
+    (see its docstring) — the disk transiently holds keep+1 entries
+    rather than ever deleting a durable checkpoint early."""
     wait_for_pending_saves()
     path = os.path.join(ckpt_dir, f"ckpt-{step:08d}.shards")
     os.makedirs(path, exist_ok=True)

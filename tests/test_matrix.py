@@ -112,6 +112,13 @@ CELLS += [
     ("tfm_pp_dropout", {**_TFM, "pipeline_parallel": 2,
                         "data_parallel": 4, "microbatches": 2,
                         "dropout_rate": 0.1}),
+    # r5: ZeRO-1 slots under plain DP and under the pipeline
+    ("zero_mlp", {"zero_opt": True, "optimizer": "adam",
+                  "learning_rate": 0.001}),
+    ("tfm_pp_zero", {**_TFM, "pipeline_parallel": 2,
+                     "data_parallel": 4, "microbatches": 2,
+                     "zero_opt": True, "optimizer": "adam",
+                     "learning_rate": 0.001}),
 ]
 
 
