@@ -143,6 +143,13 @@ class Config:
                                     # this many non-contiguous block
                                     # chunks; bubble shrinks ~v-fold
                                     # (pipeline_parallel > 1 only)
+    pp_schedule: str = "gpipe"      # gpipe (jax.grad through the tick
+                                    # loop; --remat caps residuals per
+                                    # slot) | 1f1b (fused fwd/bwd
+                                    # ticks: live microbatch stashes
+                                    # cap at 2p-1, M-independent —
+                                    # transformer.pipeline_value_and_
+                                    # grad_1f1b)
     expert_parallel: int = 1        # MoE transformer only: shard the expert
                                     # stacks over a ('data','expert') mesh
                                     # (weights, optimizer state and expert
@@ -360,6 +367,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--virtual_stages", type=int, default=d.virtual_stages,
                    help="interleaved virtual stages per pipeline stage "
                         "(>1 shrinks the pipeline bubble ~v-fold)")
+    p.add_argument("--pp_schedule", type=str, default=d.pp_schedule,
+                   choices=["gpipe", "1f1b"],
+                   help="pipeline schedule: gpipe (all-forward then "
+                        "all-backward) vs 1f1b (fused ticks; live "
+                        "microbatch activations cap at 2p-1, "
+                        "M-independent)")
     p.add_argument("--sequence_parallel", type=int, default=d.sequence_parallel,
                    help="transformer only: shard the token axis over a "
                         "('data','seq') mesh (--sp_impl selects the layout)")

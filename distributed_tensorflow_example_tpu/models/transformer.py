@@ -43,6 +43,7 @@ from typing import Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 Params = Dict[str, jnp.ndarray]
 
@@ -413,8 +414,8 @@ def _moe_ffn_sparse(spec: TransformerSpec, bp: Params, a, act,
 
     Each of a token's k routing choices goes to one expert buffer of
     static capacity ``C = ceil(capacity_factor * T * k / E)``
-    (position assigned by a
-    cumsum over the routing one-hot; tokens past capacity are dropped —
+    (position assigned by a stable argsort over the routing choices —
+    O(kT·log(kT)), E-independent; tokens past capacity are dropped —
     their FFN contribution is zero and the residual stream carries
     them, exactly Switch Transformer's overflow semantics). Under
     expert parallelism the ``[E, C, d]`` buffers are exchanged with ONE
@@ -444,16 +445,25 @@ def _moe_ffn_sparse(spec: TransformerSpec, bp: Params, a, act,
     # rule (under overflow a high-gate first choice must never lose
     # its slot to an earlier token's low-gate runner-up)
     flat_e = idx.T.reshape(k * t)
-    onehot = jax.nn.one_hot(flat_e, e, dtype=jnp.float32)   # [k*T, E]
     # position of each unit within its expert's buffer (0-based,
-    # arrival order = rank then token); routing via scatter/gather on
-    # a flat [E*C] slot index — O(T*k*E + E*C*d) memory, NOT the
+    # arrival order = rank then token), by STABLE argsort instead of a
+    # [k*T, E] one-hot cumsum (VERDICT r4 next #6: that was O(k·T·E)
+    # work/memory, linear in E — this is O(kT·log kT), E-independent):
+    # sorting groups units by expert while the stable tie-break keeps
+    # them in priority (index) order, so a unit's buffer position is
+    # its sorted rank minus its expert group's first sorted rank
+    # (found by searchsorted on the sorted keys). Routing then runs
+    # via scatter/gather on a flat [E*C] slot index — NOT the
     # [T, E, C] one-hot dispatch tensor (cf*T^2 — it OOMs the moment a
     # big eval batch walks through; overflow and out-slot both land in
     # a trash row past the buffer)
-    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) - 1.0
+    order = jnp.argsort(flat_e, stable=True)                # [k*T]
+    sorted_e = flat_e[order]
+    group_start = jnp.searchsorted(sorted_e, sorted_e, side="left")
+    pos_sorted = jnp.arange(k * t, dtype=jnp.int32) - group_start
+    pos = jnp.zeros((k * t,), jnp.int32).at[order].set(pos_sorted)
     keep = pos < cap
-    slot = jnp.where(keep, flat_e * cap + pos.astype(jnp.int32), e * cap)
+    slot = jnp.where(keep, flat_e * cap + pos, e * cap)
     xk = jnp.broadcast_to(x[None].astype(jnp.float32),
                           (k, t, d)).reshape(k * t, d)
     buf = jnp.zeros((e * cap + 1, d), jnp.float32)
@@ -787,7 +797,8 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
                    seq_axis: str | None = None,
                    expert_axis: str | None = None,
                    with_aux: bool = False, aux_axes=(),
-                   dropout_rng=None) -> jnp.ndarray:
+                   dropout_rng=None,
+                   slot_remat: bool = False) -> jnp.ndarray:
     """Pipeline-parallel forward inside shard_map: GPipe microbatch
     schedule at ``virtual == 1``, Megatron interleaved virtual stages
     at ``virtual > 1``.
@@ -915,9 +926,9 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
     want_aux = bool(with_aux and spec.num_experts)
     kc = spec.num_blocks // (p * v)   # blocks per chunk
 
-    def run_chunk(c, h, rng_m):
+    def run_chunk(lv, c, h, rng_m):
         bp_c = {k: jax.lax.dynamic_index_in_dim(a, c, 0, keepdims=False)
-                for k, a in local_v.items()}
+                for k, a in lv.items()}
         # globally-distinct dropout salts: this stage's stacked slice
         # starts at sidx*K; chunk c's blocks occupy positions
         # base..base+kc-1 (traced ints — fold_in takes them fine)
@@ -936,6 +947,17 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
 
         h_, aux_c = jax.lax.scan(body, h, (bp_c, jnp.arange(kc)))
         return h_, aux_c   # aux_c: [K/v, 2, E] raw stats, or None
+
+    # per-SLOT rematerialization (VERDICT r4 next #4, the
+    # schedule-aware-freeing half): checkpointing each (tick, chunk)
+    # slot means jax.grad's backward saves only every slot's INPUT
+    # [mb, S, D] — M live input buffers per stage — and recomputes the
+    # intra-slot residuals (attention stats, FFN hiddens: the ~10x
+    # bigger set) one slot at a time in the reverse schedule. A
+    # whole-forward jax.checkpoint cannot do this: its backward
+    # re-runs the entire tick loop and then holds every recomputed
+    # residual at once.
+    chunk_fn = jax.checkpoint(run_chunk) if slot_remat else run_chunk
 
     # full-circle ppermute only when the wrap hop is live (v > 1)
     perm = ([(j, (j + 1) % p) for j in range(p)] if v > 1
@@ -980,7 +1002,7 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
         h_in = jnp.where(
             jnp.logical_and(jnp.equal(sidx, 0), jnp.equal(c, 0)),
             _dropout(embed(m), spec, rng_m, 0x9999), recv)
-        h_out, aux_c = run_chunk(c, h_in, rng_m)
+        h_out, aux_c = chunk_fn(local_v, c, h_in, rng_m)
         if want_aux:
             # accumulate this live slot's chunk stats (dead slots
             # computed on stale values: masked to zero)
@@ -1025,6 +1047,246 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
         local = spec.num_experts * jnp.sum(f * pr)
         aux = jax.lax.psum(local, stage_axis) / spec.num_blocks
     return out, aux
+
+
+def pipeline_value_and_grad_1f1b(
+        spec: TransformerSpec, params: Params, x: jnp.ndarray,
+        stage_axis: str, n_stages: int, num_microbatches: int,
+        loss_of, head_fn=None, head_width: int | None = None,
+        model_axis: str | None = None, dropout_rng=None,
+        batch_axes: tuple = ()):
+    """1F1B pipeline schedule (VERDICT r4 next #4): fused forward AND
+    backward ticks so live microbatch activations cap at ``2p-1``
+    input buffers — O(p), M-independent — instead of ``jax.grad``
+    through the GPipe forward holding all M microbatches' residuals.
+
+    Schedule (one combined tick = one forward sub-slot + one backward
+    sub-slot per stage, forced by the dependency chain): stage ``s``
+    forwards microbatch ``m`` at tick ``m + s`` (GPipe wavefront) and
+    backwards it at tick ``m + 2(p-1) - s`` — the last stage starts
+    microbatch 0's backward at tick ``p-1``, while microbatch ``p-1+t``
+    is still flowing forward: at most ``2(p-1-s)+1 <= 2p-1`` forward
+    stashes are live on stage ``s`` at any tick, and each stash is
+    only the slot's INPUT activation ``[mb, S, D]``. The backward
+    sub-slot re-runs its slot under ``jax.vjp`` (rematerialization:
+    intra-slot residuals exist only inside that slot's backward), so
+    per-stage activation memory is ``min(M, 2p-1)`` input buffers plus
+    ONE slot's residuals — vs GPipe's M× all-blocks residuals (or,
+    under per-slot remat, M input buffers). The price is one extra
+    forward recompute per microbatch and ``p-1`` more ticks than
+    GPipe: step time ~ 4(M + 2p - 2) vs remat-GPipe's 4(M + p - 1)
+    work units.
+
+    Two ppermutes per tick: activations hop ``s -> s+1`` for the next
+    tick's forward sub-slot; input-gradients hop ``s+1 -> s`` for the
+    next tick's backward sub-slot (stage s backwards microbatch m
+    exactly one tick after stage s+1 did — the chains align, so
+    gradients are consumed on arrival and never stashed). Dead slots
+    compute on clipped garbage; their loss/stat writes are masked and
+    their vjp cotangents zeroed (vjp is linear in cotangents, so dead
+    grads are exactly zero).
+
+    ``loss_of(vals [mb, W], m) -> scalar`` is the per-microbatch loss
+    contribution, normalized by the CALLER so the sum over microbatches
+    equals the flat objective (classify: CE(mb)/M; lm:
+    nll_sum/(B·(S-1))). ``head_fn`` as apply_pipeline (default: pooled
+    classify logits). Gradients flow from sum_m loss_of on the last
+    stage through the whole schedule.
+
+    Returns ``((loss, stats [B, W]), grads)`` with grads summed over
+    ``batch_axes`` (matching what shard_map's transpose produces for
+    the jax.grad paths) and non-block leaves psum'd over
+    ``stage_axis`` (each stage contributes its embed/head slice;
+    blk_* leaves stay per-stage local).
+
+    Composition scope: DP x PP x TP. Sequence/expert sharding and the
+    MoE balance loss keep the GPipe/interleaved schedules (their
+    gradient replication rides shard_map's transpose; this function
+    manages replication manually). Dropout composes: the per-microbatch
+    fold_in rng is recomputed bit-identically in the backward sub-slot.
+    """
+    cdt = spec.compute_dtype
+    b = x.shape[0]
+    s, d = spec.seq_len, spec.d_model
+    p, m_cnt = n_stages, num_microbatches
+    if b % m_cnt:
+        raise ValueError(
+            f"local batch {b} must divide into microbatches={m_cnt}")
+    if spec.num_blocks % p:
+        raise ValueError(
+            f"num_blocks={spec.num_blocks} must divide over "
+            f"n_stages={p}")
+    mb = b // m_cnt
+    sidx = jax.lax.axis_index(stage_axis)
+    act = _ACTIVATIONS[spec.activation]
+    kc = spec.num_blocks // p
+    is0 = jnp.equal(sidx, 0)
+    isl = jnp.equal(sidx, p - 1)
+
+    if spec.objective == "lm":
+        micro_t = tokenize(spec, x).reshape(m_cnt, mb, s)
+
+        def embed(prm, m):
+            tok = jax.lax.dynamic_index_in_dim(micro_t, m, 0,
+                                               keepdims=False)
+            return (prm["W_emb"].astype(jnp.float32)[tok]
+                    + prm["pos"].astype(jnp.float32)[None])
+    else:
+        micro = x.reshape(m_cnt, mb, s, spec.d_feature)
+
+        def embed(prm, m):
+            x_t = jax.lax.dynamic_index_in_dim(
+                micro, m, 0, keepdims=False).astype(cdt)
+            return (_mm(prm, x_t, "W_in", "b_in", cdt)
+                    + prm["pos"].astype(jnp.float32)[None])
+
+    if head_fn is None:
+        head_width = spec.num_classes
+
+        def head_fn(prm, h, m):
+            hl = _layer_norm(h, prm["lnf_g"], prm["lnf_b"])
+            return _mm(prm, jnp.mean(hl, axis=1), "W_head", "b_head", cdt)
+    elif head_width is None:
+        raise ValueError("custom head_fn needs an explicit head_width")
+
+    def slot(prm, h_in, m, rng_m):
+        """One (stage, microbatch) unit: embed-or-consume, this
+        stage's blocks, head + masked loss — uniform across stages so
+        jax.vjp of it is the slot's exact backward (collective
+        transposes included)."""
+        local = {k[len("blk_"):]: a for k, a in prm.items()
+                 if k.startswith("blk_")}
+        h0 = jnp.where(is0, _dropout(embed(prm, m), spec, rng_m, 0x9999),
+                       h_in)
+
+        def body(h_, bp_i):
+            bp, i = bp_i
+            h2_, _ = _block_forward(spec, bp, h_, act, cdt,
+                                    expert_axis=None,
+                                    moe_block=sidx * kc + i,
+                                    model_axis=model_axis,
+                                    dropout_rng=rng_m)
+            return h2_, None
+
+        h1, _ = jax.lax.scan(body, h0, (local, jnp.arange(kc)))
+        vals = head_fn(prm, h1, m).astype(jnp.float32)
+        lc = jnp.where(isl, loss_of(vals, m), 0.0)
+        return h1, lc, vals
+
+    def rng_for(m):
+        return (jax.random.fold_in(dropout_rng, m)
+                if dropout_rng is not None else None)
+
+    # Lift params to VARYING over the stage and batch axes before the
+    # per-slot vjps: the pvary-aware AD otherwise inserts a psum over
+    # every unvaried axis inside EVERY backward sub-slot's vjp
+    # (grads w.r.t. an unvarying input must come back unvarying) — M
+    # full-tree collectives per step. Varying params make each slot's
+    # dprm a purely LOCAL contribution; the single psum at the end
+    # restores the jax.grad replication semantics. Axes a leaf already
+    # varies over (blk_* over 'stage'; TP-sharded dims over 'model')
+    # are left as-is — their grads stay local, exactly as in the
+    # jax.grad schedules.
+    from ..ops.ring_attention import pvary_axes
+
+    lift_axes = (stage_axis,) + tuple(batch_axes)
+
+    def lift(a):
+        try:
+            have = set(jax.typeof(a).vma)
+        except (AttributeError, TypeError):
+            return a
+        missing = tuple(ax for ax in lift_axes if ax not in have)
+        return pvary_axes(a, missing) if missing else a
+
+    params = jax.tree.map(lift, params)
+
+    cap = min(m_cnt, 2 * p - 1)
+    stash = jnp.zeros((cap, mb, s, d), jnp.float32)
+    collected = jnp.zeros((m_cnt, mb, head_width), jnp.float32)
+    g_acc = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
+                         params)
+    recv_f = jnp.zeros((mb, s, d), jnp.float32)
+    recv_b = jnp.zeros((mb, s, d), jnp.float32)
+    loss_sum = jnp.float32(0.0)
+    perm_f = [(j, j + 1) for j in range(p - 1)]
+    perm_b = [(j + 1, j) for j in range(p - 1)]
+    ticks = m_cnt + 2 * (p - 1)
+    for t in range(ticks):
+        # ---- forward sub-slot: microbatch t - s (GPipe wavefront)
+        mf = t - sidx
+        live_f = jnp.logical_and(mf >= 0, mf < m_cnt)
+        mfc = jnp.clip(mf, 0, m_cnt - 1)
+        h1, _lc, vals = slot(params, recv_f, mfc, rng_for(mfc))
+        # stash this slot's INPUT for its backward sub-slot. Slot
+        # reuse (m vs m - cap) is safe: the write at tick m+s lands
+        # 2s+1 ticks after the evicted microbatch's backward read at
+        # m - cap + 2(p-1) - s (cap = 2p-1).
+        slot_i = mfc % cap
+        prev_sl = jax.lax.dynamic_index_in_dim(stash, slot_i, 0,
+                                               keepdims=False)
+        stash = jax.lax.dynamic_update_index_in_dim(
+            stash, jnp.where(live_f, recv_f, prev_sl), slot_i, 0)
+        live_stat = jnp.logical_and(live_f, isl)
+        prev_c = jax.lax.dynamic_index_in_dim(collected, mfc, 0,
+                                              keepdims=False)
+        collected = jax.lax.dynamic_update_index_in_dim(
+            collected, jnp.where(live_stat, vals, prev_c), mfc, 0)
+        # ---- backward sub-slot: microbatch t - (2(p-1) - s)
+        mbk = t - (2 * (p - 1) - sidx)
+        live_b = jnp.logical_and(mbk >= 0, mbk < m_cnt)
+        mbc = jnp.clip(mbk, 0, m_cnt - 1)
+        rng_b = rng_for(mbc)
+        h_saved = jax.lax.dynamic_index_in_dim(
+            stash, mbc % cap, 0, keepdims=False)
+        # pin this backward's forward-recompute to its tick: the
+        # recompute depends only on the stash (available early), so
+        # without an explicit dependency on the PREVIOUS backward's
+        # output XLA's scheduler hoists every recompute to the start
+        # of the program — re-inflating live memory to O(M), the exact
+        # thing the schedule exists to prevent (measured: 478 MB vs
+        # 294 MB gpipe at M=8 before this barrier).
+        h_saved, _ = jax.lax.optimization_barrier((h_saved, recv_b))
+        (_h1b, lb, _v), vjp_fn = jax.vjp(
+            lambda prm, h: slot(prm, h, mbc, rng_b), params, h_saved)
+        live_bf = jnp.where(live_b, 1.0, 0.0)
+        # h_out cotangent: the upstream grad (zero on the last stage —
+        # its h1 feeds nothing); loss cotangent: 1 on live slots. vjp
+        # is linear in cotangents, so dead slots add exact zeros.
+        # Each cotangent must carry its primal output's varying-manual-
+        # axes type (_lift_varying) — vjp rejects vma mismatches.
+        from ..ops.ring_attention import _lift_varying
+
+        g_ct = _lift_varying(jnp.where(isl, 0.0, recv_b) * live_bf,
+                             _h1b)
+        dprm, dh = vjp_fn((g_ct, _lift_varying(live_bf * 1.0, lb),
+                           _lift_varying(jnp.zeros_like(_v), _v)))
+        g_acc = jax.tree.map(jnp.add, g_acc, dprm)
+        loss_sum = loss_sum + jnp.where(live_b, lb, 0.0)
+        # ---- communication for the next tick
+        if p > 1 and t < ticks - 1:
+            recv_f = jax.lax.ppermute(h1, stage_axis, perm_f)
+            recv_b = jax.lax.ppermute(dh, stage_axis, perm_b)
+
+    # grad replication: blk_* leaves are per-stage local; every other
+    # leaf (embed/head/pos/final-LN) got real contributions only from
+    # the stages that use it (zeros elsewhere) — psum makes them
+    # stage-replicated, exactly what shard_map's transpose produces
+    # for the jax.grad schedules. batch_axes: manual vjp never crossed
+    # the data axes, so sum the per-shard grads explicitly (the
+    # jax.grad paths get this from the transpose of the replicated
+    # params' broadcast).
+    def fix(k, v):
+        if not k.startswith("blk_"):
+            v = jax.lax.psum(v, stage_axis)
+        if batch_axes:
+            v = jax.lax.psum(v, batch_axes)
+        return v
+
+    g_acc = {k: fix(k, v) for k, v in g_acc.items()}
+    stats = jax.lax.psum(collected, stage_axis).reshape(b, head_width)
+    loss = jax.lax.psum(loss_sum, stage_axis)
+    return (loss, stats), g_acc
 
 
 def init_decode_cache(spec: TransformerSpec, batch: int,
@@ -1131,12 +1393,15 @@ def generate(spec: TransformerSpec, params: Params, prompt: jnp.ndarray,
     local_heads = (jnp.shape(params["L0_Wqkv"])[-1] // spec.d_head
                    if model_axis is not None else spec.n_heads)
     cache = init_decode_cache(spec, b, heads=local_heads)
-    if model_axis is not None:
-        # the cache holds THIS shard's heads: its zeros-init must be
-        # declared model-varying or the scan carry types mismatch
-        # after the first (genuinely varying) update
-        from ..ops.ring_attention import pvary_axes
+    # the zeros-init cache must carry every manual axis the decode
+    # will vary it over, or the scan carry types mismatch after the
+    # first (genuinely varying) update: the prompt's axes (data-
+    # sharded decode, generate_dp) plus the model axis (TP decode —
+    # each shard caches only its heads)
+    from ..ops.ring_attention import _lift_varying, pvary_axes
 
+    cache = jax.tree.map(lambda a: _lift_varying(a, prompt), cache)
+    if model_axis is not None:
         cache = jax.tree.map(
             lambda a: pvary_axes(a, (model_axis,)), cache)
     tokens0 = jnp.concatenate(
@@ -1207,6 +1472,72 @@ def generate_sharded(spec: TransformerSpec, params: Params,
                          sampled)
     return fn(params, prompt,
               rng if sampled else jax.random.PRNGKey(0))
+
+
+@functools.lru_cache(maxsize=8)
+def _gen_dp_fn(spec, mesh, data_axis: str, model_axis: str | None,
+               temperature: float, sampled: bool):
+    """Compiled DP(xTP)-decode program (LRU-bounded like
+    _gen_sharded_fn): the prompt batch shards over ``data_axis``, each
+    shard KV-decodes its slice — with ``model_axis`` the heads also
+    split Megatron-style within each data shard. Per-shard sampling
+    keys fold in the data coordinate so shards draw independent
+    tokens."""
+    from jax.sharding import PartitionSpec as P
+
+    pspecs = param_pspecs(spec, model_axis=model_axis)
+    if model_axis is None:
+        pspecs = {k: P() for k in pspecs}
+
+    def run(p, t, k):
+        if sampled:
+            k = jax.random.fold_in(k, jax.lax.axis_index(data_axis))
+        return generate(spec, p, t, rng=(k if sampled else None),
+                        temperature=temperature, model_axis=model_axis)
+
+    return jax.jit(jax.shard_map(run, mesh=mesh,
+                                 in_specs=(pspecs, P(data_axis), P()),
+                                 out_specs=P(data_axis)))
+
+
+def generate_dp(spec: TransformerSpec, params: Params,
+                prompts: jnp.ndarray, mesh, data_axis: str = "data",
+                model_axis: str | None = None, rng: jax.Array = None,
+                temperature: float = 1.0):
+    """Batched decode ON the mesh (VERDICT r4 next #8): prompts shard
+    over ``data_axis`` (padded to a multiple of its size, sliced
+    back), so ``--sample_after`` scales decode throughput with the
+    data axis in EVERY mode instead of falling back to a chief-host
+    numpy decode. ``params`` are the FLAT layout, replicated (PP/FSDP
+    callers unstack/gather first — on device); with ``model_axis`` the
+    per-shard decode is additionally Megatron tensor-parallel. Works
+    single- and multi-process: the prompt array is assembled with
+    make_array_from_callback from the (identical) host copy."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    n = int(prompts.shape[0])
+    dp = mesh.shape[data_axis]
+    pad = (-n) % dp
+    pr = np.asarray(prompts)
+    if pad:
+        pr = np.concatenate([pr, np.tile(pr[:1], (pad, 1))], axis=0)
+    sharding = NamedSharding(mesh, P(data_axis))
+    pr_g = jax.make_array_from_callback(
+        pr.shape, sharding, lambda idx: pr[idx])
+    prm = jax.device_put(
+        params, jax.tree.map(
+            lambda _: NamedSharding(mesh, P()), params)
+    ) if model_axis is None else params
+    fn = _gen_dp_fn(spec, mesh, data_axis, model_axis,
+                    float(temperature), rng is not None)
+    out = fn(prm, pr_g, rng if rng is not None else jax.random.PRNGKey(0))
+    if jax.process_count() == 1:
+        return out[:n]
+    # multi-process: cross-shard slicing is not addressable — return
+    # the padded data-sharded global array; callers process_allgather
+    # and slice [:n]
+    return out
 
 
 def num_params(spec: TransformerSpec) -> int:

@@ -58,7 +58,7 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
                   pipeline: tuple | None = None,
                   model_axis: str | None = None,
                   with_aux: bool = False, aux_axes: tuple = (),
-                  dropout_rng=None):
+                  dropout_rng=None, slot_remat: bool = False):
     """Per-shard forward to (replicated) logits; TP-aware (example.py:87-89).
 
     Model-family dispatch: TransformerSpec routes to the transformer
@@ -104,13 +104,14 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
                     model_axis=model_axis, virtual=virtual,
                     head_fn=lm_head, head_width=2, seq_axis=seq_axis,
                     expert_axis=expert_axis, with_aux=with_aux,
-                    aux_axes=aux_axes, dropout_rng=dropout_rng)
+                    aux_axes=aux_axes, dropout_rng=dropout_rng,
+                    slot_remat=slot_remat)
             return transformer.apply_pipeline(
                 spec, params, x, stage_axis, n_stages, microbatches,
                 model_axis=model_axis, virtual=virtual,
                 seq_axis=seq_axis, expert_axis=expert_axis,
                 with_aux=with_aux, aux_axes=aux_axes,
-                dropout_rng=dropout_rng)
+                dropout_rng=dropout_rng, slot_remat=slot_remat)
         return transformer.apply(spec, params, x, seq_axis=seq_axis,
                                  expert_axis=expert_axis,
                                  model_axis=model_axis,
@@ -175,19 +176,28 @@ def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas, remat=False,
     aux_w = float(getattr(spec, "aux_loss_weight", 0.0))
     want_aux = aux_w > 0.0
 
+    # under a pipeline, --remat means PER-SLOT remat inside the tick
+    # loop (apply_pipeline's chunk_fn): backward saves only each
+    # slot's input, the strictly better granularity — a whole-forward
+    # checkpoint would re-run the full tick loop and hold every
+    # recomputed residual at once
+    pipe_remat = bool(remat and pipeline is not None)
+
     def fwd(p, xx):
         if want_aux:
             return forward_local(spec, p, xx, styles, use_pallas,
                                  seq_axis, expert_axis, pipeline,
                                  model_axis, with_aux=True,
                                  aux_axes=aux_axes,
-                                 dropout_rng=dropout_rng)
+                                 dropout_rng=dropout_rng,
+                                 slot_remat=pipe_remat)
         return forward_local(spec, p, xx, styles, use_pallas,
                              seq_axis, expert_axis, pipeline,
                              model_axis,
-                             dropout_rng=dropout_rng), jnp.float32(0.0)
+                             dropout_rng=dropout_rng,
+                             slot_remat=pipe_remat), jnp.float32(0.0)
 
-    if remat:
+    if remat and not pipe_remat:
         # jax.checkpoint: recompute activations in the backward pass
         # instead of saving them — trades MXU FLOPs for HBM, the
         # standard lever once hidden sizes grow (SURVEY has no analog:
@@ -303,6 +313,64 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
 
         return jax.value_and_grad(loss_fn, has_aux=True)(params)
 
+    use_1f1b = (pipeline is not None
+                and getattr(cfg, "pp_schedule", "gpipe") == "1f1b")
+
+    def grad_1f1b(params, x, y, rng=None):
+        """(cost, acc), grads via the fused-tick 1F1B schedule
+        (transformer.pipeline_value_and_grad_1f1b) — live microbatch
+        activations cap at 2p-1 instead of jax.grad's M. Objective
+        plumbing mirrors _loss_and_acc's pipeline branch exactly."""
+        from ..models import transformer
+
+        stage_axis, n_stages, microbatches, _v = pipeline
+        mbs = x.shape[0] // microbatches
+        if getattr(spec, "objective", "classify") == "lm":
+            micro_t = transformer.tokenize(spec, x).reshape(
+                microbatches, mbs, -1)
+
+            def head(prm, h, m):
+                hl = transformer._layer_norm(h, prm["lnf_g"],
+                                             prm["lnf_b"])
+                logits = transformer._mm(
+                    prm, hl, "W_head", "b_head",
+                    spec.compute_dtype).astype(jnp.float32)
+                tok = jax.lax.dynamic_index_in_dim(micro_t, m, 0,
+                                                   keepdims=False)
+                nll, correct, _cnt = _lm_stats(spec, logits, tok, None)
+                return jnp.stack([nll, correct], axis=-1)
+
+            count = jnp.float32(x.shape[0] * (spec.seq_len - 1))
+
+            def loss_of(vals, m):
+                return jnp.sum(vals[:, 0]) / count
+
+            (loss, stats), grads = transformer.pipeline_value_and_grad_1f1b(
+                spec, params, x, stage_axis, n_stages, microbatches,
+                loss_of, head_fn=head, head_width=2,
+                model_axis=model_axis, dropout_rng=rng,
+                batch_axes=batch_axes)
+            cost = jnp.sum(stats[:, 0]) / count
+            acc = jnp.sum(stats[:, 1]) / count
+            return (cost, acc), grads
+
+        ys = y.reshape(microbatches, mbs, *y.shape[1:])
+
+        def loss_of(vals, m):
+            y_m = jax.lax.dynamic_index_in_dim(ys, m, 0, keepdims=False)
+            return losses.cross_entropy(
+                vals, y_m, naive=cfg.naive_ce,
+                label_smoothing=cfg.label_smoothing) / microbatches
+
+        (loss, stats), grads = transformer.pipeline_value_and_grad_1f1b(
+            spec, params, x, stage_axis, n_stages, microbatches,
+            loss_of, model_axis=model_axis, dropout_rng=rng,
+            batch_axes=batch_axes)
+        cost = losses.cross_entropy(stats, y, naive=cfg.naive_ce,
+                                    label_smoothing=cfg.label_smoothing)
+        acc = metrics.accuracy(stats, y)
+        return (cost, acc), grads
+
     step_rng = make_step_rng(cfg, spec, aux_axes)
 
     def body(state: TrainState, x, y):
@@ -340,6 +408,9 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
                 (xs[1:], ys[1:], jnp.arange(1, n)))
             grads = jax.tree.map(lambda g: g / n, g_sum)
             cost, acc = c_sum / n, a_sum / n
+        elif use_1f1b:
+            (cost, acc), grads = grad_1f1b(state.params, x, y,
+                                           step_rng(state))
         else:
             (_total, (cost, acc)), grads = grad_of(state.params, x, y,
                                                    step_rng(state))

@@ -1890,3 +1890,289 @@ def test_lm_grad_accum_matches_full_batch(devices8):
     for k in p1:
         np.testing.assert_allclose(p2[k], p1[k], rtol=1e-5, atol=1e-7,
                                    err_msg=k)
+
+
+# ---- 1F1B schedule (r5, VERDICT r4 next #4) ----
+
+
+def _one_device_step(spec, opt, cfg1, x, y, devices8):
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+    mesh1 = mesh_lib.build_mesh(1, 1, devices=devices8[:1])
+    st1 = create_train_state(jax.random.PRNGKey(1), spec, opt)
+    st1 = mesh_lib.place_state(st1, mesh1,
+                               mesh_lib.state_pspecs(spec, opt, 1))
+    step1 = step_lib.build_train_step(cfg1, mesh1, spec, opt)
+    new1, c1, a1 = step1(st1, x, y)
+    return jax.tree.map(np.asarray, new1.params), float(c1), float(a1)
+
+
+@pytest.mark.parametrize("objective", ["classify", "lm"])
+def test_pp_1f1b_matches_single_device(devices8, objective):
+    """The fused-tick 1F1B schedule (pipeline_value_and_grad_1f1b) on
+    a PP2 x DP2 mesh — forward and backward sub-slots interleaved so
+    live microbatch stashes cap at 2p-1 — must produce the same step
+    as one device: the schedule changes memory liveness, not math."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+    from distributed_tensorflow_example_tpu.train.state import (
+        TrainState, create_train_state)
+
+    kw = dict(num_blocks=2)
+    extra = {}
+    if objective == "lm":
+        kw.update(objective="lm", input_size=32, seq_len=32,
+                  vocab_size=16, causal=True)
+        extra = dict(objective="lm", input_size=32, vocab_size=16)
+    spec = _spec(**kw)
+    cfg = Config(model="transformer", learning_rate=0.01, num_blocks=2,
+                 pipeline_parallel=2, microbatches=4,
+                 pp_schedule="1f1b", **extra)
+    opt = make_optimizer(cfg)
+    rng = np.random.RandomState(17)
+    x = rng.rand(8, spec.input_size).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]
+
+    cfg1 = Config(model="transformer", learning_rate=0.01, **extra)
+    p1, c1, a1 = _one_device_step(spec, opt, cfg1, x, y, devices8)
+
+    meshp = mesh_lib.build_stage_mesh(2, 2, devices=devices8[:4])
+    st = create_train_state(jax.random.PRNGKey(1), spec, opt)
+    st = tfm.pipeline_train_state(spec, opt, st, 2, 1)
+    st = mesh_lib.place_state(
+        st, meshp,
+        mesh_lib.pipeline_state_pspecs(spec, opt, mesh_lib.STAGE_AXIS))
+    stepp = step_lib.build_train_step(cfg, meshp, spec, opt)
+    newp, cp, ap = stepp(st, x, y)
+    pp_un = tfm.pipeline_unstack_params(
+        spec, jax.tree.map(np.asarray, newp.params))
+
+    assert abs(c1 - float(cp)) < 2e-5
+    assert abs(a1 - float(ap)) < 2e-5
+    for k in p1:
+        np.testing.assert_allclose(pp_un[k], p1[k], rtol=3e-5, atol=3e-6,
+                                   err_msg=k)
+
+
+def test_pp_1f1b_deep_tp_matches_single_device(devices8):
+    """1F1B at p=4 (the schedule's warmup/steady/cooldown phases all
+    exercised: ticks = M + 2(p-1) = 10) crossed with TP2 — Megatron
+    psums transpose inside each backward sub-slot's vjp."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+    from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+    spec = _spec(num_blocks=4)
+    cfg = Config(model="transformer", learning_rate=0.01, num_blocks=4,
+                 pipeline_parallel=4, model_parallel=2, microbatches=4,
+                 pp_schedule="1f1b")
+    opt = make_optimizer(cfg)
+    rng = np.random.RandomState(19)
+    x = rng.rand(8, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]
+
+    cfg1 = Config(model="transformer", learning_rate=0.01, num_blocks=4)
+    p1, c1, _a1 = _one_device_step(spec, opt, cfg1, x, y, devices8)
+
+    meshp = mesh_lib.build_stage_mesh(1, 4, devices=devices8,
+                                      model_parallel=2)
+    st = create_train_state(jax.random.PRNGKey(1), spec, opt)
+    st = tfm.pipeline_train_state(spec, opt, st, 4, 1)
+    st = mesh_lib.place_state(
+        st, meshp,
+        mesh_lib.pipeline_state_pspecs(
+            spec, opt, mesh_lib.STAGE_AXIS, mesh_lib.MODEL_AXIS))
+    stepp = step_lib.build_train_step(cfg, meshp, spec, opt)
+    newp, cp, _ap = stepp(st, x, y)
+    pp_un = tfm.pipeline_unstack_params(
+        spec, jax.tree.map(np.asarray, newp.params))
+
+    assert abs(c1 - float(cp)) < 2e-5
+    for k in p1:
+        np.testing.assert_allclose(pp_un[k], p1[k], rtol=3e-5, atol=3e-6,
+                                   err_msg=k)
+
+
+def test_pp_1f1b_dropout_matches_gpipe(devices8):
+    """Dropout under 1F1B: the backward sub-slot re-derives each
+    microbatch's fold_in rng bit-identically, and the schedule uses
+    the same per-microbatch streams as gpipe — the two schedules must
+    produce the SAME step from the same state."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+    from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+    spec = _spec(num_blocks=2, dropout_rate=0.2)
+    rng = np.random.RandomState(23)
+    x = rng.rand(8, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]
+
+    def one(schedule):
+        cfg = Config(model="transformer", learning_rate=0.01,
+                     num_blocks=2, dropout_rate=0.2,
+                     pipeline_parallel=2, microbatches=4,
+                     pp_schedule=schedule)
+        opt = make_optimizer(cfg)
+        meshp = mesh_lib.build_stage_mesh(2, 2, devices=devices8[:4])
+        st = create_train_state(jax.random.PRNGKey(1), spec, opt)
+        st = tfm.pipeline_train_state(spec, opt, st, 2, 1)
+        st = mesh_lib.place_state(
+            st, meshp,
+            mesh_lib.pipeline_state_pspecs(spec, opt,
+                                           mesh_lib.STAGE_AXIS))
+        stepp = step_lib.build_train_step(cfg, meshp, spec, opt)
+        newp, cp, _ = stepp(st, x, y)
+        return jax.tree.map(np.asarray, newp.params), float(cp)
+
+    pg, cg = one("gpipe")
+    pf, cf = one("1f1b")
+    assert abs(cg - cf) < 1e-5
+    for k in pg:
+        np.testing.assert_allclose(pf[k], pg[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)
+
+
+def test_pp_slot_remat_matches_plain(devices8):
+    """--remat under the pipeline = per-slot jax.checkpoint: identical
+    numbers, smaller liveness (backward stores only slot inputs)."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+    from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+    spec = _spec(num_blocks=2)
+    rng = np.random.RandomState(29)
+    x = rng.rand(8, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 8)]
+
+    def one(remat):
+        cfg = Config(model="transformer", learning_rate=0.01,
+                     num_blocks=2, pipeline_parallel=2, microbatches=2,
+                     remat=remat)
+        opt = make_optimizer(cfg)
+        meshp = mesh_lib.build_stage_mesh(2, 2, devices=devices8[:4])
+        st = create_train_state(jax.random.PRNGKey(1), spec, opt)
+        st = tfm.pipeline_train_state(spec, opt, st, 2, 1)
+        st = mesh_lib.place_state(
+            st, meshp,
+            mesh_lib.pipeline_state_pspecs(spec, opt,
+                                           mesh_lib.STAGE_AXIS))
+        stepp = step_lib.build_train_step(cfg, meshp, spec, opt)
+        newp, cp, _ = stepp(st, x, y)
+        return jax.tree.map(np.asarray, newp.params), float(cp)
+
+    p0, c0 = one(False)
+    p1, c1 = one(True)
+    assert abs(c0 - c1) < 1e-6
+    for k in p0:
+        np.testing.assert_allclose(p1[k], p0[k], rtol=1e-6, atol=1e-8,
+                                   err_msg=k)
+
+
+def test_pp_1f1b_driver_end_to_end(devices8):
+    """--pp_schedule=1f1b through the full driver (train + eval)."""
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    res = run(Config(
+        model="transformer", pipeline_parallel=2, num_blocks=2,
+        data_parallel=4, microbatches=4, pp_schedule="1f1b",
+        training_epochs=1, batch_size=64, learning_rate=0.003,
+        optimizer="adam", synthetic_train_size=1024,
+        synthetic_test_size=256, summaries=False, compilation_cache="",
+        frequency=8,
+    ))
+    assert res["devices"] == 8
+    assert np.isfinite(res["final_cost"])
+    assert res["test_accuracy"] > 0.2
+
+
+def test_pp_1f1b_validation():
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    with pytest.raises(ValueError, match="pipeline_parallel > 1"):
+        run(Config(model="transformer", pp_schedule="1f1b"))
+    with pytest.raises(ValueError, match="virtual_stages=1"):
+        run(Config(model="transformer", pipeline_parallel=2,
+                   num_blocks=4, virtual_stages=2, microbatches=4,
+                   pp_schedule="1f1b"))
+    with pytest.raises(ValueError, match="balance loss"):
+        run(Config(model="transformer", pipeline_parallel=2,
+                   num_blocks=2, num_experts=4, moe_aux_weight=0.01,
+                   pp_schedule="1f1b"))
+    with pytest.raises(ValueError, match="sequence/expert"):
+        run(Config(model="transformer", pipeline_parallel=2,
+                   num_blocks=2, sequence_parallel=2,
+                   pp_schedule="1f1b"))
+    with pytest.raises(ValueError, match="grad_accum"):
+        run(Config(model="transformer", pipeline_parallel=2,
+                   num_blocks=2, grad_accum=2, pp_schedule="1f1b"))
+
+
+# ---- DP-sharded decode (r5, VERDICT r4 next #8) ----
+
+
+def test_generate_dp_matches_host(devices8):
+    """generate_dp (prompt batch sharded over 'data') must reproduce
+    the host generate exactly under greedy decoding — including a
+    batch that does not divide the data axis (pad + slice)."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+
+    spec = _lm_spec()
+    params = tfm.init(jax.random.PRNGKey(3), spec)
+    rng = np.random.RandomState(41)
+    prompts = jnp.asarray(rng.randint(0, 16, size=(6, 8)), jnp.int32)
+
+    host = np.asarray(tfm.generate(spec, params, prompts, rng=None,
+                                   temperature=0.0))
+    mesh = mesh_lib.build_mesh(4, 1, devices=devices8[:4])
+    dp_out = np.asarray(tfm.generate_dp(spec, params, prompts, mesh,
+                                        rng=None, temperature=0.0))
+    np.testing.assert_array_equal(dp_out, host)
+
+
+def test_generate_dp_tp_matches_host(devices8):
+    """DP x TP decode: batch shards over 'data' while each shard's
+    heads split over 'model' — still exactly the host greedy decode."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+
+    spec = _lm_spec()
+    params = tfm.init(jax.random.PRNGKey(5), spec)
+    rng = np.random.RandomState(43)
+    prompts = jnp.asarray(rng.randint(0, 16, size=(4, 8)), jnp.int32)
+
+    host = np.asarray(tfm.generate(spec, params, prompts, rng=None,
+                                   temperature=0.0))
+    mesh = mesh_lib.build_mesh(2, 2, devices=devices8[:4])
+    pspecs = tfm.param_pspecs(spec, model_axis=mesh_lib.MODEL_AXIS)
+    from jax.sharding import NamedSharding
+
+    placed = {k: jax.device_put(v, NamedSharding(mesh, pspecs[k]))
+              for k, v in params.items()}
+    dp_out = np.asarray(tfm.generate_dp(
+        spec, placed, prompts, mesh, model_axis=mesh_lib.MODEL_AXIS,
+        rng=None, temperature=0.0))
+    np.testing.assert_array_equal(dp_out, host)
+
+
+def test_generate_dp_sampled_finite(devices8):
+    """Sampled DP decode: per-shard keys fold in the data coordinate,
+    tokens stay inside the vocabulary."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+
+    spec = _lm_spec()
+    params = tfm.init(jax.random.PRNGKey(7), spec)
+    rng = np.random.RandomState(47)
+    prompts = jnp.asarray(rng.randint(0, 16, size=(8, 8)), jnp.int32)
+    mesh = mesh_lib.build_mesh(4, 1, devices=devices8[:4])
+    out = np.asarray(tfm.generate_dp(spec, params, prompts, mesh,
+                                     rng=jax.random.PRNGKey(9),
+                                     temperature=1.0))
+    assert out.shape == (8, spec.seq_len)
+    assert out.min() >= 0 and out.max() < spec.vocab_size
+    # prompt teacher-forced
+    np.testing.assert_array_equal(out[:, :8], np.asarray(prompts))
