@@ -784,7 +784,8 @@ def bench_transformer_wide(repeats: int = 3, d_model: int = 2048,
                            n_heads: int = 16, blocks: int = 4,
                            d_ff: int = 8192, seq: int = 512,
                            batch: int = 64, spe: int = 4,
-                           epochs: int = 4):
+                           epochs: int = 4,
+                           moments_dtype: str = "bfloat16"):
     """MXU-saturation evidence for the transformer FAMILY (VERDICT r3
     next #1): a chip-filling configuration — d_model 2048, d_ff 8192,
     heads at the full 128 systolic width, bf16 — through the real
@@ -819,6 +820,7 @@ def bench_transformer_wide(repeats: int = 3, d_model: int = 2048,
             input_size=4 * seq, seq_len=seq, d_model=d_model,
             n_heads=n_heads, num_blocks=blocks, d_ff=d_ff,
             compute_dtype="bfloat16", optimizer="adam",
+            adam_moments_dtype=moments_dtype,
             learning_rate=1e-3, batch_size=batch, dataset="synthetic",
             summaries=False,
         )
@@ -1155,7 +1157,7 @@ def bench_moe_dispatch(e: int = 32, seq: int = 128, batch: int = 64,
     return row
 
 
-def bench_moe_wide(e: int = 64, seq: int = 512, batch: int = 16,
+def bench_moe_wide(e: int = 64, seq: int = 1024, batch: int = 32,
                    d_model: int = 1024, d_ff: int = 2048,
                    repeats: int = 3, steps: int = 8):
     """MoE at realistic width (VERDICT r4 next #6): d_model >= 1024,

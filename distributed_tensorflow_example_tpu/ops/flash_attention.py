@@ -17,15 +17,23 @@ Beyond-reference capability (the reference has no attention at all,
 
 Throughput design (tuned on a v5e chip, measured by in-program
 dispatch chains so tunnel round-trips cancel):
-- **Tile size**: 1024x1024 q/k tiles (``_pick_tiles``) — the dominant
-  lever. The kernel is bounded by per-grid-step overhead and VPU
-  softmax passes, both of which amortize with tile area: 256-tiles run
-  ~11 TF/s, 1024-tiles ~41 TF/s f32 / ~55-85 TF/s bf16 on
-  ``[4,4096,8,64]`` causal (the bundled production kernel measures
-  ~48 TF/s bf16 at its best block size on the same chip and method;
-  the d=64 head-dim caps the MXU at ~98 TF/s of the 197 bf16 peak).
-  Tiles shrink to keep dividing the padded sequence, and cap at 512
-  when D > 128 (VMEM working set).
+- **Tile size**: 2048x1024 rectangular q/k tiles (``_pick_tiles``) —
+  the dominant lever. The kernel is bounded by per-grid-step overhead
+  and VPU softmax passes, both of which amortize with tile area
+  (256-tiles ran ~11 TF/s, 1024-tiles ~41 TF/s f32 / ~55-85 TF/s
+  bf16 on ``[4,4096,8,64]`` causal; the bundled production kernel
+  measures ~48 TF/s bf16 at its best block size on the same chip and
+  method; the d=64 head-dim caps the MXU at ~98 TF/s of the 197 bf16
+  peak — d=128 drives the full contraction width at 110-156 TF/s).
+  blk_q doubles blk_k when S divides (r5): the 2:1 tile amortizes
+  every k/v fetch over twice the q rows (+13% in-window) at
+  [2048, 1024] f32 score/p intermediates (8 MB, inside the VMEM
+  cap). blk_k shrinks to keep dividing the padded sequence, capped
+  at 512 when D > 128.
+- **Causal fetch elimination** (r5): dead (above-diagonal) grid
+  steps clamp their fetch indices to the causal frontier
+  (``_causal_frontier``) — the Pallas pipeline elides repeated-index
+  copies, so skipped steps cost grid overhead, not HBM traffic.
 - **exp2 scores**: q is pre-scaled ONCE by ``log2(e)/sqrt(d)``
   (O(S·D)), so the kernel's scores live in the log2 domain and every
   transcendental is a raw ``exp2`` — the per-tile O(blk²) scale
@@ -93,15 +101,20 @@ def _interpret() -> bool:
 
 
 def _pick_tiles(s: int, d: int) -> tuple[int, int]:
-    """(blk_q, blk_k) for a padded length ``s`` (s % _BLK == 0): the
-    largest power-of-two tile in [_BLK, _BLK_PREF] dividing s, capped
-    at 512 when D > 128 to keep the backward kernels' [blk, blk]
-    intermediates inside scoped VMEM."""
+    """(blk_q, blk_k) for a padded length ``s`` (s % _BLK == 0):
+    blk_k is the largest power-of-two tile in [_BLK, _BLK_PREF]
+    dividing s (capped at 512 when D > 128 to keep the backward
+    kernels' [blk_q, blk_k] intermediates inside scoped VMEM);
+    blk_q doubles it when s allows — a 2:1 rectangular tile amortizes
+    every k/v fetch over twice the q rows (measured +13% on
+    [4,4096,8,64] bf16 causal) at 2x the [blk_q, blk_k] score/p VMEM
+    (8 MB f32 at 2048x1024, well inside the 100 MB cap)."""
     cap = _BLK_PREF if d <= 128 else 512
     blk = _BLK
     while blk * 2 <= cap and s % (blk * 2) == 0:
         blk *= 2
-    return blk, blk
+    blk_q = blk * 2 if s % (blk * 2) == 0 else blk
+    return blk_q, blk
 
 
 def _compiler_params():
@@ -121,6 +134,22 @@ def _prescale(q):
     natural-domain scores in log2 units."""
     c = _LOG2E / np.sqrt(q.shape[-1])
     return (q.astype(jnp.float32) * c).astype(q.dtype)
+
+
+def _causal_frontier(i, blk_q: int, blk_k: int):
+    """Last k tile visible to q tile ``i`` under the causal mask —
+    the tile holding q row ``(i+1)*blk_q - 1``'s diagonal. Must stay
+    consistent with ``_causal_tile_classes``' visibility predicate:
+    the fetch-elision clamps (forward kv, backward k/v and q/do) are
+    only safe while every live (computed) step fetches its true
+    tile."""
+    return ((i + 1) * blk_q - 1) // blk_k
+
+
+def _causal_first_q(j, blk_q: int, blk_k: int):
+    """First q tile that sees k tile ``j`` (the dkv kernel's stream
+    start) — sibling of ``_causal_frontier``."""
+    return (j * blk_k) // blk_q
 
 
 def _causal_tile_classes(iq, blk_q, j, blk_k):
@@ -343,7 +372,19 @@ def _flash_call(qf, kf, vf, causal: bool, blk: int, return_stats: bool):
         return jax.ShapeDtypeStruct(shape, dt)
 
     tile_q = pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0))
-    kv_spec = pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0))
+    # causal: clamp the k/v fetch index to the causal frontier — dead
+    # (above-diagonal) grid steps then request the SAME tile as the
+    # row's last live step, and the Pallas pipeline elides the
+    # refetch (it re-issues a copy only when the block index
+    # changes), so skipped steps cost grid overhead, not HBM traffic.
+    # The frontier for q tile i is _causal_frontier (blk_q-vs-blk_k
+    # general). Safe because the tile-class predicates use the
+    # UNCLAMPED program id: dead steps compute nothing and the
+    # j == nk-1 finalize only reads scratch.
+    kv_idx = ((lambda b, i, j:
+               (b, jnp.minimum(j, _causal_frontier(i, blk_q, blk_k)), 0))
+              if causal else (lambda b, i, j: (b, j, 0)))
+    kv_spec = pl.BlockSpec((1, blk_k, d), kv_idx)
     tile_1 = pl.BlockSpec((1, blk_q, 1), lambda b, i, j: (b, i, 0))
     if return_stats:
         out_specs = [tile_q, tile_1, tile_1]
@@ -445,6 +486,23 @@ def _flash_backward_flat(qf, kf, vf, dof, mf, lf, dlt, causal: bool,
     t1 = lambda: pl.BlockSpec((1, blk_q, 1), lambda b_h, a, b_: (b_h, a, 0))
     t1_b = lambda: pl.BlockSpec((1, blk_q, 1), lambda b_h, a, b_: (b_h, b_, 0))
     scr = lambda blk, w: pltpu.VMEM((blk, w), jnp.float32)
+    if causal:
+        # clamp dead-step fetches to the causal frontier (see
+        # _flash_call; blk_q-vs-blk_k general): dq streams k tiles
+        # j <= _causal_frontier(iq) past each q tile, dkv streams q
+        # tiles i >= _causal_first_q(jk) past each k tile — the
+        # Pallas pipeline elides the repeated-index refetch either way
+        kfront = lambda a: _causal_frontier(a, blk_q, blk_k)
+        qfirst = lambda a: _causal_first_q(a, blk_q, blk_k)
+        tk_b = lambda: pl.BlockSpec(
+            (1, blk_k, d),
+            lambda b_h, a, b_: (b_h, jnp.minimum(b_, kfront(a)), 0))
+        tq_b = lambda: pl.BlockSpec(
+            (1, blk_q, d),
+            lambda b_h, a, b_: (b_h, jnp.maximum(b_, qfirst(a)), 0))
+        t1_b = lambda: pl.BlockSpec(
+            (1, blk_q, 1),
+            lambda b_h, a, b_: (b_h, jnp.maximum(b_, qfirst(a)), 0))
 
     dq = pl.pallas_call(
         _make_dq_kernel(blk_q, blk_k, causal, compute_dtype, scale),

@@ -182,3 +182,32 @@ def test_cross_length_causal_rejected():
         fa.flash_attention(q, k, v, True)
     with pytest.raises(ValueError, match="equal q/k lengths"):
         ra.attention(q, k, v, causal=True)
+
+
+def test_rectangular_tiles_causal_s2048():
+    """S=2048 picks the r5 rectangular geometry (blk_q=2048,
+    blk_k=1024) — the generalized causal tile classes and the
+    frontier-clamped fetch indices (_causal_frontier/_causal_first_q)
+    must stay exact for blk_q != blk_k in the forward AND all three
+    backward kernels (no smaller test reaches this path: square
+    tiles are picked for every S < 2048)."""
+    bq, bk = fa._pick_tiles(2048, 8)
+    assert (bq, bk) == (2048, 1024), "geometry drifted; update test"
+    q, k, v = _inputs(b=1, s=2048, h=1, d=8)
+
+    want = np.asarray(ra.attention(q, k, v, causal=True))
+    got = np.asarray(fa.flash_attention(q, k, v, True))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    def loss_fa(q_, k_, v_):
+        return jnp.sum(fa.flash_attention(q_, k_, v_, True) ** 2)
+
+    def loss_ra(q_, k_, v_):
+        return jnp.sum(ra.attention(q_, k_, v_, causal=True) ** 2)
+
+    g_fa = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+    g_ra = jax.grad(loss_ra, argnums=(0, 1, 2))(q, k, v)
+    for got_g, want_g, name in zip(g_fa, g_ra, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got_g), np.asarray(want_g), rtol=5e-4,
+            atol=5e-4, err_msg=f"d{name}")
