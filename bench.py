@@ -877,7 +877,8 @@ def bench_transformer_wide_long(repeats: int = 3, d_model: int = 1024,
         model="transformer", attention="flash", causal=True,
         input_size=4 * seq, seq_len=seq, d_model=d_model,
         n_heads=n_heads, num_blocks=blocks, d_ff=d_ff,
-        compute_dtype="bfloat16", optimizer="adam", learning_rate=1e-3,
+        compute_dtype="bfloat16", optimizer="adam",
+        adam_moments_dtype="bfloat16", learning_rate=1e-3,
         batch_size=batch, dataset="synthetic", summaries=False,
     )
     spec = make_spec(cfg)
@@ -1059,14 +1060,16 @@ def bench_pp_memory(p: int = 4, m: int = 16, batch: int = 32,
     return row
 
 
-def bench_lm(seq: int = 1024, batch: int = 16, repeats: int = 3,
-             steps: int = 16):
+def bench_lm(seq: int = 2048, batch: int = 8, repeats: int = 3,
+             steps: int = 16, d_model: int = 512, n_heads: int = 4):
     """Autoregressive LM training throughput (--objective=lm): 256-way
     next-token prediction over a S-token causal transformer with the
     flash-attention kernels, bf16, whole epoch as one scan program —
     the image-GPT-style objective the classify family cannot express.
-    Reports tokens/sec and model MFU (flops_per_step counts the
-    per-position vocab head)."""
+    r5: d_head = d_model/n_heads = 128 (full MXU contraction; the r4
+    row's d_head=32 drove a quarter of the array and sat at 0.10
+    MFU), S=2048, bf16 Adam moments. Reports tokens/sec and model MFU
+    (flops_per_step counts the per-position vocab head)."""
     import numpy as np
 
     from distributed_tensorflow_example_tpu.config import Config
@@ -1085,18 +1088,20 @@ def bench_lm(seq: int = 1024, batch: int = 16, repeats: int = 3,
     img_d, lbl_d, spe = epoch_lib.shard_dataset(mesh, images, labels, batch)
     cfg = Config(
         model="transformer", objective="lm", input_size=seq,
-        vocab_size=256, attention="flash", d_model=256, n_heads=8,
-        num_blocks=4, d_ff=1024, compute_dtype="bfloat16",
-        optimizer="adam", learning_rate=1e-3, batch_size=batch,
-        dataset="synthetic", summaries=False,
+        vocab_size=256, attention="flash", d_model=d_model,
+        n_heads=n_heads, num_blocks=4, d_ff=4 * d_model,
+        compute_dtype="bfloat16", optimizer="adam",
+        adam_moments_dtype="bfloat16", learning_rate=1e-3,
+        batch_size=batch, dataset="synthetic", summaries=False,
     )
     spec = make_spec(cfg)
     step_s = _steady_state_step_time(cfg, spec, mesh, img_d, lbl_d,
                                      spe, 1, repeats)
     flops = tfm.flops_per_step(spec, batch)
     row = {"config": "lm_next_token",
-           "model": f"S={seq} vocab=256 d_model=256 blocks=4 bf16 "
-                    f"causal flash",
+           "model": f"S={seq} vocab=256 d_model={d_model} heads="
+                    f"{n_heads} (d_head={d_model // n_heads}) "
+                    f"blocks=4 bf16 causal flash",
            "global_batch": batch,
            "step_time_ms": round(step_s * 1000, 2),
            "tokens_per_sec": round(batch * seq / step_s, 1)}

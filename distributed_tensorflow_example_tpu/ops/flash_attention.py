@@ -44,11 +44,18 @@ dispatch chains so tunnel round-trips cancel):
 - **Causal tile classes**: strictly-below-diagonal tiles run a
   mask-free body (no iota/compare/select passes); only
   diagonal-crossing tiles mask; above-diagonal tiles are skipped
-  outright with ``pl.when``. Fully-masked ROWS never occur in a
-  computed tile (every diagonal row keeps its self position), so the
-  fully-masked-row guard the XLA paths need is omitted in the kernels:
-  masked entries hold NEG_INF and ``exp2(NEG_INF - m)`` underflows to
-  exactly 0.0 against any finite row max.
+  outright with ``pl.when``. The fully-masked-row guard the XLA
+  paths need is omitted in the kernels because every row's running
+  max is finite BEFORE any fully-masked rows appear: under causal
+  masking every row sees k position 0, so the ascending k stream's
+  j=0 tile (always computed — interior or crossing, never skipped)
+  contributes a real score to every row; fully-masked rows in later
+  crossing tiles (which DO occur under the 2:1 rectangular tiles —
+  e.g. q tile i's rows below 2048i+1024 against k tile 2i+1) then
+  hold NEG_INF entries that underflow via ``exp2(NEG_INF - m)`` to
+  exactly 0.0 against that finite max. This ordering argument is
+  load-bearing: a k stream that skips or reorders tile 0 would
+  evaluate ``exp2(NEG_INF - NEG_INF) = 1`` and corrupt l/acc.
 
 Training: ``flash_attention`` carries a ``jax.custom_vjp`` whose
 backward is ALSO tiled Pallas (``_make_dq_kernel`` /
@@ -188,8 +195,10 @@ def _make_kernel(blk_q: int, blk_k: int, causal: bool, compute_dtype,
             m_blk = jnp.max(s, axis=-1, keepdims=True)
             m_new = jnp.maximum(m, m_blk)
             # log2-domain online softmax: masked entries are NEG_INF
-            # and exp2(NEG_INF - finite) == 0.0 exactly, and computed
-            # tiles never contain a fully-masked row (module docstring)
+            # and exp2(NEG_INF - finite) == 0.0 exactly; every row's
+            # m is finite by the time a fully-masked row can appear
+            # (the j=0 tile always computes and every row sees k
+            # position 0 — module docstring's ordering argument)
             p = jnp.exp2(s - m_new)
             alpha = jnp.exp2(m - m_new)
             m_scr[...] = m_new
