@@ -841,7 +841,8 @@ def bench_transformer_wide_long(repeats: int = 3, d_model: int = 1024,
                                 n_heads: int = 8, blocks: int = 4,
                                 d_ff: int = 4096, seq: int = 8192,
                                 batch: int = 8, spe: int = 2,
-                                epochs: int = 2):
+                                epochs: int = 2,
+                                name: str = "transformer_wide_long"):
     """Attention-DOMINATED training throughput at full MXU width
     (VERDICT r4 next #1): d_head = d_model/n_heads = 128 — the full
     128-lane systolic contraction (the d=64 kernel rows drive half the
@@ -860,7 +861,7 @@ def bench_transformer_wide_long(repeats: int = 3, d_model: int = 1024,
     from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
     from distributed_tensorflow_example_tpu.train.loop import make_spec
 
-    row = {"config": "transformer_wide_long",
+    row = {"config": name,
            "model": f"S={seq} d_model={d_model} heads={n_heads} "
                     f"(d_head={d_model // n_heads}) blocks={blocks} "
                     f"d_ff={d_ff} bf16 causal flash",
@@ -1457,6 +1458,11 @@ def main(argv=None) -> int:
         guarded("ring_flash", bench_ring_flash)
         guarded("transformer_wide", bench_transformer_wide)
         guarded("transformer_wide_long", bench_transformer_wide_long)
+        # the max-context flagship: attention is the MAJORITY (61%) of
+        # the analytic FLOPs at S=16384
+        guarded("transformer_wide_long_s16k", bench_transformer_wide_long,
+                repeats=2, seq=16384, batch=2, spe=2, epochs=1,
+                name="transformer_wide_long_s16k")
         guarded("transformer_flash_long_context", bench_transformer)
         guarded("pipeline_bubble", bench_pipeline_bubble)
         guarded("pp_memory", bench_pp_memory)
@@ -1528,6 +1534,13 @@ def main(argv=None) -> int:
         extra["transformer_wide_long_mfu"] = long_row["mfu"]
         extra["transformer_wide_long_attn_frac"] = \
             long_row["attention_flop_frac"]
+    s16k_row = next(
+        (r for r in rows if r.get("config") == "transformer_wide_long_s16k"
+         and "mfu" in r), None)
+    if s16k_row:
+        extra["wide_long_s16k_mfu"] = s16k_row["mfu"]
+        extra["wide_long_s16k_attn_frac"] = \
+            s16k_row["attention_flop_frac"]
     if flash_row and flash_row.get("d128_s16384_bf16_tflops") is not None:
         extra["flash_d128_s16384_tflops"] = \
             flash_row["d128_s16384_bf16_tflops"]
