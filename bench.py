@@ -49,31 +49,16 @@ import time
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
-# bf16 peak matmul throughput per chip, by jax device_kind.
-PEAK_BF16_FLOPS = {
-    "TPU v5 lite": 197e12,   # v5e
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,        # v5p
-    "TPU v4": 275e12,
-    "TPU v6 lite": 918e12,   # v6e / Trillium
-}
-
-
-def _chip_peak_flops():
-    import jax
-
-    d = jax.devices()[0]
-    if d.platform != "tpu":
-        return None
-    return PEAK_BF16_FLOPS.get(d.device_kind)
-
-
-def _model_flops_per_step(hidden_sizes, batch, input_size=784, num_classes=10):
-    """Analytic fwd+bwd matmul FLOPs: 2*MACs fwd, 4*MACs bwd (dW and dx
-    each cost one matmul per layer) = 6*MACs total, per example."""
-    sizes = (input_size, *hidden_sizes, num_classes)
-    macs = sum(sizes[i] * sizes[i + 1] for i in range(len(sizes) - 1))
-    return 6.0 * batch * macs
+# FLOPs/MFU accounting is shared with the train loop's --metrics rows
+# (round 6): obs/flops.py is the single implementation, so the bench's
+# committed MFU and the telemetry stream's MFU cannot drift. These
+# aliases keep the bench's historical names.
+from distributed_tensorflow_example_tpu.obs.flops import (  # noqa: E402
+    PEAK_BF16_FLOPS,
+    attention_flops as _attn_flops,
+    chip_peak_flops as _chip_peak_flops,
+    mlp_flops_per_step as _model_flops_per_step,
+)
 
 
 def _load_measured_baseline():
@@ -522,16 +507,6 @@ def bench_learning_regime(repeats: int = 1):
         row["matches_cpu"] = bool(
             abs(row["test_accuracy"] - cpu_acc) <= 0.02)
     return row
-
-
-def _attn_flops(b: int, s: int, h: int, d: int, causal: bool,
-                grad: bool = False) -> float:
-    """Analytic attention FLOPs: forward = 4*B*H*S^2*D (QK^T and P@V,
-    2 FLOPs per MAC), halved under causal masking; a value+grad call
-    adds the backward's ~5 matmuls (p recompute, dp, dq, dk, dv) for
-    ~2.5x forward on top (VERDICT r2 next #4)."""
-    f = 4.0 * b * h * float(s) * s * d * (0.5 if causal else 1.0)
-    return f * 3.5 if grad else f
 
 
 def bench_flash_attention(s: int = 4096, b: int = 4, h: int = 8,
@@ -1017,7 +992,7 @@ def bench_pp_memory(p: int = 4, m: int = 16, batch: int = 32,
     row["stash_mb_per_buf"] = round(
         mb * seq * d_model * 4 / 2**20, 2)
     row["gpipe_live_stashes"] = m
-    row["f1b_live_stashes"] = min(m, 2 * p - 1)
+    row["1f1b_live_stashes"] = min(m, 2 * p - 1)
     for mode, kw in (("gpipe", {}), ("gpipe_remat", dict(remat=True)),
                      ("1f1b", dict(pp_schedule="1f1b")),
                      ("interleaved", dict(virtual_stages=2,
@@ -1056,7 +1031,9 @@ def bench_pp_memory(p: int = 4, m: int = 16, batch: int = 32,
         except Exception as e:
             row[f"{mode}_error"] = str(e)[:140]
     if row.get("gpipe_temp_mb") and row.get("1f1b_temp_mb"):
-        row["f1b_temp_saving_vs_gpipe"] = round(
+        # every 1F1B key carries the '1f1b' prefix so the JSON row
+        # joins cleanly (ADVICE r5 #4)
+        row["1f1b_temp_saving_vs_gpipe"] = round(
             row["gpipe_temp_mb"] / max(row["1f1b_temp_mb"], 0.1), 2)
     return row
 
@@ -1403,7 +1380,12 @@ def main(argv=None) -> int:
         # already-measured rows
         print(json.dumps(row), file=sys.stderr, flush=True)
 
-    def guarded(name, fn, *a, **kw):
+    def guarded(name, fn, /, *a, **kw):
+        # name/fn are positional-ONLY: a row function's own `name=`
+        # kwarg (e.g. the s16k transformer_wide_long variant) must
+        # pass through to `kw`, not collide with the label — the
+        # collision crashed the round-5 driver capture mid-sweep
+        # (VERDICT r5; tests/test_bench_smoke.py pins this)
         try:
             emit(fn(*a, **kw))
         except Exception as e:  # a failing row must not discard the rest
@@ -1572,9 +1554,9 @@ def main(argv=None) -> int:
     if mem_row:
         extra["pp_1f1b_temp_mb"] = mem_row["1f1b_temp_mb"]
         extra["pp_gpipe_temp_mb"] = mem_row.get("gpipe_temp_mb")
-        if mem_row.get("f1b_temp_saving_vs_gpipe"):
+        if mem_row.get("1f1b_temp_saving_vs_gpipe"):
             extra["pp_1f1b_mem_saving"] = \
-                mem_row["f1b_temp_saving_vs_gpipe"]
+                mem_row["1f1b_temp_saving_vs_gpipe"]
     lm_row = next(
         (r for r in rows if r.get("config") == "lm_next_token"
          and "tokens_per_sec" in r), None)

@@ -209,6 +209,22 @@ class Config:
                                         # chief-only default
     profile: bool = False               # jax.profiler trace into logs_path
     debug_nans: bool = False
+    metrics: bool = False               # structured telemetry: one JSON row
+                                        # per --log_every window appended to
+                                        # <logs_path>/metrics.<proc>.jsonl
+                                        # (step-time p50/p95/max, data-wait/
+                                        # dispatch/device split, examples/s,
+                                        # MFU, RSS, device memory) + per-
+                                        # process heartbeat files with a
+                                        # chief straggler report (obs/)
+    log_every: int = 100                # metrics window size in steps; also
+                                        # the histogram-summary cadence
+    histograms: bool = False            # grad-norm/param-norm/learning-rate
+                                        # summaries every --log_every steps,
+                                        # fetched alongside the windowed
+                                        # cost (no per-step host sync);
+                                        # forces the host loop and the
+                                        # synchronous step
 
     # ---- validation / early stopping (beyond-reference) ----
     early_stop_patience: int = 0    # > 0: evaluate the validation split
@@ -396,7 +412,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "data axis (params keep their layout; composes "
                         "with --pipeline_parallel and TP/EP)")
     p.add_argument("--remat", action="store_true",
-                   help="rematerialize activations in the backward pass")
+                   help="rematerialize activations in the backward pass "
+                        "(under a pipeline this is per-slot remat on the "
+                        "gpipe schedule; rejected with --pp_schedule=1f1b, "
+                        "which already rematerializes per slot)")
     p.add_argument("--data_dir", type=str, default=d.data_dir)
     p.add_argument("--dataset", type=str, default=d.dataset,
                    choices=["auto", "mnist", "synthetic"])
@@ -413,6 +432,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "reference's per-worker final eval does")
     p.add_argument("--profile", action="store_true")
     p.add_argument("--debug_nans", action="store_true")
+    p.add_argument("--metrics", action="store_true",
+                   help="write structured telemetry rows (step-time "
+                        "percentiles, data-wait/device split, examples/s, "
+                        "MFU, memory) to <logs_path>/metrics.<proc>.jsonl "
+                        "every --log_every steps, plus per-process "
+                        "heartbeat files and a chief straggler report")
+    p.add_argument("--log_every", type=int, default=d.log_every,
+                   help="metrics window size in steps (also the "
+                        "--histograms summary cadence)")
+    p.add_argument("--histograms", action="store_true",
+                   help="emit grad-norm/param-norm histogram and "
+                        "learning-rate summaries into the event file "
+                        "every --log_every steps (host loop, "
+                        "synchronous step only; no per-step host sync)")
     p.add_argument("--early_stop_patience", type=int,
                    default=d.early_stop_patience,
                    help="stop after P epochs without validation "

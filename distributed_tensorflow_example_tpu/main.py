@@ -29,6 +29,13 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", platform)
     cfg = parse_config(argv)
+    # Operator telemetry override: DTX_METRICS=1 enables the --metrics
+    # JSONL stream (obs/) without editing the command line — the knob a
+    # driver/orchestrator flips fleet-wide when diagnosing stragglers.
+    # Gated on the VALUE: a templated DTX_METRICS=0 must stay off.
+    if (os.environ.get("DTX_METRICS", "").strip().lower()
+            in ("1", "true", "yes", "on") and not cfg.metrics):
+        cfg = cfg.replace(metrics=True)
     run(cfg)
     return 0
 

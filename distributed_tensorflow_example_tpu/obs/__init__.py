@@ -1,0 +1,37 @@
+"""Training telemetry (observability beyond the TB event file).
+
+The reference's only observability is the per-step cost/accuracy
+scalars in its TensorBoard event log (/root/reference/example.py:
+124-128, 163) plus a Step/Epoch/Cost stdout line every 100 steps
+(example.py:166-174) — reproduced by utils/summary.py and
+train/loop.py. This package adds the telemetry layer production
+training systems rely on for throughput accounting and straggler
+diagnosis (MegaScale, arXiv:2402.15627):
+
+    flops       analytic per-model FLOPs + chip peaks — the ONE
+                MFU accounting shared by the train loop, bench.py
+                and the tests
+    metrics     MetricsLogger: one JSON object per logging window
+                appended to <logs_path>/metrics.<proc>.jsonl
+                (step-time percentiles, data-wait/dispatch/device
+                split, examples/sec, MFU, RSS, device memory)
+    heartbeat   per-process heartbeat files at window boundaries +
+                the chief's straggler report
+
+Enabled by ``--metrics`` (with ``--log_every`` windows); grad/param
+norm histograms ride the event file via ``--histograms``
+(utils/summary.py's HistogramProto support). See
+docs/observability.md.
+"""
+
+from .flops import (  # noqa: F401
+    PEAK_BF16_FLOPS,
+    attention_flops,
+    chip_peak_flops,
+    mfu,
+    mlp_flops_per_step,
+    model_flops_per_step,
+    tokens_per_example,
+)
+from .heartbeat import Heartbeat, read_heartbeats, straggler_report  # noqa: F401
+from .metrics import MetricsLogger, WindowTimer, read_metrics  # noqa: F401

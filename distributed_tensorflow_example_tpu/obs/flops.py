@@ -1,0 +1,95 @@
+"""Analytic FLOPs and MFU accounting — the single source of truth.
+
+Hoisted from bench.py (round 6) so the train loop's metrics rows, the
+bench rows, and the tests all compute MFU from ONE implementation: a
+drift between the loop's number and the bench's number would make the
+committed perf record unauditable. bench.py now imports these.
+
+Conventions (unchanged from the bench's original accounting):
+
+- matmul FLOPs are 6*MACs per training step (forward 2*MACs, backward
+  4*MACs — dW and dx each cost one matmul per layer);
+- attention is 4*B*H*S^2*Dh forward (QK^T and P@V at 2 FLOPs/MAC),
+  halved under causal masking, and 3.5x forward for fwd+bwd (the
+  backward's ~5 matmuls: p recompute, dp, dq, dk, dv);
+- MFU divides by the chip's bf16 peak (the MXU's native input width);
+  for f32 runs this is conservative.
+"""
+
+from __future__ import annotations
+
+# bf16 peak matmul throughput per chip, by jax device_kind.
+PEAK_BF16_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+}
+
+
+def chip_peak_flops(device=None):
+    """Per-chip bf16 peak for ``device`` (default: jax.devices()[0]);
+    None off-TPU or for an unknown device_kind — MFU is then
+    undefined (reported as null, never fabricated)."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    if device.platform != "tpu":
+        return None
+    return PEAK_BF16_FLOPS.get(device.device_kind)
+
+
+def mlp_flops_per_step(hidden_sizes, batch, input_size=784, num_classes=10):
+    """Analytic fwd+bwd matmul FLOPs for the MLP family: 2*MACs fwd,
+    4*MACs bwd (dW and dx each cost one matmul per layer) = 6*MACs
+    total, per example."""
+    sizes = (input_size, *hidden_sizes, num_classes)
+    macs = sum(sizes[i] * sizes[i + 1] for i in range(len(sizes) - 1))
+    return 6.0 * batch * macs
+
+
+def attention_flops(b: int, s: int, h: int, d: int, causal: bool,
+                    grad: bool = False) -> float:
+    """Analytic attention FLOPs: forward = 4*B*H*S^2*D (QK^T and P@V,
+    2 FLOPs per MAC), halved under causal masking; a value+grad call
+    adds the backward's ~5 matmuls (p recompute, dp, dq, dk, dv) for
+    ~2.5x forward on top."""
+    f = 4.0 * b * h * float(s) * s * d * (0.5 if causal else 1.0)
+    return f * 3.5 if grad else f
+
+
+def model_flops_per_step(spec, batch: int) -> float:
+    """Fwd+bwd FLOPs per training step for any model spec the train
+    loop builds (make_spec): dispatches to the family's accounting."""
+    from ..models import mlp
+
+    if isinstance(spec, mlp.MLPSpec):
+        return mlp_flops_per_step(tuple(spec.hidden_sizes), batch,
+                                  input_size=spec.input_size,
+                                  num_classes=spec.num_classes)
+    from ..models import transformer
+
+    if isinstance(spec, transformer.TransformerSpec):
+        # transformer.flops_per_step uses the same 6*MACs + 3.5x-fwd
+        # attention conventions as this module (cross-pinned by
+        # tests/test_obs.py)
+        return transformer.flops_per_step(spec, batch)
+    raise TypeError(f"no FLOPs accounting for spec type {type(spec)!r}")
+
+
+def tokens_per_example(spec):
+    """Tokens one example contributes per step (for tokens/sec rows);
+    None for families without a token axis (the MLP)."""
+    seq = getattr(spec, "seq_len", None)
+    return int(seq) if seq else None
+
+
+def mfu(flops_per_step: float, steps_per_sec: float, peak,
+        n_devices: int = 1):
+    """Model FLOPs utilization vs the fleet's aggregate bf16 peak;
+    None when the peak is unknown (non-TPU backends)."""
+    if not peak:
+        return None
+    return flops_per_step * steps_per_sec / (peak * max(n_devices, 1))

@@ -1,0 +1,94 @@
+"""Multi-process heartbeats + the chief's straggler report.
+
+Each process touches ``<logs_path>/heartbeat.<proc>`` at window
+boundaries with its current step and wall time (atomic
+write-then-rename, so a reader never sees a torn file). The chief
+reads every peer's file at epoch end and folds a straggler summary —
+max step lag, the slowest process, the oldest heartbeat age — into
+its metrics stream (obs/metrics.MetricsLogger), which is how
+production systems localize slow hosts without a profiler attach
+(MegaScale-style; the reference has no multi-worker health signal at
+all beyond the Supervisor's internal ready-polling)."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+
+class Heartbeat:
+    """Writer side: ``touch(step)`` at window boundaries."""
+
+    def __init__(self, logs_path: str, process_index: int = 0):
+        os.makedirs(logs_path, exist_ok=True)
+        self.process_index = int(process_index)
+        self.path = os.path.join(logs_path,
+                                 f"heartbeat.{self.process_index}")
+        # a dead run's file for THIS index must not leak into the new
+        # run's report (each process clears only its own file — no
+        # cross-process race); peers from a previous wider run are
+        # excluded by straggler_report's `since` filter
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass
+
+    def touch(self, step: int) -> None:
+        # best-effort like the metrics stream: a full volume must not
+        # kill the run the heartbeat is monitoring
+        try:
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"proc": self.process_index, "step": int(step),
+                           "t": time.time()}, f)
+            os.replace(tmp, self.path)  # atomic on POSIX
+        except OSError:
+            pass
+
+
+def read_heartbeats(logs_path: str) -> Dict[int, Tuple[int, float]]:
+    """{proc: (step, wall_time)} for every heartbeat file present.
+    A torn/absent file is skipped (its process simply looks stale)."""
+    out: Dict[int, Tuple[int, float]] = {}
+    for path in glob.glob(os.path.join(logs_path, "heartbeat.*")):
+        if path.endswith(".tmp"):
+            continue
+        try:
+            with open(path) as f:
+                row = json.load(f)
+            out[int(row["proc"])] = (int(row["step"]), float(row["t"]))
+        except (OSError, ValueError, KeyError):
+            continue
+    return out
+
+
+def straggler_report(logs_path: str,
+                     now: Optional[float] = None,
+                     since: Optional[float] = None) -> Dict[str, object]:
+    """Fold the heartbeat files into the chief's straggler summary:
+    ``max_step_lag`` (front-runner step minus laggard step),
+    ``slowest_proc`` (the laggard; ties break to the lowest index),
+    ``oldest_heartbeat_age_s`` and the participating process count.
+    ``since`` drops beats written before this run started (stale
+    files from a previous, wider run sharing the logs_path would
+    otherwise fabricate phantom stragglers)."""
+    beats = read_heartbeats(logs_path)
+    if since is not None:
+        beats = {p: (s, t) for p, (s, t) in beats.items() if t >= since}
+    if not beats:
+        return {"procs": 0, "max_step_lag": None, "slowest_proc": None,
+                "oldest_heartbeat_age_s": None}
+    now = time.time() if now is None else now
+    steps = {p: s for p, (s, _t) in beats.items()}
+    lead = max(steps.values())
+    slowest = min(sorted(steps), key=lambda p: steps[p])
+    oldest = min(t for _s, t in beats.values())
+    return {
+        "procs": len(beats),
+        "max_step_lag": lead - steps[slowest],
+        "slowest_proc": slowest,
+        "oldest_heartbeat_age_s": round(max(0.0, now - oldest), 3),
+    }

@@ -1,0 +1,178 @@
+"""Structured metrics sink: one JSON object per logging window.
+
+``MetricsLogger`` appends newline-delimited JSON to
+``<logs_path>/metrics.<proc>.jsonl`` (``<proc>`` = jax process index;
+one file per process so multi-process runs never interleave writes).
+Two row kinds:
+
+- ``window``: the per-``--log_every``-steps training telemetry —
+  step-time p50/p95/max over the window, the host loop's
+  data-wait / dispatch / device-wait split, examples/sec, tokens/sec,
+  analytic MFU (obs/flops.py), process RSS and device memory stats;
+- ``event``: point events (compile times, straggler reports, run end).
+
+``WindowTimer`` is the host-loop accumulator behind the window rows:
+the loop charges each step's phases into named buckets (``data_wait``
+= blocking on the prefetcher, ``dispatch`` = the jit'd step call,
+``device_wait`` = blocking fetches: the bounded-queue drain and the
+window-boundary metric fetch) and records per-step wall times for the
+percentiles. Everything not charged is the ``host`` residual. The
+timer adds NO device traffic — it only wraps host-side waits the loop
+already performs, so the dispatch-queue depth is unchanged.
+
+``read_metrics`` parses a file back (tests, tooling).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List
+
+
+def rss_bytes():
+    """Resident set size of this process via /proc (no psutil
+    dependency); None where /proc is unavailable."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+def device_memory_stats(device=None):
+    """``device.memory_stats()`` where the backend provides it (TPU;
+    returns None on CPU), reduced to the portable byte counters."""
+    try:
+        if device is None:
+            import jax
+
+            device = jax.local_devices()[0]
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+            "largest_alloc_size")
+    return {k: int(stats[k]) for k in keep if k in stats}
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile on a pre-sorted list."""
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1, max(0, int(round(
+        q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class WindowTimer:
+    """Accumulates one logging window's per-step host-loop timing."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.step_times: List[float] = []
+        self.buckets: Dict[str, float] = {}
+        self._t_start = time.perf_counter()
+        self._t_last = self._t_start
+
+    @property
+    def steps(self) -> int:
+        return len(self.step_times)
+
+    def charge(self, bucket: str, seconds: float) -> None:
+        self.buckets[bucket] = self.buckets.get(bucket, 0.0) + seconds
+
+    def step_done(self) -> None:
+        now = time.perf_counter()
+        self.step_times.append(now - self._t_last)
+        self._t_last = now
+
+    def window_row(self) -> Dict[str, Any]:
+        """Timing fields for the closing window; caller adds identity
+        (step/epoch/cost) and throughput fields then resets."""
+        wall = time.perf_counter() - self._t_start
+        st = sorted(self.step_times)
+        data_wait = self.buckets.get("data_wait", 0.0)
+        dispatch = self.buckets.get("dispatch", 0.0)
+        device_wait = self.buckets.get("device_wait", 0.0)
+        return {
+            "steps": len(st),
+            "window_wall_s": round(wall, 6),
+            "step_time_p50_ms": round(_percentile(st, 50) * 1e3, 4),
+            "step_time_p95_ms": round(_percentile(st, 95) * 1e3, 4),
+            "step_time_max_ms": round((st[-1] if st else float("nan"))
+                                      * 1e3, 4),
+            "data_wait_s": round(data_wait, 6),
+            "dispatch_s": round(dispatch, 6),
+            "device_wait_s": round(device_wait, 6),
+            "host_s": round(max(0.0, wall - data_wait - dispatch
+                                 - device_wait), 6),
+        }
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics stream, one file per process."""
+
+    def __init__(self, logs_path: str, process_index: int = 0):
+        os.makedirs(logs_path, exist_ok=True)
+        self.process_index = int(process_index)
+        self.path = os.path.join(logs_path,
+                                 f"metrics.{self.process_index}.jsonl")
+        self._f = open(self.path, "a", buffering=1)  # line-buffered
+
+    def _emit(self, row: Dict[str, Any]) -> None:
+        # telemetry must degrade, never kill the run it observes: a
+        # bad fd / full volume disables the stream instead of raising
+        # into the training loop
+        if self._f is None:
+            return
+        try:
+            self._f.write(json.dumps(row) + "\n")
+        except (OSError, ValueError):
+            try:
+                self._f.close()
+            except Exception:
+                pass
+            self._f = None
+
+    def log_window(self, **fields) -> None:
+        self._emit({"kind": "window", "t": time.time(),
+                    "proc": self.process_index, **fields,
+                    "rss_bytes": rss_bytes(),
+                    "device_memory": device_memory_stats()})
+
+    def log_event(self, event: str, **fields) -> None:
+        self._emit({"kind": "event", "event": event, "t": time.time(),
+                    "proc": self.process_index, **fields})
+
+    def flush(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+
+    def close(self) -> None:
+        if self._f is None:
+            return
+        try:
+            self._f.flush()
+        finally:
+            self._f.close()
+            self._f = None
+
+
+def read_metrics(path: str) -> List[Dict[str, Any]]:
+    """Parse a metrics.<proc>.jsonl back into row dicts."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows

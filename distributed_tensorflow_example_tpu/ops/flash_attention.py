@@ -29,7 +29,9 @@ dispatch chains so tunnel round-trips cancel):
   every k/v fetch over twice the q rows (+13% in-window) at
   [2048, 1024] f32 score/p intermediates (8 MB, inside the VMEM
   cap). blk_k shrinks to keep dividing the padded sequence, capped
-  at 512 when D > 128.
+  at 512 when D > 128 — and for D > 128 the doubled blk_q is bounded
+  by the same 512 cap (square tiles; the wide head already scales
+  the backward's VMEM working set).
 - **Causal fetch elimination** (r5): dead (above-diagonal) grid
   steps clamp their fetch indices to the causal frontier
   (``_causal_frontier``) — the Pallas pipeline elides repeated-index
@@ -115,12 +117,19 @@ def _pick_tiles(s: int, d: int) -> tuple[int, int]:
     blk_q doubles it when s allows — a 2:1 rectangular tile amortizes
     every k/v fetch over twice the q rows (measured +13% on
     [4,4096,8,64] bf16 causal) at 2x the [blk_q, blk_k] score/p VMEM
-    (8 MB f32 at 2048x1024, well inside the 100 MB cap)."""
+    (8 MB f32 at 2048x1024, well inside the 100 MB cap). For D > 128
+    the doubled blk_q is ALSO bounded by the 512 cap (square tiles):
+    wide heads already multiply the backward kernels' [blk_q, blk_k]
+    intermediates and the q/do fetch buffers by D/128 — doubling q on
+    top would run twice the scoped-VMEM budget the cap protects
+    (ADVICE r5 #1; tests/test_flash_attention.py pins the geometry)."""
     cap = _BLK_PREF if d <= 128 else 512
     blk = _BLK
     while blk * 2 <= cap and s % (blk * 2) == 0:
         blk *= 2
     blk_q = blk * 2 if s % (blk * 2) == 0 else blk
+    if d > 128:
+        blk_q = min(blk_q, cap)
     return blk_q, blk
 
 

@@ -227,6 +227,42 @@ def _loss_and_acc(spec, params, x, y, styles, naive, use_pallas, remat=False,
     return cost + aux_w * aux, (cost, acc)
 
 
+def _pspec_axes(sp) -> tuple:
+    """The mesh axes a leaf's PartitionSpec shards over (flattened,
+    deduped, sorted) — the axes its square-sum must psum across for an
+    exact global reduction."""
+    axes = []
+    for part in (sp or ()):
+        if part is None:
+            continue
+        axes.extend(part if isinstance(part, tuple) else (part,))
+    return tuple(sorted(set(axes)))
+
+
+def _leaf_norms(tree, param_pspecs):
+    """Per-leaf global L2 norms as one [n_leaves] f32 vector, exact
+    under parameter sharding (each leaf's square-sum is psum'd over
+    the axes its PartitionSpec mentions, as _clip_sharded does). The
+    telemetry source for the --histograms grad/param-norm summaries —
+    a handful of scalars per step, so keeping the latest device value
+    and fetching it once per logging window adds no per-step host
+    traffic."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if param_pspecs is None:
+        spec_leaves = [None] * len(leaves)
+    else:
+        spec_leaves = jax.tree_util.tree_leaves(
+            param_pspecs, is_leaf=lambda x: isinstance(x, P))
+    out = []
+    for g, sp in zip(leaves, spec_leaves):
+        sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        axes = _pspec_axes(sp)
+        if axes:
+            sq = jax.lax.psum(sq, axes)
+        out.append(jnp.sqrt(sq))
+    return jnp.stack(out)
+
+
 def _clip_sharded(grads, param_pspecs, max_norm: float):
     """Global-norm clip that is exact under PARAMETER sharding: a
     leaf's square-sum is psum'd over exactly the mesh axes its
@@ -240,12 +276,7 @@ def _clip_sharded(grads, param_pspecs, max_norm: float):
         param_pspecs, is_leaf=lambda x: isinstance(x, P))
     groups: dict = {}
     for g, sp in zip(g_leaves, s_leaves):
-        axes = []
-        for part in (sp or ()):
-            if part is None:
-                continue
-            axes.extend(part if isinstance(part, tuple) else (part,))
-        key = tuple(sorted(set(axes)))
+        key = _pspec_axes(sp)
         groups.setdefault(key, []).append(
             jnp.sum(jnp.square(g.astype(jnp.float32))))
     sq = jnp.float32(0.0)
@@ -287,7 +318,8 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
                         model_axis: str | None = None,
                         batch_axes: tuple = (DATA_AXIS,),
                         param_pspecs=None,
-                        zero_dp: int = 0) -> Callable:
+                        zero_dp: int = 0,
+                        with_norms: bool = False) -> Callable:
     """The per-shard synchronous step body (state, x, y) -> (state, cost,
     acc) — shared by the host-fed step (build_train_step) and the
     device-resident scan paths (parallel/epoch.py) so both train with
@@ -418,6 +450,9 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
         # axes (params are batch-unvarying); rescale for mean semantics.
         if cfg.grad_reduce == "mean" and dp > 1:
             grads = jax.tree.map(lambda g: g / dp, grads)
+        # telemetry norms ride the step PRE-clip (the raw gradient
+        # scale is the debugging signal a clip would mask)
+        grad_norms = _leaf_norms(grads, param_pspecs) if with_norms else None
         if cfg.grad_clip > 0:
             if param_pspecs is not None:
                 grads = _clip_sharded(grads, param_pspecs, cfg.grad_clip)
@@ -435,7 +470,13 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
                 grads, state.opt_state, state.params)
         cost = jax.lax.pmean(cost, batch_axes)
         acc = jax.lax.pmean(acc, batch_axes)
-        return TrainState(state.step + 1, new_params, new_opt), cost, acc
+        new_state = TrainState(state.step + 1, new_params, new_opt)
+        if with_norms:
+            return new_state, cost, acc, {
+                "grad": grad_norms,
+                "param": _leaf_norms(new_params, param_pspecs),
+            }
+        return new_state, cost, acc
 
     return body
 
@@ -532,12 +573,19 @@ def _pipeline_info(mesh, cfg, spec, optimizer=None):
         spec, stage_axis, model_axis, expert_axis)
 
 
-def build_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer) -> Callable:
+def build_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer,
+                     with_norms: bool = False) -> Callable:
     """Synchronous SPMD step: (state, x, y) -> (state, cost, acc).
 
     The returned callable is jit'd with the state donated — params never
     leave the devices (the inverse of the reference's per-step parameter
     round-trip, SURVEY.md §3.3).
+
+    ``with_norms=True`` (the --histograms telemetry) appends a fourth
+    output: {'grad': [n_leaves], 'param': [n_leaves]} per-leaf global
+    L2 norms, computed inside the same compiled step (exact under
+    parameter sharding) — the host keeps the latest device value and
+    fetches it once per logging window.
     """
     mp = mesh.shape.get(MODEL_AXIS, 1)
     seq_axis = mesh_lib.axis_if_present(mesh, mesh_lib.SEQ_AXIS)
@@ -563,12 +611,16 @@ def build_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer) -> Callable:
                                      seq_axis, expert_axis, pipeline,
                                      model_axis, batch_axes,
                                      param_pspecs=sspecs.params,
-                                     zero_dp=zero_dp)
+                                     zero_dp=zero_dp,
+                                     with_norms=with_norms)
+    out_specs = (sspecs, P(), P())
+    if with_norms:
+        out_specs = out_specs + ({"grad": P(), "param": P()},)
     fn = jax.shard_map(
         shard_step,
         mesh=mesh,
         in_specs=(sspecs, x_spec, y_spec),
-        out_specs=(sspecs, P(), P()),
+        out_specs=out_specs,
     )
     return jax.jit(fn, donate_argnums=0)
 

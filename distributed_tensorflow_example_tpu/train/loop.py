@@ -111,6 +111,21 @@ def _print_window(step: int, epoch: int, batch_i: int, batch_count: int,
           " AvgTime: %3.2fms" % float(elapsed_time * 1000 / frequency))
 
 
+def _host_lr(cfg, total_steps: int):
+    """Host-side mirror of make_optimizer's lr schedule (train.optim):
+    step (1-based) -> learning rate, for the --histograms telemetry
+    summaries (the device step never exports its lr)."""
+    from .optim import schedule_multiplier
+
+    if cfg.lr_schedule == "constant" and not cfg.warmup_steps:
+        return lambda step: float(cfg.learning_rate)
+    mult = schedule_multiplier(cfg.lr_schedule, cfg.warmup_steps,
+                               cfg.schedule_steps or total_steps,
+                               cfg.lr_min_factor)
+    return lambda step: float(cfg.learning_rate) * float(
+        mult(jnp.float32(step)))
+
+
 def _eval_accuracy(eval_step, params, images, labels, dp: int, chunk: int,
                    unit: int | None = None) -> float:
     """Full-test-set accuracy (example.py:177), zero-padded to the mesh.
@@ -208,6 +223,13 @@ def run(cfg: Config) -> Dict[str, Any]:
         if cfg.grad_accum > 1:
             raise ValueError("--pp_schedule=1f1b already microbatches "
                              "the local batch; --grad_accum must be 1")
+        if cfg.remat:
+            # pipe_remat only feeds the jax.grad schedules; silently
+            # ignoring the flag here would misreport the memory story
+            raise ValueError("--remat has no effect under "
+                             "--pp_schedule=1f1b (the fused schedule "
+                             "already rematerializes per slot); drop "
+                             "the flag or use --pp_schedule=gpipe")
     if cfg.virtual_stages < 1:
         raise ValueError(
             f"virtual_stages={cfg.virtual_stages} must be >= 1")
@@ -261,6 +283,17 @@ def run(cfg: Config) -> Dict[str, Any]:
                          "objective only")
     if cfg.weight_decay < 0 or cfg.grad_clip < 0:
         raise ValueError("weight_decay and grad_clip must be >= 0")
+    if cfg.log_every < 1:
+        raise ValueError(f"log_every={cfg.log_every} must be >= 1")
+    if cfg.histograms:
+        if cfg.fsdp or cfg.sync_period > 1:
+            raise ValueError("--histograms rides the synchronous SPMD "
+                             "step's norm outputs (no --fsdp, "
+                             "sync_period=1)")
+        if not cfg.summaries:
+            raise ValueError("--histograms writes histogram summaries "
+                             "into the event file; do not combine "
+                             "with --no_summaries")
     if cfg.early_stop_patience < 0:
         raise ValueError(
             f"early_stop_patience={cfg.early_stop_patience} must be >= 0")
@@ -367,6 +400,10 @@ def run(cfg: Config) -> Dict[str, Any]:
     else:
         mesh = mesh_lib.build_mesh(cfg.data_parallel, cfg.model_parallel)
     dp = mesh.shape[mesh_lib.DATA_AXIS]
+    n_devices = (dp * mesh.shape.get(mesh_lib.MODEL_AXIS, 1)
+                 * mesh.shape.get(mesh_lib.SEQ_AXIS, 1)
+                 * mesh.shape.get(mesh_lib.EXPERT_AXIS, 1)
+                 * mesh.shape.get(mesh_lib.STAGE_AXIS, 1))
 
     # total batch shards: dp, times ep under sparse-dispatch expert
     # parallelism (tokens shard over the expert axis too — the GShard
@@ -378,6 +415,52 @@ def run(cfg: Config) -> Dict[str, Any]:
     total_steps = cfg.training_epochs * max(
         1, dataset.train.images.shape[0] // global_batch)
     optimizer = make_optimizer(cfg, total_steps)
+
+    # --metrics telemetry (obs/): per-process structured JSONL sink +
+    # heartbeat file; MFU accounting shared with bench.py via obs.flops
+    mlogger = None
+    heartbeat = None
+    metrics_row = None
+    if cfg.metrics:
+        from ..obs import flops as flops_lib
+        from ..obs import heartbeat as hb_lib
+        from ..obs.metrics import MetricsLogger
+
+        mlogger = MetricsLogger(cfg.logs_path, process_index=proc_idx)
+        heartbeat = hb_lib.Heartbeat(cfg.logs_path,
+                                     process_index=proc_idx)
+        telemetry_start = time.time()
+        flops_step = flops_lib.model_flops_per_step(spec, global_batch)
+        peak = flops_lib.chip_peak_flops()
+        toks = flops_lib.tokens_per_example(spec)
+
+        def metrics_row(step: int, epoch: int, cost: float,
+                        timing: Dict[str, Any]) -> None:
+            """One window row: identity + timing + throughput/MFU."""
+            row: Dict[str, Any] = dict(step=int(step), epoch=int(epoch),
+                                       cost=cost, **timing)
+            wall = timing.get("window_wall_s") or 0.0
+            steps_n = timing.get("steps") or 0
+            sps = steps_n / wall if wall > 0 and steps_n else None
+            row["examples_per_sec"] = (round(sps * global_batch, 3)
+                                       if sps else None)
+            row["tokens_per_sec"] = (round(sps * global_batch * toks, 1)
+                                     if sps and toks else None)
+            row["model_flops_per_step"] = flops_step
+            row["tflops_per_sec"] = (round(flops_step * sps / 1e12, 5)
+                                     if sps else None)
+            m = (flops_lib.mfu(flops_step, sps, peak, n_devices)
+                 if sps else None)
+            row["mfu"] = round(m, 6) if m is not None else None
+            mlogger.log_window(**row)
+
+        def straggler_event(epoch: int) -> None:
+            if chief:
+                mlogger.log_event(
+                    "stragglers", epoch=int(epoch),
+                    **hb_lib.straggler_report(cfg.logs_path,
+                                              since=telemetry_start))
+
     pp_mode = cfg.pipeline_parallel > 1
     if pp_mode:
         # the pipeline schedule sees one grad-accum chunk at a time;
@@ -398,6 +481,9 @@ def run(cfg: Config) -> Dict[str, Any]:
     fast = (
         cfg.fast_loop and proc_cnt == 1
         and (cfg.shard_data or dp == 1)
+        # --histograms needs the host loop's per-window norm fetch
+        # (the scan runners return only cost/acc arrays)
+        and not cfg.histograms
         # sequence-parallel steps shard x over ('data','seq'), which the
         # scan runners' P('data') dataset layout doesn't express yet;
         # expert-parallel state pspecs likewise; the ZeRO-1 flat slot
@@ -446,7 +532,8 @@ def run(cfg: Config) -> Dict[str, Any]:
         get_params = step_lib.build_unstack_params(mesh, state)
         sspecs = step_lib._stacked_specs(state)
     else:
-        train_step = None if fast else step_lib.build_train_step(cfg, mesh, spec, optimizer)
+        train_step = (None if fast else step_lib.build_train_step(
+            cfg, mesh, spec, optimizer, with_norms=cfg.histograms))
         param_sync = None
         get_params = None
         if pp_mode:
@@ -729,7 +816,8 @@ def run(cfg: Config) -> Dict[str, Any]:
         shuffle_key = jax.random.PRNGKey(cfg.seed + 0x5EED)
 
         def emit_epoch(epoch: int, costs: np.ndarray, accs: np.ndarray,
-                       avg_step_s: float) -> float:
+                       avg_step_s: float,
+                       metrics_step_s: float | None = None) -> float:
             nonlocal examples_seen
             examples_seen += batch_count * global_batch
             if writer is not None:
@@ -749,6 +837,29 @@ def run(cfg: Config) -> Dict[str, Any]:
                     _print_window(step, epoch, i, batch_count, last,
                                   count * avg_step_s, frequency)
                     count = 0
+            if mlogger is not None:
+                # per-epoch telemetry from the already-returned arrays
+                # (the scan path has no per-step host timing: the
+                # percentiles collapse to the epoch mean, flagged by
+                # timing="epoch_mean"; the whole epoch is one device
+                # program, so the wall is all device time).
+                # metrics_step_s, when given, excludes the measured
+                # compile wall — the print's AvgTime keeps the seed
+                # semantics, but MFU must not amortize compile.
+                m_s = (metrics_step_s if metrics_step_s is not None
+                       else avg_step_s)
+                ms = round(m_s * 1e3, 4)
+                wall = round(m_s * batch_count, 6)
+                metrics_row(
+                    (epoch + 1) * batch_count * step_scale, epoch, last,
+                    {"path": "fast", "timing": "epoch_mean",
+                     "steps": batch_count, "window_wall_s": wall,
+                     "step_time_p50_ms": ms, "step_time_p95_ms": ms,
+                     "step_time_max_ms": ms, "data_wait_s": 0.0,
+                     "dispatch_s": 0.0, "device_wait_s": wall,
+                     "host_s": 0.0})
+                heartbeat.touch((epoch + 1) * batch_count)
+                straggler_event(epoch)
             return last
 
         n_ep = cfg.training_epochs - start_epoch
@@ -773,6 +884,13 @@ def run(cfg: Config) -> Dict[str, Any]:
             state, costs2d, accs2d = runner(
                 state, img_d, lbl_d, shuffle_key, start_epoch
             )
+            # jit dispatch returns after trace+compile (execution is
+            # async): the call's wall is the compile, logged as its
+            # own event and excluded from the metrics rows' step time
+            disp_wall = time.time() - t0
+            if mlogger is not None:
+                mlogger.log_event("compile", what="run_to_completion",
+                                  dispatch_wall_s=round(disp_wall, 3))
             # enqueue the final eval now so it executes on-device right
             # after the run, then fetch metrics AND the eval count in a
             # single device_get — every separate fetch through the
@@ -784,11 +902,15 @@ def run(cfg: Config) -> Dict[str, Any]:
             costs2d, accs2d, eval_pending = jax.device_get(
                 (costs2d, accs2d, eval_pending)
             )
-            avg_step_s = (time.time() - t0) / (n_ep * batch_count)
+            total_wall = time.time() - t0
+            avg_step_s = total_wall / (n_ep * batch_count)
+            metrics_step_s = max(0.0, total_wall - disp_wall) / (
+                n_ep * batch_count)
             epochs_done = start_epoch + n_ep
             for e_off in range(n_ep):
                 cost = emit_epoch(start_epoch + e_off, costs2d[e_off],
-                                  accs2d[e_off], avg_step_s)
+                                  accs2d[e_off], avg_step_s,
+                                  metrics_step_s)
         elif not async_mode:
             # per-epoch runner, for host control between epochs
             # (periodic checkpoints). Fast async always takes the
@@ -809,10 +931,17 @@ def run(cfg: Config) -> Dict[str, Any]:
                 state, costs, accs = epoch_runner(
                     state, img_d, lbl_d, shuffle_key, epoch
                 )
+                disp_wall = time.time() - t0 if epoch == start_epoch else 0.0
+                if mlogger is not None and epoch == start_epoch:
+                    mlogger.log_event("compile", what="epoch_runner",
+                                      dispatch_wall_s=round(disp_wall, 3))
                 # one round trip for both metric arrays
                 costs, accs = jax.device_get((costs, accs))
-                avg_step_s = (time.time() - t0) / batch_count
-                cost = emit_epoch(epoch, costs, accs, avg_step_s)
+                total_wall = time.time() - t0
+                avg_step_s = total_wall / batch_count
+                cost = emit_epoch(
+                    epoch, costs, accs, avg_step_s,
+                    max(0.0, total_wall - disp_wall) / batch_count)
                 epochs_done = epoch + 1
                 # validation BEFORE the checkpoint so the saved
                 # best_val/val_wait include this epoch — a --resume run
@@ -865,6 +994,63 @@ def run(cfg: Config) -> Dict[str, Any]:
         start_time = time.time()  # example.py:149
         from ..data.prefetch import Prefetcher
 
+        # telemetry state: the window timer charges the loop's existing
+        # host-side waits into named buckets (data_wait = prefetcher
+        # block, dispatch = the jit'd call, device_wait = the bounded-
+        # queue drain + the window-boundary metric fetch) — it never
+        # adds a fetch of its own, so the dispatch queue is untouched
+        want_norms = cfg.histograms
+        norms_dev = None
+        lr_host = _host_lr(cfg, total_steps) if want_norms else None
+        wtimer = None
+        if mlogger is not None or want_norms:
+            from ..obs.metrics import WindowTimer
+
+            wtimer = WindowTimer()
+        compile_logged = False
+
+        def timed_batches(prefetcher):
+            """enumerate(prefetcher), charging the blocking next() into
+            the window's data_wait bucket."""
+            it = iter(prefetcher)
+            i = 0
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+                if wtimer is not None:
+                    wtimer.charge("data_wait", time.perf_counter() - t0)
+                yield i, item
+                i += 1
+
+        def close_window(epoch: int, cost_dev) -> None:
+            """Window boundary: ONE blocking fetch (cost + the step's
+            latest norm vectors together), then the metrics row, the
+            heartbeat touch, and the histogram/lr summaries."""
+            t0 = time.perf_counter()
+            fetched = jax.device_get(
+                (cost_dev, norms_dev) if norms_dev is not None
+                else (cost_dev, None))
+            cost_w, norms_host = float(fetched[0]), fetched[1]
+            wtimer.charge("device_wait", time.perf_counter() - t0)
+            step = steps_done * step_scale
+            if mlogger is not None:
+                timing = wtimer.window_row()
+                timing["path"] = "host"
+                metrics_row(step, epoch, cost_w, timing)
+            if heartbeat is not None:
+                heartbeat.touch(steps_done)
+            if norms_host is not None and writer is not None:
+                writer.add_histograms(step, {
+                    "grad_norm": norms_host["grad"],
+                    "param_norm": norms_host["param"],
+                })
+                writer.add_scalars(
+                    step, {"learning_rate": lr_host(steps_done)})
+            wtimer.reset()
+
         steps_done = start_epoch * iterator.batches_per_epoch
         graph_dumped = False
         for epoch in range(start_epoch, cfg.training_epochs):
@@ -873,9 +1059,13 @@ def run(cfg: Config) -> Dict[str, Any]:
             # epoch-keyed shuffle: resume at epoch E replays the same
             # permutations an uninterrupted run would have used
             prefetcher = Prefetcher(iterator.epoch(epoch))
+            if wtimer is not None:
+                # inter-epoch host work (validation eval, checkpoint,
+                # prefetcher spin-up) must not bleed into the next
+                # window's wall and deflate its throughput fields
+                wtimer.reset()
             try:
-                batches = enumerate(prefetcher)
-                for i, (batch_x, batch_y) in batches:
+                for i, (batch_x, batch_y) in timed_batches(prefetcher):
                     if batch_sharding is not None:
                         if seq_mp:
                             # every process holds the full batch; each
@@ -898,7 +1088,29 @@ def run(cfg: Config) -> Dict[str, Any]:
                     if not graph_dumped:
                         graph_dumped = True
                         dump_graph(train_step, state, batch_x, batch_y)
-                    state, cost_dev, acc_dev = train_step(state, batch_x, batch_y)
+                    t_disp = time.perf_counter()
+                    if want_norms:
+                        state, cost_dev, acc_dev, norms_dev = train_step(
+                            state, batch_x, batch_y)
+                    else:
+                        state, cost_dev, acc_dev = train_step(
+                            state, batch_x, batch_y)
+                    if wtimer is not None:
+                        t_disp = time.perf_counter() - t_disp
+                        wtimer.charge("dispatch", t_disp)
+                        if not compile_logged:
+                            # first jit dispatch = trace + compile
+                            # (execution itself is async)
+                            compile_logged = True
+                            if mlogger is not None:
+                                mlogger.log_event(
+                                    "compile", what="train_step",
+                                    dispatch_wall_s=round(t_disp, 3))
+                            # compile is its own event; like the fast
+                            # paths, the first window's throughput
+                            # must not amortize it — restart the
+                            # window clock post-compile
+                            wtimer.reset()
                     steps_done += 1
                     # host-side step counter: state.step advances 1 per call
                     # deterministically, and fetching it would force a
@@ -908,7 +1120,11 @@ def run(cfg: Config) -> Dict[str, Any]:
                     examples_seen += global_batch
                     inflight.append(cost_dev)
                     if len(inflight) > window:
+                        t_drain = time.perf_counter()
                         inflight.pop(0).block_until_ready()
+                        if wtimer is not None:
+                            wtimer.charge("device_wait",
+                                          time.perf_counter() - t_drain)
                     if writer is not None:
                         # the reference writes cost+accuracy every step
                         # (example.py:163)
@@ -926,10 +1142,17 @@ def run(cfg: Config) -> Dict[str, Any]:
                         _print_window(step, epoch, i, batch_count, cost,
                                       elapsed_time, frequency)
                         count = 0
+                    if wtimer is not None:
+                        wtimer.step_done()
+                        if (wtimer.steps >= cfg.log_every
+                                or i + 1 == batch_count):
+                            close_window(epoch, cost_dev)
                     maybe_checkpoint(epoch)
             finally:
                 prefetcher.close()
             epochs_done = epoch + 1
+            if mlogger is not None:
+                straggler_event(epoch)
             if early:
                 p_eval = (get_params(state)
                           if (async_mode or fsdp_mode) else state.params)
@@ -1032,6 +1255,14 @@ def run(cfg: Config) -> Dict[str, Any]:
         ckpt_lib.wait_for_pending_saves()
     if writer is not None:
         writer.close()
+    if mlogger is not None:
+        mlogger.log_event(
+            "run_end", steps=int(state.step),
+            total_time_s=round(total_time, 3),
+            test_accuracy=float(test_acc),
+            examples_per_sec=(round(examples_seen / total_time, 3)
+                              if total_time > 0 else None))
+        mlogger.close()
 
     if chief:
         print("done")  # example.py:182
@@ -1045,10 +1276,7 @@ def run(cfg: Config) -> Dict[str, Any]:
         "examples_seen": examples_seen,
         "examples_per_sec": examples_seen / total_time if total_time > 0 else 0.0,
         "dataset_source": dataset.source,
-        "devices": dp * mesh.shape.get(mesh_lib.MODEL_AXIS, 1)
-        * mesh.shape.get(mesh_lib.SEQ_AXIS, 1)
-        * mesh.shape.get(mesh_lib.EXPERT_AXIS, 1)
-        * mesh.shape.get(mesh_lib.STAGE_AXIS, 1),
+        "devices": n_devices,
         "global_batch": global_batch,
         "fast_loop": fast,
         "epochs_completed": epochs_done,

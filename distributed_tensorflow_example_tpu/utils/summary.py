@@ -68,6 +68,12 @@ def _bytes_field(field: int, value: bytes) -> bytes:
     return _key(field, 2) + _varint(len(value)) + value
 
 
+def _packed_doubles_field(field: int, values) -> bytes:
+    """Packed repeated double (wire type 2, consecutive LE doubles)."""
+    payload = b"".join(struct.pack("<d", float(v)) for v in values)
+    return _key(field, 2) + _varint(len(payload)) + payload
+
+
 # --- Event / Summary messages (tensorflow/core/util/event.proto) ---------
 
 
@@ -76,6 +82,59 @@ def encode_scalar_summary(values: dict[str, float]) -> bytes:
     out = b""
     for tag, val in values.items():
         value_msg = _bytes_field(1, tag.encode()) + _float_field(2, float(val))
+        out += _bytes_field(1, value_msg)
+    return out
+
+
+def encode_histogram_proto(values) -> bytes:
+    """HistogramProto{ min=1, max=2, num=3, sum=4, sum_squares=5,
+    repeated bucket_limit=6 [packed], repeated bucket=7 [packed] }
+    (tensorflow/core/framework/summary.proto).
+
+    Buckets are 30 equal-width bins over [min, max] (right edges in
+    ``bucket_limit``), degenerating to one bin when all values are
+    equal — TensorBoard renders arbitrary edges, and equal-width bins
+    keep the encoder dependency-free. Counts always sum to
+    ``len(values)`` (pinned by tests/test_summary.py).
+
+    Non-finite values must not kill the run that is recording them —
+    a diverging loss producing an inf grad norm is exactly what the
+    histogram exists to show. They are clamped into the finite
+    values' range (landing in the edge buckets; NaN counts high);
+    an all-non-finite tensor collapses to one bucket at 0."""
+    import numpy as np
+
+    v = np.asarray(values, dtype=np.float64).ravel()
+    if v.size == 0:
+        raise ValueError("cannot encode an empty histogram")
+    finite = v[np.isfinite(v)]
+    if finite.size == 0:
+        lo = hi = 0.0
+        vb = np.zeros_like(v)
+    else:
+        lo, hi = float(finite.min()), float(finite.max())
+        vb = np.clip(np.nan_to_num(v, nan=hi, posinf=hi, neginf=lo),
+                     lo, hi)
+    msg = _double_field(1, lo) + _double_field(2, hi)
+    msg += _double_field(3, float(v.size))
+    msg += _double_field(4, float(vb.sum()))
+    msg += _double_field(5, float(np.square(vb).sum()))
+    if hi > lo:
+        counts, edges = np.histogram(vb, bins=30, range=(lo, hi))
+        limits = edges[1:]
+    else:
+        counts, limits = np.array([v.size]), np.array([hi])
+    msg += _packed_doubles_field(6, limits)
+    msg += _packed_doubles_field(7, counts)
+    return msg
+
+
+def encode_histogram_summary(histos: dict) -> bytes:
+    """Summary{ repeated Value{ tag=1, histo=5 } } from {tag: array}."""
+    out = b""
+    for tag, vals in histos.items():
+        value_msg = _bytes_field(1, tag.encode()) + _bytes_field(
+            5, encode_histogram_proto(vals))
         out += _bytes_field(1, value_msg)
     return out
 
@@ -176,6 +235,7 @@ def encode_event(
     file_version: str | None = None,
     scalars: dict[str, float] | None = None,
     graph_def: bytes | None = None,
+    histograms: dict | None = None,
 ) -> bytes:
     """Event{ wall_time=1(double), step=2(int64), file_version=3,
     graph_def=4(bytes), summary=5 }."""
@@ -186,8 +246,13 @@ def encode_event(
         msg += _bytes_field(3, file_version.encode())
     if graph_def is not None:
         msg += _bytes_field(4, graph_def)
+    summary = b""
     if scalars:
-        msg += _bytes_field(5, encode_scalar_summary(scalars))
+        summary += encode_scalar_summary(scalars)
+    if histograms:
+        summary += encode_histogram_summary(histograms)
+    if summary:
+        msg += _bytes_field(5, summary)
     return msg
 
 
@@ -222,6 +287,13 @@ class SummaryWriter:
     def add_scalars(self, step: int, values: dict[str, float]) -> None:
         """``writer.add_summary(summary, step)`` equivalent (example.py:163)."""
         self._write_event(encode_event(time.time(), step=step, scalars=values))
+
+    def add_histograms(self, step: int, values: dict) -> None:
+        """Write histogram summaries (e.g. grad/param norms) — the
+        capability the reference's merged scalar summary never had;
+        TensorBoard's Histograms tab reads these."""
+        self._write_event(encode_event(time.time(), step=step,
+                                       histograms=values))
 
     def add_graph(self, nodes) -> None:
         """``FileWriter(logdir, graph=...)`` equivalent (example.py:146):
@@ -275,8 +347,25 @@ def _parse_fields(buf: bytes) -> Iterator[Tuple[int, int, bytes | int | float]]:
         yield field, wire, val
 
 
+def _parse_histogram(buf: bytes) -> dict:
+    """Decode a HistogramProto (see encode_histogram_proto)."""
+    histo = {"min": None, "max": None, "num": None, "sum": None,
+             "sum_squares": None, "bucket_limit": [], "bucket": []}
+    names = {1: "min", 2: "max", 3: "num", 4: "sum", 5: "sum_squares"}
+    for hfield, _hw, hval in _parse_fields(buf):
+        if hfield in names:
+            histo[names[hfield]] = hval
+        elif hfield in (6, 7):
+            key = "bucket_limit" if hfield == 6 else "bucket"
+            vals = [struct.unpack_from("<d", hval, off)[0]
+                    for off in range(0, len(hval), 8)]
+            histo[key].extend(vals)
+    return histo
+
+
 def read_event_file(path: str):
-    """Parse a tfevents file into [{wall_time, step, file_version, scalars}]."""
+    """Parse a tfevents file into [{wall_time, step, file_version,
+    scalars, histograms, graph_nodes}]."""
     events = []
     with open(path, "rb") as f:
         data = f.read()
@@ -294,7 +383,7 @@ def read_event_file(path: str):
         pos += 12 + length + 4
 
         ev = {"wall_time": None, "step": None, "file_version": None,
-              "scalars": {}, "graph_nodes": None}
+              "scalars": {}, "histograms": {}, "graph_nodes": None}
         for field, _wire, val in _parse_fields(payload):
             if field == 1:
                 ev["wall_time"] = val
@@ -320,13 +409,17 @@ def read_event_file(path: str):
             elif field == 5:
                 for sfield, _w, sval in _parse_fields(val):
                     if sfield == 1:
-                        tag, simple = None, None
+                        tag, simple, histo = None, None, None
                         for vfield, _w2, vval in _parse_fields(sval):
                             if vfield == 1:
                                 tag = vval.decode()
                             elif vfield == 2:
                                 simple = vval
-                        if tag is not None:
+                            elif vfield == 5:
+                                histo = _parse_histogram(vval)
+                        if tag is not None and histo is not None:
+                            ev["histograms"][tag] = histo
+                        elif tag is not None:
                             ev["scalars"][tag] = simple
         events.append(ev)
     return events

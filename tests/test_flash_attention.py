@@ -211,3 +211,45 @@ def test_rectangular_tiles_causal_s2048():
         np.testing.assert_allclose(
             np.asarray(got_g), np.asarray(want_g), rtol=5e-4,
             atol=5e-4, err_msg=f"d{name}")
+
+
+def test_pick_tiles_wide_head_vmem_cap():
+    """ADVICE r5 #1: for D > 128 the doubled blk_q is bounded by the
+    same 512 VMEM cap as blk_k (square tiles) — the backward kernels'
+    [blk_q, blk_k] intermediates and q/do fetch buffers already scale
+    with D/128, and doubling q on top would run twice the scoped-VMEM
+    budget. D <= 128 keeps the 2:1 rectangular geometry."""
+    assert fa._pick_tiles(4096, 64) == (2048, 1024)
+    assert fa._pick_tiles(4096, 128) == (2048, 1024)
+    # wide heads: blk_q capped with blk_k at 512
+    assert fa._pick_tiles(4096, 256) == (512, 512)
+    assert fa._pick_tiles(2048, 256) == (512, 512)
+    assert fa._pick_tiles(1024, 256) == (512, 512)
+    # s too short to double: unchanged either way
+    assert fa._pick_tiles(512, 256) == (512, 512)
+    assert fa._pick_tiles(256, 256) == (256, 256)
+
+
+@pytest.mark.skipif(
+    not hasattr(fa.pltpu, "CompilerParams"),
+    reason="pallas CompilerParams API needs a newer jax than this env")
+def test_d256_capped_tiles_match_dense():
+    """Functional check at d_head=256 (the capped square-tile path):
+    forward and all three gradients match dense attention."""
+    q, k, v = _inputs(b=1, s=512, h=1, d=256)
+    want = np.asarray(ra.attention(q, k, v, causal=True))
+    got = np.asarray(fa.flash_attention(q, k, v, True))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def loss_fa(q_, k_, v_):
+        return jnp.sum(fa.flash_attention(q_, k_, v_, True) ** 2)
+
+    def loss_ra(q_, k_, v_):
+        return jnp.sum(ra.attention(q_, k_, v_, causal=True) ** 2)
+
+    g_fa = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+    g_ra = jax.grad(loss_ra, argnums=(0, 1, 2))(q, k, v)
+    for got_g, want_g, name in zip(g_fa, g_ra, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got_g), np.asarray(want_g), rtol=2e-3,
+            atol=2e-3, err_msg=f"d{name}")

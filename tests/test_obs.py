@@ -1,0 +1,322 @@
+"""Telemetry subsystem tests (obs/): the shared FLOPs/MFU accounting,
+the metrics.<proc>.jsonl round-trip (host and fast paths), histogram
+cadence, and heartbeats/straggler reporting."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import bench
+from distributed_tensorflow_example_tpu.obs import flops as flops_lib
+from distributed_tensorflow_example_tpu.obs import heartbeat as hb_lib
+from distributed_tensorflow_example_tpu.obs.metrics import (
+    MetricsLogger, WindowTimer, read_metrics, rss_bytes)
+
+
+def _stack_available():
+    try:
+        from distributed_tensorflow_example_tpu.train import loop  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+needs_stack = pytest.mark.skipif(
+    not _stack_available(),
+    reason="training stack needs a newer jax than this environment has")
+
+
+# --- obs.flops: the ONE MFU accounting -----------------------------------
+
+
+def test_bench_uses_obs_flops():
+    """bench.py's accounting IS obs/flops.py (aliases, not copies) —
+    the loop's metrics MFU and the bench MFU cannot drift."""
+    assert bench._model_flops_per_step is flops_lib.mlp_flops_per_step
+    assert bench._attn_flops is flops_lib.attention_flops
+    assert bench._chip_peak_flops is flops_lib.chip_peak_flops
+    assert bench.PEAK_BF16_FLOPS is flops_lib.PEAK_BF16_FLOPS
+
+
+def test_mfu_matches_bench_mxu_wide():
+    """MFU for the bench's mxu_wide shape (784-4096-4096-10 @ batch
+    8192) computed the bench's way and via the shared helper agree to
+    float tolerance."""
+    hidden, batch = (4096, 4096), 8192
+    flops = flops_lib.mlp_flops_per_step(hidden, batch)
+    macs = 784 * 4096 + 4096 * 4096 + 4096 * 10
+    assert flops == 6.0 * batch * macs
+    peak = flops_lib.PEAK_BF16_FLOPS["TPU v5 lite"]
+    steps_per_sec = 37.5
+    bench_style = flops * steps_per_sec / peak  # bench_mxu's formula
+    shared = flops_lib.mfu(flops, steps_per_sec, peak)
+    assert shared == pytest.approx(bench_style, rel=1e-12)
+    assert flops_lib.mfu(flops, steps_per_sec, None) is None
+
+
+def test_attention_flops_convention():
+    # forward 4*B*H*S^2*D, halved causal, 3.5x fwd for value+grad
+    f = flops_lib.attention_flops(2, 128, 4, 64, causal=False)
+    assert f == 4.0 * 2 * 4 * 128 * 128 * 64
+    assert flops_lib.attention_flops(2, 128, 4, 64, causal=True) == f / 2
+    assert flops_lib.attention_flops(2, 128, 4, 64, True, grad=True) \
+        == f / 2 * 3.5
+
+
+def test_model_flops_dispatch_mlp():
+    from distributed_tensorflow_example_tpu.models.mlp import MLPSpec
+
+    spec = MLPSpec(input_size=784, hidden_sizes=(100,), num_classes=10)
+    assert flops_lib.model_flops_per_step(spec, 100) == \
+        flops_lib.mlp_flops_per_step((100,), 100)
+    assert flops_lib.tokens_per_example(spec) is None
+
+
+def test_model_flops_dispatch_transformer():
+    tfm = pytest.importorskip(
+        "distributed_tensorflow_example_tpu.models.transformer")
+    spec = tfm.TransformerSpec(input_size=112, seq_len=28, d_model=64,
+                               n_heads=4, num_blocks=2, d_ff=128)
+    assert flops_lib.model_flops_per_step(spec, 32) == \
+        tfm.flops_per_step(spec, 32)
+    assert flops_lib.tokens_per_example(spec) == 28
+
+
+# --- obs.metrics ---------------------------------------------------------
+
+
+def test_window_timer_percentiles():
+    t = WindowTimer()
+    t.step_times = [0.01 * k for k in range(1, 101)]  # 10ms .. 1000ms
+    t.charge("data_wait", 1.5)
+    t.charge("dispatch", 2.0)
+    t.charge("device_wait", 0.25)
+    row = t.window_row()
+    assert row["steps"] == 100
+    assert row["step_time_p50_ms"] == pytest.approx(510, abs=15)
+    assert row["step_time_p95_ms"] == pytest.approx(950, abs=15)
+    assert row["step_time_max_ms"] == pytest.approx(1000, abs=1)
+    assert row["data_wait_s"] == 1.5
+    assert row["dispatch_s"] == 2.0
+    assert row["device_wait_s"] == 0.25
+
+
+def test_metrics_logger_roundtrip(tmp_path):
+    m = MetricsLogger(str(tmp_path), process_index=3)
+    m.log_window(step=50, epoch=0, cost=1.25, steps=50)
+    m.log_event("compile", what="train_step", dispatch_wall_s=0.7)
+    m.close()
+    assert os.path.basename(m.path) == "metrics.3.jsonl"
+    rows = read_metrics(m.path)
+    assert [r["kind"] for r in rows] == ["window", "event"]
+    w = rows[0]
+    assert (w["step"], w["proc"], w["cost"]) == (50, 3, 1.25)
+    assert "rss_bytes" in w and "device_memory" in w
+    assert rows[1]["event"] == "compile"
+    # every row is one self-contained JSON line
+    lines = open(m.path).read().strip().splitlines()
+    assert len(lines) == 2 and all(json.loads(ln) for ln in lines)
+
+
+def test_rss_bytes_sane():
+    rss = rss_bytes()
+    if rss is not None:  # /proc platforms
+        assert 1 << 20 < rss < 1 << 40
+
+
+# --- obs.heartbeat -------------------------------------------------------
+
+
+def test_heartbeat_straggler_report(tmp_path):
+    for proc, step in ((0, 100), (1, 80), (2, 95)):
+        hb_lib.Heartbeat(str(tmp_path), proc).touch(step)
+    beats = hb_lib.read_heartbeats(str(tmp_path))
+    assert {p: s for p, (s, _t) in beats.items()} == {0: 100, 1: 80, 2: 95}
+    rep = hb_lib.straggler_report(str(tmp_path))
+    assert rep["procs"] == 3
+    assert rep["max_step_lag"] == 20
+    assert rep["slowest_proc"] == 1
+    assert rep["oldest_heartbeat_age_s"] >= 0.0
+
+
+def test_straggler_report_empty(tmp_path):
+    rep = hb_lib.straggler_report(str(tmp_path))
+    assert rep["procs"] == 0 and rep["max_step_lag"] is None
+
+
+def test_heartbeat_init_clears_own_stale_file(tmp_path):
+    """A rerun over the same logs_path must not report the dead run's
+    own-index heartbeat."""
+    hb_lib.Heartbeat(str(tmp_path), 0).touch(500)
+    hb_lib.Heartbeat(str(tmp_path), 0)  # new run, same process index
+    assert hb_lib.read_heartbeats(str(tmp_path)) == {}
+
+
+def test_straggler_report_since_filters_stale_peers(tmp_path):
+    """A previous WIDER run's leftover heartbeat files are excluded by
+    the run-start cutoff — no phantom stragglers."""
+    import time as _time
+
+    hb_lib.Heartbeat(str(tmp_path), 5).touch(999)  # dead run's peer
+    cut = _time.time()
+    hb_lib.Heartbeat(str(tmp_path), 0).touch(10)
+    rep = hb_lib.straggler_report(str(tmp_path), since=cut)
+    assert rep["procs"] == 1
+    assert rep["slowest_proc"] == 0
+    assert rep["max_step_lag"] == 0
+
+
+def test_metrics_logger_degrades_on_write_failure(tmp_path):
+    """Telemetry must never kill the run it observes: a dead fd
+    disables the stream instead of raising into the train loop."""
+    m = MetricsLogger(str(tmp_path))
+    m._f.close()  # simulate ENOSPC / bad fd
+    m.log_window(step=1, epoch=0, cost=1.0)  # must not raise
+    m.log_event("compile", what="train_step")
+    m.flush()
+    m.close()
+
+
+# --- end-to-end through train.loop --------------------------------------
+
+
+@needs_stack
+def test_metrics_jsonl_host_path(tmp_path):
+    """--metrics --log_every 50 on the host loop: parseable
+    metrics.<proc>.jsonl whose window rows carry the step-time
+    percentiles, the data-wait/dispatch/device split, examples/sec
+    and MFU, with the bench's own FLOPs number; compile + straggler +
+    run_end events; a heartbeat file at the last window's step."""
+    from distributed_tensorflow_example_tpu.config import Config
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    run(Config(
+        training_epochs=1, batch_size=16, dataset="synthetic",
+        synthetic_train_size=1600, synthetic_test_size=64,
+        logs_path=str(tmp_path), frequency=50, metrics=True,
+        log_every=50, fast_loop=False, summaries=False,
+        compilation_cache="",
+    ))
+    files = glob.glob(os.path.join(str(tmp_path), "metrics.*.jsonl"))
+    assert len(files) == 1
+    rows = read_metrics(files[0])
+    windows = [r for r in rows if r["kind"] == "window"]
+    assert len(windows) == 2  # 100 steps / log_every=50
+    for r in windows:
+        for key in ("step", "epoch", "cost", "steps", "window_wall_s",
+                    "step_time_p50_ms", "step_time_p95_ms",
+                    "step_time_max_ms", "data_wait_s", "dispatch_s",
+                    "device_wait_s", "host_s", "examples_per_sec",
+                    "tokens_per_sec", "model_flops_per_step",
+                    "tflops_per_sec", "mfu", "rss_bytes",
+                    "device_memory"):
+            assert key in r, key
+        assert r["path"] == "host"
+        assert r["steps"] == 50
+        assert np.isfinite(r["cost"])
+        assert r["examples_per_sec"] > 0
+        assert r["step_time_p95_ms"] >= r["step_time_p50_ms"] > 0
+        # the split is charged from real waits the loop already pays
+        assert r["dispatch_s"] > 0
+        assert r["data_wait_s"] >= 0 and r["device_wait_s"] >= 0
+    assert windows[-1]["step"] == 100
+    # MFU accounting is the bench's own helper (obs/flops.py): the
+    # FLOPs match bench._model_flops_per_step exactly; on CPU the
+    # peak is unknown so mfu is null, never fabricated
+    assert windows[0]["model_flops_per_step"] == \
+        bench._model_flops_per_step((100,), 16)
+    events = {r["event"] for r in rows if r["kind"] == "event"}
+    assert {"compile", "stragglers", "run_end"} <= events
+    beats = hb_lib.read_heartbeats(str(tmp_path))
+    assert beats[0][0] == 100
+
+
+@needs_stack
+def test_metrics_fast_path(tmp_path):
+    """The fast (whole-run-on-device) path emits its per-epoch window
+    rows from the already-returned cost/acc arrays."""
+    from distributed_tensorflow_example_tpu.config import Config
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    run(Config(
+        training_epochs=2, batch_size=16, dataset="synthetic",
+        synthetic_train_size=320, synthetic_test_size=64,
+        logs_path=str(tmp_path), frequency=20, metrics=True,
+        log_every=50, summaries=False, compilation_cache="",
+    ))
+    files = glob.glob(os.path.join(str(tmp_path), "metrics.*.jsonl"))
+    assert len(files) == 1
+    rows = read_metrics(files[0])
+    windows = [r for r in rows if r["kind"] == "window"]
+    assert len(windows) == 2  # one per epoch
+    for epoch, r in enumerate(windows):
+        assert r["path"] == "fast"
+        assert r["timing"] == "epoch_mean"
+        assert (r["epoch"], r["steps"]) == (epoch, 20)
+        assert r["examples_per_sec"] > 0
+        assert r["device_wait_s"] == r["window_wall_s"] > 0
+        assert r["data_wait_s"] == 0.0  # dataset lives in HBM
+        assert "mfu" in r
+    events = {r["event"] for r in rows if r["kind"] == "event"}
+    assert {"compile", "stragglers", "run_end"} <= events
+
+
+@needs_stack
+def test_histograms_window_cadence(tmp_path):
+    """--histograms: grad-norm/param-norm histogram events decode via
+    read_event_file with bucket counts summing to the tensor size
+    (4 MLP leaves), at the WINDOW cadence — 2 events for 40 steps at
+    log_every=20, not 40 — plus the learning-rate scalar."""
+    from distributed_tensorflow_example_tpu.config import Config
+    from distributed_tensorflow_example_tpu.train.loop import run
+    from distributed_tensorflow_example_tpu.utils.summary import (
+        read_event_file)
+
+    run(Config(
+        training_epochs=1, batch_size=16, dataset="synthetic",
+        synthetic_train_size=640, synthetic_test_size=64,
+        logs_path=str(tmp_path), frequency=20, histograms=True,
+        log_every=20, compilation_cache="",
+    ))
+    files = glob.glob(os.path.join(str(tmp_path), "events.out.tfevents.*"))
+    assert len(files) == 1
+    events = read_event_file(files[0])
+    hist_events = [e for e in events if e["histograms"]]
+    assert len(hist_events) == 2  # 40 steps / log_every=20: window cadence
+    for e in hist_events:
+        for tag in ("grad_norm", "param_norm"):
+            h = e["histograms"][tag]
+            # W1, b1, W2, b2 -> 4 per-leaf norms
+            assert h["num"] == 4
+            assert sum(h["bucket"]) == pytest.approx(h["num"])
+            assert len(h["bucket"]) == len(h["bucket_limit"])
+            assert h["min"] <= h["max"]
+            assert h["sum"] > 0  # norms are positive
+    assert hist_events[-1]["step"] == 40
+    lr_events = [e for e in events
+                 if e["scalars"].get("learning_rate") is not None]
+    assert len(lr_events) == 2
+    assert lr_events[0]["scalars"]["learning_rate"] == \
+        pytest.approx(5e-4, rel=1e-5)
+
+
+@needs_stack
+def test_telemetry_flag_validation():
+    from distributed_tensorflow_example_tpu.config import Config
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    with pytest.raises(ValueError, match="log_every"):
+        run(Config(log_every=0))
+    with pytest.raises(ValueError, match="histograms"):
+        run(Config(histograms=True, summaries=False))
+    with pytest.raises(ValueError, match="histograms"):
+        run(Config(histograms=True, sync_period=5))
+    # --remat under 1f1b is a rejected no-op (ADVICE r5 #2)
+    with pytest.raises(ValueError, match="remat.*1f1b|1f1b.*remat"):
+        run(Config(model="transformer", num_blocks=2,
+                   pipeline_parallel=2, pp_schedule="1f1b",
+                   remat=True))

@@ -68,6 +68,82 @@ def test_graph_event_roundtrip(tmp_path):
     assert nodes["y"]["op"] == "Softmax"
 
 
+def test_histogram_event_roundtrip(tmp_path):
+    """HistogramProto encode/decode (Summary.Value field 5): bucket
+    counts sum to the tensor size, min/max/sum/sum_squares survive,
+    and scalar events in the same file still parse."""
+    import numpy as np
+    import pytest
+
+    rng = np.random.RandomState(0)
+    gvals = np.abs(rng.randn(37)) + 1e-3
+    pvals = np.abs(rng.randn(5)) + 1e-3
+    w = SummaryWriter(str(tmp_path))
+    w.add_scalars(1, {"cost": 2.5})
+    w.add_histograms(2, {"grad_norm": gvals, "param_norm": pvals})
+    w.close()
+    files = glob.glob(os.path.join(str(tmp_path), "events.out.tfevents.*"))
+    events = read_event_file(files[0])
+    assert events[1]["scalars"]["cost"] == pytest.approx(2.5)
+    assert not events[1]["histograms"]
+    he = events[2]
+    assert he["step"] == 2
+    assert set(he["histograms"]) == {"grad_norm", "param_norm"}
+    for tag, vals in (("grad_norm", gvals), ("param_norm", pvals)):
+        h = he["histograms"][tag]
+        assert sum(h["bucket"]) == pytest.approx(vals.size)
+        assert h["num"] == pytest.approx(vals.size)
+        assert h["min"] == pytest.approx(vals.min())
+        assert h["max"] == pytest.approx(vals.max())
+        assert h["sum"] == pytest.approx(vals.sum())
+        assert h["sum_squares"] == pytest.approx(np.square(vals).sum())
+        assert len(h["bucket"]) == len(h["bucket_limit"])
+        # right edges are sorted and end at max
+        assert h["bucket_limit"] == sorted(h["bucket_limit"])
+        assert h["bucket_limit"][-1] == pytest.approx(vals.max())
+
+
+def test_histogram_degenerate_and_empty():
+    """All-equal values collapse to one bucket; empty input is a
+    caller error, not a silent zero-histogram."""
+    import numpy as np
+    import pytest
+
+    from distributed_tensorflow_example_tpu.utils.summary import (
+        _parse_histogram, encode_histogram_proto)
+
+    h = _parse_histogram(encode_histogram_proto(np.full(8, 3.25)))
+    assert h["bucket"] == [8.0]
+    assert h["bucket_limit"] == [3.25]
+    assert h["min"] == h["max"] == 3.25
+    with pytest.raises(ValueError, match="empty"):
+        encode_histogram_proto(np.array([]))
+
+
+def test_histogram_nonfinite_values_survive():
+    """A diverging run's inf/NaN norms must be RECORDED, not crash the
+    writer at the window boundary (the histogram exists to show the
+    divergence): non-finite values clamp into the finite range's edge
+    buckets, counts still sum to the tensor size; an all-non-finite
+    tensor collapses to one bucket."""
+    import numpy as np
+    import pytest
+
+    from distributed_tensorflow_example_tpu.utils.summary import (
+        _parse_histogram, encode_histogram_proto)
+
+    vals = np.array([1.0, 2.0, np.inf, -np.inf, np.nan, 3.0])
+    h = _parse_histogram(encode_histogram_proto(vals))
+    assert h["num"] == vals.size
+    assert sum(h["bucket"]) == pytest.approx(vals.size)
+    assert h["min"] == 1.0 and h["max"] == 3.0  # the finite range
+    assert np.isfinite(h["sum"]) and np.isfinite(h["sum_squares"])
+    h2 = _parse_histogram(encode_histogram_proto(
+        np.array([np.inf, np.nan])))
+    assert h2["bucket"] == [2.0]
+    assert h2["min"] == h2["max"] == 0.0
+
+
 def test_run_writes_graph_event(tmp_path):
     """End-to-end: a training run's event file carries the graph record
     (example.py:146 parity), alongside the per-step scalars."""
