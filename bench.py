@@ -243,17 +243,88 @@ def _run(cfg):
     return res, buf.getvalue()
 
 
-def bench_config(name: str, cfg, epochs_full: int = 20, repeats: int = 5):
+def _cold_forensics(cfg, profile_steps: str = ""):
+    """Forensics-instrumented cold-run config: the cold run (the one
+    that pays compile) writes its --metrics stream into a throwaway
+    logs dir so the row can carry the compile events, with the
+    windowed profiler capture when the driver asked for one. Returns
+    (cold_cfg, logs_dir). With ``profile_steps`` the dir is the KEPT
+    artifact (the row records its trace path), so it lives under
+    <repo>/bench_traces — not /tmp, where OS reaping would eat it."""
+    import tempfile
+
+    if profile_steps:
+        base = os.path.join(_REPO, "bench_traces")
+        os.makedirs(base, exist_ok=True)
+        tdir = tempfile.mkdtemp(prefix="run_", dir=base)
+    else:
+        tdir = tempfile.mkdtemp(prefix="bench_forensics_")
+    kw = dict(metrics=True, logs_path=tdir)
+    if profile_steps:
+        kw["profile_steps"] = profile_steps
+    return cfg.replace(**kw), tdir
+
+
+def _forensics_row_fields(tdir: str, profile_steps: str = ""):
+    """Fold the cold run's telemetry into bench-row fields: the
+    compile events (what compiled, how long the first dispatch took),
+    the trace path under --profile-steps, and any metrics-schema
+    drift (obs/schema.py) — so format rot fails loudly in the bench
+    capture, not in a dashboard weeks later."""
+    import glob as glob_lib
+
+    from distributed_tensorflow_example_tpu.obs import schema as schema_lib
+    from distributed_tensorflow_example_tpu.obs.metrics import read_metrics
+
+    fields = {}
+    mfiles = sorted(glob_lib.glob(os.path.join(tdir, "metrics.*.jsonl")))
+    if mfiles:
+        rows = read_metrics(mfiles[0])
+        fields["compile_events"] = [
+            {"what": r.get("what"),
+             "dispatch_wall_s": r.get("dispatch_wall_s")}
+            for r in rows
+            if r.get("kind") == "event" and r.get("event") == "compile"]
+        errs = schema_lib.validate_metrics_file(mfiles[0])
+        if errs:
+            fields["metrics_schema_errors"] = errs[:5]
+    if profile_steps:
+        fields["profile_trace_path"] = os.path.join(tdir, "profile")
+        fields["profile_steps"] = profile_steps
+    return fields
+
+
+def bench_config(name: str, cfg, epochs_full: int = 20, repeats: int = 5,
+                 profile_steps: str = ""):
     """Run the config ``repeats`` warm times; report median/min/max of
     the warm wall-clocks, with the cold (compile-paying first) run timed
-    separately and excluded from the median."""
+    separately and excluded from the median. The cold run doubles as
+    the forensics capture: its compile events (and, with
+    ``profile_steps``, the windowed trace path) land in the row."""
     print(f"[bench] {name}: cold run ...", file=sys.stderr, flush=True)
-    cold = _run(cfg)[0]
+    try:
+        cold_cfg, forensics_dir = _cold_forensics(cfg, profile_steps)
+    except Exception:
+        cold_cfg, forensics_dir = cfg, None
+    def _discard_forensics():
+        # guarded() swallows row failures — the throwaway dir must not
+        # leak once per failed config across a sweep (a kept
+        # profile-steps trace dir is the artifact and stays)
+        if forensics_dir is not None and not profile_steps:
+            import shutil
+
+            shutil.rmtree(forensics_dir, ignore_errors=True)
+
     results = []
-    for i in range(max(1, repeats)):
-        print(f"[bench] {name}: warm run {i + 1}/{repeats}",
-              file=sys.stderr, flush=True)
-        results.append(_run(cfg)[0])
+    try:
+        cold = _run(cold_cfg)[0]
+        for i in range(max(1, repeats)):
+            print(f"[bench] {name}: warm run {i + 1}/{repeats}",
+                  file=sys.stderr, flush=True)
+            results.append(_run(cfg)[0])
+    except BaseException:
+        _discard_forensics()
+        raise
     scale = epochs_full / cfg.training_epochs
     walls = sorted(r["total_time_s"] * scale for r in results)
     median_wall = statistics.median(walls)
@@ -288,6 +359,15 @@ def bench_config(name: str, cfg, epochs_full: int = 20, repeats: int = 5):
         "devices": rep["devices"],
         "dataset": rep["dataset_source"],
     }
+    if forensics_dir is not None:
+        try:
+            row.update(_forensics_row_fields(forensics_dir, profile_steps))
+        except Exception as e:  # forensics must never void the measurement
+            row["forensics_error"] = str(e)[:200]
+        # nothing in the row points at the dir once the compile events
+        # are folded in — don't leak a tempdir per config (with
+        # profile_steps the trace path IS the artifact and is kept)
+        _discard_forensics()
     return row
 
 
@@ -1340,7 +1420,16 @@ def main(argv=None) -> int:
     p.add_argument("--repeats", type=int, default=5)
     p.add_argument("--cpu-baseline", action="store_true")
     p.add_argument("--all-configs", action="store_true")
+    p.add_argument("--profile-steps", type=str, default="",
+                   metavar="START:COUNT",
+                   help="windowed profiler capture on each headline "
+                        "config's cold run; the trace path lands in "
+                        "the row JSON (profile_trace_path)")
     args = p.parse_args(argv)
+    # forwarded only when set: the row stubs in the smoke tests (and
+    # any external bench_config monkeypatch) keep their old signature
+    prof_kw = ({"profile_steps": args.profile_steps}
+               if args.profile_steps else {})
 
     if args.cpu_baseline:
         import jax
@@ -1417,10 +1506,10 @@ def main(argv=None) -> int:
         ]
         for name, cfg in configs:
             guarded(name, bench_config, name, cfg, epochs_full=20,
-                    repeats=args.repeats)
+                    repeats=args.repeats, **prof_kw)
     else:
         guarded("reference_default", bench_config, "reference_default",
-                base, epochs_full=20, repeats=args.repeats)
+                base, epochs_full=20, repeats=args.repeats, **prof_kw)
 
     # The rows below run on BOTH paths (VERDICT r2 next #1: the default
     # `python bench.py` — the exact command the driver captures — must

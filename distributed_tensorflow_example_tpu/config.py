@@ -208,7 +208,38 @@ class Config:
                                         # every worker (example.py:177);
                                         # chief-only default
     profile: bool = False               # jax.profiler trace into logs_path
-    debug_nans: bool = False
+                                        # (whole run; prefer
+                                        # --profile_steps for anything
+                                        # longer than a smoke test)
+    profile_steps: str = ""             # "START:COUNT": programmatic
+                                        # windowed profiler capture
+                                        # around exactly those steps
+                                        # (obs/tracer.py) — replaces
+                                        # the whole-run --profile trace
+    profile_port: int = 0               # > 0: start the on-demand
+                                        # jax.profiler server on this
+                                        # port (chief) so TensorBoard
+                                        # can attach to a live run
+    debug_nans: bool = False            # superseded by --on_anomaly:
+                                        # jax_debug_nans crashes with
+                                        # no forensics context
+    on_anomaly: str = ""                # anomaly policy: "" (off) |
+                                        # halt (record + raise) | dump
+                                        # (flight dump + continue) |
+                                        # skip (compiled step masks
+                                        # the update on a non-finite
+                                        # loss/grad; skipped steps
+                                        # accounted) — obs/anomaly.py
+    anomaly_factor: float = 10.0        # loss-EMA divergence watchdog:
+                                        # flag loss > factor * EMA
+    flight: bool = False                # flight recorder: ring of the
+                                        # last K step records + env
+                                        # snapshot, dumped to
+                                        # <logs_path>/flight/<proc>.json
+                                        # on crash/anomaly/SIGUSR1
+                                        # (auto-on when --on_anomaly
+                                        # is set)
+    flight_steps: int = 64              # flight-recorder ring size K
     metrics: bool = False               # structured telemetry: one JSON row
                                         # per --log_every window appended to
                                         # <logs_path>/metrics.<proc>.jsonl
@@ -430,8 +461,44 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--eval_all_hosts", action="store_true",
                    help="print Test-Accuracy on every process, as the "
                         "reference's per-worker final eval does")
-    p.add_argument("--profile", action="store_true")
-    p.add_argument("--debug_nans", action="store_true")
+    p.add_argument("--profile", action="store_true",
+                   help="whole-run jax.profiler trace (skews perf and "
+                        "grows unboundedly; prefer --profile_steps)")
+    p.add_argument("--profile_steps", type=str, default=d.profile_steps,
+                   metavar="START:COUNT",
+                   help="windowed profiler capture: trace exactly "
+                        "COUNT steps starting at global step START "
+                        "(0-based), with StepTraceAnnotation/"
+                        "TraceAnnotation scopes matching the --metrics "
+                        "timing split; replaces --profile")
+    p.add_argument("--profile_port", type=int, default=d.profile_port,
+                   help="start the on-demand profiler server on this "
+                        "port (chief only; TensorBoard 'Capture "
+                        "profile' attaches to a live run)")
+    p.add_argument("--debug_nans", action="store_true",
+                   help="jax_debug_nans (superseded by --on_anomaly, "
+                        "which records forensics context instead of "
+                        "crashing without it)")
+    from .obs.anomaly import POLICIES
+
+    p.add_argument("--on_anomaly", type=str, default=d.on_anomaly,
+                   choices=list(POLICIES),
+                   help="in-step anomaly policy: halt (record + stop), "
+                        "dump (flight dump + continue), skip (the "
+                        "compiled step masks the update on a "
+                        "non-finite loss/grad; skipped steps "
+                        "accounted). Enables the flight recorder and "
+                        "the loss-EMA divergence watchdog")
+    p.add_argument("--anomaly_factor", type=float, default=d.anomaly_factor,
+                   help="divergence watchdog threshold: flag a loss "
+                        "above factor * rolling EMA")
+    p.add_argument("--flight", action="store_true",
+                   help="crash flight recorder: last --flight_steps "
+                        "step records + env snapshot dumped to "
+                        "<logs_path>/flight/<proc>.json on crash, "
+                        "anomaly or SIGUSR1 (with stack dumps)")
+    p.add_argument("--flight_steps", type=int, default=d.flight_steps,
+                   help="flight-recorder ring capacity (last K steps)")
     p.add_argument("--metrics", action="store_true",
                    help="write structured telemetry rows (step-time "
                         "percentiles, data-wait/device split, examples/s, "

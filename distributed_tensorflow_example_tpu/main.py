@@ -29,13 +29,19 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", platform)
     cfg = parse_config(argv)
-    # Operator telemetry override: DTX_METRICS=1 enables the --metrics
-    # JSONL stream (obs/) without editing the command line — the knob a
-    # driver/orchestrator flips fleet-wide when diagnosing stragglers.
-    # Gated on the VALUE: a templated DTX_METRICS=0 must stay off.
-    if (os.environ.get("DTX_METRICS", "").strip().lower()
-            in ("1", "true", "yes", "on") and not cfg.metrics):
-        cfg = cfg.replace(metrics=True)
+    # Operator env overrides — the knobs a driver/orchestrator flips
+    # fleet-wide without editing the command line: DTX_METRICS=1
+    # enables the --metrics JSONL stream (straggler diagnosis),
+    # DTX_FLIGHT=1 the crash flight recorder (obs/flight.py post-
+    # mortem dumps). Gated on the VALUE: a templated DTX_X=0 stays off.
+    def env_flag(name: str) -> bool:
+        return (os.environ.get(name, "").strip().lower()
+                in ("1", "true", "yes", "on"))
+
+    for env_name, field in (("DTX_METRICS", "metrics"),
+                            ("DTX_FLIGHT", "flight")):
+        if env_flag(env_name) and not getattr(cfg, field):
+            cfg = cfg.replace(**{field: True})
     run(cfg)
     return 0
 

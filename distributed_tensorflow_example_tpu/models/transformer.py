@@ -1505,14 +1505,22 @@ def generate_dp(spec: TransformerSpec, params: Params,
                 model_axis: str | None = None, rng: jax.Array = None,
                 temperature: float = 1.0):
     """Batched decode ON the mesh (VERDICT r4 next #8): prompts shard
-    over ``data_axis`` (padded to a multiple of its size, sliced
-    back), so ``--sample_after`` scales decode throughput with the
-    data axis in EVERY mode instead of falling back to a chief-host
-    numpy decode. ``params`` are the FLAT layout, replicated (PP/FSDP
-    callers unstack/gather first — on device); with ``model_axis`` the
+    over ``data_axis`` (padded up to a multiple of its size), so
+    ``--sample_after`` scales decode throughput with the data axis in
+    EVERY mode instead of falling back to a chief-host numpy decode.
+    ``params`` are the FLAT layout, replicated (PP/FSDP callers
+    unstack/gather first — on device); with ``model_axis`` the
     per-shard decode is additionally Megatron tensor-parallel. Works
     single- and multi-process: the prompt array is assembled with
-    make_array_from_callback from the (identical) host copy."""
+    make_array_from_callback from the (identical) host copy.
+
+    Returns ``(tokens, n)`` — SYMMETRIC across process counts (r5
+    ADVICE: the old contract sliced ``[:n]`` single-process but
+    returned the padded global array multi-process, so callers written
+    against one topology silently broke on the other): ``tokens`` is
+    ALWAYS the padded, data-sharded global array and ``n`` the valid
+    row count. ``dp_samples_host`` materializes the first ``n`` rows
+    on every host (allgather only when multi-process)."""
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
@@ -1532,12 +1540,22 @@ def generate_dp(spec: TransformerSpec, params: Params,
     fn = _gen_dp_fn(spec, mesh, data_axis, model_axis,
                     float(temperature), rng is not None)
     out = fn(prm, pr_g, rng if rng is not None else jax.random.PRNGKey(0))
-    if jax.process_count() == 1:
-        return out[:n]
-    # multi-process: cross-shard slicing is not addressable — return
-    # the padded data-sharded global array; callers process_allgather
-    # and slice [:n]
-    return out
+    # cross-shard slicing is not addressable multi-process, so the
+    # padded global array + count is the one contract every topology
+    # shares; dp_samples_host does the (allgather +) [:n] slice
+    return out, n
+
+
+def dp_samples_host(tokens, n: int):
+    """Materialize ``generate_dp``'s padded output as the first ``n``
+    rows on every host: one ``process_allgather`` when the shards span
+    processes (single-process arrays are fully addressable and fetch
+    directly), then the ``[:n]`` slice dropping the pad rows."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        tokens = multihost_utils.process_allgather(tokens, tiled=True)
+    return np.asarray(tokens)[:int(n)]
 
 
 def num_params(spec: TransformerSpec) -> int:

@@ -18,12 +18,32 @@ diagnosis (MegaScale, arXiv:2402.15627):
     heartbeat   per-process heartbeat files at window boundaries +
                 the chief's straggler report
 
+and the failure-forensics layer (the run explains its own failures):
+
+    tracer      WindowedTracer: programmatic --profile_steps
+                START:COUNT profiler capture around exact steps,
+                trace scopes named after the metrics buckets, the
+                exception-safe whole-run --profile mode and the
+                --profile_port on-demand profiler server
+    anomaly     LossWatchdog (loss-EMA divergence) + AnomalyPolicy
+                (--on_anomaly={halt,dump,skip} with skipped-step
+                accounting and per-leaf blame); the compiled
+                non-finite flags live in parallel/step.py
+    flight      FlightRecorder: ring buffer of the last K step
+                records + env snapshot, dumped to
+                <logs_path>/flight/<proc>.json on crash, anomaly or
+                SIGUSR1; chief-side collate() post-mortem report
+    schema      the written-down metrics/flight format contract +
+                validators (bench.py and tier-1 pin it)
+
 Enabled by ``--metrics`` (with ``--log_every`` windows); grad/param
 norm histograms ride the event file via ``--histograms``
 (utils/summary.py's HistogramProto support). See
 docs/observability.md.
 """
 
+from .anomaly import AnomalyError, AnomalyPolicy, LossWatchdog  # noqa: F401
+from .flight import FlightRecorder, collate, env_snapshot, read_flight  # noqa: F401
 from .flops import (  # noqa: F401
     PEAK_BF16_FLOPS,
     attention_flops,
@@ -35,3 +55,10 @@ from .flops import (  # noqa: F401
 )
 from .heartbeat import Heartbeat, read_heartbeats, straggler_report  # noqa: F401
 from .metrics import MetricsLogger, WindowTimer, read_metrics  # noqa: F401
+from .schema import (  # noqa: F401
+    validate_flight_dump,
+    validate_flight_file,
+    validate_metrics_file,
+    validate_metrics_row,
+)
+from .tracer import WindowedTracer, parse_profile_steps  # noqa: F401

@@ -118,6 +118,19 @@ class WindowTimer:
         }
 
 
+def _scrub_nonfinite(row):
+    """Strict-JSON-safe copy: NaN/Inf floats stringify ("nan"/"inf"),
+    unknown types fall back to repr. The stream's consumers are
+    standards parsers (dashboards, jq) and a NaN cost is routine
+    under --on_anomaly=skip — a bare ``NaN`` literal in the jsonl
+    would break them (obs/schema.py documents this contract). ONE
+    sanitizer for the whole obs package: this is flight.py's
+    _jsonable, shared so the two streams cannot drift."""
+    from .flight import _jsonable
+
+    return _jsonable(row)
+
+
 class MetricsLogger:
     """Append-only JSONL metrics stream, one file per process."""
 
@@ -135,7 +148,8 @@ class MetricsLogger:
         if self._f is None:
             return
         try:
-            self._f.write(json.dumps(row) + "\n")
+            self._f.write(json.dumps(_scrub_nonfinite(row),
+                                     allow_nan=False) + "\n")
         except (OSError, ValueError):
             try:
                 self._f.close()

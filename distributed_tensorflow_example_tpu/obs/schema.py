@@ -1,0 +1,163 @@
+"""Telemetry/forensics format contracts + validators.
+
+The metrics JSONL rows (obs/metrics.py) and flight dumps
+(obs/flight.py) are consumed by tooling that is NOT in this repo
+(dashboards, the bench driver, post-mortem scripts). A silently
+renamed field breaks those consumers long after the commit that did
+it. This module is the single written-down contract — field names and
+types for every row kind — plus validators that bench.py runs on its
+own capture and tier-1 tests pin, so format drift fails loudly at the
+commit that causes it.
+
+Validators return a list of error strings (empty = valid) rather than
+raising: callers decide whether drift is fatal (tests) or a logged
+warning (bench).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+_NUM = (int, float)
+
+
+# field -> allowed types; a tuple including type(None) marks nullable
+METRICS_COMMON = {
+    "kind": (str,),
+    "t": _NUM,
+    "proc": (int,),
+}
+
+# kind == "window": the per---log_every training telemetry row. Both
+# the host and fast paths emit every field below (metrics_row +
+# log_window in train/loop.py + obs/metrics.py).
+METRICS_WINDOW = {
+    "step": (int,),
+    "epoch": (int,),
+    "cost": _NUM + (str,),  # non-finite costs stringify (strict JSON)
+    "path": (str,),
+    "steps": (int,),
+    "window_wall_s": _NUM,
+    "step_time_p50_ms": _NUM,
+    "step_time_p95_ms": _NUM,
+    "step_time_max_ms": _NUM,
+    "data_wait_s": _NUM,
+    "dispatch_s": _NUM,
+    "device_wait_s": _NUM,
+    "host_s": _NUM,
+    "examples_per_sec": _NUM + (type(None),),
+    "tokens_per_sec": _NUM + (type(None),),
+    "model_flops_per_step": _NUM,
+    "tflops_per_sec": _NUM + (type(None),),
+    "mfu": _NUM + (type(None),),
+    "rss_bytes": (int, type(None)),
+    "device_memory": (dict, type(None)),
+}
+
+# kind == "event": point events; free-form payload beyond these.
+METRICS_EVENT = {
+    "event": (str,),
+}
+
+FLIGHT_DUMP = {
+    "version": (int,),
+    "proc": (int,),
+    "reason": (str,),
+    "t": _NUM,
+    "last_step": (int, type(None)),
+    "steps": (list,),
+    "windows": (list,),
+    "anomalies": (list,),
+    "env": (dict,),
+}
+
+FLIGHT_STEP_RECORD = {
+    "step": (int,),
+    "t": _NUM,
+}
+
+FLIGHT_ANOMALY_RECORD = {
+    "step": (int,),
+    "t": _NUM,
+    "reasons": (list,),
+    "policy": (str,),
+}
+
+
+def _check(doc: Dict[str, Any], spec: Dict[str, tuple],
+           where: str) -> List[str]:
+    errs = []
+    if not isinstance(doc, dict):
+        return [f"{where}: not an object"]
+    for field, types in spec.items():
+        if field not in doc:
+            errs.append(f"{where}: missing field {field!r}")
+        elif not isinstance(doc[field], tuple(types)):
+            # bool is an int subclass: reject bool where int expected
+            errs.append(f"{where}: field {field!r} has type "
+                        f"{type(doc[field]).__name__}, expected "
+                        f"{'/'.join(t.__name__ for t in types)}")
+        elif isinstance(doc[field], bool) and bool not in types:
+            errs.append(f"{where}: field {field!r} is bool, expected "
+                        f"{'/'.join(t.__name__ for t in types)}")
+    return errs
+
+
+def validate_metrics_row(row: Dict[str, Any], where: str = "row") -> List[str]:
+    """Validate one metrics JSONL row (window or event)."""
+    errs = _check(row, METRICS_COMMON, where)
+    kind = row.get("kind") if isinstance(row, dict) else None
+    if kind == "window":
+        errs += _check(row, METRICS_WINDOW, where)
+    elif kind == "event":
+        errs += _check(row, METRICS_EVENT, where)
+    elif kind is not None:
+        errs.append(f"{where}: unknown kind {kind!r}")
+    return errs
+
+
+def validate_metrics_file(path: str) -> List[str]:
+    """Validate every line of a metrics.<proc>.jsonl file."""
+    errs: List[str] = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError as e:
+                errs.append(f"line {i}: not JSON ({e})")
+                continue
+            errs += validate_metrics_row(row, where=f"line {i}")
+    return errs
+
+
+def validate_flight_dump(doc: Dict[str, Any],
+                         where: str = "dump") -> List[str]:
+    """Validate a flight/<proc>.json document, including every step
+    and anomaly record inside it."""
+    errs = _check(doc, FLIGHT_DUMP, where)
+    if isinstance(doc, dict):
+        for i, rec in enumerate(doc.get("steps") or []):
+            errs += _check(rec, FLIGHT_STEP_RECORD, f"{where}.steps[{i}]")
+        for i, rec in enumerate(doc.get("windows") or []):
+            errs += _check(rec, FLIGHT_STEP_RECORD,
+                           f"{where}.windows[{i}]")
+        for i, rec in enumerate(doc.get("anomalies") or []):
+            errs += _check(rec, FLIGHT_ANOMALY_RECORD,
+                           f"{where}.anomalies[{i}]")
+        exc = doc.get("exception")
+        if exc is not None and not isinstance(exc, dict):
+            errs.append(f"{where}: exception must be an object")
+    return errs
+
+
+def validate_flight_file(path: str) -> List[str]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable ({e})"]
+    return validate_flight_dump(doc, where=path)

@@ -1,0 +1,208 @@
+"""Windowed profiler capture (`--profile_steps`) + trace scopes.
+
+The whole-run ``--profile`` trace has two production problems: it
+skews the numbers it reports (the profiler's own overhead rides every
+step) and a multi-hour run produces a trace too large to open. The
+MegaScale-style answer (arXiv:2402.15627) is *programmatic windowed
+capture*: start the trace right before an exact step, stop it a fixed
+number of steps later, and annotate the phases inside so the timeline
+lines up with the metrics split (obs/metrics.py buckets).
+
+``WindowedTracer`` owns the whole lifecycle:
+
+- ``--profile_steps START:COUNT`` (``parse_profile_steps``) captures
+  exactly the steps ``[START, START+COUNT)`` on the host path; the
+  fast path traces at its program granularity (``on_range``) — the
+  epochs/run overlapping the window;
+- the legacy whole-run ``--profile`` mode rides the same object
+  (``begin_run``), which is what makes it exception-safe: the loop's
+  ``finally`` calls ``stop()``, so a mid-run crash always terminates
+  the trace instead of leaving a corrupt/unterminated capture;
+- ``step_annotation``/``annotate`` wrap ``jax.profiler``'s
+  ``StepTraceAnnotation``/``TraceAnnotation`` with the SAME scope
+  names as the metrics buckets (``data_wait``, ``dispatch``,
+  ``device_wait``, ``eval``, ``checkpoint``) and collapse to
+  ``nullcontext`` when tracing is off — zero steady-state cost;
+- ``--profile_port`` starts the on-demand profiler server
+  (``jax.profiler.start_server``) so TensorBoard/perfetto can attach
+  to a live run without any flag planned in advance.
+
+The profiler module is injected (``profiler=``, default
+``jax.profiler``) so the windowing contract — start/stop called
+exactly once per window, annotations nest — is testable without a
+real trace backend (tests/test_forensics.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional, Tuple
+
+
+def parse_profile_steps(s: str) -> Optional[Tuple[int, int]]:
+    """``"START:COUNT"`` -> ``(start, count)``; ``""``/None -> None.
+    start is the 0-based global step index of the first traced step."""
+    if not s:
+        return None
+    parts = str(s).split(":")
+    if len(parts) != 2:
+        raise ValueError(
+            f"profile_steps={s!r}: expected 'START:COUNT' (e.g. '500:20')")
+    try:
+        start, count = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"profile_steps={s!r}: START and COUNT must be integers")
+    if start < 0:
+        raise ValueError(f"profile_steps={s!r}: START must be >= 0")
+    if count < 1:
+        raise ValueError(f"profile_steps={s!r}: COUNT must be >= 1")
+    return start, count
+
+
+class WindowedTracer:
+    """Programmatic jax.profiler capture around exact steps.
+
+    One instance per process; ``enabled=False`` (non-chief, or no
+    profiling flag) makes every method a no-op returning
+    ``nullcontext`` — the off-path costs one attribute check.
+    """
+
+    def __init__(self, logs_path: str, window: Optional[Tuple[int, int]] = None,
+                 whole_run: bool = False, enabled: bool = True,
+                 profiler=None):
+        self.trace_dir = os.path.join(logs_path, "profile")
+        self.window = window
+        self.whole_run = bool(whole_run) and window is None
+        self.enabled = bool(enabled) and (window is not None or whole_run)
+        self._profiler = profiler
+        self._active = False
+        self._finished = False
+        self._server = None
+        self.windows_captured = 0
+
+    def _prof(self):
+        if self._profiler is None:
+            import jax.profiler
+
+            self._profiler = jax.profiler
+        return self._profiler
+
+    # -- capture lifecycle ------------------------------------------------
+
+    def _start(self) -> None:
+        try:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            self._prof().start_trace(self.trace_dir)
+            self._active = True
+        except Exception as e:  # tracing must never take down training
+            print(f"NOTE: profiler start_trace failed: {e}")
+            self.enabled = False
+
+    def _stop(self) -> None:
+        try:
+            self._prof().stop_trace()
+            self.windows_captured += 1
+        except Exception as e:
+            print(f"NOTE: profiler stop_trace failed: {e}")
+        self._active = False
+
+    def begin_run(self) -> None:
+        """Whole-run (--profile) mode: start now. Windowed mode waits
+        for its step."""
+        if self.enabled and self.whole_run and not self._active:
+            self._start()
+
+    def boundary(self, step: int) -> bool:
+        """True when ``on_step(step)`` will open or close the window.
+        A host loop running an async dispatch queue MUST drain it
+        before crossing a boundary (block on the newest in-flight
+        result): the host runs up to the queue depth ahead of the
+        device, so an unaligned start/stop would capture the device
+        execution of EARLIER steps, not the requested window. Two
+        syncs per run, only at the window edges — zero cost
+        otherwise."""
+        if (not self.enabled or self._finished or self.whole_run
+                or self.window is None):
+            return False
+        start, count = self.window
+        if self._active:
+            return step >= start + count
+        return start <= step < start + count
+
+    def on_step(self, step: int) -> None:
+        """Host-path hook, called once per step (0-based global id of
+        the step ABOUT to run): opens the window at START, closes it
+        before step START+COUNT dispatches — exactly COUNT steps."""
+        if not self.enabled or self._finished or self.whole_run:
+            return
+        start, count = self.window
+        if self._active:
+            if step >= start + count:
+                self._stop()
+                self._finished = True
+        elif start <= step < start + count:
+            self._start()
+
+    def on_range(self, lo: int, hi: int) -> None:
+        """Fast-path hook: the program about to dispatch covers steps
+        ``[lo, hi)``. The scan paths compile whole epochs/runs into one
+        executable, so capture is at that granularity: start when the
+        program overlaps the window, stop once past it."""
+        if not self.enabled or self._finished or self.whole_run:
+            return
+        start, count = self.window
+        end = start + count
+        if self._active:
+            if lo >= end:
+                self._stop()
+                self._finished = True
+        elif lo < end and hi > start:
+            self._start()
+
+    def stop(self) -> None:
+        """Final stop: idempotent and exception-safe — the loop's
+        ``finally`` calls this so a crash can never leave an
+        unterminated trace behind."""
+        if self._active:
+            self._stop()
+        self._finished = True
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    # -- annotations ------------------------------------------------------
+
+    def step_annotation(self, step: int):
+        """``StepTraceAnnotation`` scope for one train step (the unit
+        TensorBoard's trace viewer groups by). Only while a capture is
+        OPEN — a 50k-step run with a 20-step window must not pay the
+        TraceMe construct/enter/exit on the other 49 980 steps."""
+        if not self._active:
+            return contextlib.nullcontext()
+        return self._prof().StepTraceAnnotation("train", step_num=step)
+
+    def annotate(self, name: str):
+        """Named ``TraceAnnotation`` scope; names match the metrics
+        buckets (data_wait / dispatch / device_wait / eval /
+        checkpoint) so the trace timeline and the JSONL split agree.
+        nullcontext whenever no capture is open (see step_annotation)."""
+        if not self._active:
+            return contextlib.nullcontext()
+        return self._prof().TraceAnnotation(name)
+
+    # -- on-demand server -------------------------------------------------
+
+    def start_server(self, port: int):
+        """``--profile_port``: profiler server for on-demand capture
+        (TensorBoard 'Capture profile' / `jax.profiler.trace` attach).
+        Independent of windowed/whole-run capture."""
+        if not port:
+            return None
+        try:
+            self._server = self._prof().start_server(int(port))
+        except Exception as e:
+            print(f"NOTE: profiler server on port {port} failed: {e}")
+        return self._server

@@ -239,14 +239,14 @@ def _pspec_axes(sp) -> tuple:
     return tuple(sorted(set(axes)))
 
 
-def _leaf_norms(tree, param_pspecs):
-    """Per-leaf global L2 norms as one [n_leaves] f32 vector, exact
-    under parameter sharding (each leaf's square-sum is psum'd over
-    the axes its PartitionSpec mentions, as _clip_sharded does). The
-    telemetry source for the --histograms grad/param-norm summaries —
-    a handful of scalars per step, so keeping the latest device value
-    and fetching it once per logging window adds no per-step host
-    traffic."""
+def _leaf_reduce(tree, param_pspecs, leaf_fn):
+    """Shared per-leaf global-reduction scaffolding for the telemetry
+    vectors: ``leaf_fn`` maps each leaf to a scalar local partial,
+    which is psum'd over exactly the mesh axes the leaf's
+    PartitionSpec mentions (its shards partition the full leaf, so
+    the result is the GLOBAL value on every shard, as _clip_sharded
+    computes). Returns the list of per-leaf scalars in tree_leaves
+    order."""
     leaves = jax.tree_util.tree_leaves(tree)
     if param_pspecs is None:
         spec_leaves = [None] * len(leaves)
@@ -255,12 +255,34 @@ def _leaf_norms(tree, param_pspecs):
             param_pspecs, is_leaf=lambda x: isinstance(x, P))
     out = []
     for g, sp in zip(leaves, spec_leaves):
-        sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        v = leaf_fn(g)
         axes = _pspec_axes(sp)
         if axes:
-            sq = jax.lax.psum(sq, axes)
-        out.append(jnp.sqrt(sq))
-    return jnp.stack(out)
+            v = jax.lax.psum(v, axes)
+        out.append(v)
+    return out
+
+
+def _leaf_norms(tree, param_pspecs):
+    """Per-leaf global L2 norms as one [n_leaves] f32 vector, exact
+    under parameter sharding (_leaf_reduce). The telemetry source for
+    the --histograms grad/param-norm summaries — a handful of scalars
+    per step, so keeping the latest device value and fetching it once
+    per logging window adds no per-step host traffic."""
+    sq = _leaf_reduce(tree, param_pspecs,
+                      lambda g: jnp.sum(jnp.square(g.astype(jnp.float32))))
+    return jnp.sqrt(jnp.stack(sq))
+
+
+def _leaf_nonfinite(tree, param_pspecs):
+    """Per-leaf GLOBAL non-finite element counts as one [n_leaves] i32
+    vector — the --on_anomaly blame signal, sharding-exact via the
+    same _leaf_reduce scaffolding as the norms. A couple of
+    reductions per leaf — noise next to the matmuls."""
+    return jnp.stack(_leaf_reduce(
+        tree, param_pspecs,
+        lambda g: jnp.sum(~jnp.isfinite(g.astype(jnp.float32)))
+        .astype(jnp.int32)))
 
 
 def _clip_sharded(grads, param_pspecs, max_norm: float):
@@ -319,7 +341,8 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
                         batch_axes: tuple = (DATA_AXIS,),
                         param_pspecs=None,
                         zero_dp: int = 0,
-                        with_norms: bool = False) -> Callable:
+                        with_norms: bool = False,
+                        with_anomaly: bool = False) -> Callable:
     """The per-shard synchronous step body (state, x, y) -> (state, cost,
     acc) — shared by the host-fed step (build_train_step) and the
     device-resident scan paths (parallel/epoch.py) so both train with
@@ -334,6 +357,18 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
     # plus the sequence axis when the token dim itself is sharded
     aux_axes = tuple(batch_axes) + ((seq_axis,) if seq_axis else ())
     dropping = getattr(spec, "dropout_rate", 0.0) > 0
+    # --on_anomaly: 'skip' masks the update on-device (a NaN batch
+    # cannot poison params even before the host notices); any mode
+    # needs the flag when the caller asks for the compiled outputs.
+    anomaly_mode = getattr(cfg, "on_anomaly", "") or ""
+    detect_anomaly = with_anomaly or anomaly_mode == "skip"
+    # every mesh axis the step runs over: the scalar flag must psum
+    # across ALL of them so every shard takes the same skip/keep
+    # branch (replicated leaves would otherwise drift apart)
+    all_axes = tuple(dict.fromkeys(
+        tuple(batch_axes)
+        + tuple(a for a in (seq_axis, expert_axis, model_axis) if a)
+        + ((pipeline[0],) if pipeline else ())))
 
     def grad_of(params, x, y, rng=None):
         def loss_fn(p):
@@ -453,6 +488,17 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
         # telemetry norms ride the step PRE-clip (the raw gradient
         # scale is the debugging signal a clip would mask)
         grad_norms = _leaf_norms(grads, param_pspecs) if with_norms else None
+        bad_counts = bad_flag = None
+        if detect_anomaly:
+            # pre-clip, like the norms: a clip of NaN stays NaN but
+            # the RAW gradient is the forensic signal. The flag folds
+            # in the local objective too (psum over every axis makes
+            # it identical on all shards).
+            bad_counts = _leaf_nonfinite(grads, param_pspecs)
+            loss_bad = (~jnp.isfinite(cost)).astype(jnp.int32)
+            if all_axes:
+                loss_bad = jax.lax.psum(loss_bad, all_axes)
+            bad_flag = jnp.any(bad_counts > 0) | (loss_bad > 0)
         if cfg.grad_clip > 0:
             if param_pspecs is not None:
                 grads = _clip_sharded(grads, param_pspecs, cfg.grad_clip)
@@ -468,14 +514,30 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
         else:
             new_params, new_opt = optimizer.update(
                 grads, state.opt_state, state.params)
+        if anomaly_mode == "skip" and bad_flag is not None:
+            # masked update: a flagged step keeps the old params/opt
+            # (step still advances — it counts steps ATTEMPTED; the
+            # host's skipped-step accounting rides the flag/the
+            # non-finite cost). bad_flag is globally consistent, so
+            # every shard keeps or applies together.
+            keep_old = bad_flag
+            new_params = jax.tree.map(
+                lambda n, o: jnp.where(keep_old, o, n),
+                new_params, state.params)
+            new_opt = jax.tree.map(
+                lambda n, o: jnp.where(keep_old, o, n),
+                new_opt, state.opt_state)
         cost = jax.lax.pmean(cost, batch_axes)
         acc = jax.lax.pmean(acc, batch_axes)
         new_state = TrainState(state.step + 1, new_params, new_opt)
+        extras = ()
         if with_norms:
-            return new_state, cost, acc, {
-                "grad": grad_norms,
-                "param": _leaf_norms(new_params, param_pspecs),
-            }
+            extras += ({"grad": grad_norms,
+                        "param": _leaf_norms(new_params, param_pspecs)},)
+        if with_anomaly:
+            extras += ({"flag": bad_flag, "counts": bad_counts},)
+        if extras:
+            return (new_state, cost, acc) + extras
         return new_state, cost, acc
 
     return body
@@ -574,18 +636,28 @@ def _pipeline_info(mesh, cfg, spec, optimizer=None):
 
 
 def build_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer,
-                     with_norms: bool = False) -> Callable:
+                     with_norms: bool = False,
+                     with_anomaly: bool = False) -> Callable:
     """Synchronous SPMD step: (state, x, y) -> (state, cost, acc).
 
     The returned callable is jit'd with the state donated — params never
     leave the devices (the inverse of the reference's per-step parameter
     round-trip, SURVEY.md §3.3).
 
-    ``with_norms=True`` (the --histograms telemetry) appends a fourth
+    ``with_norms=True`` (the --histograms telemetry) appends an
     output: {'grad': [n_leaves], 'param': [n_leaves]} per-leaf global
     L2 norms, computed inside the same compiled step (exact under
     parameter sharding) — the host keeps the latest device value and
     fetches it once per logging window.
+
+    ``with_anomaly=True`` (--on_anomaly forensics) appends a LAST
+    output {'flag': bool, 'counts': [n_leaves] i32}: one globally
+    consistent "non-finite loss or gradient this step" bit plus the
+    per-leaf non-finite element counts (the blame vector) — fetched
+    lazily by the host (obs/anomaly.py), never a per-step sync. When
+    ``cfg.on_anomaly == 'skip'`` the compiled update is additionally
+    masked on the flag (here AND in the scan paths, which share this
+    body), so a poisoned batch leaves params untouched.
     """
     mp = mesh.shape.get(MODEL_AXIS, 1)
     seq_axis = mesh_lib.axis_if_present(mesh, mesh_lib.SEQ_AXIS)
@@ -612,10 +684,13 @@ def build_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer,
                                      model_axis, batch_axes,
                                      param_pspecs=sspecs.params,
                                      zero_dp=zero_dp,
-                                     with_norms=with_norms)
+                                     with_norms=with_norms,
+                                     with_anomaly=with_anomaly)
     out_specs = (sspecs, P(), P())
     if with_norms:
         out_specs = out_specs + ({"grad": P(), "param": P()},)
+    if with_anomaly:
+        out_specs = out_specs + ({"flag": P(), "counts": P()},)
     fn = jax.shard_map(
         shard_step,
         mesh=mesh,

@@ -294,6 +294,34 @@ def run(cfg: Config) -> Dict[str, Any]:
             raise ValueError("--histograms writes histogram summaries "
                              "into the event file; do not combine "
                              "with --no_summaries")
+    from ..obs import tracer as tracer_lib
+
+    # raises ValueError on a malformed START:COUNT
+    profile_window = tracer_lib.parse_profile_steps(cfg.profile_steps)
+    if profile_window is not None and cfg.profile:
+        raise ValueError("--profile_steps replaces the whole-run "
+                         "--profile trace; drop one of the two")
+    if cfg.profile_port < 0:
+        raise ValueError(f"profile_port={cfg.profile_port} must be >= 0")
+    from ..obs.anomaly import POLICIES
+
+    if cfg.on_anomaly not in POLICIES:
+        raise ValueError(
+            f"on_anomaly={cfg.on_anomaly!r}: expected one of "
+            f"{[p for p in POLICIES if p]}")
+    if cfg.on_anomaly and cfg.debug_nans:
+        raise ValueError("--debug_nans is superseded by --on_anomaly "
+                         "(jax_debug_nans crashes with no forensics "
+                         "context); drop one of the two")
+    if cfg.on_anomaly == "skip" and (cfg.fsdp or cfg.sync_period > 1):
+        raise ValueError("--on_anomaly=skip rides the synchronous "
+                         "step's compiled update mask (no --fsdp, "
+                         "sync_period=1); halt/dump work on any path")
+    if cfg.on_anomaly and cfg.anomaly_factor <= 1.0:
+        raise ValueError(
+            f"anomaly_factor={cfg.anomaly_factor} must be > 1")
+    if cfg.flight_steps < 1:
+        raise ValueError(f"flight_steps={cfg.flight_steps} must be >= 1")
     if cfg.early_stop_patience < 0:
         raise ValueError(
             f"early_stop_patience={cfg.early_stop_patience} must be >= 0")
@@ -461,825 +489,1039 @@ def run(cfg: Config) -> Dict[str, Any]:
                     **hb_lib.straggler_report(cfg.logs_path,
                                               since=telemetry_start))
 
-    pp_mode = cfg.pipeline_parallel > 1
-    if pp_mode:
-        # the pipeline schedule sees one grad-accum chunk at a time;
-        # batch_shards counts EVERY batch-sharding axis (dp, plus
-        # 'expert' under sparse-dispatch PP x EP)
-        per_shard = global_batch // batch_shards
-        if per_shard % cfg.grad_accum:
-            raise ValueError(
-                f"per-shard batch {per_shard} must divide into "
-                f"grad_accum={cfg.grad_accum}")
-        if (per_shard // cfg.grad_accum) % cfg.microbatches:
-            raise ValueError(
-                f"per-shard batch {per_shard // cfg.grad_accum} (after "
-                f"grad_accum={cfg.grad_accum}) must divide into "
-                f"microbatches={cfg.microbatches}")
-    async_mode = cfg.sync_period > 1
-    fsdp_mode = cfg.fsdp
-    fast = (
-        cfg.fast_loop and proc_cnt == 1
-        and (cfg.shard_data or dp == 1)
-        # --histograms needs the host loop's per-window norm fetch
-        # (the scan runners return only cost/acc arrays)
-        and not cfg.histograms
-        # sequence-parallel steps shard x over ('data','seq'), which the
-        # scan runners' P('data') dataset layout doesn't express yet;
-        # expert-parallel state pspecs likewise; the ZeRO-1 flat slot
-        # layout is a host-path feature
-        and cfg.sequence_parallel == 1 and cfg.expert_parallel == 1
-        and cfg.pipeline_parallel == 1 and not cfg.zero_opt
-        # async fast path runs the whole program on-device; periodic
-        # host-side checkpoints and early stopping need the host loop
-        and not (async_mode and (cfg.checkpoint_every or cfg.model_parallel > 1
-                                 or cfg.early_stop_patience))
-    )
+    # Failure forensics (obs/, the second half of the observability
+    # subsystem): windowed profiler capture, the --on_anomaly policy
+    # and the crash flight recorder. Everything below runs inside one
+    # try/except/finally so a mid-run failure always (1) terminates an
+    # open profiler trace and (2) leaves a flight dump behind.
+    from ..obs import anomaly as anomaly_lib
+    from ..obs import flight as flight_lib
 
-    # init_op equivalent (example.py:129, 74): identical seeded init on
-    # every process — deterministic, no chief broadcast needed.
-    state = create_train_state(jax.random.PRNGKey(cfg.seed), spec, optimizer)
+    tracer = tracer_lib.WindowedTracer(
+        cfg.logs_path, window=profile_window, whole_run=cfg.profile,
+        enabled=chief)
+    if cfg.profile_port and chief:
+        tracer.start_server(cfg.profile_port)
+    flight = None
+    if cfg.flight or cfg.on_anomaly:
+        import dataclasses as dc_lib
 
-    full_template = None
-    if fsdp_mode:
-        from ..parallel import fsdp as fsdp_lib
+        flight = flight_lib.FlightRecorder(
+            cfg.logs_path, process_index=proc_idx,
+            capacity=cfg.flight_steps, config=dc_lib.asdict(cfg))
+        flight.install()
+    policy = None
+    if cfg.on_anomaly:
+        policy = anomaly_lib.AnomalyPolicy(
+            cfg.on_anomaly, flight=flight, mlogger=mlogger,
+            watchdog=anomaly_lib.LossWatchdog(factor=cfg.anomaly_factor))
+    # --- forensics guard: the body below is try-wrapped ---
+    try:
 
-        full_template = jax.tree.map(np.asarray, state)
-        # FSDP x TP: each leaf Megatron-shards over 'model' first,
-        # then flattens over 'data' (fsdp_lib module docstring)
-        mp_f = mesh.shape.get(mesh_lib.MODEL_AXIS, 1)
-        fsdp_tp_specs = (mesh_lib.state_pspecs(spec, optimizer, mp_f)
-                         if mp_f > 1 else None)
-        state = fsdp_lib.shard_state_host(full_template, dp, mp_f,
-                                          fsdp_tp_specs)
-        train_step = (
-            None if fast
-            else fsdp_lib.build_fsdp_train_step(
-                cfg, mesh, spec, optimizer, full_template
-            )
-        )
-        param_sync = None
-        get_params = fsdp_lib.build_gather_params(mesh, full_template,
-                                                  spec)
-        sspecs = fsdp_lib.fsdp_specs(state, mp_f)
-    elif async_mode:
-        state = step_lib.stack_state(state, dp)
-        train_step = (
-            None if fast
-            else step_lib.build_local_train_step(cfg, mesh, spec, optimizer, state)
-        )
-        param_sync = None if fast else step_lib.build_param_sync(mesh, state)
-        get_params = step_lib.build_unstack_params(mesh, state)
-        sspecs = step_lib._stacked_specs(state)
-    else:
-        train_step = (None if fast else step_lib.build_train_step(
-            cfg, mesh, spec, optimizer, with_norms=cfg.histograms))
-        param_sync = None
-        get_params = None
+        pp_mode = cfg.pipeline_parallel > 1
         if pp_mode:
-            # pipeline layout: block leaves stacked [num_blocks, ...]
-            # and sharded over 'stage' (checkpoints keep this stacked
-            # layout — with virtual_stages=1 restorable at any stage
-            # count dividing num_blocks; virtual_stages>1 permutes the
-            # stacking order, pinning the checkpoint to the same
-            # (stages, virtual) — validated on resume via the saved
-            # pp_stages/pp_virtual extras; never interchangeable with
-            # non-PP runs)
-            from ..models import transformer as tfm_lib
-
-            state = tfm_lib.pipeline_train_state(
-                spec, optimizer, state, cfg.pipeline_parallel,
-                cfg.virtual_stages)
-            sspecs = mesh_lib.pipeline_state_pspecs(
-                spec, optimizer, mesh_lib.STAGE_AXIS,
-                mesh_lib.tp_axis(spec, cfg.model_parallel),
-                mesh_lib.axis_if_present(mesh, mesh_lib.EXPERT_AXIS))
-        else:
-            sspecs = mesh_lib.state_pspecs(
-                spec, optimizer, cfg.model_parallel,
-                mesh_lib.axis_if_present(mesh, mesh_lib.EXPERT_AXIS))
-        if cfg.zero_opt:
-            # ZeRO-1 (r5): re-lay the optimizer slots as flat
-            # [.., dp, chunk] shards over 'data' — composes with the
-            # PP-stacked params above (slot memory: state/(p*dp))
-            from jax.sharding import PartitionSpec as P_
-
-            from ..parallel import zero as zero_lib
-            from .state import TrainState
-
-            z_state, z_specs = zero_lib.zero_opt_state(
-                optimizer, state.params, sspecs.params, mesh, dp)
-            state = TrainState(step=state.step, params=state.params,
-                               opt_state=z_state)
-            sspecs = TrainState(step=P_(), params=sspecs.params,
-                                opt_state=z_specs)
-    state = mesh_lib.place_state(state, mesh, sspecs)
-    print("Variables initialized ...")  # example.py:130
-
-    start_epoch = 0
-    resumed_extras: dict = {}
-    if cfg.resume and cfg.checkpoint_dir:
-        path = ckpt_lib.latest_checkpoint(cfg.checkpoint_dir)
-        if path:
-            resumed_extras = ckpt_lib.load_extras(path)
-            saved_zdp = int(resumed_extras.get("zero_dp", 0))
-            if saved_zdp != (dp if cfg.zero_opt else 0):
+            # the pipeline schedule sees one grad-accum chunk at a time;
+            # batch_shards counts EVERY batch-sharding axis (dp, plus
+            # 'expert' under sparse-dispatch PP x EP)
+            per_shard = global_batch // batch_shards
+            if per_shard % cfg.grad_accum:
                 raise ValueError(
-                    f"checkpoint {path} was written with "
-                    f"zero_dp={saved_zdp} (ZeRO-1 flat slots are "
-                    f"dp-shaped): resume needs the same --zero_opt "
-                    f"setting and data-parallel degree (this run: "
-                    f"{dp if cfg.zero_opt else 0})")
-            if pp_mode:
-                # the stacked block ORDER is (stages, virtual)-pinned
-                # once virtual > 1 (pipeline_stack_params); shapes
-                # match across layouts, so a mismatch would restore
-                # silently permuted blocks — reject it instead
-                saved = resumed_extras
-                sv = int(saved.get("pp_virtual", 1))
-                sp = int(saved.get("pp_stages", cfg.pipeline_parallel))
-                if (sv != cfg.virtual_stages
-                        or (sv > 1 and sp != cfg.pipeline_parallel)):
-                    raise ValueError(
-                        f"checkpoint {path} was written with pipeline "
-                        f"layout (stages={sp}, virtual={sv}): resuming "
-                        f"needs the same --virtual_stages (and the "
-                        f"same --pipeline_parallel when virtual > 1) — "
-                        f"the stacked block order is pinned to that "
-                        f"layout")
-            if fsdp_mode and os.path.isdir(path):
-                # sharded-FSDP checkpoint: leaves are the SAVED run's
-                # flat [.., dp_old, chunk] layout — reassemble,
-                # un-flatten at the saved model-parallel degree, and
-                # re-lay-out for this run's (dp, mp)
-                raw, _, start_epoch = ckpt_lib.restore_sharded_arrays(
-                    path)
-                mp_old = int(resumed_extras.get("fsdp_mp", 1))
-                old_specs = (mesh_lib.state_pspecs(spec, optimizer,
-                                                   mp_old)
-                             if mp_old > 1 else None)
-                raw_state = ckpt_lib.rebuild_tree(raw, state)
-                full = fsdp_lib.unshard_state_host(
-                    raw_state, full_template, mp_old, old_specs)
-                state = fsdp_lib.shard_state_host(full, dp, mp_f,
-                                                  fsdp_tp_specs)
-            elif fsdp_mode:
-                # checkpoints keep the portable unsharded layout
-                full, _, start_epoch = ckpt_lib.restore_checkpoint(
-                    path, full_template
-                )
-                state = fsdp_lib.shard_state_host(full, dp, mp_f,
-                                                  fsdp_tp_specs)
-            else:
-                state, _, start_epoch = ckpt_lib.restore_checkpoint(path, state)
-            state = mesh_lib.place_state(state, mesh, sspecs)
-            print(f"Resumed from {path} at epoch {start_epoch}")
-
-    writer = None
-    if cfg.summaries and (chief or cfg.summaries_all_hosts):
-        writer = SummaryWriter(cfg.logs_path)  # example.py:145-146
-        # the reference attaches its graph to the event log
-        # (FileWriter(logs_path, graph=..., example.py:146)); write the
-        # equivalent GraphDef record so TB's Graphs tab is populated
-        from ..utils.summary import mlp_graph_nodes, transformer_graph_nodes
-
-        if cfg.model == "transformer":
-            writer.add_graph(transformer_graph_nodes(cfg.num_blocks))
-        else:
-            writer.add_graph(mlp_graph_nodes(
-                cfg.input_size, tuple(cfg.hidden_sizes), cfg.num_classes,
-                cfg.activation, optimizer=cfg.optimizer,
-            ))
-
-    if cfg.profile and chief:
-        jax.profiler.start_trace(cfg.logs_path + "/profile")
-
-    def dump_graph(jitted, *args) -> None:
-        """--profile graph observability: the TPU-native analog of the
-        reference's TB graph write (example.py:146) — StableHLO +
-        optimized HLO text next to the profiler trace (utils.hlo).
-        Plain-int args are marshalled to int32 exactly as the epoch
-        runners' call wrappers do."""
-        if cfg.profile and chief:
-            import jax.numpy as jnp
-
-            from ..utils.hlo import dump_graph as _dump
-
-            args = tuple(
-                jnp.int32(a) if isinstance(a, int) else a for a in args
-            )
-            _dump(jitted, args, cfg.logs_path, "train_step")
-
-    # global_step parity: the reference's global_step counts every
-    # worker's update (≈3x per round under 3 async workers, SURVEY.md
-    # §3.3); in local-SGD mode each of the dp shards applies one update
-    # per round, so the printed step advances by dp per round.
-    step_scale = dp if async_mode else 1
-
-    early = cfg.early_stop_patience > 0
-    best_val = float(resumed_extras.get("best_val", -1.0))
-    val_wait = int(resumed_extras.get("val_wait", 0))
-    val_eval_step = None   # host-path evaluator, built lazily, shared
-                           # by per-epoch validation and the final eval
-
-    def host_eval_accuracy(params, images, labels) -> float:
-        nonlocal val_eval_step
-        if val_eval_step is None:
-            val_eval_step = step_lib.build_eval_step(cfg, mesh, spec)
-        unit = (batch_shards * cfg.microbatches if pp_mode
-                else batch_shards)
-        return _eval_accuracy(
-            val_eval_step, params, images, labels, batch_shards,
-            chunk=max(step_lib.eval_chunk_cap(spec, cfg.eval_batch_size),
-                      unit),
-            unit=unit,
+                    f"per-shard batch {per_shard} must divide into "
+                    f"grad_accum={cfg.grad_accum}")
+            if (per_shard // cfg.grad_accum) % cfg.microbatches:
+                raise ValueError(
+                    f"per-shard batch {per_shard // cfg.grad_accum} (after "
+                    f"grad_accum={cfg.grad_accum}) must divide into "
+                    f"microbatches={cfg.microbatches}")
+        async_mode = cfg.sync_period > 1
+        fsdp_mode = cfg.fsdp
+        fast = (
+            cfg.fast_loop and proc_cnt == 1
+            and (cfg.shard_data or dp == 1)
+            # --histograms needs the host loop's per-window norm fetch
+            # (the scan runners return only cost/acc arrays)
+            and not cfg.histograms
+            # halt means STOP the run promptly — a whole-epoch/run
+            # device program can only be judged after it completed, so
+            # halt forces the host loop (dump/skip stay post-hoc/
+            # device-side and compose with the scan paths)
+            and cfg.on_anomaly != "halt"
+            # sequence-parallel steps shard x over ('data','seq'), which the
+            # scan runners' P('data') dataset layout doesn't express yet;
+            # expert-parallel state pspecs likewise; the ZeRO-1 flat slot
+            # layout is a host-path feature
+            and cfg.sequence_parallel == 1 and cfg.expert_parallel == 1
+            and cfg.pipeline_parallel == 1 and not cfg.zero_opt
+            # async fast path runs the whole program on-device; periodic
+            # host-side checkpoints and early stopping need the host loop
+            and not (async_mode and (cfg.checkpoint_every or cfg.model_parallel > 1
+                                     or cfg.early_stop_patience))
         )
 
-    def note_validation(val_acc: float) -> bool:
-        """Track the per-epoch validation accuracy; True = stop now.
-        The accuracy is computed collectively (SPMD eval), so every
-        process takes the same decision."""
-        nonlocal best_val, val_wait
-        if chief or cfg.eval_all_hosts:
-            print("Validation-Accuracy: %2.2f" % val_acc)
-        if val_acc > best_val + 1e-12:
-            best_val, val_wait = val_acc, 0
-            return False
-        val_wait += 1
-        return val_wait >= cfg.early_stop_patience
+        # init_op equivalent (example.py:129, 74): identical seeded init on
+        # every process — deterministic, no chief broadcast needed.
+        state = create_train_state(jax.random.PRNGKey(cfg.seed), spec, optimizer)
 
-    # Fast path: stage the dataset into HBM now — this is the data-load
-    # phase, which the reference also performs before starting its timer
-    # (example.py:48 precedes begin_time at :136). Upload happens once;
-    # compile, training, and eval stay inside the timed window.
-    if fast:
-        img_d, lbl_d, batch_count = epoch_lib.shard_dataset(
-            mesh, dataset.train.images, dataset.train.labels, global_batch
-        )
-        fast_eval = epoch_lib.build_fast_eval(
-            cfg, mesh, spec, dataset.test.images, dataset.test.labels
-        )
-        # wait for every staged transfer with a fetch-backed barrier:
-        # device_put is async and block_until_ready can return early on
-        # this backend (utils.sync), which would leak the upload into
-        # the timed window below
-        fast_val = None
-        if early:
-            fast_val = epoch_lib.build_fast_eval(
-                cfg, mesh, spec, dataset.validation.images,
-                dataset.validation.labels)
-        from ..utils.sync import hard_sync
-
-        hard_sync((img_d, lbl_d, fast_eval.staged)
-                  + ((fast_val.staged,) if fast_val else ()))
-
-    epochs_done = start_epoch
-    begin_time = time.time()       # example.py:136
-    frequency = cfg.frequency      # example.py:137
-    cost = float("nan")
-    examples_seen = 0
-
-    def _ckpt_extras() -> dict:
-        extras = dict({"best_val": best_val, "val_wait": val_wait}
-                      if early else {})
-        if pp_mode:
-            # pin the stacked block order's layout (see the resume
-            # validation above)
-            extras.update(pp_stages=cfg.pipeline_parallel,
-                          pp_virtual=cfg.virtual_stages)
-        if cfg.zero_opt:
-            # flat slot chunking is dp-shaped; resume validates it
-            extras.update(zero_dp=dp)
-        if fsdp_mode and cfg.sharded_checkpoints:
-            # a sharded-FSDP checkpoint stores the flat [.., dp, chunk]
-            # layout; resume needs the model-parallel degree it was
-            # written at to un-flatten (dp itself is leaf-shape-evident)
-            extras.update(fsdp_mp=mp_f)
-        return extras
-
-    def save_state(step: int, resume_epoch: int) -> None:
-        """Write a checkpoint. Sharded mode: every process writes only
-        its addressable shards, the chief adds the manifest — no
-        cross-process gather anywhere, O(state/processes) host memory.
-        Portable single-file mode: in multi-process runs state leaves
-        may span non-addressable devices; every process joins the
-        allgather, only the chief writes."""
-        if cfg.sharded_checkpoints:
-            # FSDP saves its flat sharded layout AS IS (no host
-            # unshard): restore reassembles + re-lays-out. Pruning
-            # rides the completion callback so an async in-flight
-            # (still invisible) checkpoint is never miscounted.
-            prune = (
-                (lambda: ckpt_lib.prune_checkpoints(
-                    cfg.checkpoint_dir, cfg.keep_checkpoints))
-                if chief and cfg.keep_checkpoints else None)
-            ckpt_lib.save_checkpoint_sharded(
-                cfg.checkpoint_dir, state, step, resume_epoch,
-                _ckpt_extras() or None, async_=cfg.async_checkpoints,
-                on_complete=prune)
-            return
-        to_save = state
-        if proc_cnt > 1:
-            from jax.experimental import multihost_utils
-
-            to_save = multihost_utils.process_allgather(state, tiled=True)
+        full_template = None
         if fsdp_mode:
             from ..parallel import fsdp as fsdp_lib
 
-            to_save = fsdp_lib.unshard_state_host(to_save, full_template,
-                                                  mp_f, fsdp_tp_specs)
-        if chief:
-            ckpt_lib.save_checkpoint(cfg.checkpoint_dir, to_save, step,
-                                     resume_epoch, _ckpt_extras() or None)
-            if cfg.keep_checkpoints:
-                ckpt_lib.prune_checkpoints(cfg.checkpoint_dir,
-                                           cfg.keep_checkpoints)
+            full_template = jax.tree.map(np.asarray, state)
+            # FSDP x TP: each leaf Megatron-shards over 'model' first,
+            # then flattens over 'data' (fsdp_lib module docstring)
+            mp_f = mesh.shape.get(mesh_lib.MODEL_AXIS, 1)
+            fsdp_tp_specs = (mesh_lib.state_pspecs(spec, optimizer, mp_f)
+                             if mp_f > 1 else None)
+            state = fsdp_lib.shard_state_host(full_template, dp, mp_f,
+                                              fsdp_tp_specs)
+            train_step = (
+                None if fast
+                else fsdp_lib.build_fsdp_train_step(
+                    cfg, mesh, spec, optimizer, full_template
+                )
+            )
+            param_sync = None
+            get_params = fsdp_lib.build_gather_params(mesh, full_template,
+                                                      spec)
+            sspecs = fsdp_lib.fsdp_specs(state, mp_f)
+        elif async_mode:
+            state = step_lib.stack_state(state, dp)
+            train_step = (
+                None if fast
+                else step_lib.build_local_train_step(cfg, mesh, spec, optimizer, state)
+            )
+            param_sync = None if fast else step_lib.build_param_sync(mesh, state)
+            get_params = step_lib.build_unstack_params(mesh, state)
+            sspecs = step_lib._stacked_specs(state)
+        else:
+            train_step = (None if fast else step_lib.build_train_step(
+                cfg, mesh, spec, optimizer, with_norms=cfg.histograms,
+                with_anomaly=bool(cfg.on_anomaly)))
+            param_sync = None
+            get_params = None
+            if pp_mode:
+                # pipeline layout: block leaves stacked [num_blocks, ...]
+                # and sharded over 'stage' (checkpoints keep this stacked
+                # layout — with virtual_stages=1 restorable at any stage
+                # count dividing num_blocks; virtual_stages>1 permutes the
+                # stacking order, pinning the checkpoint to the same
+                # (stages, virtual) — validated on resume via the saved
+                # pp_stages/pp_virtual extras; never interchangeable with
+                # non-PP runs)
+                from ..models import transformer as tfm_lib
 
-    ckpt_enabled = bool(cfg.checkpoint_dir and cfg.checkpoint_every)
-    last_ckpt_step = 0
+                state = tfm_lib.pipeline_train_state(
+                    spec, optimizer, state, cfg.pipeline_parallel,
+                    cfg.virtual_stages)
+                sspecs = mesh_lib.pipeline_state_pspecs(
+                    spec, optimizer, mesh_lib.STAGE_AXIS,
+                    mesh_lib.tp_axis(spec, cfg.model_parallel),
+                    mesh_lib.axis_if_present(mesh, mesh_lib.EXPERT_AXIS))
+            else:
+                sspecs = mesh_lib.state_pspecs(
+                    spec, optimizer, cfg.model_parallel,
+                    mesh_lib.axis_if_present(mesh, mesh_lib.EXPERT_AXIS))
+            if cfg.zero_opt:
+                # ZeRO-1 (r5): re-lay the optimizer slots as flat
+                # [.., dp, chunk] shards over 'data' — composes with the
+                # PP-stacked params above (slot memory: state/(p*dp))
+                from jax.sharding import PartitionSpec as P_
 
-    def maybe_checkpoint(resume_epoch: int) -> None:
-        """Save when a checkpoint_every boundary has been crossed since
-        the last save. ``resume_epoch`` is the epoch --resume should
-        restart from (the epoch after a completed one; the current epoch
-        for a mid-epoch save, which re-runs its partial work)."""
-        nonlocal last_ckpt_step
-        if not ckpt_enabled:
-            return
-        step = int(state.step)
-        if step // cfg.checkpoint_every > last_ckpt_step // cfg.checkpoint_every:
-            save_state(step, resume_epoch)
-            last_ckpt_step = step
+                from ..parallel import zero as zero_lib
+                from .state import TrainState
 
-    eval_pending = None  # host scalar: eval count fetched with the metrics
-    if fast:
-        shuffle_key = jax.random.PRNGKey(cfg.seed + 0x5EED)
+                z_state, z_specs = zero_lib.zero_opt_state(
+                    optimizer, state.params, sspecs.params, mesh, dp)
+                state = TrainState(step=state.step, params=state.params,
+                                   opt_state=z_state)
+                sspecs = TrainState(step=P_(), params=sspecs.params,
+                                    opt_state=z_specs)
+        if policy is not None:
+            # per-leaf blame names, in the SAME order _leaf_nonfinite
+            # walks the grads tree (= the final params layout: pipeline
+            # stacking above already happened)
+            from jax.tree_util import keystr, tree_flatten_with_path
 
-        def emit_epoch(epoch: int, costs: np.ndarray, accs: np.ndarray,
-                       avg_step_s: float,
-                       metrics_step_s: float | None = None) -> float:
-            nonlocal examples_seen
-            examples_seen += batch_count * global_batch
-            if writer is not None:
-                base_step = epoch * batch_count
-                for i in range(batch_count):
-                    writer.add_scalars(
-                        (base_step + i + 1) * step_scale,
-                        {"cost": float(costs[i]), "accuracy": float(accs[i])},
+            policy.leaf_names = [
+                keystr(kp)
+                for kp, _ in tree_flatten_with_path(state.params)[0]]
+        state = mesh_lib.place_state(state, mesh, sspecs)
+        print("Variables initialized ...")  # example.py:130
+
+        start_epoch = 0
+        resumed_extras: dict = {}
+        if cfg.resume and cfg.checkpoint_dir:
+            path = ckpt_lib.latest_checkpoint(cfg.checkpoint_dir)
+            if path:
+                resumed_extras = ckpt_lib.load_extras(path)
+                saved_zdp = int(resumed_extras.get("zero_dp", 0))
+                if saved_zdp != (dp if cfg.zero_opt else 0):
+                    raise ValueError(
+                        f"checkpoint {path} was written with "
+                        f"zero_dp={saved_zdp} (ZeRO-1 flat slots are "
+                        f"dp-shaped): resume needs the same --zero_opt "
+                        f"setting and data-parallel degree (this run: "
+                        f"{dp if cfg.zero_opt else 0})")
+                if pp_mode:
+                    # the stacked block ORDER is (stages, virtual)-pinned
+                    # once virtual > 1 (pipeline_stack_params); shapes
+                    # match across layouts, so a mismatch would restore
+                    # silently permuted blocks — reject it instead
+                    saved = resumed_extras
+                    sv = int(saved.get("pp_virtual", 1))
+                    sp = int(saved.get("pp_stages", cfg.pipeline_parallel))
+                    if (sv != cfg.virtual_stages
+                            or (sv > 1 and sp != cfg.pipeline_parallel)):
+                        raise ValueError(
+                            f"checkpoint {path} was written with pipeline "
+                            f"layout (stages={sp}, virtual={sv}): resuming "
+                            f"needs the same --virtual_stages (and the "
+                            f"same --pipeline_parallel when virtual > 1) — "
+                            f"the stacked block order is pinned to that "
+                            f"layout")
+                if fsdp_mode and os.path.isdir(path):
+                    # sharded-FSDP checkpoint: leaves are the SAVED run's
+                    # flat [.., dp_old, chunk] layout — reassemble,
+                    # un-flatten at the saved model-parallel degree, and
+                    # re-lay-out for this run's (dp, mp)
+                    raw, _, start_epoch = ckpt_lib.restore_sharded_arrays(
+                        path)
+                    mp_old = int(resumed_extras.get("fsdp_mp", 1))
+                    old_specs = (mesh_lib.state_pspecs(spec, optimizer,
+                                                       mp_old)
+                                 if mp_old > 1 else None)
+                    raw_state = ckpt_lib.rebuild_tree(raw, state)
+                    full = fsdp_lib.unshard_state_host(
+                        raw_state, full_template, mp_old, old_specs)
+                    state = fsdp_lib.shard_state_host(full, dp, mp_f,
+                                                      fsdp_tp_specs)
+                elif fsdp_mode:
+                    # checkpoints keep the portable unsharded layout
+                    full, _, start_epoch = ckpt_lib.restore_checkpoint(
+                        path, full_template
                     )
-            count = 0
-            last = float("nan")
-            for i in range(batch_count):
-                count += 1
-                if count % frequency == 0 or i + 1 == batch_count:
-                    last = float(costs[i])
-                    step = (epoch * batch_count + i + 1) * step_scale
-                    _print_window(step, epoch, i, batch_count, last,
-                                  count * avg_step_s, frequency)
-                    count = 0
-            if mlogger is not None:
-                # per-epoch telemetry from the already-returned arrays
-                # (the scan path has no per-step host timing: the
-                # percentiles collapse to the epoch mean, flagged by
-                # timing="epoch_mean"; the whole epoch is one device
-                # program, so the wall is all device time).
-                # metrics_step_s, when given, excludes the measured
-                # compile wall — the print's AvgTime keeps the seed
-                # semantics, but MFU must not amortize compile.
-                m_s = (metrics_step_s if metrics_step_s is not None
-                       else avg_step_s)
-                ms = round(m_s * 1e3, 4)
-                wall = round(m_s * batch_count, 6)
-                metrics_row(
-                    (epoch + 1) * batch_count * step_scale, epoch, last,
-                    {"path": "fast", "timing": "epoch_mean",
-                     "steps": batch_count, "window_wall_s": wall,
-                     "step_time_p50_ms": ms, "step_time_p95_ms": ms,
-                     "step_time_max_ms": ms, "data_wait_s": 0.0,
-                     "dispatch_s": 0.0, "device_wait_s": wall,
-                     "host_s": 0.0})
-                heartbeat.touch((epoch + 1) * batch_count)
-                straggler_event(epoch)
-            return last
+                    state = fsdp_lib.shard_state_host(full, dp, mp_f,
+                                                      fsdp_tp_specs)
+                else:
+                    state, _, start_epoch = ckpt_lib.restore_checkpoint(path, state)
+                state = mesh_lib.place_state(state, mesh, sspecs)
+                print(f"Resumed from {path} at epoch {start_epoch}")
 
-        n_ep = cfg.training_epochs - start_epoch
-        if cfg.checkpoint_every == 0 and n_ep > 0 and not early:
-            # the whole run as one device program
-            if async_mode:
-                runner = epoch_lib.build_local_run_to_completion(
-                    cfg, mesh, spec, optimizer, batch_count, n_ep
-                )(state)
-            elif fsdp_mode:
-                runner = epoch_lib.build_fsdp_run_to_completion(
-                    cfg, mesh, spec, optimizer, full_template, batch_count,
-                    n_ep,
-                )
+        writer = None
+        if cfg.summaries and (chief or cfg.summaries_all_hosts):
+            writer = SummaryWriter(cfg.logs_path)  # example.py:145-146
+            # the reference attaches its graph to the event log
+            # (FileWriter(logs_path, graph=..., example.py:146)); write the
+            # equivalent GraphDef record so TB's Graphs tab is populated
+            from ..utils.summary import mlp_graph_nodes, transformer_graph_nodes
+
+            if cfg.model == "transformer":
+                writer.add_graph(transformer_graph_nodes(cfg.num_blocks))
             else:
-                runner = epoch_lib.build_run_to_completion(
-                    cfg, mesh, spec, optimizer, batch_count, n_ep
+                writer.add_graph(mlp_graph_nodes(
+                    cfg.input_size, tuple(cfg.hidden_sizes), cfg.num_classes,
+                    cfg.activation, optimizer=cfg.optimizer,
+                ))
+
+        # whole-run --profile starts here; --profile_steps windows open at
+        # their step. Either way the forensics guard's finally stops the
+        # trace, so a crash never leaves an unterminated capture.
+        tracer.begin_run()
+
+        def dump_graph(jitted, *args) -> None:
+            """--profile graph observability: the TPU-native analog of the
+            reference's TB graph write (example.py:146) — StableHLO +
+            optimized HLO text next to the profiler trace (utils.hlo).
+            Plain-int args are marshalled to int32 exactly as the epoch
+            runners' call wrappers do."""
+            if (cfg.profile or profile_window is not None) and chief:
+                import jax.numpy as jnp
+
+                from ..utils.hlo import dump_graph as _dump
+
+                args = tuple(
+                    jnp.int32(a) if isinstance(a, int) else a for a in args
                 )
-            dump_graph(runner.jitted, state, img_d, lbl_d, shuffle_key,
-                       start_epoch)
-            t0 = time.time()
-            state, costs2d, accs2d = runner(
-                state, img_d, lbl_d, shuffle_key, start_epoch
+                _dump(jitted, args, cfg.logs_path, "train_step")
+
+        # global_step parity: the reference's global_step counts every
+        # worker's update (≈3x per round under 3 async workers, SURVEY.md
+        # §3.3); in local-SGD mode each of the dp shards applies one update
+        # per round, so the printed step advances by dp per round.
+        step_scale = dp if async_mode else 1
+
+        early = cfg.early_stop_patience > 0
+        best_val = float(resumed_extras.get("best_val", -1.0))
+        val_wait = int(resumed_extras.get("val_wait", 0))
+        val_eval_step = None   # host-path evaluator, built lazily, shared
+                               # by per-epoch validation and the final eval
+
+        def host_eval_accuracy(params, images, labels) -> float:
+            nonlocal val_eval_step
+            if val_eval_step is None:
+                val_eval_step = step_lib.build_eval_step(cfg, mesh, spec)
+            unit = (batch_shards * cfg.microbatches if pp_mode
+                    else batch_shards)
+            with tracer.annotate("eval"):
+                return _eval_accuracy(
+                    val_eval_step, params, images, labels, batch_shards,
+                    chunk=max(step_lib.eval_chunk_cap(spec,
+                                                      cfg.eval_batch_size),
+                              unit),
+                    unit=unit,
+                )
+
+        def note_validation(val_acc: float) -> bool:
+            """Track the per-epoch validation accuracy; True = stop now.
+            The accuracy is computed collectively (SPMD eval), so every
+            process takes the same decision."""
+            nonlocal best_val, val_wait
+            if chief or cfg.eval_all_hosts:
+                print("Validation-Accuracy: %2.2f" % val_acc)
+            if val_acc > best_val + 1e-12:
+                best_val, val_wait = val_acc, 0
+                return False
+            val_wait += 1
+            return val_wait >= cfg.early_stop_patience
+
+        # Fast path: stage the dataset into HBM now — this is the data-load
+        # phase, which the reference also performs before starting its timer
+        # (example.py:48 precedes begin_time at :136). Upload happens once;
+        # compile, training, and eval stay inside the timed window.
+        if fast:
+            img_d, lbl_d, batch_count = epoch_lib.shard_dataset(
+                mesh, dataset.train.images, dataset.train.labels, global_batch
             )
-            # jit dispatch returns after trace+compile (execution is
-            # async): the call's wall is the compile, logged as its
-            # own event and excluded from the metrics rows' step time
-            disp_wall = time.time() - t0
-            if mlogger is not None:
-                mlogger.log_event("compile", what="run_to_completion",
-                                  dispatch_wall_s=round(disp_wall, 3))
-            # enqueue the final eval now so it executes on-device right
-            # after the run, then fetch metrics AND the eval count in a
-            # single device_get — every separate fetch through the
-            # tunnel costs a full round trip
-            eval_pending = fast_eval.dispatch(
-                get_params(state) if (async_mode or fsdp_mode)
-                else state.params
+            fast_eval = epoch_lib.build_fast_eval(
+                cfg, mesh, spec, dataset.test.images, dataset.test.labels
             )
-            costs2d, accs2d, eval_pending = jax.device_get(
-                (costs2d, accs2d, eval_pending)
-            )
-            total_wall = time.time() - t0
-            avg_step_s = total_wall / (n_ep * batch_count)
-            metrics_step_s = max(0.0, total_wall - disp_wall) / (
-                n_ep * batch_count)
-            epochs_done = start_epoch + n_ep
-            for e_off in range(n_ep):
-                cost = emit_epoch(start_epoch + e_off, costs2d[e_off],
-                                  accs2d[e_off], avg_step_s,
-                                  metrics_step_s)
-        elif not async_mode:
-            # per-epoch runner, for host control between epochs
-            # (periodic checkpoints). Fast async always takes the
-            # whole-run branch above — it reaches here solely when no
-            # epochs remain, so nothing must be built for it.
-            if fsdp_mode:
-                epoch_runner = epoch_lib.build_fsdp_epoch_runner(
-                    cfg, mesh, spec, optimizer, full_template, batch_count
-                )
-            else:
-                epoch_runner = epoch_lib.build_epoch_runner(
-                    cfg, mesh, spec, optimizer, batch_count
-                )
-            dump_graph(epoch_runner.jitted, state, img_d, lbl_d,
-                       shuffle_key, start_epoch)
-            for epoch in range(start_epoch, cfg.training_epochs):
-                t0 = time.time()
-                state, costs, accs = epoch_runner(
-                    state, img_d, lbl_d, shuffle_key, epoch
-                )
-                disp_wall = time.time() - t0 if epoch == start_epoch else 0.0
-                if mlogger is not None and epoch == start_epoch:
-                    mlogger.log_event("compile", what="epoch_runner",
-                                      dispatch_wall_s=round(disp_wall, 3))
-                # one round trip for both metric arrays
-                costs, accs = jax.device_get((costs, accs))
-                total_wall = time.time() - t0
-                avg_step_s = total_wall / batch_count
-                cost = emit_epoch(
-                    epoch, costs, accs, avg_step_s,
-                    max(0.0, total_wall - disp_wall) / batch_count)
-                epochs_done = epoch + 1
-                # validation BEFORE the checkpoint so the saved
-                # best_val/val_wait include this epoch — a --resume run
-                # then replays the same early-stop trajectory
-                stop_now = False
-                if early:
-                    p_eval = (get_params(state) if (async_mode or fsdp_mode)
-                              else state.params)
-                    stop_now = note_validation(fast_val(p_eval))
-                maybe_checkpoint(epoch + 1)
-                if stop_now:
-                    break
-    else:
-        # Under multi-process SEQUENCE parallelism x shards its token
-        # (column) axis, so a process's devices need rows outside its
-        # example shard: every process then iterates the FULL global
-        # batch (same seed -> identical order) and the feed below slices
-        # per-device blocks via make_array_from_callback.
-        seq_mp = proc_cnt > 1 and mesh_lib.SEQ_AXIS in mesh.shape
-        local_batch = global_batch if seq_mp else global_batch // proc_cnt
-        iterator = EpochIterator(
-            dataset.train,
-            batch_size=local_batch,
-            seed=cfg.seed,
-            shard=cfg.shard_data and not seq_mp,
-            process_index=proc_idx,
-            process_count=proc_cnt,
-        )
-        # Bound the async dispatch queue. On TPU a deep window keeps the
-        # pipeline full; on the CPU backend (tests: 8 virtual devices on
-        # few cores) concurrent in-flight programs can starve the
-        # collective rendezvous, so dispatch is serialized there.
-        window = 1 if jax.default_backend() == "cpu" else 32
-        inflight: list = []
-        # Multi-process: every process holds only its local batch slice;
-        # assemble the global array explicitly (a bare numpy arg would be
-        # treated as the full global batch on every process).
-        batch_sharding = None
-        x_sharding = None
-        if proc_cnt > 1:
-            from jax.sharding import NamedSharding
-
-            # x/y must be committed with the step's own layout (from
-            # batch_layout: 'data' + 'seq' for the token axis + 'expert'
-            # under sparse-dispatch EP); committing a different spec
-            # would force a reshard collective every step
-            _, _, x_ps, y_ps = step_lib.batch_layout(mesh, spec)
-            batch_sharding = NamedSharding(mesh, y_ps)
-            x_sharding = NamedSharding(mesh, x_ps)
-        start_time = time.time()  # example.py:149
-        from ..data.prefetch import Prefetcher
-
-        # telemetry state: the window timer charges the loop's existing
-        # host-side waits into named buckets (data_wait = prefetcher
-        # block, dispatch = the jit'd call, device_wait = the bounded-
-        # queue drain + the window-boundary metric fetch) — it never
-        # adds a fetch of its own, so the dispatch queue is untouched
-        want_norms = cfg.histograms
-        norms_dev = None
-        lr_host = _host_lr(cfg, total_steps) if want_norms else None
-        wtimer = None
-        if mlogger is not None or want_norms:
-            from ..obs.metrics import WindowTimer
-
-            wtimer = WindowTimer()
-        compile_logged = False
-
-        def timed_batches(prefetcher):
-            """enumerate(prefetcher), charging the blocking next() into
-            the window's data_wait bucket."""
-            it = iter(prefetcher)
-            i = 0
-            while True:
-                t0 = time.perf_counter()
-                try:
-                    item = next(it)
-                except StopIteration:
-                    return
-                if wtimer is not None:
-                    wtimer.charge("data_wait", time.perf_counter() - t0)
-                yield i, item
-                i += 1
-
-        def close_window(epoch: int, cost_dev) -> None:
-            """Window boundary: ONE blocking fetch (cost + the step's
-            latest norm vectors together), then the metrics row, the
-            heartbeat touch, and the histogram/lr summaries."""
-            t0 = time.perf_counter()
-            fetched = jax.device_get(
-                (cost_dev, norms_dev) if norms_dev is not None
-                else (cost_dev, None))
-            cost_w, norms_host = float(fetched[0]), fetched[1]
-            wtimer.charge("device_wait", time.perf_counter() - t0)
-            step = steps_done * step_scale
-            if mlogger is not None:
-                timing = wtimer.window_row()
-                timing["path"] = "host"
-                metrics_row(step, epoch, cost_w, timing)
-            if heartbeat is not None:
-                heartbeat.touch(steps_done)
-            if norms_host is not None and writer is not None:
-                writer.add_histograms(step, {
-                    "grad_norm": norms_host["grad"],
-                    "param_norm": norms_host["param"],
-                })
-                writer.add_scalars(
-                    step, {"learning_rate": lr_host(steps_done)})
-            wtimer.reset()
-
-        steps_done = start_epoch * iterator.batches_per_epoch
-        graph_dumped = False
-        for epoch in range(start_epoch, cfg.training_epochs):
-            batch_count = iterator.batches_per_epoch  # example.py:153
-            count = 0
-            # epoch-keyed shuffle: resume at epoch E replays the same
-            # permutations an uninterrupted run would have used
-            prefetcher = Prefetcher(iterator.epoch(epoch))
-            if wtimer is not None:
-                # inter-epoch host work (validation eval, checkpoint,
-                # prefetcher spin-up) must not bleed into the next
-                # window's wall and deflate its throughput fields
-                wtimer.reset()
-            try:
-                for i, (batch_x, batch_y) in timed_batches(prefetcher):
-                    if batch_sharding is not None:
-                        if seq_mp:
-                            # every process holds the full batch; each
-                            # device takes its (row, token-block) slice
-                            bx, by = batch_x, batch_y
-                            batch_x = jax.make_array_from_callback(
-                                bx.shape, x_sharding, lambda idx: bx[idx]
-                            )
-                            batch_y = jax.make_array_from_callback(
-                                by.shape, batch_sharding,
-                                lambda idx: by[idx]
-                            )
-                        else:
-                            batch_x = jax.make_array_from_process_local_data(
-                                x_sharding, batch_x
-                            )
-                            batch_y = jax.make_array_from_process_local_data(
-                                batch_sharding, batch_y
-                            )
-                    if not graph_dumped:
-                        graph_dumped = True
-                        dump_graph(train_step, state, batch_x, batch_y)
-                    t_disp = time.perf_counter()
-                    if want_norms:
-                        state, cost_dev, acc_dev, norms_dev = train_step(
-                            state, batch_x, batch_y)
-                    else:
-                        state, cost_dev, acc_dev = train_step(
-                            state, batch_x, batch_y)
-                    if wtimer is not None:
-                        t_disp = time.perf_counter() - t_disp
-                        wtimer.charge("dispatch", t_disp)
-                        if not compile_logged:
-                            # first jit dispatch = trace + compile
-                            # (execution itself is async)
-                            compile_logged = True
-                            if mlogger is not None:
-                                mlogger.log_event(
-                                    "compile", what="train_step",
-                                    dispatch_wall_s=round(t_disp, 3))
-                            # compile is its own event; like the fast
-                            # paths, the first window's throughput
-                            # must not amortize it — restart the
-                            # window clock post-compile
-                            wtimer.reset()
-                    steps_done += 1
-                    # host-side step counter: state.step advances 1 per call
-                    # deterministically, and fetching it would force a
-                    # host-device sync every step
-                    if async_mode and steps_done % cfg.sync_period == 0:
-                        state = param_sync(state)
-                    examples_seen += global_batch
-                    inflight.append(cost_dev)
-                    if len(inflight) > window:
-                        t_drain = time.perf_counter()
-                        inflight.pop(0).block_until_ready()
-                        if wtimer is not None:
-                            wtimer.charge("device_wait",
-                                          time.perf_counter() - t_drain)
-                    if writer is not None:
-                        # the reference writes cost+accuracy every step
-                        # (example.py:163)
-                        cost = float(cost_dev)
-                        writer.add_scalars(
-                            steps_done * step_scale,
-                            {"cost": cost, "accuracy": float(acc_dev)},
-                        )
-                    count += 1
-                    if count % frequency == 0 or i + 1 == batch_count:
-                        cost = float(cost_dev)
-                        step = steps_done * step_scale
-                        elapsed_time = time.time() - start_time  # example.py:167
-                        start_time = time.time()
-                        _print_window(step, epoch, i, batch_count, cost,
-                                      elapsed_time, frequency)
-                        count = 0
-                    if wtimer is not None:
-                        wtimer.step_done()
-                        if (wtimer.steps >= cfg.log_every
-                                or i + 1 == batch_count):
-                            close_window(epoch, cost_dev)
-                    maybe_checkpoint(epoch)
-            finally:
-                prefetcher.close()
-            epochs_done = epoch + 1
-            if mlogger is not None:
-                straggler_event(epoch)
+            # wait for every staged transfer with a fetch-backed barrier:
+            # device_put is async and block_until_ready can return early on
+            # this backend (utils.sync), which would leak the upload into
+            # the timed window below
+            fast_val = None
             if early:
-                p_eval = (get_params(state)
-                          if (async_mode or fsdp_mode) else state.params)
-                if note_validation(host_eval_accuracy(
-                        p_eval, dataset.validation.images,
-                        dataset.validation.labels)):
-                    break
+                fast_val = epoch_lib.build_fast_eval(
+                    cfg, mesh, spec, dataset.validation.images,
+                    dataset.validation.labels)
+            from ..utils.sync import hard_sync
 
-    if cfg.profile and chief:
-        jax.profiler.stop_trace()
+            hard_sync((img_d, lbl_d, fast_eval.staged)
+                      + ((fast_val.staged,) if fast_val else ()))
 
-    # Final eval (example.py:177-179): chief-only in spirit; every
-    # process computes (cheap, collective-free divergence is impossible
-    # under SPMD) but only chief prints.
-    eval_params = None
-    if eval_pending is not None:        # fast path, eval count already fetched
-        test_acc = float(eval_pending) / fast_eval.n
-    else:
-        params = eval_params = (
-            get_params(state) if (async_mode or fsdp_mode) else state.params
-        )
-        if fast:                        # fast per-epoch path
-            test_acc = fast_eval(params)
-        else:                           # host path
-            test_acc = host_eval_accuracy(
-                params, dataset.test.images, dataset.test.labels)
-    total_time = time.time() - begin_time
-    cost = float(cost)
-    # the reference runs + prints the final eval on EVERY worker
-    # (example.py:177); chief-only by default here, with
-    # --eval_all_hosts mirroring the reference behavior the same way
-    # --summaries_all_hosts mirrors per-machine logging
-    if chief or cfg.eval_all_hosts:
-        print("Test-Accuracy: %2.2f" % test_acc)          # example.py:177
-    if chief:
-        print("Total Time: %3.2fs" % float(total_time))   # example.py:178
-        print("Final Cost: %.4f" % cost)                  # example.py:179
+        epochs_done = start_epoch
+        begin_time = time.time()       # example.py:136
+        frequency = cfg.frequency      # example.py:137
+        cost = float("nan")
+        examples_seen = 0
 
-    if cfg.sample_after > 0 and cfg.objective == "lm":
-        # complete the train->generate story: KV-cached decoding from
-        # the first test examples' opening tokens (beyond-reference;
-        # the classify objective has nothing to sample). EVERY process
-        # joins the collectives — only the write is chief-only (gating
-        # them would deadlock the others).
-        from ..models import transformer as tfm_lib
+        def _ckpt_extras() -> dict:
+            extras = dict({"best_val": best_val, "val_wait": val_wait}
+                          if early else {})
+            if pp_mode:
+                # pin the stacked block order's layout (see the resume
+                # validation above)
+                extras.update(pp_stages=cfg.pipeline_parallel,
+                              pp_virtual=cfg.virtual_stages)
+            if cfg.zero_opt:
+                # flat slot chunking is dp-shaped; resume validates it
+                extras.update(zero_dp=dp)
+            if fsdp_mode and cfg.sharded_checkpoints:
+                # a sharded-FSDP checkpoint stores the flat [.., dp, chunk]
+                # layout; resume needs the model-parallel degree it was
+                # written at to un-flatten (dp itself is leaf-shape-evident)
+                extras.update(fsdp_mp=mp_f)
+            return extras
 
-        n_s = min(cfg.sample_after, dataset.test.images.shape[0])
-        prompt_len = max(1, spec.seq_len // 8)
-        prompts = tfm_lib.tokenize(
-            spec, dataset.test.images[:n_s])[:, :prompt_len]
-        sample_rng = (jax.random.PRNGKey(cfg.seed)
-                      if cfg.sample_temperature > 0 else None)
-        tp_axis = mesh_lib.tp_axis(spec, cfg.model_parallel)
-        samples = None
-        if n_s and tp_axis and not (pp_mode or fsdp_mode or async_mode):
-            # Megatron TP is live: decode ON the mesh — params stay in
-            # their training placement (heads split over 'model', Wo/W2
-            # psums), never fetched to a host
-            samples = np.asarray(tfm_lib.generate_sharded(
-                spec, state.params, prompts, mesh, tp_axis,
-                rng=sample_rng, temperature=cfg.sample_temperature))
-        elif n_s:
-            # every other mode (r5, VERDICT r4 next #8): batched decode
-            # SHARDED over 'data' on the mesh — the only gather is the
-            # params' own (PP unstack / FSDP allgather), never a
-            # chief-host numpy decode loop
-            sample_params = (
-                eval_params if eval_params is not None
-                else get_params(state) if (async_mode or fsdp_mode)
-                else state.params
-            )
+        def save_state(step: int, resume_epoch: int) -> None:
+            """Write a checkpoint. Sharded mode: every process writes only
+            its addressable shards, the chief adds the manifest — no
+            cross-process gather anywhere, O(state/processes) host memory.
+            Portable single-file mode: in multi-process runs state leaves
+            may span non-addressable devices; every process joins the
+            allgather, only the chief writes."""
+            if cfg.sharded_checkpoints:
+                # FSDP saves its flat sharded layout AS IS (no host
+                # unshard): restore reassembles + re-lays-out. Pruning
+                # rides the completion callback so an async in-flight
+                # (still invisible) checkpoint is never miscounted.
+                prune = (
+                    (lambda: ckpt_lib.prune_checkpoints(
+                        cfg.checkpoint_dir, cfg.keep_checkpoints))
+                    if chief and cfg.keep_checkpoints else None)
+                ckpt_lib.save_checkpoint_sharded(
+                    cfg.checkpoint_dir, state, step, resume_epoch,
+                    _ckpt_extras() or None, async_=cfg.async_checkpoints,
+                    on_complete=prune)
+                return
+            to_save = state
             if proc_cnt > 1:
                 from jax.experimental import multihost_utils
 
-                sample_params = multihost_utils.process_allgather(
-                    sample_params, tiled=True)
-            if pp_mode:
-                # decode_step walks flat L{i}_* leaves: un-stack the
-                # pipeline layout (same (stages, virtual) as training)
-                sample_params = tfm_lib.pipeline_unstack_params(
-                    spec, jax.tree.map(jnp.asarray, sample_params),
-                    cfg.pipeline_parallel, cfg.virtual_stages)
-            out = tfm_lib.generate_dp(
-                spec, sample_params, prompts, mesh,
-                data_axis=mesh_lib.DATA_AXIS, rng=sample_rng,
-                temperature=cfg.sample_temperature)
+                to_save = multihost_utils.process_allgather(state, tiled=True)
+            if fsdp_mode:
+                from ..parallel import fsdp as fsdp_lib
+
+                to_save = fsdp_lib.unshard_state_host(to_save, full_template,
+                                                      mp_f, fsdp_tp_specs)
+            if chief:
+                ckpt_lib.save_checkpoint(cfg.checkpoint_dir, to_save, step,
+                                         resume_epoch, _ckpt_extras() or None)
+                if cfg.keep_checkpoints:
+                    ckpt_lib.prune_checkpoints(cfg.checkpoint_dir,
+                                               cfg.keep_checkpoints)
+
+        ckpt_enabled = bool(cfg.checkpoint_dir and cfg.checkpoint_every)
+        last_ckpt_step = 0
+
+        def maybe_checkpoint(resume_epoch: int) -> None:
+            """Save when a checkpoint_every boundary has been crossed since
+            the last save. ``resume_epoch`` is the epoch --resume should
+            restart from (the epoch after a completed one; the current epoch
+            for a mid-epoch save, which re-runs its partial work)."""
+            nonlocal last_ckpt_step
+            if not ckpt_enabled:
+                return
+            step = int(state.step)
+            if step // cfg.checkpoint_every > last_ckpt_step // cfg.checkpoint_every:
+                with tracer.annotate("checkpoint"):
+                    save_state(step, resume_epoch)
+                last_ckpt_step = step
+
+        eval_pending = None  # host scalar: eval count fetched with the metrics
+        if fast:
+            shuffle_key = jax.random.PRNGKey(cfg.seed + 0x5EED)
+
+            def emit_epoch(epoch: int, costs: np.ndarray, accs: np.ndarray,
+                           avg_step_s: float,
+                           metrics_step_s: float | None = None) -> float:
+                nonlocal examples_seen
+                examples_seen += batch_count * global_batch
+                if writer is not None:
+                    base_step = epoch * batch_count
+                    for i in range(batch_count):
+                        writer.add_scalars(
+                            (base_step + i + 1) * step_scale,
+                            {"cost": float(costs[i]), "accuracy": float(accs[i])},
+                        )
+                count = 0
+                last = float("nan")
+                for i in range(batch_count):
+                    count += 1
+                    if count % frequency == 0 or i + 1 == batch_count:
+                        last = float(costs[i])
+                        step = (epoch * batch_count + i + 1) * step_scale
+                        _print_window(step, epoch, i, batch_count, last,
+                                      count * avg_step_s, frequency)
+                        count = 0
+                if mlogger is not None:
+                    # per-epoch telemetry from the already-returned arrays
+                    # (the scan path has no per-step host timing: the
+                    # percentiles collapse to the epoch mean, flagged by
+                    # timing="epoch_mean"; the whole epoch is one device
+                    # program, so the wall is all device time).
+                    # metrics_step_s, when given, excludes the measured
+                    # compile wall — the print's AvgTime keeps the seed
+                    # semantics, but MFU must not amortize compile.
+                    m_s = (metrics_step_s if metrics_step_s is not None
+                           else avg_step_s)
+                    ms = round(m_s * 1e3, 4)
+                    wall = round(m_s * batch_count, 6)
+                    metrics_row(
+                        (epoch + 1) * batch_count * step_scale, epoch, last,
+                        {"path": "fast", "timing": "epoch_mean",
+                         "steps": batch_count, "window_wall_s": wall,
+                         "step_time_p50_ms": ms, "step_time_p95_ms": ms,
+                         "step_time_max_ms": ms, "data_wait_s": 0.0,
+                         "dispatch_s": 0.0, "device_wait_s": wall,
+                         "host_s": 0.0})
+                    heartbeat.touch((epoch + 1) * batch_count)
+                    straggler_event(epoch)
+                if flight is not None:
+                    # the scan paths have no per-step host visibility:
+                    # one enriched record per epoch, carrying the cost
+                    # and the count of non-finite per-step costs
+                    flight.record_window(
+                        (epoch + 1) * batch_count, epoch=epoch,
+                        path="fast", cost=float(last),
+                        nonfinite_steps=int(np.sum(~np.isfinite(costs))),
+                        step_wall_ms=round(avg_step_s * 1e3, 4))
+                if policy is not None:
+                    # post-hoc over the returned per-step cost array;
+                    # under 'skip' the compiled step already masked the
+                    # flagged updates (make_sync_step_body reads
+                    # cfg.on_anomaly) and the non-finite cost entries
+                    # are the visible accounting. A grad-only anomaly
+                    # with a finite loss is masked but uncounted here —
+                    # the scan program returns only costs; the host
+                    # loop (--no_fast_loop) has the exact per-step flag
+                    policy.on_epoch(epoch, costs,
+                                    base_step=epoch * batch_count)
+                return last
+
+            n_ep = cfg.training_epochs - start_epoch
+            if cfg.checkpoint_every == 0 and n_ep > 0 and not early:
+                # the whole run as one device program
+                if async_mode:
+                    runner = epoch_lib.build_local_run_to_completion(
+                        cfg, mesh, spec, optimizer, batch_count, n_ep
+                    )(state)
+                elif fsdp_mode:
+                    runner = epoch_lib.build_fsdp_run_to_completion(
+                        cfg, mesh, spec, optimizer, full_template, batch_count,
+                        n_ep,
+                    )
+                else:
+                    runner = epoch_lib.build_run_to_completion(
+                        cfg, mesh, spec, optimizer, batch_count, n_ep
+                    )
+                dump_graph(runner.jitted, state, img_d, lbl_d, shuffle_key,
+                           start_epoch)
+                # fast-path capture granularity is the compiled program:
+                # this ONE program covers every remaining step
+                tracer.on_range(start_epoch * batch_count,
+                                (start_epoch + n_ep) * batch_count)
+                if flight is not None:
+                    flight.record_step(start_epoch * batch_count,
+                                       epoch=start_epoch, path="fast",
+                                       note="run_to_completion dispatched")
+                t0 = time.time()
+                with tracer.step_annotation(start_epoch * batch_count):
+                    state, costs2d, accs2d = runner(
+                        state, img_d, lbl_d, shuffle_key, start_epoch
+                    )
+                # jit dispatch returns after trace+compile (execution is
+                # async): the call's wall is the compile, logged as its
+                # own event and excluded from the metrics rows' step time
+                disp_wall = time.time() - t0
+                if mlogger is not None:
+                    mlogger.log_event("compile", what="run_to_completion",
+                                      dispatch_wall_s=round(disp_wall, 3))
+                # enqueue the final eval now so it executes on-device right
+                # after the run, then fetch metrics AND the eval count in a
+                # single device_get — every separate fetch through the
+                # tunnel costs a full round trip
+                with tracer.annotate("eval"):
+                    eval_pending = fast_eval.dispatch(
+                        get_params(state) if (async_mode or fsdp_mode)
+                        else state.params
+                    )
+                costs2d, accs2d, eval_pending = jax.device_get(
+                    (costs2d, accs2d, eval_pending)
+                )
+                total_wall = time.time() - t0
+                avg_step_s = total_wall / (n_ep * batch_count)
+                metrics_step_s = max(0.0, total_wall - disp_wall) / (
+                    n_ep * batch_count)
+                epochs_done = start_epoch + n_ep
+                for e_off in range(n_ep):
+                    cost = emit_epoch(start_epoch + e_off, costs2d[e_off],
+                                      accs2d[e_off], avg_step_s,
+                                      metrics_step_s)
+            elif not async_mode:
+                # per-epoch runner, for host control between epochs
+                # (periodic checkpoints). Fast async always takes the
+                # whole-run branch above — it reaches here solely when no
+                # epochs remain, so nothing must be built for it.
+                if fsdp_mode:
+                    epoch_runner = epoch_lib.build_fsdp_epoch_runner(
+                        cfg, mesh, spec, optimizer, full_template, batch_count
+                    )
+                else:
+                    epoch_runner = epoch_lib.build_epoch_runner(
+                        cfg, mesh, spec, optimizer, batch_count
+                    )
+                dump_graph(epoch_runner.jitted, state, img_d, lbl_d,
+                           shuffle_key, start_epoch)
+                for epoch in range(start_epoch, cfg.training_epochs):
+                    tracer.on_range(epoch * batch_count,
+                                    (epoch + 1) * batch_count)
+                    t0 = time.time()
+                    with tracer.step_annotation(epoch * batch_count):
+                        state, costs, accs = epoch_runner(
+                            state, img_d, lbl_d, shuffle_key, epoch
+                        )
+                    disp_wall = time.time() - t0 if epoch == start_epoch else 0.0
+                    if mlogger is not None and epoch == start_epoch:
+                        mlogger.log_event("compile", what="epoch_runner",
+                                          dispatch_wall_s=round(disp_wall, 3))
+                    # one round trip for both metric arrays
+                    costs, accs = jax.device_get((costs, accs))
+                    total_wall = time.time() - t0
+                    avg_step_s = total_wall / batch_count
+                    cost = emit_epoch(
+                        epoch, costs, accs, avg_step_s,
+                        max(0.0, total_wall - disp_wall) / batch_count)
+                    epochs_done = epoch + 1
+                    # validation BEFORE the checkpoint so the saved
+                    # best_val/val_wait include this epoch — a --resume run
+                    # then replays the same early-stop trajectory
+                    stop_now = False
+                    if early:
+                        p_eval = (get_params(state) if (async_mode or fsdp_mode)
+                                  else state.params)
+                        with tracer.annotate("eval"):
+                            stop_now = note_validation(fast_val(p_eval))
+                    maybe_checkpoint(epoch + 1)
+                    if stop_now:
+                        break
+        else:
+            # Under multi-process SEQUENCE parallelism x shards its token
+            # (column) axis, so a process's devices need rows outside its
+            # example shard: every process then iterates the FULL global
+            # batch (same seed -> identical order) and the feed below slices
+            # per-device blocks via make_array_from_callback.
+            seq_mp = proc_cnt > 1 and mesh_lib.SEQ_AXIS in mesh.shape
+            local_batch = global_batch if seq_mp else global_batch // proc_cnt
+            iterator = EpochIterator(
+                dataset.train,
+                batch_size=local_batch,
+                seed=cfg.seed,
+                shard=cfg.shard_data and not seq_mp,
+                process_index=proc_idx,
+                process_count=proc_cnt,
+            )
+            # Bound the async dispatch queue. On TPU a deep window keeps the
+            # pipeline full; on the CPU backend (tests: 8 virtual devices on
+            # few cores) concurrent in-flight programs can starve the
+            # collective rendezvous, so dispatch is serialized there.
+            window = 1 if jax.default_backend() == "cpu" else 32
+            inflight: list = []
+            # Multi-process: every process holds only its local batch slice;
+            # assemble the global array explicitly (a bare numpy arg would be
+            # treated as the full global batch on every process).
+            batch_sharding = None
+            x_sharding = None
             if proc_cnt > 1:
-                out = multihost_utils.process_allgather(out, tiled=True)
-            samples = np.asarray(out)[:n_s]
-        if chief and samples is not None:
-            os.makedirs(cfg.logs_path, exist_ok=True)
-            sample_path = os.path.join(cfg.logs_path, "samples.npz")
-            np.savez(sample_path, samples=samples, prompt_len=prompt_len,
-                     vocab_size=spec.vocab_size)
-            print(f"Sampled {n_s} sequences -> {sample_path}")
+                from jax.sharding import NamedSharding
 
-    if cfg.checkpoint_dir:
-        save_state(int(state.step), cfg.training_epochs)
-        # a background checkpoint writer must finish before exit
-        ckpt_lib.wait_for_pending_saves()
-    if writer is not None:
-        writer.close()
-    if mlogger is not None:
-        mlogger.log_event(
-            "run_end", steps=int(state.step),
-            total_time_s=round(total_time, 3),
-            test_accuracy=float(test_acc),
-            examples_per_sec=(round(examples_seen / total_time, 3)
-                              if total_time > 0 else None))
-        mlogger.close()
+                # x/y must be committed with the step's own layout (from
+                # batch_layout: 'data' + 'seq' for the token axis + 'expert'
+                # under sparse-dispatch EP); committing a different spec
+                # would force a reshard collective every step
+                _, _, x_ps, y_ps = step_lib.batch_layout(mesh, spec)
+                batch_sharding = NamedSharding(mesh, y_ps)
+                x_sharding = NamedSharding(mesh, x_ps)
+            start_time = time.time()  # example.py:149
+            from ..data.prefetch import Prefetcher
 
-    if chief:
-        print("done")  # example.py:182
-    cluster.shutdown()  # sv.stop() analog (example.py:181)
+            # telemetry state: the window timer charges the loop's existing
+            # host-side waits into named buckets (data_wait = prefetcher
+            # block, dispatch = the jit'd call, device_wait = the bounded-
+            # queue drain + the window-boundary metric fetch) — it never
+            # adds a fetch of its own, so the dispatch queue is untouched
+            want_norms = cfg.histograms
+            norms_dev = None
+            lr_host = _host_lr(cfg, total_steps) if want_norms else None
+            # --on_anomaly: the sync step returns compiled flag/counts;
+            # the async/FSDP builders don't — there the policy runs
+            # host-side only (loss watchdog at the fetch points)
+            want_anomaly = (policy is not None
+                            and not (fsdp_mode or async_mode))
+            anom_dev = None
+            anom_pending: list = []  # (step_id, cost_dev, anom_dev)
+            # drain depth: bounded by the dispatch queue AND the
+            # flight ring — a drain arriving after the ring evicted
+            # its step record could no longer backfill the fetched
+            # loss onto it (small --flight_steps on a deep queue)
+            anom_depth = (min(window, max(1, flight.capacity - 1))
+                          if flight is not None else window)
+            wtimer = None
+            if mlogger is not None or want_norms:
+                from ..obs.metrics import WindowTimer
 
-    return {
-        "test_accuracy": test_acc,
-        "total_time_s": total_time,
-        "final_cost": cost,
-        "steps": int(state.step),
-        "examples_seen": examples_seen,
-        "examples_per_sec": examples_seen / total_time if total_time > 0 else 0.0,
-        "dataset_source": dataset.source,
-        "devices": n_devices,
-        "global_batch": global_batch,
-        "fast_loop": fast,
-        "epochs_completed": epochs_done,
-        "stopped_early": bool(early
-                              and val_wait >= cfg.early_stop_patience),
-    }
+                wtimer = WindowTimer()
+            compile_logged = False
+
+            def drain_anomaly(entry) -> None:
+                """Fetch one queued step's anomaly signals and apply the
+                policy. Rides the SAME lazy cadence as the bounded
+                dispatch queue, so detection lags by at most the window
+                depth and adds no fetch beyond the flag (+ counts only
+                when flagged)."""
+                sid, c_dev, a_dev = entry
+                t0 = time.perf_counter()
+                # ONE combined fetch (each separate fetch through the
+                # tunnel costs a full round trip); the counts vector
+                # is fetched only on the rare flagged step
+                flagged_h, c_h = jax.device_get((a_dev["flag"], c_dev))
+                flagged, c = bool(flagged_h), float(c_h)
+                counts = np.asarray(a_dev["counts"]) if flagged else None
+                if wtimer is not None:
+                    wtimer.charge("device_wait", time.perf_counter() - t0)
+                if flight is not None:
+                    # the drain is the one place the host learns this
+                    # step's loss in an --on_anomaly-only run (no
+                    # --metrics window fetch): backfill the ring record
+                    flight.attach_loss(sid, c)
+                policy.on_step(sid, loss=c, flagged=flagged, counts=counts)
+
+            def timed_batches(prefetcher):
+                """enumerate(prefetcher), charging the blocking next() into
+                the window's data_wait bucket."""
+                it = iter(prefetcher)
+                i = 0
+                while True:
+                    t0 = time.perf_counter()
+                    try:
+                        with tracer.annotate("data_wait"):
+                            item = next(it)
+                    except StopIteration:
+                        return
+                    if wtimer is not None:
+                        wtimer.charge("data_wait", time.perf_counter() - t0)
+                    yield i, item
+                    i += 1
+
+            def close_window(epoch: int, cost_dev) -> None:
+                """Window boundary: ONE blocking fetch (cost + the step's
+                latest norm vectors together), then the metrics row, the
+                heartbeat touch, and the histogram/lr summaries."""
+                while anom_pending:
+                    drain_anomaly(anom_pending.pop(0))
+                t0 = time.perf_counter()
+                with tracer.annotate("device_wait"):
+                    fetched = jax.device_get(
+                        (cost_dev, norms_dev) if norms_dev is not None
+                        else (cost_dev, None))
+                cost_w, norms_host = float(fetched[0]), fetched[1]
+                wtimer.charge("device_wait", time.perf_counter() - t0)
+                step = steps_done * step_scale
+                timing = wtimer.window_row()
+                timing["path"] = "host"
+                if mlogger is not None:
+                    metrics_row(step, epoch, cost_w, timing)
+                if flight is not None:
+                    # the enriched record: window loss + timing split
+                    # (+ the freshly fetched norm vectors under
+                    # --histograms) — what the post-mortem actually
+                    # reads, kept in its own ring so the bare per-step
+                    # appends can never evict it
+                    flight.record_window(
+                        steps_done, epoch=epoch, cost=cost_w,
+                        timing=timing,
+                        grad_norms=(norms_host["grad"].tolist()
+                                    if norms_host is not None else None))
+                if heartbeat is not None:
+                    heartbeat.touch(steps_done)
+                if norms_host is not None and writer is not None:
+                    writer.add_histograms(step, {
+                        "grad_norm": norms_host["grad"],
+                        "param_norm": norms_host["param"],
+                    })
+                    writer.add_scalars(
+                        step, {"learning_rate": lr_host(steps_done)})
+                wtimer.reset()
+
+            steps_done = start_epoch * iterator.batches_per_epoch
+            graph_dumped = False
+            for epoch in range(start_epoch, cfg.training_epochs):
+                batch_count = iterator.batches_per_epoch  # example.py:153
+                count = 0
+                # epoch-keyed shuffle: resume at epoch E replays the same
+                # permutations an uninterrupted run would have used
+                prefetcher = Prefetcher(iterator.epoch(epoch))
+                if wtimer is not None:
+                    # inter-epoch host work (validation eval, checkpoint,
+                    # prefetcher spin-up) must not bleed into the next
+                    # window's wall and deflate its throughput fields
+                    wtimer.reset()
+                try:
+                    for i, (batch_x, batch_y) in timed_batches(prefetcher):
+                        if batch_sharding is not None:
+                            if seq_mp:
+                                # every process holds the full batch; each
+                                # device takes its (row, token-block) slice
+                                bx, by = batch_x, batch_y
+                                batch_x = jax.make_array_from_callback(
+                                    bx.shape, x_sharding, lambda idx: bx[idx]
+                                )
+                                batch_y = jax.make_array_from_callback(
+                                    by.shape, batch_sharding,
+                                    lambda idx: by[idx]
+                                )
+                            else:
+                                batch_x = jax.make_array_from_process_local_data(
+                                    x_sharding, batch_x
+                                )
+                                batch_y = jax.make_array_from_process_local_data(
+                                    batch_sharding, batch_y
+                                )
+                        if not graph_dumped:
+                            graph_dumped = True
+                            dump_graph(train_step, state, batch_x, batch_y)
+                        # windowed capture opens/closes on exact step
+                        # ids; at a window edge the async queue must
+                        # drain first or the trace would capture the
+                        # device execution of EARLIER steps (the host
+                        # dispatches up to `window` steps ahead)
+                        if inflight and tracer.boundary(steps_done):
+                            inflight[-1].block_until_ready()
+                        tracer.on_step(steps_done)
+                        t_disp = time.perf_counter()
+                        with tracer.step_annotation(steps_done), \
+                                tracer.annotate("dispatch"):
+                            if want_norms and want_anomaly:
+                                (state, cost_dev, acc_dev, norms_dev,
+                                 anom_dev) = train_step(state, batch_x,
+                                                        batch_y)
+                            elif want_norms:
+                                state, cost_dev, acc_dev, norms_dev = \
+                                    train_step(state, batch_x, batch_y)
+                            elif want_anomaly:
+                                state, cost_dev, acc_dev, anom_dev = \
+                                    train_step(state, batch_x, batch_y)
+                            else:
+                                state, cost_dev, acc_dev = train_step(
+                                    state, batch_x, batch_y)
+                        if wtimer is not None:
+                            t_disp = time.perf_counter() - t_disp
+                            wtimer.charge("dispatch", t_disp)
+                            if not compile_logged:
+                                # first jit dispatch = trace + compile
+                                # (execution itself is async)
+                                compile_logged = True
+                                if mlogger is not None:
+                                    mlogger.log_event(
+                                        "compile", what="train_step",
+                                        dispatch_wall_s=round(t_disp, 3))
+                                # compile is its own event; like the fast
+                                # paths, the first window's throughput
+                                # must not amortize it — restart the
+                                # window clock post-compile
+                                wtimer.reset()
+                        steps_done += 1
+                        # host-side step counter: state.step advances 1 per call
+                        # deterministically, and fetching it would force a
+                        # host-device sync every step
+                        if async_mode and steps_done % cfg.sync_period == 0:
+                            state = param_sync(state)
+                        examples_seen += global_batch
+                        if flight is not None:
+                            # one deque append — the ring's step identity;
+                            # loss/norms/timing ride the window records
+                            flight.record_step(steps_done, epoch=epoch,
+                                               batch_index=i)
+                        if want_anomaly:
+                            anom_pending.append((steps_done, cost_dev,
+                                                 anom_dev))
+                            if len(anom_pending) > anom_depth:
+                                drain_anomaly(anom_pending.pop(0))
+                        inflight.append(cost_dev)
+                        if len(inflight) > window:
+                            t_drain = time.perf_counter()
+                            with tracer.annotate("device_wait"):
+                                inflight.pop(0).block_until_ready()
+                            if wtimer is not None:
+                                wtimer.charge("device_wait",
+                                              time.perf_counter() - t_drain)
+                        if writer is not None:
+                            # the reference writes cost+accuracy every step
+                            # (example.py:163)
+                            cost = float(cost_dev)
+                            writer.add_scalars(
+                                steps_done * step_scale,
+                                {"cost": cost, "accuracy": float(acc_dev)},
+                            )
+                        count += 1
+                        if count % frequency == 0 or i + 1 == batch_count:
+                            cost = float(cost_dev)
+                            if policy is not None and not want_anomaly:
+                                # async/FSDP path: no compiled flags — the
+                                # loss watchdog rides the print fetch
+                                policy.on_step(steps_done, loss=cost)
+                            step = steps_done * step_scale
+                            elapsed_time = time.time() - start_time  # example.py:167
+                            start_time = time.time()
+                            _print_window(step, epoch, i, batch_count, cost,
+                                          elapsed_time, frequency)
+                            count = 0
+                        if wtimer is not None:
+                            wtimer.step_done()
+                            if (wtimer.steps >= cfg.log_every
+                                    or i + 1 == batch_count):
+                                close_window(epoch, cost_dev)
+                        maybe_checkpoint(epoch)
+                    # epoch boundary: no queued anomaly may cross into the
+                    # next epoch unchecked
+                    while anom_pending:
+                        drain_anomaly(anom_pending.pop(0))
+                finally:
+                    prefetcher.close()
+                epochs_done = epoch + 1
+                if mlogger is not None:
+                    straggler_event(epoch)
+                if early:
+                    p_eval = (get_params(state)
+                              if (async_mode or fsdp_mode) else state.params)
+                    if note_validation(host_eval_accuracy(
+                            p_eval, dataset.validation.images,
+                            dataset.validation.labels)):
+                        break
+
+        # a WINDOWED capture still open when training ends closes HERE:
+        # the requested steps — not eval, sampling or shutdown — are
+        # the trace. Same invariant as the mid-run close edge: the
+        # async dispatch queue must drain first, or stop_trace would
+        # truncate the device execution of the final traced steps.
+        # Whole-run --profile keeps tracing through eval (its contract
+        # is the whole run) and is closed below / by the forensics
+        # guard's finally.
+        if not tracer.whole_run:
+            if tracer.active and not fast and inflight:
+                inflight[-1].block_until_ready()
+            tracer.stop()
+
+        # Final eval (example.py:177-179): chief-only in spirit; every
+        # process computes (cheap, collective-free divergence is impossible
+        # under SPMD) but only chief prints.
+        eval_params = None
+        if eval_pending is not None:        # fast path, eval count already fetched
+            test_acc = float(eval_pending) / fast_eval.n
+        else:
+            params = eval_params = (
+                get_params(state) if (async_mode or fsdp_mode) else state.params
+            )
+            if fast:                        # fast per-epoch path
+                with tracer.annotate("eval"):
+                    test_acc = fast_eval(params)
+            else:                           # host path
+                test_acc = host_eval_accuracy(
+                    params, dataset.test.images, dataset.test.labels)
+        total_time = time.time() - begin_time
+        cost = float(cost)
+        # the reference runs + prints the final eval on EVERY worker
+        # (example.py:177); chief-only by default here, with
+        # --eval_all_hosts mirroring the reference behavior the same way
+        # --summaries_all_hosts mirrors per-machine logging
+        if chief or cfg.eval_all_hosts:
+            print("Test-Accuracy: %2.2f" % test_acc)          # example.py:177
+        if chief:
+            print("Total Time: %3.2fs" % float(total_time))   # example.py:178
+            print("Final Cost: %.4f" % cost)                  # example.py:179
+
+        if cfg.sample_after > 0 and cfg.objective == "lm":
+            # complete the train->generate story: KV-cached decoding from
+            # the first test examples' opening tokens (beyond-reference;
+            # the classify objective has nothing to sample). EVERY process
+            # joins the collectives — only the write is chief-only (gating
+            # them would deadlock the others).
+            from ..models import transformer as tfm_lib
+
+            n_s = min(cfg.sample_after, dataset.test.images.shape[0])
+            prompt_len = max(1, spec.seq_len // 8)
+            prompts = tfm_lib.tokenize(
+                spec, dataset.test.images[:n_s])[:, :prompt_len]
+            sample_rng = (jax.random.PRNGKey(cfg.seed)
+                          if cfg.sample_temperature > 0 else None)
+            tp_axis = mesh_lib.tp_axis(spec, cfg.model_parallel)
+            samples = None
+            if n_s and tp_axis and not (pp_mode or fsdp_mode or async_mode):
+                # Megatron TP is live: decode ON the mesh — params stay in
+                # their training placement (heads split over 'model', Wo/W2
+                # psums), never fetched to a host
+                samples = np.asarray(tfm_lib.generate_sharded(
+                    spec, state.params, prompts, mesh, tp_axis,
+                    rng=sample_rng, temperature=cfg.sample_temperature))
+            elif n_s:
+                # every other mode (r5, VERDICT r4 next #8): batched decode
+                # SHARDED over 'data' on the mesh — the only gather is the
+                # params' own (PP unstack / FSDP allgather), never a
+                # chief-host numpy decode loop
+                sample_params = (
+                    eval_params if eval_params is not None
+                    else get_params(state) if (async_mode or fsdp_mode)
+                    else state.params
+                )
+                if proc_cnt > 1:
+                    from jax.experimental import multihost_utils
+
+                    sample_params = multihost_utils.process_allgather(
+                        sample_params, tiled=True)
+                if pp_mode:
+                    # decode_step walks flat L{i}_* leaves: un-stack the
+                    # pipeline layout (same (stages, virtual) as training)
+                    sample_params = tfm_lib.pipeline_unstack_params(
+                        spec, jax.tree.map(jnp.asarray, sample_params),
+                        cfg.pipeline_parallel, cfg.virtual_stages)
+                out, n_valid = tfm_lib.generate_dp(
+                    spec, sample_params, prompts, mesh,
+                    data_axis=mesh_lib.DATA_AXIS, rng=sample_rng,
+                    temperature=cfg.sample_temperature)
+                # symmetric contract (r5 ADVICE): generate_dp always
+                # returns the padded data-sharded global array + the valid
+                # count; dp_samples_host does the allgather (multi-process
+                # only) and the [:n] slice in one place
+                samples = tfm_lib.dp_samples_host(out, n_valid)
+            if chief and samples is not None:
+                os.makedirs(cfg.logs_path, exist_ok=True)
+                sample_path = os.path.join(cfg.logs_path, "samples.npz")
+                np.savez(sample_path, samples=samples, prompt_len=prompt_len,
+                         vocab_size=spec.vocab_size)
+                print(f"Sampled {n_s} sequences -> {sample_path}")
+
+        if cfg.checkpoint_dir:
+            save_state(int(state.step), cfg.training_epochs)
+            # a background checkpoint writer must finish before exit
+            ckpt_lib.wait_for_pending_saves()
+        if writer is not None:
+            writer.close()
+        if mlogger is not None:
+            mlogger.log_event(
+                "run_end", steps=int(state.step),
+                total_time_s=round(total_time, 3),
+                test_accuracy=float(test_acc),
+                examples_per_sec=(round(examples_seen / total_time, 3)
+                                  if total_time > 0 else None),
+                **(policy.summary() if policy is not None else {}))
+            mlogger.close()
+
+        if chief:
+            print("done")  # example.py:182
+        cluster.shutdown()  # sv.stop() analog (example.py:181)
+
+        # close a still-open capture BEFORE building the result (the
+        # finally's stop() would otherwise increment windows_captured
+        # after the count below was already read — a window reaching
+        # the end of training, or whole-run --profile, must report)
+        tracer.stop()
+        return {
+            "test_accuracy": test_acc,
+            "total_time_s": total_time,
+            "final_cost": cost,
+            "steps": int(state.step),
+            "examples_seen": examples_seen,
+            "examples_per_sec": examples_seen / total_time if total_time > 0 else 0.0,
+            "dataset_source": dataset.source,
+            "devices": n_devices,
+            "global_batch": global_batch,
+            "fast_loop": fast,
+            "epochs_completed": epochs_done,
+            "stopped_early": bool(early
+                                  and val_wait >= cfg.early_stop_patience),
+            "anomalies": (policy.anomalies if policy is not None else 0),
+            "skipped_steps": (policy.skipped_steps
+                              if policy is not None else 0),
+            "profile_windows": tracer.windows_captured,
+        }
+    except BaseException as e:
+        # the crash path IS the product here: before propagating,
+        # persist the flight record (sys.excepthook never fires for
+        # callers that catch — pytest, bench, embedding) and collate
+        # whatever the fleet has dumped so far into the post-mortem
+        # report
+        if flight is not None:
+            reason = ("anomaly_halt"
+                      if isinstance(e, anomaly_lib.AnomalyError)
+                      else "crash")
+            flight.dump(reason, exc=e)
+            if chief:
+                flight_lib.collate(cfg.logs_path)
+        raise
+    finally:
+        # a crash can never leave an unterminated profiler trace
+        # (exception-safe start/stop), and the signal/excepthook
+        # handlers must not leak past this run
+        tracer.stop()
+        if flight is not None:
+            flight.uninstall()

@@ -1,0 +1,753 @@
+"""Failure-forensics tests (obs/tracer, obs/anomaly, obs/flight,
+obs/schema + their train-loop wiring): profiler windowing via a
+stubbed jax.profiler (start/stop exactly once per window, annotations
+nest), the anomaly policies on injected NaN losses, flight-recorder
+ring/dump/SIGUSR1 round-trips, the chief collator, and the schema
+validators that pin the telemetry formats."""
+
+import json
+import os
+import signal
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.obs import anomaly as anomaly_lib
+from distributed_tensorflow_example_tpu.obs import flight as flight_lib
+from distributed_tensorflow_example_tpu.obs import schema as schema_lib
+from distributed_tensorflow_example_tpu.obs import tracer as tracer_lib
+from distributed_tensorflow_example_tpu.obs.metrics import MetricsLogger
+
+
+def _stack_available():
+    try:
+        from distributed_tensorflow_example_tpu.train import loop  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+needs_stack = pytest.mark.skipif(
+    not _stack_available(),
+    reason="training stack needs a newer jax than this environment has")
+
+
+class StubProfiler:
+    """Records the windowing contract instead of tracing."""
+
+    def __init__(self, raise_on_stop: bool = False):
+        self.starts = []
+        self.stops = 0
+        self.events = []
+        self._raise_on_stop = raise_on_stop
+
+    def start_trace(self, d):
+        self.starts.append(d)
+        self.events.append(("start", d))
+
+    def stop_trace(self):
+        self.stops += 1
+        self.events.append(("stop", None))
+        if self._raise_on_stop:
+            raise RuntimeError("synthetic corrupt-trace failure")
+
+    def _scope(self, label):
+        events = self.events
+
+        class _S:
+            def __enter__(self):
+                events.append(("enter", label))
+                return self
+
+            def __exit__(self, *exc):
+                events.append(("exit", label))
+                return False
+
+        return _S()
+
+    def StepTraceAnnotation(self, name, step_num=None):
+        return self._scope(f"{name}:{step_num}")
+
+    def TraceAnnotation(self, name):
+        return self._scope(name)
+
+    def start_server(self, port):
+        self.events.append(("server", port))
+        return ("server", port)
+
+
+# --- obs.tracer -----------------------------------------------------------
+
+
+def test_parse_profile_steps():
+    assert tracer_lib.parse_profile_steps("") is None
+    assert tracer_lib.parse_profile_steps("500:20") == (500, 20)
+    assert tracer_lib.parse_profile_steps("0:1") == (0, 1)
+    for bad in ("20", "a:b", "5:0", "-1:5", "1:2:3"):
+        with pytest.raises(ValueError):
+            tracer_lib.parse_profile_steps(bad)
+
+
+def test_windowed_capture_exactly_once(tmp_path):
+    """Window 5:3 over 12 host steps: start_trace at step 5, stop
+    before step 8 dispatches — called exactly once each."""
+    prof = StubProfiler()
+    tr = tracer_lib.WindowedTracer(str(tmp_path), window=(5, 3),
+                                   profiler=prof)
+    tr.begin_run()  # windowed mode: must NOT start here
+    assert prof.starts == []
+    for step in range(12):
+        tr.on_step(step)
+        with tr.step_annotation(step):
+            pass
+    tr.stop()  # idempotent final stop
+    assert len(prof.starts) == 1 and prof.stops == 1
+    assert tr.windows_captured == 1
+    # the trace went to <logs_path>/profile
+    assert prof.starts[0] == os.path.join(str(tmp_path), "profile")
+    # start fired before step 5's annotation; stop fired after the
+    # last in-window step (7) and before step 8 would have dispatched
+    # (post-window steps are no longer annotated at all)
+    ev = prof.events
+    assert ev.index(("start", prof.starts[0])) \
+        < ev.index(("enter", "train:5"))
+    assert ev.index(("exit", "train:7")) < ev.index(("stop", None))
+    assert ("enter", "train:8") not in ev
+
+
+def test_window_annotations_nest(tmp_path):
+    """TraceAnnotation scopes nest inside the StepTraceAnnotation —
+    enters/exits pair LIFO."""
+    prof = StubProfiler()
+    tr = tracer_lib.WindowedTracer(str(tmp_path), window=(0, 2),
+                                   profiler=prof)
+    tr.on_step(0)
+    with tr.step_annotation(0):
+        with tr.annotate("data_wait"):
+            pass
+        with tr.annotate("dispatch"):
+            pass
+    labels = [e for e in prof.events if e[0] in ("enter", "exit")]
+    assert labels == [("enter", "train:0"),
+                      ("enter", "data_wait"), ("exit", "data_wait"),
+                      ("enter", "dispatch"), ("exit", "dispatch"),
+                      ("exit", "train:0")]
+
+
+def test_crash_mid_window_still_stops(tmp_path):
+    """A run dying inside the window: the finally-path stop() closes
+    the trace; a stop_trace that itself raises is swallowed (the
+    original exception must not be masked)."""
+    prof = StubProfiler(raise_on_stop=True)
+    tr = tracer_lib.WindowedTracer(str(tmp_path), window=(1, 100),
+                                   profiler=prof)
+    tr.on_step(0)
+    tr.on_step(1)
+    assert tr.active
+    tr.stop()  # must not raise despite the stub raising
+    assert prof.stops == 1 and not tr.active
+    tr.stop()  # idempotent
+    assert prof.stops == 1
+
+
+def test_whole_run_mode_exception_safe(tmp_path):
+    """Legacy --profile: begin_run starts, stop() (the finally) stops
+    — exactly once each, no window arithmetic involved."""
+    prof = StubProfiler()
+    tr = tracer_lib.WindowedTracer(str(tmp_path), whole_run=True,
+                                   profiler=prof)
+    tr.begin_run()
+    for step in range(5):
+        tr.on_step(step)  # must not re-start or stop
+    tr.stop()
+    assert len(prof.starts) == 1 and prof.stops == 1
+
+
+def test_on_range_fast_path_granularity(tmp_path):
+    """Fast path traces at program granularity: only epochs
+    overlapping the window start the trace; the first program past
+    the window stops it."""
+    prof = StubProfiler()
+    tr = tracer_lib.WindowedTracer(str(tmp_path), window=(15, 5),
+                                   profiler=prof)
+    for epoch in range(4):  # 10 steps per epoch
+        tr.on_range(epoch * 10, (epoch + 1) * 10)
+    tr.stop()
+    # epoch 0 [0,10): no; epoch 1 [10,20): overlaps -> start; epoch 2
+    # [20,30): past the window -> stop before dispatch
+    assert len(prof.starts) == 1 and prof.stops == 1
+
+
+def test_disabled_tracer_is_inert(tmp_path):
+    prof = StubProfiler()
+    tr = tracer_lib.WindowedTracer(str(tmp_path), window=(0, 5),
+                                   enabled=False, profiler=prof)
+    tr.begin_run()
+    tr.on_step(0)
+    with tr.step_annotation(0), tr.annotate("dispatch"):
+        pass
+    tr.stop()
+    assert prof.events == []
+
+
+def test_boundary_signals_window_edges(tmp_path):
+    """boundary(step) is the host loop's drain-the-queue signal: True
+    exactly when on_step(step) will open or close the window (the
+    async dispatch queue must sync there or the trace captures the
+    device execution of earlier steps)."""
+    prof = StubProfiler()
+    tr2 = tracer_lib.WindowedTracer(str(tmp_path), window=(5, 3),
+                                    profiler=prof)
+    edges = []
+    for s in range(12):
+        if tr2.boundary(s):
+            edges.append(s)
+        tr2.on_step(s)
+    assert edges == [5, 8]
+    # whole-run and disabled tracers never ask for a drain
+    tr3 = tracer_lib.WindowedTracer(str(tmp_path), whole_run=True,
+                                    profiler=prof)
+    assert not any(tr3.boundary(s) for s in range(5))
+
+
+def test_anomaly_record_loss_is_strict_json():
+    """A NaN loss reaches the metrics event stream stringified, never
+    as a bare NaN literal (the schema contract)."""
+    fl = _StubFlight()
+
+    class _StubLogger:
+        events = []
+
+        def log_event(self, event, **fields):
+            self.events.append(fields)
+
+    ml = _StubLogger()
+    p = anomaly_lib.AnomalyPolicy("dump", flight=fl, mlogger=ml)
+    p.on_step(1, loss=float("nan"), flagged=True, counts=np.array([1]))
+    assert ml.events[0]["loss"] == "nan"
+    assert json.dumps(ml.events[0], allow_nan=False)  # strict-safe
+
+
+def test_profiler_server(tmp_path):
+    prof = StubProfiler()
+    tr = tracer_lib.WindowedTracer(str(tmp_path), profiler=prof)
+    assert tr.start_server(0) is None
+    assert tr.start_server(9999) == ("server", 9999)
+
+
+# --- obs.anomaly ----------------------------------------------------------
+
+
+def test_watchdog_nonfinite_and_divergence():
+    w = anomaly_lib.LossWatchdog(factor=10.0, warmup=3)
+    assert w.observe(0, float("nan")) == "nonfinite_loss"
+    assert w.observe(1, float("inf")) == "nonfinite_loss"
+    for i in range(4):
+        assert w.observe(i, 2.0) is None  # warmup absorbs
+    assert w.observe(10, 2.1) is None
+    assert w.observe(11, 50.0) == "divergence"
+    # the flagged loss did NOT drag the EMA up
+    assert w.ema == pytest.approx(2.0, rel=0.1)
+    assert w.observe(12, 2.0) is None
+
+
+def test_watchdog_no_flags_during_warmup():
+    w = anomaly_lib.LossWatchdog(factor=2.0, warmup=50)
+    # wild but finite swings during warmup stay unflagged
+    for i, loss in enumerate([1.0, 30.0, 0.1, 500.0]):
+        assert w.observe(i, loss) is None
+
+
+class _StubFlight:
+    def __init__(self):
+        self.anomalies = []
+        self.dumps = []
+
+    def record_anomaly(self, step, **fields):
+        self.anomalies.append(dict(step=step, **fields))
+
+    def dump(self, reason, exc=None):
+        self.dumps.append(reason)
+        return "/dev/null"
+
+
+def test_policy_halt_records_then_raises():
+    fl = _StubFlight()
+    p = anomaly_lib.AnomalyPolicy("halt", leaf_names=["['W1']", "['b1']"],
+                                  flight=fl)
+    assert p.on_step(1, loss=1.0, flagged=False) is False
+    with pytest.raises(anomaly_lib.AnomalyError, match="nonfinite_grads"):
+        p.on_step(2, loss=float("nan"), flagged=True,
+                  counts=np.array([7, 0]))
+    assert p.anomalies == 1
+    assert fl.anomalies and fl.anomalies[0]["blame"] == {"['W1']": 7}
+    assert fl.anomalies[0]["policy"] == "halt"
+
+
+def test_policy_dump_continues_and_bounds_writes():
+    fl = _StubFlight()
+    p = anomaly_lib.AnomalyPolicy("dump", flight=fl, max_dump_writes=2)
+    for step in range(5):
+        assert p.on_step(step, loss=float("nan"), flagged=True,
+                         counts=np.array([1]))
+    assert p.anomalies == 5
+    assert fl.dumps == ["anomaly", "anomaly"]  # bounded
+    assert p.skipped_steps == 0
+
+
+def test_policy_skip_accounting():
+    p = anomaly_lib.AnomalyPolicy("skip", flight=_StubFlight())
+    p.on_step(1, loss=float("nan"), flagged=True, counts=np.array([3]))
+    p.on_step(2, loss=1.0, flagged=False)
+    p.on_step(3, loss=float("nan"), flagged=True, counts=np.array([2]))
+    assert p.summary() == {"anomalies": 2, "skipped_steps": 2}
+
+
+def test_policy_on_epoch_fast_path():
+    """Post-hoc fast-path check: non-finite entries in the returned
+    cost array are per-step anomalies (and the skip accounting)."""
+    fl = _StubFlight()
+    p = anomaly_lib.AnomalyPolicy("skip", flight=fl)
+    costs = np.array([1.0, 2.0, float("nan"), 1.5, float("inf")])
+    bad = p.on_epoch(0, costs, base_step=100)
+    assert bad == 2
+    assert p.skipped_steps == 2
+    assert [a["step"] for a in fl.anomalies] == [103, 105]
+
+
+def test_policy_rejects_bad_mode():
+    with pytest.raises(ValueError):
+        anomaly_lib.AnomalyPolicy("explode")
+    with pytest.raises(ValueError):
+        anomaly_lib.AnomalyPolicy("")
+
+
+# --- obs.flight -----------------------------------------------------------
+
+
+def test_flight_ring_keeps_last_k(tmp_path):
+    fr = flight_lib.FlightRecorder(str(tmp_path), 0, capacity=4)
+    for i in range(10):
+        fr.record_step(i, epoch=0, batch_index=i)
+    path = fr.dump("test")
+    doc = flight_lib.read_flight(path)
+    assert [r["step"] for r in doc["steps"]] == [6, 7, 8, 9]
+    assert doc["last_step"] == 9
+    assert doc["proc"] == 0 and doc["reason"] == "test"
+    assert schema_lib.validate_flight_dump(doc) == []
+
+
+def test_flight_window_ring_survives_step_churn(tmp_path):
+    """Enriched window records live in their own ring: thousands of
+    bare per-step appends must not evict the few records carrying the
+    post-mortem signal (loss/timing)."""
+    fr = flight_lib.FlightRecorder(str(tmp_path), 0, capacity=4,
+                                   window_capacity=3)
+    fr.record_window(100, cost=1.5, timing={"steps": 100})
+    for i in range(101, 400):
+        fr.record_step(i, epoch=0, batch_index=i)
+    fr.record_window(200, cost=1.2, timing={"steps": 100})
+    doc = flight_lib.read_flight(fr.dump("crash"))
+    assert [w["step"] for w in doc["windows"]] == [100, 200]
+    assert doc["windows"][0]["cost"] == 1.5
+    assert [r["step"] for r in doc["steps"]] == [396, 397, 398, 399]
+    assert schema_lib.validate_flight_dump(doc) == []
+
+
+def test_flight_attach_loss_backfills_ring(tmp_path):
+    """The anomaly drain learns a step's loss after dispatch; it
+    backfills the matching ring record (and quietly no-ops for a
+    record already evicted)."""
+    fr = flight_lib.FlightRecorder(str(tmp_path), 0, capacity=4)
+    for i in range(1, 7):
+        fr.record_step(i, epoch=0)
+    fr.attach_loss(5, 2.25)
+    fr.attach_loss(1, 9.9)  # already evicted — no-op
+    recs = {r["step"]: r for r in fr.records}
+    assert recs[5]["loss"] == 2.25
+    assert "loss" not in recs[3]
+
+
+def test_flight_dump_is_strict_json_with_nonfinite(tmp_path):
+    """NaN/Inf losses must not produce a dump that a standards
+    parser rejects."""
+    fr = flight_lib.FlightRecorder(str(tmp_path), 0, capacity=4)
+    fr.record_step(1, cost=float("nan"))
+    fr.record_anomaly(1, reasons=["nonfinite_loss"], policy="dump",
+                      loss=float("inf"))
+    path = fr.dump("anomaly")
+    raw = open(path).read()
+    assert "NaN" not in raw and "Infinity" not in raw
+    doc = json.loads(raw)  # strict-parseable
+    assert doc["steps"][0]["cost"] == "nan"
+    assert schema_lib.validate_flight_dump(doc) == []
+
+
+def test_flight_dump_carries_exception_and_env(tmp_path):
+    fr = flight_lib.FlightRecorder(str(tmp_path), 0,
+                                   config={"seed": 1, "lr": 5e-4})
+    try:
+        raise RuntimeError("mid-step boom")
+    except RuntimeError as e:
+        path = fr.dump("crash", exc=e)
+    doc = flight_lib.read_flight(path)
+    assert doc["exception"]["type"] == "RuntimeError"
+    assert "mid-step boom" in doc["exception"]["message"]
+    assert any("mid-step boom" in ln
+               for ln in doc["exception"]["traceback"])
+    env = doc["env"]
+    assert env["pid"] == os.getpid()
+    assert env["config"]["seed"] == 1
+    assert "python" in env
+
+
+def test_flight_excepthook_chains(tmp_path):
+    fr = flight_lib.FlightRecorder(str(tmp_path), 1)
+    fr.record_step(42)
+    seen = []
+    old = sys.excepthook
+    sys.excepthook = lambda *a: seen.append(a)
+    try:
+        fr.install()
+        try:
+            raise ValueError("unhandled")
+        except ValueError:
+            sys.excepthook(*sys.exc_info())
+        doc = flight_lib.read_flight(fr.path)
+        assert doc["reason"] == "crash"
+        assert doc["exception"]["type"] == "ValueError"
+        assert seen, "previous excepthook must still run"
+    finally:
+        fr.uninstall()
+        sys.excepthook = old
+    assert sys.excepthook is old  # uninstall restored the chain
+
+
+def test_flight_sigusr1_dump_and_stacks(tmp_path):
+    """kill -USR1: flight dump + faulthandler stack file from a live
+    process, handlers restored on uninstall."""
+    fr = flight_lib.FlightRecorder(str(tmp_path), 0, capacity=8)
+    fr.record_step(7, epoch=0)
+    prev = signal.getsignal(signal.SIGUSR1)
+    fr.install()
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        # the handler runs at the next bytecode boundary
+        for _ in range(100):
+            if os.path.exists(fr.path):
+                break
+        doc = flight_lib.read_flight(fr.path)
+        assert doc["reason"] == "sigusr1"
+        assert doc["last_step"] == 7
+        assert schema_lib.validate_flight_file(fr.path) == []
+        stacks = open(fr.stacks_path).read()
+        assert "test_flight_sigusr1_dump_and_stacks" in stacks
+    finally:
+        fr.uninstall()
+    assert signal.getsignal(signal.SIGUSR1) == prev
+
+
+def test_flight_dump_never_raises(tmp_path, monkeypatch):
+    fr = flight_lib.FlightRecorder(str(tmp_path), 0)
+    monkeypatch.setattr(flight_lib.os, "replace",
+                        lambda *a: (_ for _ in ()).throw(OSError("full")))
+    assert fr.dump("crash") is None  # degraded, not raised
+
+
+def test_collate_post_mortem(tmp_path):
+    """Chief collator: per-proc last step/reason, the step spread
+    (blast radius) and merged anomalies, written to report.json."""
+    for proc, last, reason in ((0, 120, "crash"), (1, 90, "sigusr1")):
+        fr = flight_lib.FlightRecorder(str(tmp_path), proc, capacity=4)
+        fr.record_step(last, epoch=0)
+        if proc == 1:
+            fr.record_anomaly(88, reasons=["divergence"], policy="halt")
+        fr.dump(reason)
+    rep = flight_lib.collate(str(tmp_path))
+    assert rep["proc_count"] == 2
+    assert rep["min_last_step"] == 90 and rep["max_last_step"] == 120
+    assert rep["step_spread"] == 30
+    assert rep["slowest_proc"] == "1"
+    assert [a["step"] for a in rep["anomalies"]] == [88]
+    on_disk = json.load(
+        open(os.path.join(str(tmp_path), "flight", "report.json")))
+    assert on_disk["step_spread"] == 30
+
+
+def test_collate_empty(tmp_path):
+    rep = flight_lib.collate(str(tmp_path))
+    assert rep["proc_count"] == 0 and rep["step_spread"] is None
+
+
+# --- obs.schema -----------------------------------------------------------
+
+
+def _full_window_fields():
+    """Every field the train loop's metrics_row emits (docs schema)."""
+    return dict(step=100, epoch=0, cost=1.5, path="host", steps=50,
+                window_wall_s=0.4, step_time_p50_ms=8.0,
+                step_time_p95_ms=9.5, step_time_max_ms=22.0,
+                data_wait_s=0.01, dispatch_s=0.1, device_wait_s=0.2,
+                host_s=0.09, examples_per_sec=1950.0,
+                tokens_per_sec=None, model_flops_per_step=4.8e6,
+                tflops_per_sec=0.012, mfu=None)
+
+
+def test_schema_validates_real_metrics_file(tmp_path):
+    m = MetricsLogger(str(tmp_path), process_index=0)
+    m.log_window(**_full_window_fields())
+    m.log_event("compile", what="train_step", dispatch_wall_s=0.7)
+    m.log_event("anomaly", step=3, reasons=["divergence"], policy="dump")
+    m.close()
+    assert schema_lib.validate_metrics_file(m.path) == []
+
+
+def test_schema_flags_drift(tmp_path):
+    """A renamed/missing/mistyped field fails loudly — the contract
+    the dashboards depend on."""
+    fields = _full_window_fields()
+    del fields["step_time_p95_ms"]          # dropped field
+    fields["data_wait_s"] = "0.01"          # wrong type
+    m = MetricsLogger(str(tmp_path), process_index=0)
+    m.log_window(**fields)
+    m.close()
+    errs = schema_lib.validate_metrics_file(m.path)
+    assert any("step_time_p95_ms" in e and "missing" in e for e in errs)
+    assert any("data_wait_s" in e and "type" in e for e in errs)
+    # unknown kinds are drift too
+    assert schema_lib.validate_metrics_row(
+        {"kind": "windoww", "t": 1.0, "proc": 0})
+    # and non-JSON lines
+    with open(m.path, "a") as f:
+        f.write("not json\n")
+    assert any("not JSON" in e
+               for e in schema_lib.validate_metrics_file(m.path))
+
+
+def test_schema_flight_records_checked(tmp_path):
+    doc = {"version": 1, "proc": 0, "reason": "crash", "t": 1.0,
+           "last_step": 5, "steps": [{"step": 5, "t": 1.0}],
+           "windows": [{"step": 5, "t": 1.0, "cost": 1.0}],
+           "anomalies": [{"step": 5, "t": 1.0, "reasons": ["x"],
+                          "policy": "halt"}],
+           "env": {}}
+    assert schema_lib.validate_flight_dump(doc) == []
+    doc["steps"].append({"t": 1.0})  # record missing its step id
+    doc["anomalies"][0].pop("policy")
+    errs = schema_lib.validate_flight_dump(doc)
+    assert any("steps[1]" in e and "step" in e for e in errs)
+    assert any("anomalies[0]" in e and "policy" in e for e in errs)
+
+
+# --- end-to-end through train.loop ---------------------------------------
+
+
+def _tiny(tmp_path, **kw):
+    from distributed_tensorflow_example_tpu.config import Config
+
+    return Config(training_epochs=1, batch_size=16, dataset="synthetic",
+                  synthetic_train_size=160, synthetic_test_size=32,
+                  logs_path=str(tmp_path), frequency=5, summaries=False,
+                  fast_loop=False, compilation_cache="", **kw)
+
+
+@needs_stack
+def test_flag_validation():
+    from distributed_tensorflow_example_tpu.config import Config
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    with pytest.raises(ValueError, match="profile_steps"):
+        run(Config(profile_steps="oops"))
+    with pytest.raises(ValueError, match="replaces"):
+        run(Config(profile=True, profile_steps="5:2"))
+    with pytest.raises(ValueError, match="debug_nans"):
+        run(Config(on_anomaly="halt", debug_nans=True))
+    with pytest.raises(ValueError, match="skip"):
+        run(Config(on_anomaly="skip", fsdp=True))
+    with pytest.raises(ValueError, match="on_anomaly"):
+        run(Config(on_anomaly="explode"))
+    with pytest.raises(ValueError, match="flight_steps"):
+        run(Config(flight=True, flight_steps=0))
+    with pytest.raises(ValueError, match="anomaly_factor"):
+        run(Config(on_anomaly="halt", anomaly_factor=1.0))
+
+
+@needs_stack
+def test_anomaly_halt_leaves_flight_dump(tmp_path):
+    """Injected blowup (lr=1e30): the run raises AnomalyError and
+    leaves a parseable flight/<proc>.json with per-leaf blame."""
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    # naive_ce (the reference's unstable log(softmax)) + a huge lr:
+    # step 2's saturated softmax yields inf/inf = NaN loss and NaN
+    # grads — the deterministic blowup injection
+    with pytest.raises(anomaly_lib.AnomalyError):
+        run(_tiny(tmp_path, learning_rate=1e30, naive_ce=True,
+                  on_anomaly="halt"))
+    path = os.path.join(str(tmp_path), "flight", "0.json")
+    doc = flight_lib.read_flight(path)
+    assert schema_lib.validate_flight_dump(doc) == []
+    assert doc["reason"] == "anomaly_halt"
+    assert doc["exception"]["type"] == "AnomalyError"
+    assert doc["anomalies"], "the anomaly must be in the dump"
+    assert doc["steps"], "ring records must be in the dump"
+    # per-leaf blame names resolve to real param leaves when the
+    # gradients (not just the loss) went non-finite
+    blames = [a["blame"] for a in doc["anomalies"] if a.get("blame")]
+    for b in blames:
+        assert all(k.startswith("[") for k in b)
+    # the chief collated a post-mortem report
+    rep = json.load(open(os.path.join(str(tmp_path), "flight",
+                                      "report.json")))
+    assert rep["procs"]["0"]["reason"] == "anomaly_halt"
+
+
+@needs_stack
+def test_anomaly_halt_forces_host_loop(tmp_path):
+    """halt + the default fast loop: a whole-run device program can
+    only be judged after it completed, so halt forces the host loop —
+    the run stops promptly (not after every epoch ran) and the dump's
+    ring records carry the drained losses."""
+    from distributed_tensorflow_example_tpu.config import Config
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    with pytest.raises(anomaly_lib.AnomalyError):
+        run(Config(training_epochs=3, batch_size=16,
+                   dataset="synthetic", synthetic_train_size=160,
+                   synthetic_test_size=32, logs_path=str(tmp_path),
+                   frequency=5, summaries=False, compilation_cache="",
+                   naive_ce=True, learning_rate=1e30,
+                   on_anomaly="halt"))  # fast_loop left at default True
+    doc = flight_lib.read_flight(
+        os.path.join(str(tmp_path), "flight", "0.json"))
+    assert doc["reason"] == "anomaly_halt"
+    # halted inside epoch 0 — far before the 30-step whole run ended
+    assert doc["last_step"] < 10
+    # the anomaly drain backfilled the fetched loss into the ring
+    assert any("loss" in r for r in doc["steps"])
+
+
+@needs_stack
+def test_anomaly_skip_accounts_and_completes(tmp_path):
+    """--on_anomaly=skip: the blowup is skipped on-device, the run
+    completes, skipped steps are accounted in the result."""
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    res = run(_tiny(tmp_path, learning_rate=1e30, naive_ce=True,
+                    on_anomaly="skip"))
+    assert res["anomalies"] >= 1
+    assert res["skipped_steps"] >= 1
+    assert res["steps"] == 10  # every step attempted
+
+
+@needs_stack
+def test_crash_mid_step_dumps_flight_and_stops_trace(tmp_path, monkeypatch):
+    """Killing the run mid-step (injected exception on step 4): the
+    flight dump exists with the exception, and the windowed profiler
+    trace that was open is STOPPED exactly once (stubbed profiler)."""
+    import jax
+
+    from distributed_tensorflow_example_tpu.train import loop as loop_mod
+
+    prof = StubProfiler()
+    monkeypatch.setattr(jax.profiler, "start_trace", prof.start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", prof.stop_trace)
+    monkeypatch.setattr(jax.profiler, "StepTraceAnnotation",
+                        prof.StepTraceAnnotation)
+    monkeypatch.setattr(jax.profiler, "TraceAnnotation",
+                        prof.TraceAnnotation)
+
+    real_build = loop_mod.step_lib.build_train_step
+
+    def crashing_build(*a, **kw):
+        step = real_build(*a, **kw)
+        calls = {"n": 0}
+
+        def wrapped(*sa, **skw):
+            calls["n"] += 1
+            if calls["n"] == 4:
+                raise RuntimeError("injected mid-step crash")
+            return step(*sa, **skw)
+
+        return wrapped
+
+    monkeypatch.setattr(loop_mod.step_lib, "build_train_step",
+                        crashing_build)
+    with pytest.raises(RuntimeError, match="injected"):
+        loop_mod.run(_tiny(tmp_path, flight=True, profile_steps="2:50"))
+    # flight dump with the crash context
+    doc = flight_lib.read_flight(
+        os.path.join(str(tmp_path), "flight", "0.json"))
+    assert schema_lib.validate_flight_dump(doc) == []
+    assert doc["reason"] == "crash"
+    assert "injected mid-step crash" in doc["exception"]["message"]
+    assert doc["last_step"] == 3  # three completed steps in the ring
+    # the open trace window was terminated by the finally, exactly once
+    assert len(prof.starts) == 1 and prof.stops == 1
+
+
+@needs_stack
+def test_profile_steps_windowed_run(tmp_path, monkeypatch):
+    """A clean host-path run with --profile_steps 3:2: start/stop
+    exactly once, step annotations only inside the window's span, and
+    the run result reports the captured window."""
+    import jax
+
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    prof = StubProfiler()
+    monkeypatch.setattr(jax.profiler, "start_trace", prof.start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", prof.stop_trace)
+    monkeypatch.setattr(jax.profiler, "StepTraceAnnotation",
+                        prof.StepTraceAnnotation)
+    monkeypatch.setattr(jax.profiler, "TraceAnnotation",
+                        prof.TraceAnnotation)
+    res = run(_tiny(tmp_path, profile_steps="3:2"))
+    assert len(prof.starts) == 1 and prof.stops == 1
+    assert res["profile_windows"] == 1
+    ev = prof.events
+    assert ev.index(("start", prof.starts[0])) \
+        < ev.index(("enter", "train:3"))
+    assert ev.index(("exit", "train:4")) < ev.index(("stop", None))
+    assert ("enter", "train:5") not in ev  # window closed, no scopes
+
+
+@needs_stack
+def test_profile_window_past_training_end_closes_before_eval(tmp_path,
+                                                             monkeypatch):
+    """A window still open when training ends (8:50 on a 10-step run)
+    is closed BEFORE final eval/sampling — the capture is the
+    requested steps, not the shutdown tail."""
+    import jax
+
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    prof = StubProfiler()
+    monkeypatch.setattr(jax.profiler, "start_trace", prof.start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", prof.stop_trace)
+    monkeypatch.setattr(jax.profiler, "StepTraceAnnotation",
+                        prof.StepTraceAnnotation)
+    monkeypatch.setattr(jax.profiler, "TraceAnnotation",
+                        prof.TraceAnnotation)
+    res = run(_tiny(tmp_path, profile_steps="8:50"))
+    assert len(prof.starts) == 1 and prof.stops == 1
+    assert res["profile_windows"] == 1
+    # no eval scope inside the capture: the trace closed first
+    assert ("enter", "eval") not in prof.events
+
+
+@needs_stack
+def test_flight_records_through_run(tmp_path):
+    """--flight + --metrics on a clean run: no dump (nothing failed),
+    but a SIGUSR1-style manual dump carries window records with the
+    timing split."""
+    from distributed_tensorflow_example_tpu.train import loop as loop_mod
+
+    res = loop_mod.run(_tiny(tmp_path, flight=True, metrics=True,
+                             log_every=5))
+    assert res["anomalies"] == 0
+    # a clean run leaves no dump
+    assert not os.path.exists(
+        os.path.join(str(tmp_path), "flight", "0.json"))

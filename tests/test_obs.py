@@ -231,6 +231,11 @@ def test_metrics_jsonl_host_path(tmp_path):
         bench._model_flops_per_step((100,), 16)
     events = {r["event"] for r in rows if r["kind"] == "event"}
     assert {"compile", "stragglers", "run_end"} <= events
+    # the REAL stream satisfies the written contract (obs/schema.py):
+    # telemetry format drift fails here, at the commit that causes it
+    from distributed_tensorflow_example_tpu.obs import schema as schema_lib
+
+    assert schema_lib.validate_metrics_file(files[0]) == []
     beats = hb_lib.read_heartbeats(str(tmp_path))
     assert beats[0][0] == 100
 

@@ -2119,7 +2119,10 @@ def test_pp_1f1b_validation():
 def test_generate_dp_matches_host(devices8):
     """generate_dp (prompt batch sharded over 'data') must reproduce
     the host generate exactly under greedy decoding — including a
-    batch that does not divide the data axis (pad + slice)."""
+    batch that does not divide the data axis. The contract is
+    SYMMETRIC across process counts (r5 ADVICE): always the padded
+    global array + the valid count, with dp_samples_host doing the
+    slice."""
     from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
 
     spec = _lm_spec()
@@ -2130,8 +2133,11 @@ def test_generate_dp_matches_host(devices8):
     host = np.asarray(tfm.generate(spec, params, prompts, rng=None,
                                    temperature=0.0))
     mesh = mesh_lib.build_mesh(4, 1, devices=devices8[:4])
-    dp_out = np.asarray(tfm.generate_dp(spec, params, prompts, mesh,
-                                        rng=None, temperature=0.0))
+    padded, n = tfm.generate_dp(spec, params, prompts, mesh,
+                                rng=None, temperature=0.0)
+    assert n == 6
+    assert padded.shape[0] == 8  # 6 padded up to the data axis (4)
+    dp_out = tfm.dp_samples_host(padded, n)
     np.testing.assert_array_equal(dp_out, host)
 
 
@@ -2153,9 +2159,10 @@ def test_generate_dp_tp_matches_host(devices8):
 
     placed = {k: jax.device_put(v, NamedSharding(mesh, pspecs[k]))
               for k, v in params.items()}
-    dp_out = np.asarray(tfm.generate_dp(
+    padded, n = tfm.generate_dp(
         spec, placed, prompts, mesh, model_axis=mesh_lib.MODEL_AXIS,
-        rng=None, temperature=0.0))
+        rng=None, temperature=0.0)
+    dp_out = tfm.dp_samples_host(padded, n)
     np.testing.assert_array_equal(dp_out, host)
 
 
@@ -2169,9 +2176,9 @@ def test_generate_dp_sampled_finite(devices8):
     rng = np.random.RandomState(47)
     prompts = jnp.asarray(rng.randint(0, 16, size=(8, 8)), jnp.int32)
     mesh = mesh_lib.build_mesh(4, 1, devices=devices8[:4])
-    out = np.asarray(tfm.generate_dp(spec, params, prompts, mesh,
-                                     rng=jax.random.PRNGKey(9),
-                                     temperature=1.0))
+    out = tfm.dp_samples_host(*tfm.generate_dp(
+        spec, params, prompts, mesh, rng=jax.random.PRNGKey(9),
+        temperature=1.0))
     assert out.shape == (8, spec.seq_len)
     assert out.min() >= 0 and out.max() < spec.vocab_size
     # prompt teacher-forced
