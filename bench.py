@@ -288,6 +288,23 @@ def _forensics_row_fields(tdir: str, profile_steps: str = ""):
         errs = schema_lib.validate_metrics_file(mfiles[0])
         if errs:
             fields["metrics_schema_errors"] = errs[:5]
+        # the cold run's goodput decomposition rides the row (dtx-obs
+        # report over the forensics capture), so BENCH_*.json carries
+        # goodput context alongside the wall-clock
+        try:
+            from distributed_tensorflow_example_tpu.obs.aggregate import (
+                aggregate, summary_line)
+
+            rep = aggregate(tdir)
+            g = rep["goodput"]
+            fields["goodput_summary"] = {
+                "line": summary_line(rep),
+                "goodput_frac": g.get("goodput_frac"),
+                "wall_s": g.get("wall_s"),
+                "buckets": g.get("buckets"),
+            }
+        except Exception as e:  # analytics must never void the capture
+            fields["goodput_error"] = str(e)[:120]
     if profile_steps:
         fields["profile_trace_path"] = os.path.join(tdir, "profile")
         fields["profile_steps"] = profile_steps
@@ -362,6 +379,10 @@ def bench_config(name: str, cfg, epochs_full: int = 20, repeats: int = 5,
     if forensics_dir is not None:
         try:
             row.update(_forensics_row_fields(forensics_dir, profile_steps))
+            if "goodput_summary" in row:
+                print(f"[bench] {name}: "
+                      f"{row['goodput_summary']['line']}",
+                      file=sys.stderr, flush=True)
         except Exception as e:  # forensics must never void the measurement
             row["forensics_error"] = str(e)[:200]
         # nothing in the row points at the dir once the compile events
@@ -1414,6 +1435,32 @@ def bench_pallas_parity():
     return out
 
 
+def _gate_verdict(gate_path: str, candidate: dict) -> int:
+    """--gate: compare the final summary against a recorded baseline
+    (BASELINE.json, a BENCH_*.json capture, a saved final summary or
+    an obs run report). Runs ONLY after every row and the final
+    summary line were printed — a gate failure gates the exit code,
+    never the evidence (the r5 lesson: a crash mid-driver voided half
+    a round's rows; guarded()/emit print rows as they complete and
+    the verdict is strictly last). Exit: 0 pass, 3 regression, 2
+    unusable gate file."""
+    from distributed_tensorflow_example_tpu.obs import compare as cmp_lib
+
+    try:
+        base = cmp_lib.load_doc(gate_path)
+    except (OSError, ValueError) as e:
+        print(json.dumps({"gate": gate_path,
+                          "gate_error": str(e)[:200]}))
+        return 2
+    verdict = cmp_lib.compare(base, candidate)
+    print(json.dumps({"gate": gate_path, **verdict}))
+    if not verdict["compared"]:
+        print(f"[bench] gate: no overlapping metrics with {gate_path}",
+              file=sys.stderr)
+        return 2
+    return 0 if verdict["ok"] else 3
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--epochs", type=int, default=20)
@@ -1425,6 +1472,14 @@ def main(argv=None) -> int:
                    help="windowed profiler capture on each headline "
                         "config's cold run; the trace path lands in "
                         "the row JSON (profile_trace_path)")
+    p.add_argument("--gate", type=str, default="",
+                   metavar="BASELINE_JSON",
+                   help="regression gate: after the full sweep, "
+                        "compare the final summary against this "
+                        "recorded baseline (BASELINE.json / a "
+                        "BENCH_*.json capture / a saved summary / an "
+                        "obs run report) and exit 3 on regression — "
+                        "every row is still printed first")
     args = p.parse_args(argv)
     # forwarded only when set: the row stubs in the smoke tests (and
     # any external bench_config monkeypatch) keep their old signature
@@ -1675,13 +1730,18 @@ def main(argv=None) -> int:
         extra["real_mnist_in_reference_band"] = mnist_row.get(
             "in_reference_band")
 
-    print(json.dumps({
+    final = {
         "metric": "mnist_20epoch_wall_clock",
         "value": round(wall, 3),
         "unit": "s",
         "vs_baseline": (round(baseline_s / wall, 3) if baseline_s else None),
         **extra,
-    }))
+    }
+    print(json.dumps(final))
+    if args.gate:
+        # strictly after every row and the final line: the gate only
+        # decides the exit code, it cannot truncate the evidence
+        return _gate_verdict(args.gate, final)
     return 0
 
 

@@ -250,6 +250,12 @@ class Config:
                                         # chief straggler report (obs/)
     log_every: int = 100                # metrics window size in steps; also
                                         # the histogram-summary cadence
+    status_port: int = 0                # > 0: chief serves live run
+                                        # status over HTTP — /status
+                                        # JSON, /metrics Prometheus
+                                        # text, /report (obs/serve.py;
+                                        # dtx-obs serve re-serves a
+                                        # finished run offline)
     histograms: bool = False            # grad-norm/param-norm/learning-rate
                                         # summaries every --log_every steps,
                                         # fetched alongside the windowed
@@ -508,6 +514,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log_every", type=int, default=d.log_every,
                    help="metrics window size in steps (also the "
                         "--histograms summary cadence)")
+    p.add_argument("--status_port", type=int, default=d.status_port,
+                   help="serve live run status over HTTP on this port "
+                        "(chief only): /status JSON, /metrics "
+                        "Prometheus text, /report goodput report "
+                        "(dtx-obs serve re-serves finished runs)")
     p.add_argument("--histograms", action="store_true",
                    help="emit grad-norm/param-norm histogram and "
                         "learning-rate summaries into the event file "

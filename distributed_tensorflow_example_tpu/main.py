@@ -42,6 +42,11 @@ def main(argv=None) -> int:
                             ("DTX_FLIGHT", "flight")):
         if env_flag(env_name) and not getattr(cfg, field):
             cfg = cfg.replace(**{field: True})
+    # DTX_STATUS_PORT=P: the live /status + Prometheus endpoint
+    # (obs/serve.py), fleet-enabled the same way
+    port = os.environ.get("DTX_STATUS_PORT", "").strip()
+    if port.isdigit() and int(port) and not cfg.status_port:
+        cfg = cfg.replace(status_port=int(port))
     run(cfg)
     return 0
 

@@ -33,8 +33,24 @@ and the failure-forensics layer (the run explains its own failures):
                 records + env snapshot, dumped to
                 <logs_path>/flight/<proc>.json on crash, anomaly or
                 SIGUSR1; chief-side collate() post-mortem report
-    schema      the written-down metrics/flight format contract +
-                validators (bench.py and tier-1 pin it)
+    schema      the written-down metrics/flight/report format
+                contract + validators (bench.py and tier-1 pin it),
+                SCHEMA_VERSION stamped into every row
+
+and the read side that consumes all of the above (PR 4):
+
+    aggregate   fold one run's metrics/heartbeats/flight dumps into
+                the run report — goodput/badput wall-time
+                decomposition, cross-process step-time percentiles,
+                MFU trajectory, anomaly/restart timeline
+    compare     A/B two runs (or a run vs a BASELINE/BENCH row) with
+                relative thresholds -> machine-readable regression
+                verdict; bench.py --gate wires it into CI
+    serve       stdlib-only live status server: /status JSON,
+                /metrics Prometheus text, /report — started on the
+                chief via --status_port, or offline re-serving
+    cli         the ``dtx-obs`` console script: report / compare /
+                tail / serve / validate
 
 Enabled by ``--metrics`` (with ``--log_every`` windows); grad/param
 norm histograms ride the event file via ``--histograms``
@@ -42,7 +58,14 @@ norm histograms ride the event file via ``--histograms``
 docs/observability.md.
 """
 
+# NOTE: the aggregate()/compare() FUNCTIONS are deliberately not
+# re-exported at package level — they share their module's name, and
+# rebinding ``obs.aggregate`` to a function would shadow the submodule
+# (use ``obs.aggregate.aggregate`` / ``from ...obs.aggregate import
+# aggregate``).
+from .aggregate import BUCKETS, load_run, metrics_files, summary_line  # noqa: F401
 from .anomaly import AnomalyError, AnomalyPolicy, LossWatchdog  # noqa: F401
+from .compare import GATE_METRICS, extract_metrics  # noqa: F401
 from .flight import FlightRecorder, collate, env_snapshot, read_flight  # noqa: F401
 from .flops import (  # noqa: F401
     PEAK_BF16_FLOPS,
@@ -53,12 +76,21 @@ from .flops import (  # noqa: F401
     model_flops_per_step,
     tokens_per_example,
 )
-from .heartbeat import Heartbeat, read_heartbeats, straggler_report  # noqa: F401
+from .heartbeat import (  # noqa: F401
+    Heartbeat,
+    clear_stale_signals,
+    read_heartbeats,
+    straggler_report,
+)
 from .metrics import MetricsLogger, WindowTimer, read_metrics  # noqa: F401
 from .schema import (  # noqa: F401
+    SCHEMA_VERSION,
     validate_flight_dump,
     validate_flight_file,
     validate_metrics_file,
     validate_metrics_row,
+    validate_run_report,
+    validate_version,
 )
+from .serve import StatusServer, collect_status, prometheus_text  # noqa: F401
 from .tracer import WindowedTracer, parse_profile_steps  # noqa: F401

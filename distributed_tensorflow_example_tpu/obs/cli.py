@@ -1,0 +1,327 @@
+"""``dtx-obs`` — the operator CLI over the obs/ telemetry.
+
+Subcommands (``dtx-obs <cmd> --help`` for flags):
+
+- ``report LOGS``   — aggregate a run's logs into the run report
+  (obs/aggregate.py): goodput decomposition, step-time percentiles,
+  throughput/MFU, anomaly timeline. ``--summary`` prints the one-line
+  form instead of JSON;
+- ``compare BASE CAND`` — A/B two runs/reports/bench rows
+  (obs/compare.py); exit 3 on regression — usable directly as a CI
+  gate;
+- ``tail LOGS``     — one line per metrics window (plus anomaly/
+  run_end events), ``-f`` to follow a live run;
+- ``serve LOGS``    — (re-)serve a run directory over HTTP: /status,
+  /metrics (Prometheus), /report (obs/serve.py). Works identically
+  on a finished run and alongside a live one;
+- ``validate PATH...`` — run the obs/schema.py validators over
+  metrics JSONL files / flight dumps / run reports / whole logs
+  dirs; exit 1 on drift, 2 on unreadable input, with the precise
+  schema-version diagnosis for old-format logs.
+
+Exit codes: 0 ok; 1 validation failure; 2 bad input (missing files,
+no metrics stream); 3 regression verdict (compare).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from . import aggregate as agg_lib
+from . import compare as cmp_lib
+from . import schema as schema_lib
+from . import serve as serve_lib
+
+
+def _fmt(v, nd=4) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}g}"
+    return str(v)
+
+
+def format_row(row: Dict[str, Any]) -> Optional[str]:
+    """One terminal line per window row; anomaly/stragglers/run_end
+    events ride along; other rows (compile etc.) map to None."""
+    kind = row.get("kind")
+    proc = row.get("proc", "?")
+    if kind == "window":
+        return (f"[p{proc}] step {_fmt(row.get('step'))} "
+                f"ep {_fmt(row.get('epoch'))} "
+                f"cost {_fmt(row.get('cost'))} "
+                f"p50 {_fmt(row.get('step_time_p50_ms'))}ms "
+                f"p95 {_fmt(row.get('step_time_p95_ms'))}ms "
+                f"ex/s {_fmt(row.get('examples_per_sec'))} "
+                f"mfu {_fmt(row.get('mfu'))}")
+    if kind == "event":
+        ev = row.get("event")
+        if ev == "anomaly":
+            return (f"[p{proc}] ANOMALY step {_fmt(row.get('step'))} "
+                    f"{','.join(row.get('reasons') or [])} "
+                    f"policy={row.get('policy')}")
+        if ev == "stragglers":
+            return (f"[p{proc}] stragglers: lag "
+                    f"{_fmt(row.get('max_step_lag'))} steps "
+                    f"(slowest p{_fmt(row.get('slowest_proc'))})")
+        if ev == "run_end":
+            return (f"[p{proc}] run_end: steps {_fmt(row.get('steps'))} "
+                    f"wall {_fmt(row.get('total_time_s'))}s "
+                    f"acc {_fmt(row.get('test_accuracy'))}")
+    return None
+
+
+def _metrics_files(logs_path: str) -> List[str]:
+    return [path for _pid, path in agg_lib.metrics_files(logs_path)]
+
+
+def cmd_report(args) -> int:
+    try:
+        report = agg_lib.aggregate(args.logs_path)
+    except FileNotFoundError as e:
+        print(f"dtx-obs report: {e}", file=sys.stderr)
+        return 2
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    if args.summary:
+        print(agg_lib.summary_line(report))
+    elif not args.out:
+        print(json.dumps(report, indent=None if args.compact else 1))
+    if report["schema_error_count"] and not args.summary:
+        print(f"NOTE: {report['schema_error_count']} schema error(s) — "
+              f"see report['schema_errors']", file=sys.stderr)
+    return 0
+
+
+def cmd_compare(args) -> int:
+    try:
+        base = cmp_lib.load_doc(args.base)
+        cand = cmp_lib.load_doc(args.cand)
+    except (OSError, ValueError) as e:
+        print(f"dtx-obs compare: {e}", file=sys.stderr)
+        return 2
+    thresholds = {}
+    for spec in (args.thresholds or "").split(","):
+        if not spec.strip():
+            continue
+        name, _, val = spec.partition("=")
+        if name.strip() not in cmp_lib.GATE_METRICS:
+            print(f"dtx-obs compare: unknown metric {name.strip()!r} "
+                  f"(known: {sorted(cmp_lib.GATE_METRICS)})",
+                  file=sys.stderr)
+            return 2
+        try:
+            thresholds[name.strip()] = float(val)
+        except ValueError:
+            print(f"dtx-obs compare: bad threshold {spec.strip()!r} "
+                  f"(want NAME=REL, e.g. wall_s=0.1)", file=sys.stderr)
+            return 2
+    verdict = cmp_lib.compare(base, cand, thresholds=thresholds or None,
+                              default_threshold=args.threshold)
+    print(json.dumps(verdict, indent=None if args.compact else 1))
+    if not verdict["compared"]:
+        print("dtx-obs compare: no overlapping metrics between the two "
+              "documents", file=sys.stderr)
+        return 2
+    return 0 if verdict["ok"] else 3
+
+
+def cmd_tail(args) -> int:
+    files = _metrics_files(args.logs_path)
+    if not files and not args.follow:
+        print(f"dtx-obs tail: no metrics.<proc>.jsonl under "
+              f"{args.logs_path!r}", file=sys.stderr)
+        return 2
+    # print the last -n formatted lines across streams, then follow
+    offsets: Dict[str, int] = {}
+    backlog: List[tuple] = []
+    for path in files:
+        rows = serve_lib.tail_rows(path)
+        offsets[path] = os.path.getsize(path)
+        for r in rows:
+            line = format_row(r)
+            if line is not None:
+                backlog.append((r.get("t") or 0.0, line))
+    backlog.sort()
+    for _, line in backlog[-args.lines:]:
+        print(line)
+    if not args.follow:
+        return 0
+    try:
+        while True:
+            time.sleep(args.interval)
+            for path in _metrics_files(args.logs_path):
+                off = offsets.get(path, 0)
+                try:
+                    size = os.path.getsize(path)
+                    if size <= off:
+                        continue
+                    with open(path, "rb") as f:
+                        f.seek(off)
+                        data = f.read()
+                    # consume only whole lines: a poll landing mid-
+                    # append must leave the torn tail for next time,
+                    # not split it into two unparseable halves
+                    nl = data.rfind(b"\n")
+                    if nl < 0:
+                        continue
+                    chunk = data[:nl + 1].decode("utf-8",
+                                                 errors="replace")
+                    offsets[path] = off + nl + 1
+                except OSError:
+                    continue
+                for ln in chunk.splitlines():
+                    try:
+                        line = format_row(json.loads(ln))
+                    except ValueError:
+                        continue
+                    if line is not None:
+                        print(line, flush=True)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_serve(args) -> int:
+    srv = serve_lib.StatusServer(args.logs_path)
+    port = srv.start(args.port, host=args.host)
+    if port is None:
+        return 2
+    print(f"dtx-obs serve: http://{args.host or 'localhost'}:{port}"
+          f"  (/status /metrics /report)  logs={args.logs_path}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        srv.close()
+
+
+def _validate_one(path: str) -> List[str]:
+    """Route one file to the right obs/schema.py validator by shape."""
+    base = os.path.basename(path)
+    if base.endswith(".jsonl"):
+        return schema_lib.validate_metrics_file(path)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable ({e})"]
+    if isinstance(doc, dict) and doc.get("kind") == "run_report":
+        return schema_lib.validate_run_report(doc, where=path)
+    if base == "report.json":
+        # the chief's collate() post-mortem, not a per-proc dump: it
+        # has its own (version-stamped) shape — check the version only
+        return schema_lib.validate_version(doc, "version", where=path)
+    return schema_lib.validate_flight_dump(doc, where=path)
+
+
+def cmd_validate(args) -> int:
+    targets: List[str] = []
+    for path in args.paths:
+        if os.path.isdir(path):
+            targets += _metrics_files(path)
+            targets += sorted(glob.glob(os.path.join(path, "flight",
+                                                     "*.json")))
+        elif os.path.exists(path):
+            targets.append(path)
+        else:
+            print(f"dtx-obs validate: {path}: no such file",
+                  file=sys.stderr)
+            return 2
+    if not targets:
+        print("dtx-obs validate: nothing to validate", file=sys.stderr)
+        return 2
+    failed = 0
+    for path in targets:
+        errs = _validate_one(path)
+        if errs:
+            failed += 1
+            print(f"FAIL {path}")
+            for e in errs[:args.max_errors]:
+                print(f"  {e}")
+            if len(errs) > args.max_errors:
+                print(f"  ... {len(errs) - args.max_errors} more")
+        else:
+            print(f"OK   {path}")
+    return 1 if failed else 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dtx-obs",
+        description="run analytics over the obs/ telemetry: goodput "
+                    "reports, A/B regression gating, live tail/serve, "
+                    "schema validation")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    r = sub.add_parser("report", help="aggregate a run into the run "
+                                      "report (goodput decomposition)")
+    r.add_argument("logs_path")
+    r.add_argument("--summary", action="store_true",
+                   help="one-line summary instead of JSON")
+    r.add_argument("--compact", action="store_true",
+                   help="single-line JSON")
+    r.add_argument("-o", "--out", default="",
+                   help="also write the JSON report to this file")
+    r.set_defaults(fn=cmd_report)
+
+    c = sub.add_parser("compare", help="A/B two runs; exit 3 on "
+                                       "regression")
+    c.add_argument("base", help="baseline: logs dir, run report JSON, "
+                                "bench row/summary, BASELINE.json or "
+                                "BENCH_*.json capture")
+    c.add_argument("cand", help="candidate (same shapes)")
+    c.add_argument("--threshold", type=float, default=None,
+                   help="relative threshold for EVERY metric "
+                        "(default: per-metric, 0.05 perf / 0.02 "
+                        "accuracy)")
+    c.add_argument("--thresholds", default="",
+                   metavar="NAME=REL,...",
+                   help="per-metric overrides, e.g. wall_s=0.1,mfu=0.02")
+    c.add_argument("--compact", action="store_true")
+    c.set_defaults(fn=cmd_compare)
+
+    t = sub.add_parser("tail", help="one line per metrics window")
+    t.add_argument("logs_path")
+    t.add_argument("-n", "--lines", type=int, default=20)
+    t.add_argument("-f", "--follow", action="store_true",
+                   help="keep following a live run")
+    t.add_argument("--interval", type=float, default=2.0,
+                   help="follow poll interval seconds")
+    t.set_defaults(fn=cmd_tail)
+
+    s = sub.add_parser("serve", help="serve /status /metrics /report "
+                                     "over HTTP (works on finished "
+                                     "runs)")
+    s.add_argument("logs_path")
+    s.add_argument("--port", type=int, default=8321)
+    s.add_argument("--host", default="",
+                   help="bind address (default: all interfaces)")
+    s.set_defaults(fn=cmd_serve)
+
+    v = sub.add_parser("validate", help="schema-validate metrics/"
+                                        "flight/report files or a "
+                                        "whole logs dir")
+    v.add_argument("paths", nargs="+")
+    v.add_argument("--max-errors", type=int, default=10,
+                   help="errors printed per file")
+    v.set_defaults(fn=cmd_validate)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

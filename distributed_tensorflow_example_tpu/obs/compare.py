@@ -1,0 +1,192 @@
+"""A/B run comparison + the regression gate.
+
+``compare(base, cand)`` diffs two run documents and emits a
+machine-readable verdict::
+
+    {"metrics": {name: {"base": x, "cand": y, "rel_change": r,
+                        "threshold": t, "verdict": "ok|regression|
+                        improvement|missing"}},
+     "regressions": [name, ...], "improvements": [...], "ok": bool}
+
+Accepted document shapes (``extract_metrics`` normalizes; mixing
+shapes is fine — a fresh run report can gate against last month's
+BENCH row):
+
+- an ``obs/aggregate.py`` run report (``kind: "run_report"``);
+- a ``bench.py`` per-config row (``wall_clock_20ep_s``, ...);
+- the ``bench.py`` final summary line (``metric``/``value``);
+- ``BASELINE.json`` (its ``measured`` anchors);
+- a ``BENCH_*.json`` driver capture (``{"tail": "..."}`` — the last
+  JSON line of the captured stdout is the bench final summary).
+
+Thresholds are RELATIVE and one-sided: wall/step-time may grow, or
+throughput/MFU/accuracy/goodput shrink, by up to the threshold before
+a metric counts as a regression. ``bench.py --gate FILE`` wires this
+into the bench driver (exit code 3 on regression, after every row is
+written); ``dtx-obs compare`` is the standalone CLI.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+# metric -> (direction, default relative threshold); "lower" = smaller
+# is better (wall), "higher" = bigger is better (throughput)
+GATE_METRICS: Dict[str, tuple] = {
+    "wall_s": ("lower", 0.05),
+    "examples_per_sec": ("higher", 0.05),
+    "tokens_per_sec": ("higher", 0.05),
+    "mfu": ("higher", 0.05),
+    "step_time_p50_ms": ("lower", 0.05),
+    "goodput_frac": ("higher", 0.05),
+    "test_accuracy": ("higher", 0.02),
+}
+
+
+def _json_lines_reversed(text: str):
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                yield json.loads(line)
+            except ValueError:
+                continue
+
+
+def _last_json_line(text: str) -> Optional[Dict[str, Any]]:
+    return next(_json_lines_reversed(text), None)
+
+
+def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
+    """Normalize any accepted document shape to {gate metric: value}.
+    Absent metrics are simply omitted — compare() only diffs the
+    intersection."""
+    out: Dict[str, float] = {}
+
+    def put(name, val):
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            out[name] = float(val)
+
+    if not isinstance(doc, dict):
+        return out
+    if isinstance(doc.get("tail"), str):        # BENCH_*.json capture
+        # scan back past non-metric trailing lines (a --gate run's
+        # verdict prints AFTER the final summary) to the newest line
+        # that actually yields gate metrics
+        for inner in _json_lines_reversed(doc["tail"]):
+            m = extract_metrics(inner)
+            if m:
+                return m
+        return out
+    if doc.get("kind") == "run_report":         # aggregate.py report
+        put("wall_s", doc.get("wall_s"))
+        put("test_accuracy", doc.get("test_accuracy"))
+        g = doc.get("goodput") or {}
+        put("goodput_frac", g.get("goodput_frac"))
+        st = doc.get("step_time") or {}
+        put("step_time_p50_ms", st.get("p50_ms"))
+        tp = doc.get("throughput") or {}
+        put("examples_per_sec", tp.get("examples_per_sec_mean"))
+        put("tokens_per_sec", tp.get("tokens_per_sec_last"))
+        put("mfu", tp.get("mfu_mean"))
+        return out
+    if "measured" in doc and isinstance(doc["measured"], dict):
+        # BASELINE.json: the recorded CPU anchors
+        m = doc["measured"]
+        put("wall_s", m.get("cpu_baseline_wall_clock_20ep_s"))
+        put("test_accuracy", m.get("cpu_baseline_test_accuracy"))
+        return out
+    if "wall_clock_20ep_s" in doc:              # bench per-config row
+        put("wall_s", doc.get("wall_clock_20ep_s"))
+        put("examples_per_sec", doc.get("examples_per_sec"))
+        put("mfu", doc.get("mfu"))
+        put("test_accuracy", doc.get("test_accuracy"))
+        g = doc.get("goodput_summary") or {}
+        put("goodput_frac", g.get("goodput_frac"))
+        return out
+    if "metric" in doc and "value" in doc:      # bench final summary
+        put("wall_s", doc.get("value"))
+        put("mfu", doc.get("mfu"))
+        put("test_accuracy", doc.get("learning_accuracy"))
+        return out
+    # last resort: any directly-named gate metrics
+    for name in GATE_METRICS:
+        put(name, doc.get(name))
+    return out
+
+
+def compare(base: Dict[str, Any], cand: Dict[str, Any],
+            thresholds: Optional[Dict[str, float]] = None,
+            default_threshold: Optional[float] = None) -> Dict[str, Any]:
+    """Diff two documents (any accepted shape). ``thresholds``
+    overrides per metric; ``default_threshold`` overrides every
+    metric's default at once."""
+    b, c = extract_metrics(base), extract_metrics(cand)
+    metrics: Dict[str, Any] = {}
+    regressions, improvements = [], []
+    for name, (direction, thr) in GATE_METRICS.items():
+        if default_threshold is not None:
+            thr = default_threshold
+        if thresholds and name in thresholds:
+            thr = thresholds[name]
+        if name not in b or name not in c:
+            if name in b or name in c:
+                metrics[name] = {"base": b.get(name), "cand": c.get(name),
+                                 "verdict": "missing"}
+            continue
+        bv, cv = b[name], c[name]
+        if bv == 0 and cv != 0:
+            # no finite relative change exists against a zero
+            # baseline (a broken/aborted baseline run) — report it
+            # without fabricating Infinity (non-strict JSON) and
+            # without gating on it
+            metrics[name] = {"base": bv, "cand": cv,
+                             "rel_change": None, "threshold": thr,
+                             "direction": direction,
+                             "verdict": "incomparable"}
+            continue
+        rel = (cv - bv) / abs(bv) if bv else 0.0
+        worse = rel > thr if direction == "lower" else rel < -thr
+        better = rel < -thr if direction == "lower" else rel > thr
+        verdict = ("regression" if worse
+                   else "improvement" if better else "ok")
+        metrics[name] = {"base": bv, "cand": cv,
+                         "rel_change": round(rel, 6),
+                         "threshold": thr, "direction": direction,
+                         "verdict": verdict}
+        if worse:
+            regressions.append(name)
+        elif better:
+            improvements.append(name)
+    return {
+        "metrics": metrics,
+        "compared": sorted(k for k, v in metrics.items()
+                           if v.get("verdict") != "missing"),
+        "regressions": regressions,
+        "improvements": improvements,
+        "ok": not regressions,
+    }
+
+
+def load_doc(path: str) -> Dict[str, Any]:
+    """Read a comparison document from disk: JSON file, or a logs
+    directory (aggregated on the fly)."""
+    import os
+
+    if os.path.isdir(path):
+        from .aggregate import aggregate
+
+        return aggregate(path)
+    with open(path) as f:
+        text = f.read()
+    try:
+        return json.loads(text)
+    except ValueError:
+        # a captured stdout file: hand it over capture-shaped so
+        # extract_metrics scans back to the newest metric-bearing
+        # JSON line (skipping e.g. a trailing --gate verdict)
+        if _last_json_line(text) is None:
+            raise ValueError(f"{path}: neither JSON nor a text capture "
+                             f"with a JSON tail line")
+        return {"tail": text}

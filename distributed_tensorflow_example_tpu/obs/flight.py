@@ -38,7 +38,11 @@ import time
 import traceback
 from typing import Any, Dict, List, Optional
 
-FORMAT_VERSION = 1
+from .schema import SCHEMA_VERSION
+
+# the dump's "version" IS the obs schema version (one number for the
+# whole package — obs/schema.py documents the history)
+FORMAT_VERSION = SCHEMA_VERSION
 
 
 def _jsonable(x):

@@ -49,6 +49,34 @@ class Heartbeat:
             pass
 
 
+def clear_stale_signals(logs_path: str) -> int:
+    """Run-start hygiene, chief-only: remove a previous run's leftover
+    per-process signal files from a reused ``logs_path`` — every
+    ``heartbeat.*`` (a dead run's peers would otherwise fabricate
+    stragglers beyond what ``straggler_report(since=...)`` fences) and
+    every ``flight/*.json`` incl. ``report.json`` (a stale dump would
+    collate into THIS run's post-mortem and dtx-obs report would mix
+    runs). The metrics jsonl streams are append-only history and stay.
+    Best-effort (a locked file must not kill the run); returns the
+    number of files removed. A live peer's heartbeat written in the
+    start-up race is re-touched at its next window boundary, so a
+    spurious removal only delays that beat one window."""
+    removed = 0
+    for path in glob.glob(os.path.join(logs_path, "heartbeat.*")):
+        try:
+            os.remove(path)
+            removed += 1
+        except OSError:
+            pass
+    for path in glob.glob(os.path.join(logs_path, "flight", "*.json")):
+        try:
+            os.remove(path)
+            removed += 1
+        except OSError:
+            pass
+    return removed
+
+
 def read_heartbeats(logs_path: str) -> Dict[int, Tuple[int, float]]:
     """{proc: (step, wall_time)} for every heartbeat file present.
     A torn/absent file is skipped (its process simply looks stale)."""

@@ -30,6 +30,8 @@ import os
 import time
 from typing import Any, Dict, List
 
+from .schema import SCHEMA_VERSION
+
 
 def rss_bytes():
     """Resident set size of this process via /proc (no psutil
@@ -158,13 +160,15 @@ class MetricsLogger:
             self._f = None
 
     def log_window(self, **fields) -> None:
-        self._emit({"kind": "window", "t": time.time(),
+        self._emit({"kind": "window", "v": SCHEMA_VERSION,
+                    "t": time.time(),
                     "proc": self.process_index, **fields,
                     "rss_bytes": rss_bytes(),
                     "device_memory": device_memory_stats()})
 
     def log_event(self, event: str, **fields) -> None:
-        self._emit({"kind": "event", "event": event, "t": time.time(),
+        self._emit({"kind": "event", "v": SCHEMA_VERSION,
+                    "event": event, "t": time.time(),
                     "proc": self.process_index, **fields})
 
     def flush(self) -> None:

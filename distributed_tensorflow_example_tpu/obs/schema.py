@@ -21,12 +21,24 @@ from typing import Any, Dict, List
 
 _NUM = (int, float)
 
+# The one version number for everything obs/ writes: stamped as "v"
+# into every metrics row (obs/metrics.MetricsLogger) and as "version"
+# into every flight dump (obs/flight.FlightRecorder imports it), and
+# checked FIRST by the validators — so dtx-obs on an old-format log
+# says "written by schema v1" instead of cascading field-missing
+# errors. Bump it whenever a field is renamed/retyped/removed.
+# History: v1 = PR 1/2 (unstamped metrics rows, flight "version": 1);
+# v2 = the stamp itself + the run_end goodput fields
+# (compile_s/eval_s/sample_s).
+SCHEMA_VERSION = 2
+
 
 # field -> allowed types; a tuple including type(None) marks nullable
 METRICS_COMMON = {
     "kind": (str,),
     "t": _NUM,
     "proc": (int,),
+    "v": (int,),
 }
 
 # kind == "window": the per---log_every training telemetry row. Both
@@ -84,6 +96,29 @@ FLIGHT_ANOMALY_RECORD = {
     "policy": (str,),
 }
 
+# The run report obs/aggregate.py produces (dtx-obs report emits it,
+# obs/compare.py diffs it). Top-level contract only — the nested
+# goodput bucket names are pinned by aggregate.BUCKETS.
+RUN_REPORT = {
+    "v": (int,),
+    "kind": (str,),          # "run_report"
+    "logs_path": (str,),
+    "generated_t": _NUM,
+    "partial": (bool,),
+    "procs": (int,),
+    "steps": (int, type(None)),
+    "wall_s": _NUM,
+    "test_accuracy": _NUM + (type(None),),
+    "goodput": (dict,),
+    "step_time": (dict,),
+    "throughput": (dict,),
+    "trajectory": (list,),
+    "stragglers": (dict,),
+    "anomalies": (dict,),
+    "timeline": (list,),
+    "schema_errors": (list,),
+}
+
 
 def _check(doc: Dict[str, Any], spec: Dict[str, tuple],
            where: str) -> List[str]:
@@ -104,8 +139,30 @@ def _check(doc: Dict[str, Any], spec: Dict[str, tuple],
     return errs
 
 
+def _version_errs(doc: Dict[str, Any], field: str, where: str) -> List[str]:
+    """Precise old-format diagnosis, checked before any field check: a
+    v1 log fed to a v2 tool must say so, not cascade missing-field
+    errors."""
+    v = doc.get(field)
+    if v is None:
+        return [f"{where}: no {field!r} stamp — written by a "
+                f"pre-versioned build (schema v1); this tool reads "
+                f"schema v{SCHEMA_VERSION}"]
+    if isinstance(v, bool) or not isinstance(v, int):
+        return [f"{where}: {field!r} is {type(v).__name__}, expected int"]
+    if v != SCHEMA_VERSION:
+        return [f"{where}: written by schema v{v}; this tool reads "
+                f"schema v{SCHEMA_VERSION}"]
+    return []
+
+
 def validate_metrics_row(row: Dict[str, Any], where: str = "row") -> List[str]:
     """Validate one metrics JSONL row (window or event)."""
+    if not isinstance(row, dict):
+        return [f"{where}: not an object"]
+    verrs = _version_errs(row, "v", where)
+    if verrs:
+        return verrs
     errs = _check(row, METRICS_COMMON, where)
     kind = row.get("kind") if isinstance(row, dict) else None
     if kind == "window":
@@ -138,6 +195,11 @@ def validate_flight_dump(doc: Dict[str, Any],
                          where: str = "dump") -> List[str]:
     """Validate a flight/<proc>.json document, including every step
     and anomaly record inside it."""
+    if not isinstance(doc, dict):
+        return [f"{where}: not an object"]
+    verrs = _version_errs(doc, "version", where)
+    if verrs:
+        return verrs
     errs = _check(doc, FLIGHT_DUMP, where)
     if isinstance(doc, dict):
         for i, rec in enumerate(doc.get("steps") or []):
@@ -151,6 +213,39 @@ def validate_flight_dump(doc: Dict[str, Any],
         exc = doc.get("exception")
         if exc is not None and not isinstance(exc, dict):
             errs.append(f"{where}: exception must be an object")
+    return errs
+
+
+def validate_version(doc: Dict[str, Any], field: str = "v",
+                     where: str = "doc") -> List[str]:
+    """Public version-only check, for documents whose body has no
+    field spec here (e.g. the chief's flight/report.json collate):
+    precise old-format diagnosis, nothing else."""
+    if not isinstance(doc, dict):
+        return [f"{where}: not an object"]
+    return _version_errs(doc, field, where)
+
+
+def validate_run_report(doc: Dict[str, Any],
+                        where: str = "report") -> List[str]:
+    """Validate an aggregate.py run report (its top-level contract +
+    the goodput bucket names)."""
+    if not isinstance(doc, dict):
+        return [f"{where}: not an object"]
+    verrs = _version_errs(doc, "v", where)
+    if verrs:
+        return verrs
+    errs = _check(doc, RUN_REPORT, where)
+    if doc.get("kind") != "run_report":
+        errs.append(f"{where}: kind is {doc.get('kind')!r}, expected "
+                    f"'run_report'")
+    buckets = (doc.get("goodput") or {}).get("buckets")
+    if isinstance(buckets, dict):
+        from .aggregate import BUCKETS
+
+        missing = [b for b in BUCKETS if b not in buckets]
+        if missing:
+            errs.append(f"{where}: goodput.buckets missing {missing}")
     return errs
 
 

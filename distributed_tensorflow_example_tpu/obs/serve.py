@@ -1,0 +1,267 @@
+"""Live run status over HTTP — stdlib only (http.server, no deps).
+
+Production fleets watch training runs from outside the process; the
+chief therefore exposes (``--status_port P``, wired in train/loop.py,
+or offline re-serving via ``dtx-obs serve``):
+
+- ``/status``  — JSON assembled from the metrics JSONL *tails* plus
+  heartbeat freshness: per-process step/cost/throughput, the chief's
+  last window, liveness, run_end when finished;
+- ``/metrics`` — the same signals in Prometheus text exposition
+  format (``dtx_*`` gauges), scrapeable by any Prometheus/VictoriaM/
+  Grafana-agent stack;
+- ``/report``  — the full obs/aggregate.py run report (computed per
+  request — cheap at these log sizes, and always current).
+
+The reader side only ever *reads* files the run appends to, so the
+server adds zero overhead to the training loop and the identical code
+serves a finished run's directory offline. Tail reads are bounded
+(the last ``TAIL_BYTES`` of each stream), so /status stays O(1) as
+the run grows.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from . import heartbeat as hb_lib
+
+TAIL_BYTES = 256 * 1024
+# a heartbeat older than this marks the process (and the run) stale
+STALE_HEARTBEAT_S = 120.0
+
+
+def tail_rows(path: str, max_bytes: int = TAIL_BYTES) -> List[Dict[str, Any]]:
+    """Parse the last ``max_bytes`` of a JSONL file. When the read
+    starts mid-file the first (possibly torn) line is dropped."""
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            if size > max_bytes:
+                f.seek(size - max_bytes)
+            chunk = f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return []
+    lines = chunk.splitlines()
+    if size > max_bytes and lines:
+        lines = lines[1:]
+    rows = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rows.append(json.loads(line))
+        except ValueError:
+            continue
+    return rows
+
+
+def collect_status(logs_path: str,
+                   now: Optional[float] = None) -> Dict[str, Any]:
+    """The /status document: metrics tails + heartbeat freshness."""
+    from .aggregate import metrics_files
+
+    now = time.time() if now is None else now
+    beats = hb_lib.read_heartbeats(logs_path)
+    procs: Dict[str, Dict[str, Any]] = {}
+    run_end = None
+    last_window = None
+    anomalies = 0
+    chief: Optional[int] = None
+    for pid, path in metrics_files(logs_path):
+        rows = tail_rows(path)
+        windows = [r for r in rows if r.get("kind") == "window"]
+        events = [r for r in rows if r.get("kind") == "event"]
+        anomalies += sum(1 for r in events if r.get("event") == "anomaly")
+        w = windows[-1] if windows else {}
+        hb = beats.get(pid)
+        procs[str(pid)] = {
+            "step": w.get("step"),
+            "epoch": w.get("epoch"),
+            "cost": w.get("cost"),
+            "examples_per_sec": w.get("examples_per_sec"),
+            "tokens_per_sec": w.get("tokens_per_sec"),
+            "mfu": w.get("mfu"),
+            "step_time_p50_ms": w.get("step_time_p50_ms"),
+            "rss_bytes": w.get("rss_bytes"),
+            "t": w.get("t"),
+            "heartbeat_step": hb[0] if hb else None,
+            "heartbeat_age_s": (round(max(0.0, now - hb[1]), 3)
+                                if hb else None),
+        }
+        if chief is None or pid < chief:
+            chief = pid
+            last_window = w or None
+            run_end = next((r for r in reversed(events)
+                            if r.get("event") == "run_end"), None)
+    ages = [p["heartbeat_age_s"] for p in procs.values()
+            if p["heartbeat_age_s"] is not None]
+    complete = run_end is not None
+    return {
+        "t": now,
+        "logs_path": os.path.abspath(logs_path),
+        "procs": procs,
+        "proc_count": len(procs),
+        "last_window": last_window,
+        "run_end": run_end,
+        "run_complete": complete,
+        "live": bool(procs) and not complete
+        and (min(ages) < STALE_HEARTBEAT_S if ages else True),
+        "anomalies": anomalies,
+        "flight_dumps": len([
+            n for n in (os.listdir(os.path.join(logs_path, "flight"))
+                        if os.path.isdir(os.path.join(logs_path,
+                                                      "flight")) else [])
+            if n.endswith(".json") and n != "report.json"]),
+    }
+
+
+def prometheus_text(status: Dict[str, Any]) -> str:
+    """Render a /status document in Prometheus text exposition format
+    (version 0.0.4). Gauges only — everything here is a point-in-time
+    read of the run's own counters."""
+    out: List[str] = []
+
+    def fmt(v) -> str:
+        return format(float(v), ".10g")
+
+    def gauge(name, help_text, samples):
+        """samples: [(label_dict_or_None, value)] — None values are
+        skipped (absent ≠ zero)."""
+        kept = [(lb, v) for lb, v in samples
+                if isinstance(v, (int, float))
+                and not isinstance(v, bool)]
+        if not kept:
+            return
+        out.append(f"# HELP {name} {help_text}")
+        out.append(f"# TYPE {name} gauge")
+        for labels, v in kept:
+            if labels:
+                lab = ",".join(f'{k}="{val}"'
+                               for k, val in sorted(labels.items()))
+                out.append(f"{name}{{{lab}}} {fmt(v)}")
+            else:
+                out.append(f"{name} {fmt(v)}")
+
+    procs = status.get("procs") or {}
+
+    def per_proc(key):
+        return [({"proc": pid}, p.get(key))
+                for pid, p in sorted(procs.items(), key=lambda kv:
+                                     int(kv[0]))]
+
+    gauge("dtx_up", "1 while the run looks live (fresh heartbeat, no "
+          "run_end)", [(None, 1 if status.get("live") else 0)])
+    gauge("dtx_run_complete", "1 once the run_end event was written",
+          [(None, 1 if status.get("run_complete") else 0)])
+    gauge("dtx_procs", "processes with a metrics stream",
+          [(None, status.get("proc_count"))])
+    gauge("dtx_step", "latest window step per process",
+          per_proc("step"))
+    gauge("dtx_cost", "latest window cost per process",
+          per_proc("cost"))
+    gauge("dtx_examples_per_sec", "latest window throughput",
+          per_proc("examples_per_sec"))
+    gauge("dtx_tokens_per_sec", "latest window token throughput",
+          per_proc("tokens_per_sec"))
+    gauge("dtx_mfu", "latest window model FLOPs utilization",
+          per_proc("mfu"))
+    gauge("dtx_step_time_p50_ms", "latest window median step time",
+          per_proc("step_time_p50_ms"))
+    gauge("dtx_rss_bytes", "latest resident set size per process",
+          per_proc("rss_bytes"))
+    gauge("dtx_heartbeat_age_seconds", "seconds since each process's "
+          "last heartbeat", per_proc("heartbeat_age_s"))
+    gauge("dtx_anomalies_total", "anomaly events in the metrics tails",
+          [(None, status.get("anomalies"))])
+    gauge("dtx_flight_dumps_total", "flight dumps on disk",
+          [(None, status.get("flight_dumps"))])
+    run_end = status.get("run_end") or {}
+    gauge("dtx_total_time_seconds", "final run wall time (run_end)",
+          [(None, run_end.get("total_time_s"))])
+    gauge("dtx_test_accuracy", "final test accuracy (run_end)",
+          [(None, run_end.get("test_accuracy"))])
+    return "\n".join(out) + "\n"
+
+
+class StatusServer:
+    """Threaded HTTP status server over a ``logs_path``. ``start()``
+    binds and serves from a daemon thread (port 0 = ephemeral;
+    ``.port`` is the bound port); ``close()`` shuts down cleanly —
+    the train loop calls it from its ``finally``, so a crash never
+    leaks the socket. Never raises out of start(): a taken port logs
+    a NOTE and the run proceeds unobserved (the server must not kill
+    the run it reports on)."""
+
+    def __init__(self, logs_path: str):
+        self.logs_path = logs_path
+        self.port: Optional[int] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, port: int, host: str = "") -> Optional[int]:
+        logs_path = self.logs_path
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # stdout belongs to the run
+                pass
+
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                try:
+                    if path in ("/", "/status"):
+                        doc = collect_status(logs_path)
+                        self._send(200, json.dumps(doc).encode())
+                    elif path == "/metrics":
+                        text = prometheus_text(collect_status(logs_path))
+                        self._send(200, text.encode(),
+                                   "text/plain; version=0.0.4")
+                    elif path == "/report":
+                        from .aggregate import aggregate
+
+                        self._send(200, json.dumps(
+                            aggregate(logs_path)).encode())
+                    else:
+                        self._send(404, json.dumps(
+                            {"error": f"unknown path {path!r}",
+                             "endpoints": ["/status", "/metrics",
+                                           "/report"]}).encode())
+                except Exception as e:  # a bad read must not kill serving
+                    self._send(500, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode())
+
+        try:
+            self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        except OSError as e:
+            print(f"NOTE: status server failed to bind port {port}: {e}")
+            return None
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dtx-status",
+            daemon=True)
+        self._thread.start()
+        return self.port
+
+    def close(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -303,6 +303,8 @@ def run(cfg: Config) -> Dict[str, Any]:
                          "--profile trace; drop one of the two")
     if cfg.profile_port < 0:
         raise ValueError(f"profile_port={cfg.profile_port} must be >= 0")
+    if cfg.status_port < 0:
+        raise ValueError(f"status_port={cfg.status_port} must be >= 0")
     from ..obs.anomaly import POLICIES
 
     if cfg.on_anomaly not in POLICIES:
@@ -443,6 +445,35 @@ def run(cfg: Config) -> Dict[str, Any]:
     total_steps = cfg.training_epochs * max(
         1, dataset.train.images.shape[0] // global_batch)
     optimizer = make_optimizer(cfg, total_steps)
+
+    # Run-start signal hygiene: a reused logs_path must not leak a
+    # previous run's heartbeat/flight files into THIS run's straggler
+    # reports, post-mortems or dtx-obs report (obs/heartbeat.py has
+    # the rationale). Chief-only; the metrics jsonl history stays.
+    if chief and (cfg.metrics or cfg.flight or cfg.on_anomaly
+                  or cfg.status_port):
+        from ..obs.heartbeat import clear_stale_signals
+
+        clear_stale_signals(cfg.logs_path)
+
+    # --status_port: the live /status + Prometheus endpoint over the
+    # logs_path (obs/serve.py) — a pure reader of the files this run
+    # appends to, so it adds nothing to the training loop; closed in
+    # the forensics guard's finally so a crash never leaks the socket
+    status_server = None
+    if cfg.status_port and chief:
+        from ..obs.serve import StatusServer
+
+        status_server = StatusServer(cfg.logs_path)
+        port = status_server.start(cfg.status_port)
+        if port:
+            print(f"Status server on port {port} "
+                  f"(/status /metrics /report)")
+
+    # goodput phase accounting: cumulative wall spent OUTSIDE the
+    # per-window timing buckets, carried on the run_end event so
+    # obs/aggregate.py's decomposition sums to the run's wall time
+    phase_s = {"compile": 0.0, "eval": 0.0, "sample": 0.0}
 
     # --metrics telemetry (obs/): per-process structured JSONL sink +
     # heartbeat file; MFU accounting shared with bench.py via obs.flops
@@ -762,14 +793,17 @@ def run(cfg: Config) -> Dict[str, Any]:
                 val_eval_step = step_lib.build_eval_step(cfg, mesh, spec)
             unit = (batch_shards * cfg.microbatches if pp_mode
                     else batch_shards)
-            with tracer.annotate("eval"):
-                return _eval_accuracy(
-                    val_eval_step, params, images, labels, batch_shards,
-                    chunk=max(step_lib.eval_chunk_cap(spec,
-                                                      cfg.eval_batch_size),
-                              unit),
-                    unit=unit,
-                )
+            t0 = time.perf_counter()
+            try:
+                with tracer.annotate("eval"):
+                    return _eval_accuracy(
+                        val_eval_step, params, images, labels, batch_shards,
+                        chunk=max(step_lib.eval_chunk_cap(
+                            spec, cfg.eval_batch_size), unit),
+                        unit=unit,
+                    )
+            finally:
+                phase_s["eval"] += time.perf_counter() - t0
 
         def note_validation(val_acc: float) -> bool:
             """Track the per-epoch validation accuracy; True = stop now.
@@ -994,6 +1028,7 @@ def run(cfg: Config) -> Dict[str, Any]:
                 # async): the call's wall is the compile, logged as its
                 # own event and excluded from the metrics rows' step time
                 disp_wall = time.time() - t0
+                phase_s["compile"] += disp_wall
                 if mlogger is not None:
                     mlogger.log_event("compile", what="run_to_completion",
                                       dispatch_wall_s=round(disp_wall, 3))
@@ -1006,6 +1041,13 @@ def run(cfg: Config) -> Dict[str, Any]:
                         get_params(state) if (async_mode or fsdp_mode)
                         else state.params
                     )
+                # NO phase_s["eval"] charge here: on the whole-run
+                # path the eval program is fused into the same device
+                # stream and fetched with the metric arrays — its
+                # execution lands in the window walls (train bucket).
+                # Charging the dispatch too would double-count
+                # (accounting is program-granularity on this path,
+                # like the tracer's on_range windows).
                 costs2d, accs2d, eval_pending = jax.device_get(
                     (costs2d, accs2d, eval_pending)
                 )
@@ -1042,6 +1084,7 @@ def run(cfg: Config) -> Dict[str, Any]:
                             state, img_d, lbl_d, shuffle_key, epoch
                         )
                     disp_wall = time.time() - t0 if epoch == start_epoch else 0.0
+                    phase_s["compile"] += disp_wall
                     if mlogger is not None and epoch == start_epoch:
                         mlogger.log_event("compile", what="epoch_runner",
                                           dispatch_wall_s=round(disp_wall, 3))
@@ -1060,8 +1103,10 @@ def run(cfg: Config) -> Dict[str, Any]:
                     if early:
                         p_eval = (get_params(state) if (async_mode or fsdp_mode)
                                   else state.params)
+                        t_ev = time.perf_counter()
                         with tracer.annotate("eval"):
                             stop_now = note_validation(fast_val(p_eval))
+                        phase_s["eval"] += time.perf_counter() - t_ev
                     maybe_checkpoint(epoch + 1)
                     if stop_now:
                         break
@@ -1281,6 +1326,7 @@ def run(cfg: Config) -> Dict[str, Any]:
                                 # first jit dispatch = trace + compile
                                 # (execution itself is async)
                                 compile_logged = True
+                                phase_s["compile"] += t_disp
                                 if mlogger is not None:
                                     mlogger.log_event(
                                         "compile", what="train_step",
@@ -1383,8 +1429,10 @@ def run(cfg: Config) -> Dict[str, Any]:
                 get_params(state) if (async_mode or fsdp_mode) else state.params
             )
             if fast:                        # fast per-epoch path
+                t_ev = time.perf_counter()
                 with tracer.annotate("eval"):
                     test_acc = fast_eval(params)
+                phase_s["eval"] += time.perf_counter() - t_ev
             else:                           # host path
                 test_acc = host_eval_accuracy(
                     params, dataset.test.images, dataset.test.labels)
@@ -1400,6 +1448,7 @@ def run(cfg: Config) -> Dict[str, Any]:
             print("Total Time: %3.2fs" % float(total_time))   # example.py:178
             print("Final Cost: %.4f" % cost)                  # example.py:179
 
+        t_sample = time.perf_counter()
         if cfg.sample_after > 0 and cfg.objective == "lm":
             # complete the train->generate story: KV-cached decoding from
             # the first test examples' opening tokens (beyond-reference;
@@ -1459,6 +1508,7 @@ def run(cfg: Config) -> Dict[str, Any]:
                 np.savez(sample_path, samples=samples, prompt_len=prompt_len,
                          vocab_size=spec.vocab_size)
                 print(f"Sampled {n_s} sequences -> {sample_path}")
+        phase_s["sample"] += time.perf_counter() - t_sample
 
         if cfg.checkpoint_dir:
             save_state(int(state.step), cfg.training_epochs)
@@ -1473,6 +1523,11 @@ def run(cfg: Config) -> Dict[str, Any]:
                 test_accuracy=float(test_acc),
                 examples_per_sec=(round(examples_seen / total_time, 3)
                                   if total_time > 0 else None),
+                # the non-train phase walls obs/aggregate.py needs for
+                # the goodput decomposition to sum to total_time_s
+                compile_s=round(phase_s["compile"], 6),
+                eval_s=round(phase_s["eval"], 6),
+                sample_s=round(phase_s["sample"], 6),
                 **(policy.summary() if policy is not None else {}))
             mlogger.close()
 
@@ -1520,8 +1575,11 @@ def run(cfg: Config) -> Dict[str, Any]:
         raise
     finally:
         # a crash can never leave an unterminated profiler trace
-        # (exception-safe start/stop), and the signal/excepthook
-        # handlers must not leak past this run
+        # (exception-safe start/stop), the signal/excepthook handlers
+        # must not leak past this run, and the status server's socket
+        # closes with the run it reports on
         tracer.stop()
         if flight is not None:
             flight.uninstall()
+        if status_server is not None:
+            status_server.close()

@@ -527,7 +527,8 @@ def test_schema_flags_drift(tmp_path):
 
 
 def test_schema_flight_records_checked(tmp_path):
-    doc = {"version": 1, "proc": 0, "reason": "crash", "t": 1.0,
+    doc = {"version": schema_lib.SCHEMA_VERSION, "proc": 0,
+           "reason": "crash", "t": 1.0,
            "last_step": 5, "steps": [{"step": 5, "t": 1.0}],
            "windows": [{"step": 5, "t": 1.0, "cost": 1.0}],
            "anomalies": [{"step": 5, "t": 1.0, "reasons": ["x"],

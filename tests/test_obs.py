@@ -310,6 +310,73 @@ def test_histograms_window_cadence(tmp_path):
 
 
 @needs_stack
+def test_run_analytics_end_to_end(tmp_path):
+    """A real (CPU, tiny-config) host-path run through the full read
+    side: (1) run-start hygiene removes a previous run's stale
+    heartbeat/flight files; (2) run_end carries the goodput phase
+    walls (compile_s/eval_s/sample_s); (3) --status_port starts and
+    cleanly stops the live endpoint; (4) dtx-obs report's goodput
+    buckets sum to within 5% of the measured wall time — the PR's
+    acceptance invariant."""
+    import socket
+
+    from distributed_tensorflow_example_tpu.config import Config
+    from distributed_tensorflow_example_tpu.obs.aggregate import aggregate
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    # a "previous run's" leftovers in the same logs_path
+    hb_lib.Heartbeat(str(tmp_path), 7).touch(999)
+    os.makedirs(tmp_path / "flight", exist_ok=True)
+    with open(tmp_path / "flight" / "9.json", "w") as f:
+        json.dump({"version": 1, "proc": 9}, f)
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    res = run(Config(
+        training_epochs=1, batch_size=16, dataset="synthetic",
+        synthetic_train_size=800, synthetic_test_size=64,
+        logs_path=str(tmp_path), frequency=25, metrics=True,
+        log_every=25, fast_loop=False, summaries=False,
+        status_port=port, compilation_cache="",
+    ))
+    # (1) hygiene: the dead run's signals are gone, this run's remain
+    beats = hb_lib.read_heartbeats(str(tmp_path))
+    assert 7 not in beats and 0 in beats
+    assert not os.path.exists(tmp_path / "flight" / "9.json")
+    # (2) run_end phase walls
+    files = glob.glob(os.path.join(str(tmp_path), "metrics.*.jsonl"))
+    rows = read_metrics(files[0])
+    run_end = next(r for r in rows if r.get("event") == "run_end")
+    assert run_end["compile_s"] > 0
+    assert run_end["eval_s"] >= 0 and run_end["sample_s"] >= 0
+    assert run_end["total_time_s"] == pytest.approx(
+        res["total_time_s"], abs=0.01)
+    # (4) the decomposition sums to wall within 5%
+    rep = aggregate(str(tmp_path))
+    g = rep["goodput"]
+    assert g["wall_s"] == run_end["total_time_s"]
+    assert sum(g["buckets"].values()) == pytest.approx(
+        g["wall_s"], rel=0.05)
+    # known buckets were not over-counted either (the clamped
+    # residual stays honest)
+    assert g["residual_s"] >= -0.05 * g["wall_s"]
+    assert g["buckets"]["train"] > 0
+    assert g["buckets"]["compile"] == pytest.approx(
+        run_end["compile_s"], rel=1e-6)
+    assert rep["schema_errors"] == []
+
+
+@needs_stack
+def test_status_port_validation():
+    from distributed_tensorflow_example_tpu.config import Config
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    with pytest.raises(ValueError, match="status_port"):
+        run(Config(status_port=-1))
+
+
+@needs_stack
 def test_telemetry_flag_validation():
     from distributed_tensorflow_example_tpu.config import Config
     from distributed_tensorflow_example_tpu.train.loop import run

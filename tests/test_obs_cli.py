@@ -1,0 +1,482 @@
+"""Run-analytics consumer layer tests (obs/aggregate, obs/compare,
+obs/serve, the dtx-obs CLI, the bench --gate wiring and the
+stale-signal hygiene) — all pure python over synthetic logs, no
+training stack required, so every test runs in this container.
+
+The synthetic run is a 3-process host-path run with a deliberate
+straggler (proc 2 trails by 20 steps), one anomaly-skip window and
+hand-picked timing so the goodput decomposition is checkable in
+closed form:
+
+    wall 12.0s = train 4.8 + compile 2.0 + data_wait 1.0 + host 1.0
+               + eval 0.8 + sample 0.2 + anomaly_skipped 0.4
+               + straggler_idle 0.8 + untracked 1.0
+"""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from distributed_tensorflow_example_tpu.obs import aggregate as agg_lib
+from distributed_tensorflow_example_tpu.obs import cli as cli_lib
+from distributed_tensorflow_example_tpu.obs import compare as cmp_lib
+from distributed_tensorflow_example_tpu.obs import heartbeat as hb_lib
+from distributed_tensorflow_example_tpu.obs import schema as schema_lib
+from distributed_tensorflow_example_tpu.obs import serve as serve_lib
+from distributed_tensorflow_example_tpu.obs.flight import FlightRecorder
+from distributed_tensorflow_example_tpu.obs.metrics import MetricsLogger
+
+
+def _window(step, epoch=0, steps=50, wall=4.0, data_wait=0.5,
+            dispatch=1.0, device_wait=2.0, host=0.5, cost=1.8,
+            eps=1000.0, mfu=0.011):
+    return dict(step=step, epoch=epoch, cost=cost, path="host",
+                steps=steps, window_wall_s=wall,
+                step_time_p50_ms=80.0, step_time_p95_ms=95.0,
+                step_time_max_ms=120.0, data_wait_s=data_wait,
+                dispatch_s=dispatch, device_wait_s=device_wait,
+                host_s=host, examples_per_sec=eps, tokens_per_sec=None,
+                model_flops_per_step=4.8e6, tflops_per_sec=0.012,
+                mfu=mfu)
+
+
+def synth_run(path, procs=3, run_end=True):
+    """The closed-form synthetic 3-proc run (module docstring)."""
+    os.makedirs(path, exist_ok=True)
+    for pid in range(procs):
+        m = MetricsLogger(path, process_index=pid)
+        lag = 20 if pid == 2 else 0  # proc 2 is the straggler
+        m.log_event("compile", what="train_step", dispatch_wall_s=2.0)
+        m.log_window(**_window(50 - lag // 2))
+        if pid == 0:
+            m.log_event("anomaly", step=60, reasons=["nonfinite_loss"],
+                        loss="nan", blame={}, policy="skip",
+                        skipped_steps_total=5)
+        m.log_window(**_window(100 - lag, mfu=0.013, eps=1200.0))
+        if pid == 0:
+            m.log_event("stragglers", epoch=0, procs=procs,
+                        max_step_lag=10, slowest_proc=2,
+                        oldest_heartbeat_age_s=0.5)
+            if run_end:
+                m.log_event("run_end", steps=100, total_time_s=12.0,
+                            test_accuracy=0.91,
+                            examples_per_sec=1000.0, compile_s=2.0,
+                            eval_s=0.8, sample_s=0.2, anomalies=1,
+                            skipped_steps=5)
+        m.close()
+        hb_lib.Heartbeat(path, pid).touch(100 - lag)
+    fr = FlightRecorder(path, process_index=1, capacity=4)
+    fr.record_step(60, epoch=0)
+    fr.record_anomaly(60, reasons=["nonfinite_loss"], policy="skip")
+    fr.dump("anomaly")
+    return path
+
+
+# --- aggregation ----------------------------------------------------------
+
+
+def test_goodput_decomposition_closed_form(tmp_path):
+    rep = agg_lib.aggregate(synth_run(str(tmp_path)))
+    g = rep["goodput"]
+    b = g["buckets"]
+    assert g["wall_s"] == 12.0
+    assert b["compile"] == 2.0
+    assert b["data_wait"] == pytest.approx(1.0)
+    assert b["host"] == pytest.approx(1.0)
+    assert b["eval"] == pytest.approx(0.8)
+    assert b["sample"] == pytest.approx(0.2)
+    # mean step 8.0s/100 steps = 0.08; 5 skipped -> 0.4s carved out
+    assert b["anomaly_skipped"] == pytest.approx(0.4)
+    # recorded per-epoch lag 10 steps -> 0.8s straggler idle
+    assert b["straggler_idle"] == pytest.approx(0.8)
+    assert b["train"] == pytest.approx(4.8)
+    assert b["untracked"] == pytest.approx(1.0)
+    # the acceptance invariant: buckets sum to wall (within 5%; here
+    # exactly, because untracked is the explicit residual)
+    assert sum(b.values()) == pytest.approx(g["wall_s"], rel=0.05)
+    assert g["goodput_frac"] == pytest.approx(4.8 / 12.0)
+    assert g["badput_frac"] == pytest.approx(
+        (2.0 + 1.0 + 1.0 + 0.4 + 0.8 + 1.0) / 12.0)
+    assert set(agg_lib.BUCKETS) == set(b)
+
+
+def test_aggregate_joins_procs_heartbeats_flights(tmp_path):
+    rep = agg_lib.aggregate(synth_run(str(tmp_path)))
+    assert rep["procs"] == 3
+    assert rep["partial"] is False
+    assert rep["steps"] == 100
+    assert rep["test_accuracy"] == 0.91
+    assert set(rep["proc_summary"]) == {"0", "1", "2"}
+    assert rep["proc_summary"]["2"]["last_step"] == 80  # the straggler
+    assert rep["proc_summary"]["0"]["heartbeat_step"] == 100
+    assert rep["proc_summary"]["0"]["heartbeat_age_s"] >= 0.0
+    # step-time percentiles fold EVERY process's windows
+    assert rep["step_time"]["windows"] == 6
+    assert rep["step_time"]["p50_ms"] == 80.0
+    assert rep["step_time"]["p95_ms"] == 95.0
+    assert rep["step_time"]["max_ms"] == 120.0
+    assert rep["throughput"]["mfu_best"] == 0.013
+    assert rep["throughput"]["examples_per_sec_last"] == 1200.0
+    assert rep["stragglers"]["max_step_lag"] == 10
+    assert rep["anomalies"]["count"] == 1
+    assert rep["anomalies"]["skipped_steps"] == 5
+    assert rep["anomalies"]["flight_dumps"] == 1
+    kinds = {e["kind"] for e in rep["timeline"]}
+    assert {"anomaly", "compile", "flight_dump"} <= kinds
+    ts = [e["t"] for e in rep["timeline"]]
+    assert ts == sorted(ts)
+    assert len(rep["trajectory"]) == 2  # the chief's two windows
+    # the report itself honors its written contract
+    assert schema_lib.validate_run_report(rep) == []
+    assert rep["schema_errors"] == []
+
+
+def test_aggregate_partial_and_missing(tmp_path):
+    with pytest.raises(FileNotFoundError, match="metrics"):
+        agg_lib.aggregate(str(tmp_path / "empty"))
+    rep = agg_lib.aggregate(synth_run(str(tmp_path), run_end=False))
+    assert rep["partial"] is True
+    assert rep["wall_s"] >= 0.0
+    # without run_end the compile bucket falls back to compile events
+    assert rep["goodput"]["buckets"]["compile"] == 2.0
+
+
+def test_summary_line(tmp_path):
+    rep = agg_lib.aggregate(synth_run(str(tmp_path)))
+    line = agg_lib.summary_line(rep)
+    assert "goodput=40.0%" in line
+    assert "steps=100" in line
+    assert "anomalies=1" in line and "skipped=5" in line
+    assert "wall=12.0s" in line
+
+
+# --- schema version stamp -------------------------------------------------
+
+
+def test_schema_version_stamped_and_checked(tmp_path):
+    m = MetricsLogger(str(tmp_path), process_index=0)
+    m.log_window(**_window(50))
+    m.close()
+    rows = [json.loads(ln) for ln in open(m.path)]
+    assert rows[0]["v"] == schema_lib.SCHEMA_VERSION
+    assert schema_lib.validate_metrics_file(m.path) == []
+    # an UNstamped (pre-v2) row: one precise diagnosis, no
+    # missing-field cascade
+    old = {k: v for k, v in rows[0].items() if k != "v"}
+    errs = schema_lib.validate_metrics_row(old)
+    assert len(errs) == 1 and "schema v1" in errs[0] \
+        and f"v{schema_lib.SCHEMA_VERSION}" in errs[0]
+    # a future/mismatched version is named, not field-cascaded
+    errs = schema_lib.validate_metrics_row(dict(rows[0], v=99))
+    assert len(errs) == 1 and "written by schema v99" in errs[0]
+
+
+def test_flight_dump_carries_schema_version(tmp_path):
+    fr = FlightRecorder(str(tmp_path), process_index=0, capacity=4)
+    fr.record_step(1)
+    path = fr.dump("sigusr1")
+    doc = json.load(open(path))
+    assert doc["version"] == schema_lib.SCHEMA_VERSION
+    assert schema_lib.validate_flight_dump(doc) == []
+    doc["version"] = 1
+    errs = schema_lib.validate_flight_dump(doc)
+    assert len(errs) == 1 and "written by schema v1" in errs[0]
+
+
+# --- compare / gate -------------------------------------------------------
+
+
+def test_compare_self_is_ok(tmp_path):
+    rep = agg_lib.aggregate(synth_run(str(tmp_path)))
+    verdict = cmp_lib.compare(rep, rep)
+    assert verdict["ok"] and verdict["regressions"] == []
+    assert "wall_s" in verdict["compared"]
+    assert "goodput_frac" in verdict["compared"]
+
+
+def test_compare_flags_doctored_regression(tmp_path):
+    rep = agg_lib.aggregate(synth_run(str(tmp_path)))
+    slow = json.loads(json.dumps(rep))
+    slow["wall_s"] = rep["wall_s"] * 1.2            # +20% wall
+    slow["throughput"]["mfu_mean"] = 0.001           # MFU collapse
+    verdict = cmp_lib.compare(rep, slow)
+    assert not verdict["ok"]
+    assert "wall_s" in verdict["regressions"]
+    assert "mfu" in verdict["regressions"]
+    # the other direction reads as improvements, not regressions
+    back = cmp_lib.compare(slow, rep)
+    assert back["ok"] and "wall_s" in back["improvements"]
+
+
+def test_compare_threshold_knobs(tmp_path):
+    rep = agg_lib.aggregate(synth_run(str(tmp_path)))
+    slow = json.loads(json.dumps(rep))
+    slow["wall_s"] = rep["wall_s"] * 1.2
+    assert cmp_lib.compare(rep, slow,
+                           default_threshold=0.5)["ok"]
+    assert not cmp_lib.compare(rep, slow,
+                               thresholds={"wall_s": 0.1})["ok"]
+
+
+def test_compare_accepts_every_documented_shape():
+    base_row = {"wall_clock_20ep_s": 10.0, "examples_per_sec": 100.0,
+                "mfu": 0.5, "test_accuracy": 0.9}
+    assert cmp_lib.extract_metrics(base_row)["wall_s"] == 10.0
+    baseline = {"measured": {"cpu_baseline_wall_clock_20ep_s": 5.462,
+                             "cpu_baseline_test_accuracy": 0.2359}}
+    assert cmp_lib.extract_metrics(baseline) == {
+        "wall_s": 5.462, "test_accuracy": 0.2359}
+    summary = {"metric": "mnist_20epoch_wall_clock", "value": 0.15,
+               "mfu": 0.01}
+    assert cmp_lib.extract_metrics(summary)["wall_s"] == 0.15
+    capture = {"n": 5, "tail": "noise\n"
+               + json.dumps(summary) + "\n"}
+    assert cmp_lib.extract_metrics(capture)["wall_s"] == 0.15
+    verdict = cmp_lib.compare(base_row, {"wall_clock_20ep_s": 20.0,
+                                         "mfu": 0.5})
+    assert verdict["regressions"] == ["wall_s"]
+
+
+def test_compare_zero_baseline_stays_strict_json():
+    """A zero baseline metric must not fabricate Infinity (non-strict
+    JSON) nor gate: it reads as 'incomparable'."""
+    verdict = cmp_lib.compare({"test_accuracy": 0.0, "wall_s": 1.0},
+                              {"test_accuracy": 0.5, "wall_s": 1.0})
+    m = verdict["metrics"]["test_accuracy"]
+    assert m["verdict"] == "incomparable" and m["rel_change"] is None
+    assert verdict["ok"]
+    json.loads(json.dumps(verdict, allow_nan=False))  # strict JSON
+
+
+def test_capture_extraction_skips_trailing_verdict():
+    """A --gate run's capture ends with the verdict JSON line AFTER
+    the final summary; extract_metrics must scan back to the newest
+    metric-bearing line so gated captures still work as baselines."""
+    summary = {"metric": "mnist_20epoch_wall_clock", "value": 0.15}
+    verdict = {"gate": "BASELINE.json", "metrics": {}, "compared": [],
+               "regressions": [], "improvements": [], "ok": True}
+    capture = {"tail": json.dumps(summary) + "\n"
+               + json.dumps(verdict) + "\n"}
+    assert cmp_lib.extract_metrics(capture)["wall_s"] == 0.15
+
+
+def test_load_doc_text_capture_with_verdict(tmp_path):
+    summary = {"metric": "x", "value": 2.0}
+    verdict = {"gate": "g", "metrics": {}, "compared": [],
+               "regressions": [], "ok": True}
+    cap = tmp_path / "capture.log"
+    cap.write_text("[bench] noise\n" + json.dumps(summary) + "\n"
+                   + json.dumps(verdict) + "\n")
+    doc = cmp_lib.load_doc(str(cap))
+    assert cmp_lib.extract_metrics(doc)["wall_s"] == 2.0
+
+
+def test_bench_gate_exit_codes(monkeypatch, capsys, tmp_path):
+    """bench.py --gate: exit 0 against itself, 3 against a faster
+    (synthetically better) baseline — and EVERY row plus the final
+    summary line is still written before the non-zero exit (the r5
+    truncation lesson)."""
+    import bench
+    from tests.test_bench_smoke import _stub_rows
+
+    _stub_rows(monkeypatch)
+    self_gate = tmp_path / "self.json"          # == the stub summary
+    self_gate.write_text(json.dumps({"metric": "x", "value": 1.0,
+                                     "mfu": 0.5}))
+    assert bench.main(["--gate", str(self_gate)]) == 0
+    cap = capsys.readouterr()
+    assert json.loads(cap.out.strip().splitlines()[-1])["ok"] is True
+
+    fast_gate = tmp_path / "fast.json"          # baseline 2x faster
+    fast_gate.write_text(json.dumps({"metric": "x", "value": 0.5}))
+    assert bench.main(["--gate", str(fast_gate)]) == 3
+    cap = capsys.readouterr()
+    out_lines = cap.out.strip().splitlines()
+    verdict = json.loads(out_lines[-1])
+    assert verdict["regressions"] == ["wall_s"]
+    # the evidence survived the failing gate: final summary line
+    # precedes the verdict, rows landed on stderr
+    final = json.loads(out_lines[-2])
+    assert final["metric"] == "mnist_20epoch_wall_clock"
+    assert any('"config": "reference_default"' in ln
+               for ln in cap.err.splitlines())
+
+    empty_gate = tmp_path / "none.json"         # nothing comparable
+    empty_gate.write_text("{}")
+    assert bench.main(["--gate", str(empty_gate)]) == 2
+    capsys.readouterr()
+    assert bench.main(["--gate", str(tmp_path / "missing.json")]) == 2
+
+
+# --- serve: /status + Prometheus -----------------------------------------
+
+
+def test_collect_status(tmp_path):
+    st = serve_lib.collect_status(synth_run(str(tmp_path)))
+    assert st["proc_count"] == 3
+    assert st["run_complete"] is True
+    assert st["live"] is False
+    assert st["procs"]["0"]["step"] == 100
+    assert st["procs"]["2"]["step"] == 80
+    assert st["procs"]["0"]["heartbeat_age_s"] is not None
+    assert st["anomalies"] == 1
+    assert st["flight_dumps"] == 1
+    assert st["run_end"]["test_accuracy"] == 0.91
+
+
+def test_prometheus_text_golden(tmp_path):
+    text = serve_lib.prometheus_text(
+        serve_lib.collect_status(synth_run(str(tmp_path))))
+    lines = text.splitlines()
+    for expected in (
+        "# TYPE dtx_step gauge",
+        'dtx_step{proc="0"} 100',
+        'dtx_step{proc="2"} 80',
+        'dtx_cost{proc="0"} 1.8',
+        'dtx_mfu{proc="0"} 0.013',
+        "dtx_run_complete 1",
+        "dtx_up 0",
+        "dtx_procs 3",
+        "dtx_anomalies_total 1",
+        "dtx_flight_dumps_total 1",
+        "dtx_test_accuracy 0.91",
+        "dtx_total_time_seconds 12",
+    ):
+        assert expected in lines, f"missing: {expected}\n{text}"
+    # every sample line belongs to a # TYPE'd family, values numeric
+    for ln in lines:
+        if ln.startswith("#") or not ln:
+            continue
+        name = ln.split("{")[0].split(" ")[0]
+        assert f"# TYPE {name} gauge" in lines
+        float(ln.rsplit(" ", 1)[1])
+
+
+def test_status_server_endpoints(tmp_path):
+    synth_run(str(tmp_path))
+    srv = serve_lib.StatusServer(str(tmp_path))
+    port = srv.start(0)  # ephemeral
+    assert port and srv.port == port
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                return r.status, r.read().decode()
+
+        code, body = get("/status")
+        assert code == 200 and json.loads(body)["proc_count"] == 3
+        code, body = get("/metrics")
+        assert code == 200 and 'dtx_step{proc="0"} 100' in body
+        code, body = get("/report")
+        rep = json.loads(body)
+        assert code == 200 and rep["kind"] == "run_report"
+        assert rep["goodput"]["buckets"]["train"] == pytest.approx(4.8)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get("/nope")
+        assert ei.value.code == 404
+    finally:
+        srv.close()
+    # close() is idempotent and the port is released
+    srv.close()
+
+
+# --- dtx-obs CLI ----------------------------------------------------------
+
+
+def test_cli_report(tmp_path, capsys):
+    d = synth_run(str(tmp_path))
+    assert cli_lib.main(["report", d]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["kind"] == "run_report"
+    assert cli_lib.main(["report", d, "--summary"]) == 0
+    line = capsys.readouterr().out.strip()
+    assert "goodput=40.0%" in line and "\n" not in line
+    out_file = tmp_path / "report.json"
+    assert cli_lib.main(["report", d, "-o", str(out_file)]) == 0
+    assert json.load(open(out_file))["kind"] == "run_report"
+    assert cli_lib.main(["report", str(tmp_path / "nope")]) == 2
+
+
+def test_cli_compare(tmp_path, capsys):
+    d = synth_run(str(tmp_path))
+    rep = agg_lib.aggregate(d)
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(rep))
+    # a logs DIR as candidate aggregates on the fly; self-compare ok
+    assert cli_lib.main(["compare", str(base), d]) == 0
+    capsys.readouterr()
+    slow = json.loads(json.dumps(rep))
+    slow["wall_s"] *= 1.5
+    cand = tmp_path / "slow.json"
+    cand.write_text(json.dumps(slow))
+    assert cli_lib.main(["compare", str(base), str(cand)]) == 3
+    verdict = json.loads(capsys.readouterr().out)
+    assert "wall_s" in verdict["regressions"]
+    assert cli_lib.main(["compare", str(base), str(cand),
+                         "--threshold", "0.9"]) == 0
+    capsys.readouterr()
+    assert cli_lib.main(["compare", str(base),
+                         str(tmp_path / "missing.json")]) == 2
+    # unknown metric name / malformed spec in --thresholds is a usage
+    # error (exit 2), never a traceback
+    assert cli_lib.main(["compare", str(base), str(cand),
+                         "--thresholds", "bogus=0.1"]) == 2
+    assert cli_lib.main(["compare", str(base), str(cand),
+                         "--thresholds", "wall_s"]) == 2
+    assert cli_lib.main(["compare", str(base), str(cand),
+                         "--thresholds", "wall_s=abc"]) == 2
+
+
+def test_cli_tail(tmp_path, capsys):
+    d = synth_run(str(tmp_path))
+    assert cli_lib.main(["tail", d]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert any("step 100" in ln and "[p0]" in ln for ln in out)
+    assert any("ANOMALY" in ln for ln in out)
+    assert any("run_end" in ln for ln in out)
+    assert cli_lib.main(["tail", d, "-n", "1"]) == 0
+    assert len(capsys.readouterr().out.strip().splitlines()) == 1
+    assert cli_lib.main(["tail", str(tmp_path / "nope")]) == 2
+
+
+def test_cli_validate_exit_codes(tmp_path, capsys):
+    d = synth_run(str(tmp_path))
+    # a crashed run also has the chief's collate report in flight/ —
+    # it has its own shape and must not spuriously FAIL validation
+    from distributed_tensorflow_example_tpu.obs import flight as fl
+
+    fl.collate(d)
+    assert os.path.exists(os.path.join(d, "flight", "report.json"))
+    assert cli_lib.main(["validate", d]) == 0
+    out = capsys.readouterr().out
+    # 3 metrics streams + 1 flight dump + the collate report
+    assert out.count("OK ") == 5
+    # doctor proc 1's stream with a pre-versioned row: precise error
+    bad = os.path.join(d, "metrics.1.jsonl")
+    with open(bad, "a") as f:
+        f.write(json.dumps({"kind": "window", "t": 1.0, "proc": 1})
+                + "\n")
+    assert cli_lib.main(["validate", d]) == 1
+    out = capsys.readouterr().out
+    assert "FAIL" in out and "schema v1" in out
+    assert cli_lib.main(["validate", str(tmp_path / "ghost.json")]) == 2
+
+
+# --- stale-signal hygiene -------------------------------------------------
+
+
+def test_clear_stale_signals(tmp_path):
+    d = synth_run(str(tmp_path))
+    assert hb_lib.read_heartbeats(d)
+    assert os.path.exists(os.path.join(d, "flight", "1.json"))
+    removed = hb_lib.clear_stale_signals(d)
+    assert removed == 4  # 3 heartbeats + 1 flight dump
+    assert hb_lib.read_heartbeats(d) == {}
+    assert not os.listdir(os.path.join(d, "flight"))
+    # the metrics history is NOT a per-run signal and stays
+    assert len([n for n in os.listdir(d)
+                if n.startswith("metrics.")]) == 3
+    # idempotent on a clean dir
+    assert hb_lib.clear_stale_signals(d) == 0
