@@ -610,6 +610,122 @@ def bench_learning_regime(repeats: int = 1):
     return row
 
 
+def bench_input_pipeline(repeats: int = 3, batch: int = 1024,
+                         spe: int = 25, epochs: int = 2,
+                         hidden=(256, 256)):
+    """Input-pipeline overlap evidence: the same host-fed config run
+    with the per-step H2D commit ON the critical path (blocking commit
+    at dispatch time) vs moved OFF it (``--device_prefetch``: batches
+    committed to their step layout ahead of consumption,
+    data/prefetch.DevicePrefetcher). Per-step wall comes from the
+    --metrics window rows — the WindowTimer restarts after the first
+    (compile-paying) dispatch, so compile never pollutes the
+    comparison — and the prefetched variant's capture is aggregated so
+    the row carries the populated ``h2d`` goodput bucket plus the
+    buckets-sum-to-wall check. The variants run interleaved with the
+    repeat count floored at 3 (single-sample A/B is noise; medians
+    reported). On an accelerator the ratio should exceed 1 (the
+    transfer engine runs the commits off the critical path); on the
+    CPU backend the device shares the host's cores, so the testable
+    claim is parity within the recorded tolerance — the row carries
+    ``backend`` so the two readings are never conflated. Gate keys
+    (``blocking_step_ms`` / ``prefetch_step_ms`` / ``overlap_ratio``)
+    are understood by ``dtx-obs compare``, so ``--gate`` holds the
+    line on input-pipeline regressions."""
+    import shutil
+    import tempfile
+
+    from distributed_tensorflow_example_tpu.config import Config
+    from distributed_tensorflow_example_tpu.obs.aggregate import (
+        aggregate, summary_line)
+
+    base = Config(
+        batch_size=batch, hidden_sizes=hidden, activation="relu",
+        dataset="synthetic", synthetic_train_size=batch * spe,
+        synthetic_test_size=batch, training_epochs=epochs,
+        summaries=False, fast_loop=False,   # the host-fed path IS the subject
+        data_parallel=1,                    # isolate the input pipeline from
+                                            # cross-device batch sharding (on
+                                            # the 8-virtual-device CPU harness
+                                            # an 8-way python split would
+                                            # dominate the commit wall)
+        frequency=10 ** 9,                  # no per-print fetches mid-epoch
+        metrics=True, log_every=spe)
+    # comparative row: a single-sample A/B is noise, so repeats are
+    # floored at 3 and the variants run INTERLEAVED (b,p,b,p,...) so
+    # machine drift across the sweep hits both sides equally
+    reps = max(3, repeats)
+    variants = (("blocking", False), ("prefetched", True))
+    row = {"config": "input_pipeline", "batch": batch,
+           "steps_per_epoch": spe, "epochs": epochs, "repeats": reps}
+
+    def one_run(dev: bool):
+        tdir = tempfile.mkdtemp(prefix="bench_ip_")
+        try:
+            _run(base.replace(device_prefetch=dev, logs_path=tdir))
+            # per-step wall over the chief's windows — compile-free by
+            # construction (the WindowTimer restarts after the first
+            # dispatch), so no separate cold run is needed
+            walls = _ip_window_walls(tdir)
+            return (sum(w for w, _ in walls)
+                    / max(1, sum(n for _, n in walls)), aggregate(tdir))
+        finally:
+            shutil.rmtree(tdir, ignore_errors=True)
+
+    per_run = {label: [] for label, _ in variants}
+    for _ in range(reps):
+        for label, dev in variants:
+            per_run[label].append(one_run(dev))
+    step_ms = {}
+    for label, _ in variants:
+        runs = sorted(per_run[label], key=lambda t: t[0])
+        med_step_s, med_rep = runs[len(runs) // 2]
+        step_ms[label] = round(med_step_s * 1e3, 4)
+        g = med_rep["goodput"]
+        row[f"{label}_step_ms"] = step_ms[label]
+        row[f"{label}_h2d_s"] = g["buckets"]["h2d"]
+        row[f"{label}_goodput_line"] = summary_line(med_rep)
+        if label == "prefetched":
+            row["test_accuracy"] = med_rep.get("test_accuracy")
+            # the acceptance invariant: the decomposition still sums
+            # to within 5% of wall with the h2d bucket in play
+            row["bucket_sum_s"] = g["bucket_sum_s"]
+            row["wall_s_capture"] = g["wall_s"]
+            row["buckets_sum_within_5pct"] = bool(
+                abs(g["bucket_sum_s"] - g["wall_s"])
+                <= 0.05 * max(g["wall_s"], 1e-9))
+    import jax
+
+    row["backend"] = jax.default_backend()
+    row["blocking_step_ms"] = step_ms["blocking"]
+    row["prefetch_step_ms"] = step_ms["prefetched"]
+    row["overlap_ratio"] = round(
+        step_ms["blocking"] / max(step_ms["prefetched"], 1e-9), 4)
+    # measurement-honest verdict: on an accelerator the transfer engine
+    # runs the committed copies off the critical path and the ratio
+    # should exceed 1; on the CPU backend the "device" IS the host's
+    # cores (overlap is zero-sum by construction) and jit's own numpy
+    # ingestion is already a near-zero-copy alias, so the testable
+    # claim is parity within measurement noise — the tolerance below,
+    # recorded in the row so the verdict is self-describing
+    row["step_ms_tolerance"] = 0.10
+    row["prefetch_not_slower"] = bool(
+        row["prefetch_step_ms"]
+        <= row["blocking_step_ms"] * (1.0 + row["step_ms_tolerance"]))
+    return row
+
+
+def _ip_window_walls(tdir: str):
+    """[(window_wall_s, steps)] of the chief's window rows — the
+    compile-free per-step wall source for bench_input_pipeline."""
+    from distributed_tensorflow_example_tpu.obs.metrics import read_metrics
+
+    path = os.path.join(tdir, "metrics.0.jsonl")
+    return [(float(r["window_wall_s"]), int(r["steps"]))
+            for r in read_metrics(path)
+            if r.get("kind") == "window" and r.get("steps")]
+
+
 def bench_flash_attention(s: int = 4096, b: int = 4, h: int = 8,
                           d: int = 64, repeats: int = 5):
     """Long-context kernel artifact, measured by ``_delta_chain`` so
@@ -1573,6 +1689,12 @@ def main(argv=None) -> int:
     # tiny-model reference row).
     guarded("learning_regime_lr0.5", bench_learning_regime)
     guarded("real_mnist_parity", bench_real_mnist)
+    # input-pipeline overlap evidence (host-fed path, blocking commit
+    # vs --device_prefetch); its gate keys ride the final summary.
+    # Repeats are bounded: the row floors at 3 internally (A/B rows
+    # need interleaved medians) and a deep sweep need not exceed that.
+    guarded("input_pipeline", bench_input_pipeline,
+            repeats=min(3, max(1, args.repeats)))
     if on_tpu:
         guarded("reference_device_program", bench_reference_device_program)
         # the wide-MXU rows only mean something on a TPU (and in
@@ -1711,6 +1833,16 @@ def main(argv=None) -> int:
          and "tokens_per_sec" in r), None)
     if dec_row:
         extra["decode_tokens_per_sec"] = dec_row["tokens_per_sec"]
+    ip_row = next(
+        (r for r in rows if r.get("config") == "input_pipeline"
+         and "prefetch_step_ms" in r), None)
+    if ip_row:
+        # the gate metrics dtx-obs compare reads off the final line
+        extra["input_pipeline_blocking_step_ms"] = \
+            ip_row["blocking_step_ms"]
+        extra["input_pipeline_prefetch_step_ms"] = \
+            ip_row["prefetch_step_ms"]
+        extra["input_pipeline_overlap_ratio"] = ip_row["overlap_ratio"]
     # real-MNIST parity status ALWAYS rides the final line (VERDICT r4
     # missing #1: the driver captures only the tail of stdout, so the
     # row's outcome must live in the parsed summary, ran or skipped)

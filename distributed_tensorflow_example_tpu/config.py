@@ -199,6 +199,31 @@ class Config:
     shard_data: bool = True         # reference workers each consume the FULL
                                     # dataset (example.py:150-157); sharded
                                     # epochs are the sync-DP equivalent.
+    device_prefetch: bool = False   # host path: commit upcoming batches
+                                    # to their step layout AHEAD of
+                                    # consumption (data/prefetch.
+                                    # DevicePrefetcher), so the H2D copy
+                                    # of batch N+1 overlaps the device
+                                    # execution of batch N; bit-exact
+                                    # with the synchronous commit (the
+                                    # fast path needs no host feeding
+                                    # and ignores this)
+    prefetch_depth: int = 0         # device-prefetch lookahead in
+                                    # batches; 0 = backend-aware default
+                                    # (1 on the CPU backend, where the
+                                    # "device" shares the host's cores
+                                    # and caches; 8 on accelerators,
+                                    # where a real transfer engine runs
+                                    # the copies); explicit values
+                                    # must be >= 1
+    dispatch_depth: int = 0         # bound on in-flight dispatched
+                                    # steps (the host path's async
+                                    # dispatch queue); 0 = backend-aware
+                                    # default (1 on the CPU backend,
+                                    # where concurrent in-flight
+                                    # programs starve the collective
+                                    # rendezvous; 32 on accelerators);
+                                    # explicit values must be >= 1
 
     # ---- observability (example.py:123-128, 145-146) ----
     summaries: bool = True
@@ -308,6 +333,17 @@ class Config:
 
 def _parse_hidden(s: str) -> tuple[int, ...]:
     return tuple(int(x) for x in s.replace(",", " ").split())
+
+
+def _depth(s: str) -> int:
+    """Queue/lookahead depth flag value: >= 1 (the backend-aware
+    default is selected by NOT passing the flag, never by 0)."""
+    v = int(s)
+    if v < 1:
+        raise argparse.ArgumentTypeError(
+            f"depth {v} must be >= 1 (omit the flag for the "
+            f"backend-aware default)")
+    return v
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -462,6 +498,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--synthetic_train_size", type=int, default=d.synthetic_train_size)
     p.add_argument("--synthetic_test_size", type=int, default=d.synthetic_test_size)
     p.add_argument("--no_shard_data", dest="shard_data", action="store_false")
+    p.add_argument("--device_prefetch", action="store_true",
+                   help="host path: commit upcoming batches to their "
+                        "device layout ahead of consumption so the H2D "
+                        "copy of batch N+1 overlaps the device "
+                        "execution of batch N (bit-exact with the "
+                        "synchronous commit; the default fast path "
+                        "keeps the dataset in HBM and ignores this)")
+    p.add_argument("--prefetch_depth", type=_depth, default=d.prefetch_depth,
+                   help="device-prefetch lookahead in batches (>= 1; "
+                        "omit for the backend-aware default: 1 on the "
+                        "CPU backend, 8 on accelerators)")
+    p.add_argument("--dispatch_depth", type=_depth, default=d.dispatch_depth,
+                   help="max in-flight dispatched steps on the host "
+                        "path (>= 1; omit for the backend-aware "
+                        "default: 1 on the CPU backend, where deep "
+                        "queues starve the collective rendezvous, 32 "
+                        "on accelerators)")
     p.add_argument("--no_summaries", dest="summaries", action="store_false")
     p.add_argument("--summaries_all_hosts", action="store_true")
     p.add_argument("--eval_all_hosts", action="store_true",

@@ -1,4 +1,5 @@
 from .mnist import Dataset, DataSplit, load_datasets, EpochIterator
-from .prefetch import Prefetcher
+from .prefetch import DevicePrefetcher, EpochPrefetcher, Prefetcher
 
-__all__ = ["Dataset", "DataSplit", "load_datasets", "EpochIterator", "Prefetcher"]
+__all__ = ["Dataset", "DataSplit", "load_datasets", "EpochIterator",
+           "Prefetcher", "EpochPrefetcher", "DevicePrefetcher"]

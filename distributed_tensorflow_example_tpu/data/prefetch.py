@@ -1,27 +1,50 @@
-"""Background batch prefetcher for the host-fed loop.
+"""Background batch prefetchers for the host-fed loop.
 
 Reference parity: the reference's input pipeline is fully synchronous —
 ``next_batch`` gathers on the host, then ``sess.run`` blocks
-(/root/reference/example.py:157-162); batch prep and training never
-overlap.
+(/root/reference/example.py:157-162); batch prep, host-to-device
+transfer and training never overlap.
 
-Here a daemon thread runs one epoch ahead of the consumer through a
-small bounded queue. The actual gather runs in native C++ via ctypes
-(``native.gather_batch``), which releases the GIL — so prefetch
-genuinely overlaps with the train loop's dispatch work. Used by the
-host path (async local-SGD mode, multi-process); the default fast path
-keeps the whole dataset in HBM and needs no host feeding at all.
+Three stages, composable (the host path uses all three; the default
+fast path keeps the whole dataset in HBM and needs no host feeding):
+
+- ``Prefetcher``: a daemon thread runs ahead of the consumer through a
+  small bounded queue. The actual gather runs in native C++ via ctypes
+  (``native.gather_batch``), which releases the GIL — so prefetch
+  genuinely overlaps with the train loop's dispatch work.
+- ``EpochPrefetcher``: the persistent epoch-aware variant — ONE
+  producer thread spans every epoch of the run (epoch-keyed rewind via
+  :meth:`EpochPrefetcher.epoch`), so epoch boundaries pay no cold
+  thread/queue spin-up and the next epoch's gather overlaps the
+  between-epoch host work (validation eval, checkpoints).
+- ``DevicePrefetcher``: the device-side stage (``--device_prefetch``)
+  — commits upcoming host batches to their step layout (sharded jax
+  Arrays) up to ``depth`` batches ahead of consumption. jax transfers
+  are asynchronous, so the H2D copy of batch N+k overlaps the device
+  execution of batch N instead of blocking dispatch — the
+  ``flax.jax_utils.prefetch_to_device`` lineage every production JAX
+  input stack uses.
 """
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
-from typing import Iterator, Tuple
+from typing import Callable, Iterator, Optional, Tuple
 
 import numpy as np
 
 _END = object()
+
+
+class _EpochEnd:
+    """Queue marker: the producer finished epoch ``epoch``."""
+
+    __slots__ = ("epoch",)
+
+    def __init__(self, epoch: int):
+        self.epoch = epoch
 
 
 class Prefetcher:
@@ -72,10 +95,39 @@ class Prefetcher:
         except queue.Empty:
             pass
 
+    @property
+    def closed(self) -> bool:
+        return self._stop.is_set()
+
+    def _check_open(self) -> None:
+        """A closed prefetcher has no producer and a drained queue (no
+        sentinel left): iterating it would block forever on a ``get``
+        that can never complete — fail fast instead."""
+        if self._stop.is_set():
+            raise RuntimeError(
+                f"{type(self).__name__} is closed; create a new one "
+                f"instead of iterating a closed prefetcher")
+
+    def _get(self):
+        """Blocking queue read that keeps noticing ``close()``: the
+        sentinel may already be gone by the time the consumer blocks."""
+        while True:
+            self._check_open()
+            try:
+                return self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        # eager check: iter() on a closed prefetcher raises at the
+        # call, not at the first next() (generators run lazily)
+        self._check_open()
+        return self._iter()
+
+    def _iter(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         try:
             while True:
-                item = self._q.get()
+                item = self._get()
                 if item is _END:
                     if self._err:
                         raise self._err[0]
@@ -83,3 +135,192 @@ class Prefetcher:
                 yield item
         finally:
             self.close()
+
+
+class EpochPrefetcher(Prefetcher):
+    """One persistent producer across every epoch of a run.
+
+    ``epoch_fn(e)`` must return epoch ``e``'s batch iterator (e.g.
+    ``EpochIterator.epoch``). The single producer thread runs the
+    epochs of ``epoch_indices`` back to back, separated by epoch-end
+    markers — while the consumer evaluates/checkpoints between epochs
+    the producer is already gathering the next epoch's batches, and no
+    epoch pays a cold thread/queue spin-up.
+
+    :meth:`epoch` is the epoch-keyed rewind: it yields exactly epoch
+    ``e``'s batches, first dropping whatever the consumer left behind
+    of earlier epochs. The stream is forward-only — epochs can only be
+    consumed in the order produced (re-requesting a finished epoch
+    raises), which is all the train loop needs and what keeps this one
+    bounded queue instead of a cache.
+    """
+
+    def __init__(self, epoch_fn: Callable[[int], Iterator],
+                 epoch_indices, depth: int = 2):
+        self._indices = list(epoch_indices)
+        self._pos = 0   # consumer cursor into _indices (the epoch at
+                        # the queue head, barring in-flight markers)
+        self._next_allowed = 0  # hand-out cursor: epochs at earlier
+                                # indices were already handed to a
+                                # consumer (possibly partially drained)
+        super().__init__(self._chain(epoch_fn, self._indices), depth)
+
+    def __iter__(self):
+        raise TypeError(
+            "EpochPrefetcher is consumed per epoch — use .epoch(e); "
+            "direct iteration would interleave internal epoch markers "
+            "with batches")
+
+    @staticmethod
+    def _chain(epoch_fn, indices):
+        for e in indices:
+            yield from epoch_fn(e)
+            yield _EpochEnd(e)
+
+    def _advance(self, finished_epoch: int) -> None:
+        self._pos = self._indices.index(finished_epoch) + 1
+
+    def epoch(self, e: int) -> Iterator:
+        """Yield epoch ``e``'s batches (epoch-keyed rewind)."""
+        if e not in self._indices:
+            raise RuntimeError(
+                f"epoch {e} is not in this prefetcher's sequence "
+                f"{self._indices!r}")
+        # forward-only against the HAND-OUT cursor, not just the queue
+        # position: re-requesting an epoch that was already handed out
+        # (even if only partially drained) would silently yield a
+        # truncated epoch, never 'exactly epoch e's batches'
+        if self._indices.index(e) < self._next_allowed:
+            raise RuntimeError(
+                f"epoch {e} was already consumed (or started) — the "
+                f"prefetch stream is forward-only")
+        self._next_allowed = self._indices.index(e) + 1
+        return self._epoch_iter(e)
+
+    def _epoch_iter(self, e: int) -> Iterator:
+        # fast-forward: drop earlier epochs' leftovers (a consumer that
+        # abandoned an epoch mid-way rewinds to the next epoch's start)
+        while self._pos < len(self._indices) and self._indices[self._pos] != e:
+            item = self._get()
+            if item is _END:
+                if self._err:
+                    raise self._err[0]
+                raise RuntimeError(f"stream ended before epoch {e}")
+            if isinstance(item, _EpochEnd):
+                self._advance(item.epoch)
+        while True:
+            item = self._get()
+            if item is _END:
+                if self._err:
+                    raise self._err[0]
+                raise RuntimeError(f"stream ended inside epoch {e}")
+            if isinstance(item, _EpochEnd):
+                self._advance(item.epoch)
+                return
+            yield item
+
+
+class DevicePrefetcher:
+    """Bounded depth-K device-commit pipeline — the H2D overlap stage.
+
+    Pulls host batches from a source iterator and immediately commits
+    each to its step layout via ``commit(x, y) -> (x_dev, y_dev)``
+    (``jax.device_put`` / ``make_array_from_process_local_data`` /
+    ``make_array_from_callback`` with the sharding from
+    ``parallel.step.batch_layout``), keeping up to ``depth`` committed
+    batches buffered ahead of the consumer. jax transfers are
+    asynchronous — ``commit`` returns as soon as the copies are
+    enqueued — so the H2D transfer of batch N+k proceeds while the
+    device executes batch N, and the train loop dispatches on arrays
+    that are already (becoming) device-resident instead of paying the
+    copy on the critical path.
+
+    Pure python, no thread of its own: the commit call is cheap host
+    work (the transfer engine does the copying), and running it inline
+    on the consumer thread commits batches in exactly the order the
+    source yields them — which is what keeps the device-prefetched
+    path bit-exact with the synchronous-commit path.
+
+    One instance persists across epochs: :meth:`rewind` re-arms the
+    same object on the next epoch's source, dropping any buffered
+    batches from the old source (the arrays just release) and clearing
+    a pending source error. :meth:`close` releases the buffer and
+    makes further iteration raise — early-exit safe. A source error
+    surfaces after the already-committed batches, mirroring
+    ``Prefetcher``'s ordering.
+    """
+
+    def __init__(self, commit: Callable, depth: int = 2, source=None):
+        if depth < 1:
+            raise ValueError(f"depth={depth} must be >= 1")
+        self._commit = commit
+        self._depth = depth
+        self._buf: collections.deque = collections.deque()
+        self._it = iter(source) if source is not None else None
+        self._err: Optional[BaseException] = None
+        self._done = source is None
+        self._closed = False
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    def rewind(self, source) -> "DevicePrefetcher":
+        """Re-arm on a new source (the next epoch); returns self."""
+        if self._closed:
+            raise RuntimeError("DevicePrefetcher is closed")
+        self._buf.clear()
+        self._it = iter(source)
+        self._err = None
+        self._done = False
+        return self
+
+    def close(self) -> None:
+        """Drop buffered device batches and refuse further iteration
+        (idempotent; called by consumers on early exit)."""
+        self._closed = True
+        self._buf.clear()
+        self._it = None
+        self._done = True
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def _fill(self) -> None:
+        while not self._done and len(self._buf) < self._depth:
+            try:
+                item = next(self._it)
+            except StopIteration:
+                self._done = True
+                return
+            except Exception as e:  # surfaced after buffered items.
+                # NOT BaseException: _fill runs on the consumer thread
+                # (unlike Prefetcher._produce), so a KeyboardInterrupt
+                # must stop the run now, not resurface `depth` steps
+                # later disguised as a data-pipeline failure
+                self._err = e
+                self._done = True
+                return
+            self._buf.append(self._commit(*item))
+
+    def __iter__(self) -> Iterator:
+        # eager check, like Prefetcher: iter() on a closed instance
+        # raises at the call, not at the first next()
+        if self._closed:
+            raise RuntimeError("DevicePrefetcher is closed")
+        return self._iter()
+
+    def _iter(self) -> Iterator:
+        self._fill()
+        while True:
+            if self._closed:
+                raise RuntimeError("DevicePrefetcher is closed")
+            if not self._buf:
+                if self._err is not None:
+                    err, self._err = self._err, None
+                    raise err
+                return
+            item = self._buf.popleft()
+            yield item
+            self._fill()

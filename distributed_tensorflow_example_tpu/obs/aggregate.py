@@ -10,7 +10,8 @@ into one report:
   production fleet reports use (the goodput/badput decomposition of
   Google's large-fleet training reports, MegaScale-style straggler
   attribution): productive ``train`` time vs ``compile``,
-  ``data_wait``, host overhead, ``anomaly_skipped`` step time,
+  ``data_wait``, ``h2d`` (batch device-commit wall),
+  host overhead, ``anomaly_skipped`` step time,
   ``straggler_idle`` (derived from per-proc step lag) and the
   ``untracked`` residual, plus the non-train-but-useful ``eval`` /
   ``sample`` phases. The run_end event carries the cumulative
@@ -41,8 +42,10 @@ from . import schema as schema_lib
 
 # bucket names, in presentation order; "train" is the goodput bucket,
 # "eval"/"sample" are auxiliary useful work, the rest is badput
-BUCKETS = ("train", "compile", "data_wait", "host", "eval", "sample",
-           "anomaly_skipped", "straggler_idle", "untracked")
+# ("h2d" = the host wall spent committing batches to their device
+# layout — overlapped ahead of dispatch under --device_prefetch)
+BUCKETS = ("train", "compile", "data_wait", "h2d", "host", "eval",
+           "sample", "anomaly_skipped", "straggler_idle", "untracked")
 
 _METRICS_RE = re.compile(r"metrics\.(\d+)\.jsonl$")
 
@@ -133,6 +136,7 @@ def _goodput(windows: List[Dict[str, Any]], run_end: Optional[Dict],
         return sum(float(w.get(key) or 0.0) for w in windows)
 
     data_wait = wsum("data_wait_s")
+    h2d = wsum("h2d_s")
     train = wsum("dispatch_s") + wsum("device_wait_s")
     host = wsum("host_s")
     steps_obs = int(wsum("steps"))
@@ -149,13 +153,14 @@ def _goodput(windows: List[Dict[str, Any]], run_end: Optional[Dict],
     train -= anomaly_skipped
     straggler_idle = min(train, max(0, lag_steps) * mean_step_s)
     train -= straggler_idle
-    known = (train + compile_s + data_wait + host + eval_s + sample_s
-             + anomaly_skipped + straggler_idle)
+    known = (train + compile_s + data_wait + h2d + host + eval_s
+             + sample_s + anomaly_skipped + straggler_idle)
     untracked = max(0.0, wall - known)
     buckets = {
         "train": train,
         "compile": compile_s,
         "data_wait": data_wait,
+        "h2d": h2d,
         "host": host,
         "eval": eval_s,
         "sample": sample_s,
@@ -164,7 +169,7 @@ def _goodput(windows: List[Dict[str, Any]], run_end: Optional[Dict],
         "untracked": untracked,
     }
     buckets = {k: round(v, 6) for k, v in buckets.items()}
-    badput = (compile_s + data_wait + host + anomaly_skipped
+    badput = (compile_s + data_wait + h2d + host + anomaly_skipped
               + straggler_idle + untracked)
     out = {
         "wall_s": round(wall, 6),
@@ -354,6 +359,8 @@ def summary_line(report: Dict[str, Any]) -> str:
         f"compile={g.get('buckets', {}).get('compile', 0):.3g}s",
         f"data_wait={g.get('buckets', {}).get('data_wait', 0):.3g}s",
     ]
+    if g.get("buckets", {}).get("h2d"):
+        bits.append(f"h2d={g['buckets']['h2d']:.3g}s")
     if tp.get("mfu_mean") is not None:
         bits.append(f"mfu={tp['mfu_mean']}")
     if tp.get("examples_per_sec_last") is not None:

@@ -41,6 +41,15 @@ GATE_METRICS: Dict[str, tuple] = {
     "step_time_p50_ms": ("lower", 0.05),
     "goodput_frac": ("higher", 0.05),
     "test_accuracy": ("higher", 0.02),
+    # the bench input-pipeline row (bench_input_pipeline): per-step
+    # wall with the H2D commit on vs off the critical path, and their
+    # ratio — gating these holds the device-prefetch win over time.
+    # Wider default thresholds than the steady-state metrics: these
+    # are medians of short interleaved A/B runs, noisier by nature
+    # (tighten per-deployment via --thresholds when the host is quiet)
+    "blocking_step_ms": ("lower", 0.15),
+    "prefetch_step_ms": ("lower", 0.15),
+    "overlap_ratio": ("higher", 0.15),
 }
 
 
@@ -97,6 +106,12 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
         put("wall_s", m.get("cpu_baseline_wall_clock_20ep_s"))
         put("test_accuracy", m.get("cpu_baseline_test_accuracy"))
         return out
+    if "prefetch_step_ms" in doc:               # bench input-pipeline row
+        put("prefetch_step_ms", doc.get("prefetch_step_ms"))
+        put("blocking_step_ms", doc.get("blocking_step_ms"))
+        put("overlap_ratio", doc.get("overlap_ratio"))
+        put("test_accuracy", doc.get("test_accuracy"))
+        return out
     if "wall_clock_20ep_s" in doc:              # bench per-config row
         put("wall_s", doc.get("wall_clock_20ep_s"))
         put("examples_per_sec", doc.get("examples_per_sec"))
@@ -109,6 +124,11 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
         put("wall_s", doc.get("value"))
         put("mfu", doc.get("mfu"))
         put("test_accuracy", doc.get("learning_accuracy"))
+        # the input-pipeline keys ride the final line (input_pipeline_*
+        # prefix there), so --gate holds the prefetch win too
+        put("blocking_step_ms", doc.get("input_pipeline_blocking_step_ms"))
+        put("prefetch_step_ms", doc.get("input_pipeline_prefetch_step_ms"))
+        put("overlap_ratio", doc.get("input_pipeline_overlap_ratio"))
         return out
     # last resort: any directly-named gate metrics
     for name in GATE_METRICS:

@@ -13,12 +13,15 @@ Two row kinds:
 
 ``WindowTimer`` is the host-loop accumulator behind the window rows:
 the loop charges each step's phases into named buckets (``data_wait``
-= blocking on the prefetcher, ``dispatch`` = the jit'd step call,
-``device_wait`` = blocking fetches: the bounded-queue drain and the
-window-boundary metric fetch) and records per-step wall times for the
-percentiles. Everything not charged is the ``host`` residual. The
-timer adds NO device traffic — it only wraps host-side waits the loop
-already performs, so the dispatch-queue depth is unchanged.
+= blocking on the prefetcher, ``h2d`` = committing batches to their
+device layout — at dispatch time on the blocking path, ahead of
+consumption under ``--device_prefetch``, ``dispatch`` = the jit'd
+step call, ``device_wait`` = blocking fetches: the bounded-queue
+drain and the window-boundary metric fetch) and records per-step wall
+times for the percentiles. Everything not charged is the ``host``
+residual. The timer adds NO device traffic — it only wraps host-side
+waits the loop already performs, so the dispatch-queue depth is
+unchanged.
 
 ``read_metrics`` parses a file back (tests, tooling).
 """
@@ -103,6 +106,7 @@ class WindowTimer:
         wall = time.perf_counter() - self._t_start
         st = sorted(self.step_times)
         data_wait = self.buckets.get("data_wait", 0.0)
+        h2d = self.buckets.get("h2d", 0.0)
         dispatch = self.buckets.get("dispatch", 0.0)
         device_wait = self.buckets.get("device_wait", 0.0)
         return {
@@ -113,9 +117,10 @@ class WindowTimer:
             "step_time_max_ms": round((st[-1] if st else float("nan"))
                                       * 1e3, 4),
             "data_wait_s": round(data_wait, 6),
+            "h2d_s": round(h2d, 6),
             "dispatch_s": round(dispatch, 6),
             "device_wait_s": round(device_wait, 6),
-            "host_s": round(max(0.0, wall - data_wait - dispatch
+            "host_s": round(max(0.0, wall - data_wait - h2d - dispatch
                                  - device_wait), 6),
         }
 

@@ -29,8 +29,9 @@ _NUM = (int, float)
 # errors. Bump it whenever a field is renamed/retyped/removed.
 # History: v1 = PR 1/2 (unstamped metrics rows, flight "version": 1);
 # v2 = the stamp itself + the run_end goodput fields
-# (compile_s/eval_s/sample_s).
-SCHEMA_VERSION = 2
+# (compile_s/eval_s/sample_s); v3 = the h2d_s window bucket (the
+# batch device-commit wall) + the matching h2d goodput bucket.
+SCHEMA_VERSION = 3
 
 
 # field -> allowed types; a tuple including type(None) marks nullable
@@ -55,6 +56,7 @@ METRICS_WINDOW = {
     "step_time_p95_ms": _NUM,
     "step_time_max_ms": _NUM,
     "data_wait_s": _NUM,
+    "h2d_s": _NUM,
     "dispatch_s": _NUM,
     "device_wait_s": _NUM,
     "host_s": _NUM,

@@ -20,7 +20,7 @@ lines up with the metrics split (obs/metrics.py buckets).
   the trace instead of leaving a corrupt/unterminated capture;
 - ``step_annotation``/``annotate`` wrap ``jax.profiler``'s
   ``StepTraceAnnotation``/``TraceAnnotation`` with the SAME scope
-  names as the metrics buckets (``data_wait``, ``dispatch``,
+  names as the metrics buckets (``data_wait``, ``h2d``, ``dispatch``,
   ``device_wait``, ``eval``, ``checkpoint``) and collapse to
   ``nullcontext`` when tracing is off — zero steady-state cost;
 - ``--profile_port`` starts the on-demand profiler server
@@ -186,7 +186,7 @@ class WindowedTracer:
 
     def annotate(self, name: str):
         """Named ``TraceAnnotation`` scope; names match the metrics
-        buckets (data_wait / dispatch / device_wait / eval /
+        buckets (data_wait / h2d / dispatch / device_wait / eval /
         checkpoint) so the trace timeline and the JSONL split agree.
         nullcontext whenever no capture is open (see step_annotation)."""
         if not self._active:

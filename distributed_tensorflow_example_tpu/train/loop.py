@@ -21,7 +21,11 @@ process (barrier-free, SURVEY.md §3.2). Two execution paths:
   per-step summaries and per-100-step prints exactly;
 - **host path** (async local-SGD mode, multi-process, or
   ``--no_fast_loop``): a host loop feeding one batch per step — still
-  one donated jit'd SPMD step, with a bounded dispatch queue.
+  one donated jit'd SPMD step, with a bounded dispatch queue
+  (``--dispatch_depth``), a persistent cross-epoch host prefetcher
+  and, under ``--device_prefetch``, batches committed to their device
+  layout ahead of consumption so H2D overlaps compute
+  (data/prefetch.py).
 """
 
 from __future__ import annotations
@@ -285,6 +289,14 @@ def run(cfg: Config) -> Dict[str, Any]:
         raise ValueError("weight_decay and grad_clip must be >= 0")
     if cfg.log_every < 1:
         raise ValueError(f"log_every={cfg.log_every} must be >= 1")
+    # depth flags: 0 = backend-aware default (the CLI type already
+    # rejects explicit values < 1; this guards direct Config use)
+    if cfg.dispatch_depth < 0:
+        raise ValueError(f"dispatch_depth={cfg.dispatch_depth} must be "
+                         f">= 1 (0 = backend default)")
+    if cfg.prefetch_depth < 0:
+        raise ValueError(f"prefetch_depth={cfg.prefetch_depth} must be "
+                         f">= 1 (0 = backend default)")
     if cfg.histograms:
         if cfg.fsdp or cfg.sync_period > 1:
             raise ValueError("--histograms rides the synchronous SPMD "
@@ -967,8 +979,8 @@ def run(cfg: Config) -> Dict[str, Any]:
                          "steps": batch_count, "window_wall_s": wall,
                          "step_time_p50_ms": ms, "step_time_p95_ms": ms,
                          "step_time_max_ms": ms, "data_wait_s": 0.0,
-                         "dispatch_s": 0.0, "device_wait_s": wall,
-                         "host_s": 0.0})
+                         "h2d_s": 0.0, "dispatch_s": 0.0,
+                         "device_wait_s": wall, "host_s": 0.0})
                     heartbeat.touch((epoch + 1) * batch_count)
                     straggler_event(epoch)
                 if flight is not None:
@@ -1126,18 +1138,32 @@ def run(cfg: Config) -> Dict[str, Any]:
                 process_index=proc_idx,
                 process_count=proc_cnt,
             )
-            # Bound the async dispatch queue. On TPU a deep window keeps the
+            # Bound the async dispatch queue (--dispatch_depth; 0 = the
+            # backend-aware default). On TPU a deep window keeps the
             # pipeline full; on the CPU backend (tests: 8 virtual devices on
             # few cores) concurrent in-flight programs can starve the
             # collective rendezvous, so dispatch is serialized there.
-            window = 1 if jax.default_backend() == "cpu" else 32
+            is_cpu = jax.default_backend() == "cpu"
+            window = cfg.dispatch_depth or (1 if is_cpu else 32)
             inflight: list = []
+            # --device_prefetch: commit upcoming batches to their step
+            # layout AHEAD of consumption (data/prefetch.DevicePrefetcher)
+            # so the H2D copy of batch N+1 overlaps the device execution
+            # of batch N instead of blocking dispatch. Depth default is
+            # backend-aware like the dispatch window: 1 on CPU (the
+            # "device" shares the host's cores and caches, so committing
+            # deeper than one batch ahead only evicts cache lines), 8 on
+            # accelerators (a real transfer engine runs the copies).
+            dev_prefetch = cfg.device_prefetch
+            prefetch_depth = cfg.prefetch_depth or (1 if is_cpu else 8)
             # Multi-process: every process holds only its local batch slice;
             # assemble the global array explicitly (a bare numpy arg would be
-            # treated as the full global batch on every process).
-            batch_sharding = None
+            # treated as the full global batch on every process). Single
+            # process commits only under --device_prefetch (the jit call
+            # does the transfer itself on the blocking path).
             x_sharding = None
-            if proc_cnt > 1:
+            y_sharding = None
+            if proc_cnt > 1 or dev_prefetch:
                 from jax.sharding import NamedSharding
 
                 # x/y must be committed with the step's own layout (from
@@ -1145,10 +1171,9 @@ def run(cfg: Config) -> Dict[str, Any]:
                 # under sparse-dispatch EP); committing a different spec
                 # would force a reshard collective every step
                 _, _, x_ps, y_ps = step_lib.batch_layout(mesh, spec)
-                batch_sharding = NamedSharding(mesh, y_ps)
                 x_sharding = NamedSharding(mesh, x_ps)
+                y_sharding = NamedSharding(mesh, y_ps)
             start_time = time.time()  # example.py:149
-            from ..data.prefetch import Prefetcher
 
             # telemetry state: the window timer charges the loop's existing
             # host-side waits into named buckets (data_wait = prefetcher
@@ -1201,20 +1226,71 @@ def run(cfg: Config) -> Dict[str, Any]:
                     flight.attach_loss(sid, c)
                 policy.on_step(sid, loss=c, flagged=flagged, counts=counts)
 
-            def timed_batches(prefetcher):
-                """enumerate(prefetcher), charging the blocking next() into
-                the window's data_wait bucket."""
-                it = iter(prefetcher)
+            h2d_wall = [0.0]  # cumulative commit wall (timed_batches
+                              # subtracts it from data_wait: the two
+                              # buckets must stay disjoint when commits
+                              # run inside the device prefetcher's next())
+
+            def commit_batch(bx, by):
+                """Commit one host batch to the step's batch layout
+                (step_lib.batch_layout): the H2D transfer. Multi-process
+                assembles the global array from local slices; sequence-
+                parallel multi-process slices per-device blocks out of
+                the full batch every process iterates; single-process
+                commits only under --device_prefetch (otherwise the
+                numpy batch passes through and the jit call transfers
+                it at dispatch)."""
+                if seq_mp:
+                    # every process holds the full batch; each device
+                    # takes its (row, token-block) slice
+                    x = jax.make_array_from_callback(
+                        bx.shape, x_sharding, lambda idx: bx[idx])
+                    y = jax.make_array_from_callback(
+                        by.shape, y_sharding, lambda idx: by[idx])
+                elif proc_cnt > 1:
+                    x = jax.make_array_from_process_local_data(
+                        x_sharding, bx)
+                    y = jax.make_array_from_process_local_data(
+                        y_sharding, by)
+                elif dev_prefetch:
+                    x = jax.device_put(bx, x_sharding)
+                    y = jax.device_put(by, y_sharding)
+                else:
+                    return bx, by
+                return x, y
+
+            def commit_timed(bx, by):
+                """commit_batch, charged into the h2d bucket (and the
+                matching trace scope). jax transfers are async — this
+                wall is the host-side enqueue, not the copy itself."""
+                t0 = time.perf_counter()
+                with tracer.annotate("h2d"):
+                    out = commit_batch(bx, by)
+                dt = time.perf_counter() - t0
+                h2d_wall[0] += dt
+                if wtimer is not None:
+                    wtimer.charge("h2d", dt)
+                return out
+
+            def timed_batches(batches):
+                """enumerate(batches), charging the blocking next() into
+                the window's data_wait bucket — minus any h2d commit
+                wall spent inside that next() when the device
+                prefetcher is the feed."""
+                it = iter(batches)
                 i = 0
                 while True:
                     t0 = time.perf_counter()
+                    h0 = h2d_wall[0]
                     try:
                         with tracer.annotate("data_wait"):
                             item = next(it)
                     except StopIteration:
                         return
                     if wtimer is not None:
-                        wtimer.charge("data_wait", time.perf_counter() - t0)
+                        wtimer.charge("data_wait",
+                                      max(0.0, time.perf_counter() - t0
+                                          - (h2d_wall[0] - h0)))
                     yield i, item
                     i += 1
 
@@ -1260,38 +1336,40 @@ def run(cfg: Config) -> Dict[str, Any]:
 
             steps_done = start_epoch * iterator.batches_per_epoch
             graph_dumped = False
-            for epoch in range(start_epoch, cfg.training_epochs):
-                batch_count = iterator.batches_per_epoch  # example.py:153
-                count = 0
-                # epoch-keyed shuffle: resume at epoch E replays the same
-                # permutations an uninterrupted run would have used
-                prefetcher = Prefetcher(iterator.epoch(epoch))
-                if wtimer is not None:
-                    # inter-epoch host work (validation eval, checkpoint,
-                    # prefetcher spin-up) must not bleed into the next
-                    # window's wall and deflate its throughput fields
-                    wtimer.reset()
-                try:
-                    for i, (batch_x, batch_y) in timed_batches(prefetcher):
-                        if batch_sharding is not None:
-                            if seq_mp:
-                                # every process holds the full batch; each
-                                # device takes its (row, token-block) slice
-                                bx, by = batch_x, batch_y
-                                batch_x = jax.make_array_from_callback(
-                                    bx.shape, x_sharding, lambda idx: bx[idx]
-                                )
-                                batch_y = jax.make_array_from_callback(
-                                    by.shape, batch_sharding,
-                                    lambda idx: by[idx]
-                                )
-                            else:
-                                batch_x = jax.make_array_from_process_local_data(
-                                    x_sharding, batch_x
-                                )
-                                batch_y = jax.make_array_from_process_local_data(
-                                    batch_sharding, batch_y
-                                )
+            # ONE persistent host producer spans every epoch (epoch-keyed
+            # rewind — the next epoch's gather overlaps the between-epoch
+            # eval/checkpoint host work, and no epoch pays a cold
+            # thread/queue spin-up). Epoch-keyed shuffle: resume at epoch
+            # E replays the same permutations an uninterrupted run would
+            # have used. Under --device_prefetch ONE DevicePrefetcher
+            # keeps up to prefetch_depth committed batches in flight
+            # across the whole run.
+            from ..data.prefetch import DevicePrefetcher, EpochPrefetcher
+
+            prefetcher = EpochPrefetcher(
+                iterator.epoch, range(start_epoch, cfg.training_epochs))
+            dev_feed = (DevicePrefetcher(commit_timed, depth=prefetch_depth)
+                        if dev_prefetch else None)
+            try:
+                for epoch in range(start_epoch, cfg.training_epochs):
+                    batch_count = iterator.batches_per_epoch  # example.py:153
+                    count = 0
+                    feed = prefetcher.epoch(epoch)
+                    if dev_feed is not None:
+                        feed = dev_feed.rewind(feed)
+                    if wtimer is not None:
+                        # inter-epoch host work (validation eval,
+                        # checkpoint) must not bleed into the next
+                        # window's wall and deflate its throughput fields
+                        wtimer.reset()
+                    for i, (batch_x, batch_y) in timed_batches(feed):
+                        if dev_feed is None:
+                            # blocking path: the commit runs on the
+                            # critical path, at dispatch time (the
+                            # prefetched feed yields pre-committed
+                            # device arrays instead)
+                            batch_x, batch_y = commit_timed(batch_x,
+                                                            batch_y)
                         if not graph_dumped:
                             graph_dumped = True
                             dump_graph(train_step, state, batch_x, batch_y)
@@ -1392,18 +1470,25 @@ def run(cfg: Config) -> Dict[str, Any]:
                     # next epoch unchecked
                     while anom_pending:
                         drain_anomaly(anom_pending.pop(0))
-                finally:
-                    prefetcher.close()
-                epochs_done = epoch + 1
-                if mlogger is not None:
-                    straggler_event(epoch)
-                if early:
-                    p_eval = (get_params(state)
-                              if (async_mode or fsdp_mode) else state.params)
-                    if note_validation(host_eval_accuracy(
-                            p_eval, dataset.validation.images,
-                            dataset.validation.labels)):
-                        break
+                    epochs_done = epoch + 1
+                    if mlogger is not None:
+                        straggler_event(epoch)
+                    if early:
+                        p_eval = (get_params(state)
+                                  if (async_mode or fsdp_mode)
+                                  else state.params)
+                        if note_validation(host_eval_accuracy(
+                                p_eval, dataset.validation.images,
+                                dataset.validation.labels)):
+                            break
+            finally:
+                # early exit / crash: release the committed device
+                # batches and stop the producer thread (the persistent
+                # prefetcher outlives every epoch, so this is the one
+                # close point)
+                if dev_feed is not None:
+                    dev_feed.close()
+                prefetcher.close()
 
         # a WINDOWED capture still open when training ends closes HERE:
         # the requested steps — not eval, sampling or shutdown — are

@@ -54,6 +54,14 @@ def _stub_rows(monkeypatch):
         bench, "bench_real_mnist",
         lambda repeats=1: {"config": "real_mnist_parity",
                            "skipped": "stubbed: no real MNIST"})
+    monkeypatch.setattr(
+        bench, "bench_input_pipeline",
+        lambda repeats=3: {"config": "input_pipeline",
+                           "blocking_step_ms": 10.0,
+                           "prefetch_step_ms": 9.0,
+                           "overlap_ratio": 1.1111,
+                           "prefetch_not_slower": True,
+                           "test_accuracy": 0.9})
     for name in ("bench_reference_device_program", "bench_mxu",
                  "bench_pallas_parity", "bench_flash_attention",
                  "bench_ring_flash", "bench_transformer_wide",
@@ -83,6 +91,11 @@ def test_bench_main_cpu_stubbed(monkeypatch, capsys):
     for key in ("value", "unit", "vs_baseline", "config", "real_mnist"):
         assert key in final, key
     assert final["real_mnist"] == "skipped"
+    # the input-pipeline gate keys ride the final line (dtx-obs
+    # compare reads them off a BENCH capture via extract_metrics)
+    assert final["input_pipeline_blocking_step_ms"] == 10.0
+    assert final["input_pipeline_prefetch_step_ms"] == 9.0
+    assert final["input_pipeline_overlap_ratio"] == 1.1111
 
 
 def test_bench_main_all_configs_stubbed(monkeypatch, capsys):

@@ -57,6 +57,25 @@ def test_mnist_mirror_flag():
     assert parse_config([]).mnist_mirrors == ()
 
 
+def test_input_pipeline_flags():
+    """--device_prefetch / --prefetch_depth / --dispatch_depth parse;
+    explicit depths below 1 are rejected at the CLI (0 = the
+    backend-aware default, selected by omitting the flag)."""
+    import pytest
+
+    cfg = parse_config(["--device_prefetch", "--prefetch_depth=4",
+                        "--dispatch_depth=16"])
+    assert cfg.device_prefetch
+    assert cfg.prefetch_depth == 4 and cfg.dispatch_depth == 16
+    d = parse_config([])
+    assert not d.device_prefetch
+    assert d.prefetch_depth == 0 and d.dispatch_depth == 0  # auto
+    for bad in (["--prefetch_depth=0"], ["--dispatch_depth=0"],
+                ["--dispatch_depth=-3"]):
+        with pytest.raises(SystemExit):
+            parse_config(bad)
+
+
 def test_r3_flag_surface_parses():
     """Every r3 flag parses and lands on its Config field."""
     from distributed_tensorflow_example_tpu.config import parse_config

@@ -93,6 +93,7 @@ def test_window_timer_percentiles():
     t = WindowTimer()
     t.step_times = [0.01 * k for k in range(1, 101)]  # 10ms .. 1000ms
     t.charge("data_wait", 1.5)
+    t.charge("h2d", 0.5)
     t.charge("dispatch", 2.0)
     t.charge("device_wait", 0.25)
     row = t.window_row()
@@ -101,8 +102,12 @@ def test_window_timer_percentiles():
     assert row["step_time_p95_ms"] == pytest.approx(950, abs=15)
     assert row["step_time_max_ms"] == pytest.approx(1000, abs=1)
     assert row["data_wait_s"] == 1.5
+    assert row["h2d_s"] == 0.5
     assert row["dispatch_s"] == 2.0
     assert row["device_wait_s"] == 0.25
+    # the host residual excludes EVERY charged bucket, h2d included
+    assert row["host_s"] == pytest.approx(
+        max(0.0, row["window_wall_s"] - 1.5 - 0.5 - 2.0 - 0.25), abs=1e-6)
 
 
 def test_metrics_logger_roundtrip(tmp_path):
@@ -209,11 +214,11 @@ def test_metrics_jsonl_host_path(tmp_path):
     for r in windows:
         for key in ("step", "epoch", "cost", "steps", "window_wall_s",
                     "step_time_p50_ms", "step_time_p95_ms",
-                    "step_time_max_ms", "data_wait_s", "dispatch_s",
-                    "device_wait_s", "host_s", "examples_per_sec",
-                    "tokens_per_sec", "model_flops_per_step",
-                    "tflops_per_sec", "mfu", "rss_bytes",
-                    "device_memory"):
+                    "step_time_max_ms", "data_wait_s", "h2d_s",
+                    "dispatch_s", "device_wait_s", "host_s",
+                    "examples_per_sec", "tokens_per_sec",
+                    "model_flops_per_step", "tflops_per_sec", "mfu",
+                    "rss_bytes", "device_memory"):
             assert key in r, key
         assert r["path"] == "host"
         assert r["steps"] == 50
@@ -223,6 +228,7 @@ def test_metrics_jsonl_host_path(tmp_path):
         # the split is charged from real waits the loop already pays
         assert r["dispatch_s"] > 0
         assert r["data_wait_s"] >= 0 and r["device_wait_s"] >= 0
+        assert r["h2d_s"] >= 0
     assert windows[-1]["step"] == 100
     # MFU accounting is the bench's own helper (obs/flops.py): the
     # FLOPs match bench._model_flops_per_step exactly; on CPU the
@@ -265,6 +271,7 @@ def test_metrics_fast_path(tmp_path):
         assert r["examples_per_sec"] > 0
         assert r["device_wait_s"] == r["window_wall_s"] > 0
         assert r["data_wait_s"] == 0.0  # dataset lives in HBM
+        assert r["h2d_s"] == 0.0       # staged once, before the timer
         assert "mfu" in r
     events = {r["event"] for r in rows if r["kind"] == "event"}
     assert {"compile", "stragglers", "run_end"} <= events

@@ -8,9 +8,10 @@ straggler (proc 2 trails by 20 steps), one anomaly-skip window and
 hand-picked timing so the goodput decomposition is checkable in
 closed form:
 
-    wall 12.0s = train 4.8 + compile 2.0 + data_wait 1.0 + host 1.0
-               + eval 0.8 + sample 0.2 + anomaly_skipped 0.4
-               + straggler_idle 0.8 + untracked 1.0
+    wall 12.0s = train 4.8 + compile 2.0 + data_wait 1.0 + h2d 0.5
+               + host 0.5 + eval 0.8 + sample 0.2
+               + anomaly_skipped 0.4 + straggler_idle 0.8
+               + untracked 1.0
 """
 
 import json
@@ -30,14 +31,15 @@ from distributed_tensorflow_example_tpu.obs.metrics import MetricsLogger
 
 
 def _window(step, epoch=0, steps=50, wall=4.0, data_wait=0.5,
-            dispatch=1.0, device_wait=2.0, host=0.5, cost=1.8,
-            eps=1000.0, mfu=0.011):
+            h2d=0.25, dispatch=1.0, device_wait=2.0, host=0.25,
+            cost=1.8, eps=1000.0, mfu=0.011):
     return dict(step=step, epoch=epoch, cost=cost, path="host",
                 steps=steps, window_wall_s=wall,
                 step_time_p50_ms=80.0, step_time_p95_ms=95.0,
                 step_time_max_ms=120.0, data_wait_s=data_wait,
-                dispatch_s=dispatch, device_wait_s=device_wait,
-                host_s=host, examples_per_sec=eps, tokens_per_sec=None,
+                h2d_s=h2d, dispatch_s=dispatch,
+                device_wait_s=device_wait, host_s=host,
+                examples_per_sec=eps, tokens_per_sec=None,
                 model_flops_per_step=4.8e6, tflops_per_sec=0.012,
                 mfu=mfu)
 
@@ -84,7 +86,8 @@ def test_goodput_decomposition_closed_form(tmp_path):
     assert g["wall_s"] == 12.0
     assert b["compile"] == 2.0
     assert b["data_wait"] == pytest.approx(1.0)
-    assert b["host"] == pytest.approx(1.0)
+    assert b["h2d"] == pytest.approx(0.5)
+    assert b["host"] == pytest.approx(0.5)
     assert b["eval"] == pytest.approx(0.8)
     assert b["sample"] == pytest.approx(0.2)
     # mean step 8.0s/100 steps = 0.08; 5 skipped -> 0.4s carved out
@@ -98,7 +101,7 @@ def test_goodput_decomposition_closed_form(tmp_path):
     assert sum(b.values()) == pytest.approx(g["wall_s"], rel=0.05)
     assert g["goodput_frac"] == pytest.approx(4.8 / 12.0)
     assert g["badput_frac"] == pytest.approx(
-        (2.0 + 1.0 + 1.0 + 0.4 + 0.8 + 1.0) / 12.0)
+        (2.0 + 1.0 + 0.5 + 0.5 + 0.4 + 0.8 + 1.0) / 12.0)
     assert set(agg_lib.BUCKETS) == set(b)
 
 
@@ -237,6 +240,34 @@ def test_compare_accepts_every_documented_shape():
     verdict = cmp_lib.compare(base_row, {"wall_clock_20ep_s": 20.0,
                                          "mfu": 0.5})
     assert verdict["regressions"] == ["wall_s"]
+
+
+def test_compare_understands_input_pipeline_keys():
+    """The bench input-pipeline row (and its final-summary carriage)
+    is a first-class compare shape, so --gate holds the line on
+    device-prefetch regressions."""
+    row = {"config": "input_pipeline", "blocking_step_ms": 10.0,
+           "prefetch_step_ms": 8.0, "overlap_ratio": 1.25,
+           "prefetch_not_slower": True}
+    m = cmp_lib.extract_metrics(row)
+    assert m == {"blocking_step_ms": 10.0, "prefetch_step_ms": 8.0,
+                 "overlap_ratio": 1.25}
+    # a doctored candidate whose prefetch path got slower gates
+    worse = dict(row, prefetch_step_ms=9.5, overlap_ratio=1.05)
+    verdict = cmp_lib.compare(row, worse)
+    assert not verdict["ok"]
+    assert "prefetch_step_ms" in verdict["regressions"]
+    assert "overlap_ratio" in verdict["regressions"]
+    assert cmp_lib.compare(row, row)["ok"]
+    # the same keys ride the bench final summary (input_pipeline_*)
+    summary = {"metric": "mnist_20epoch_wall_clock", "value": 0.15,
+               "input_pipeline_blocking_step_ms": 10.0,
+               "input_pipeline_prefetch_step_ms": 8.0,
+               "input_pipeline_overlap_ratio": 1.25}
+    ms = cmp_lib.extract_metrics(summary)
+    assert ms["prefetch_step_ms"] == 8.0
+    assert ms["blocking_step_ms"] == 10.0
+    assert ms["overlap_ratio"] == 1.25
 
 
 def test_compare_zero_baseline_stays_strict_json():
