@@ -1006,26 +1006,42 @@ def bench_transformer_wide(repeats: int = 3, d_model: int = 2048,
         np.float32) / np.float32(255.0)
     labels = np.eye(10, dtype=np.float32)[rng.randint(0, 10, n)]
     img_d, lbl_d, spe_ = epoch_lib.shard_dataset(mesh, images, labels, batch)
-    for backend in ("dense", "flash"):
+    # fused_ln: the Pallas LayerNorm(+residual) kernels attack the f32
+    # LN passes VERDICT r5 named as the first suspect for this row's
+    # MFU gap — measured as a third variant so the win (or its
+    # absence) is a recorded A/B, not an assumption
+    for label, kw in (("dense", dict(attention="dense")),
+                      ("flash", dict(attention="flash")),
+                      ("fused_ln", dict(attention="flash",
+                                        fused_ln=True))):
         cfg = Config(
-            model="transformer", attention=backend,
+            model="transformer",
             input_size=4 * seq, seq_len=seq, d_model=d_model,
             n_heads=n_heads, num_blocks=blocks, d_ff=d_ff,
             compute_dtype="bfloat16", optimizer="adam",
             adam_moments_dtype=moments_dtype,
             learning_rate=1e-3, batch_size=batch, dataset="synthetic",
-            summaries=False,
+            summaries=False, **kw,
         )
         spec = make_spec(cfg)
         step_s = _steady_state_step_time(cfg, spec, mesh, img_d, lbl_d,
                                          spe_, epochs, repeats)
         flops = tfm.flops_per_step(spec, batch)
-        row[f"{backend}_step_time_ms"] = round(step_s * 1000, 2)
-        row[f"{backend}_examples_per_sec"] = round(batch / step_s, 1)
-        row.update({f"{backend}_{kk}": v
+        row[f"{label}_step_time_ms"] = round(step_s * 1000, 2)
+        row[f"{label}_examples_per_sec"] = round(batch / step_s, 1)
+        row.update({f"{label}_{kk}": v
                     for kk, v in _rate(flops, step_s, peak).items()})
-    # the row's headline mfu = the better backend (feeds best_mfu)
-    row["mfu"] = max(row.get("dense_mfu", 0), row.get("flash_mfu", 0))
+    # the row's headline mfu = the best variant (feeds best_mfu);
+    # only when some variant produced one — an unknown chip peak must
+    # not fabricate a gated mfu=0 (spurious --gate regression)
+    mfus = [row[k] for k in ("dense_mfu", "flash_mfu", "fused_ln_mfu")
+            if row.get(k) is not None]
+    if mfus:
+        row["mfu"] = max(mfus)
+    # the row contract's TPU target (ISSUE 6 acceptance; CPU runs
+    # record it too — the number is a TPU claim, gated by
+    # transformer_wide_mfu in obs/compare.GATE_METRICS)
+    row["target_mfu"] = 0.60
     return row
 
 
@@ -1084,6 +1100,24 @@ def bench_transformer_wide_long(repeats: int = 3, d_model: int = 1024,
     row["tokens_per_sec"] = round(batch * seq / step_s, 1)
     row["attention_flop_frac"] = round(attn / flops, 3)
     row.update(_rate(flops, step_s, peak))
+    # fused-LN A/B (the non-attention FLOPs still carry ~56% of this
+    # row; the f32 LN passes ride every block) — only for the gated
+    # default-name variant: the s16k flagship is the most expensive
+    # transformer row and has no fused target/gate key, so it keeps
+    # its single-measurement cost and headline semantics
+    if name == "transformer_wide_long":
+        cfg_f = cfg.replace(fused_ln=True)
+        spec_f = make_spec(cfg_f)
+        step_f = _steady_state_step_time(cfg_f, spec_f, mesh, img_d,
+                                         lbl_d, spe_, epochs, repeats)
+        row["fused_ln_step_time_ms"] = round(step_f * 1000, 2)
+        row.update({f"fused_ln_{kk}": v
+                    for kk, v in _rate(flops, step_f, peak).items()})
+        if row.get("fused_ln_mfu") is not None:
+            # headline = best variant; never fabricate mfu=0 when the
+            # chip peak is unknown (_rate omits the key entirely then)
+            row["mfu"] = max(row.get("mfu") or 0, row["fused_ln_mfu"])
+        row["target_mfu"] = 0.52   # ISSUE 6 row contract (TPU claim)
     return row
 
 
@@ -1409,7 +1443,96 @@ def bench_moe_wide(e: int = 64, seq: int = 1024, batch: int = 32,
     row["step_time_ms"] = round(step_s * 1000, 2)
     row["tokens_per_sec"] = round(batch * seq / step_s, 1)
     row.update(_rate(flops, step_s, peak))
+    # --grouped_moe A/B: the fused grouped expert kernel vs the two
+    # batched XLA einsums, through the identical training pipeline
+    cfg_g = cfg.replace(grouped_moe=True)
+    spec_g = make_spec(cfg_g)
+    step_g = _steady_state_step_time(cfg_g, spec_g, mesh, img_d, lbl_d,
+                                     spe, 1, repeats)
+    row["grouped_step_time_ms"] = round(step_g * 1000, 2)
+    row["grouped_tokens_per_sec"] = round(batch * seq / step_g, 1)
+    row.update({f"grouped_{kk}": v
+                for kk, v in _rate(flops, step_g, peak).items()})
+    if row.get("grouped_mfu") is not None:
+        # headline = best variant; never fabricate mfu=0 when the
+        # chip peak is unknown (_rate omits the key entirely then)
+        row["mfu"] = max(row.get("mfu") or 0, row["grouped_mfu"])
+    row["target_mfu"] = 0.35   # ISSUE 6 row contract (TPU claim)
+    # dispatch-vs-expert breakdown: VERDICT r5 SUSPECTED the
+    # scatter/gather dispatch dominates this row's 0.21 MFU — measure
+    # it (forward components as standalone jitted programs on the
+    # row's exact shapes; see _moe_component_times)
+    try:
+        row.update(_moe_component_times(spec, batch, seq, repeats))
+    except Exception as ex:  # the breakdown must never void the row
+        row["breakdown_error"] = str(ex)[:200]
     return row
+
+
+def _moe_component_times(spec, batch: int, seq: int, repeats: int):
+    """Time the sparse-MoE FORWARD components on one block's exact
+    shapes, each as its own jitted program: route (router + argsort
+    slotting + scatter into the [E, C, d] buffers) + combine
+    (gather/gate-weight) = the dispatch side, vs the grouped expert
+    FFN = the matmul side. Returns ``moe_dispatch_ms`` /
+    ``moe_expert_ms`` (medians) plus the grouped-kernel expert time —
+    the measured form of the 'dispatch scatter/gather suspected
+    dominant' diagnosis. Forward components only: the training step
+    also pays their transposes, so treat the split as a ratio, not an
+    absolute accounting of step_time_ms."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_example_tpu.models import transformer as tfm
+    from distributed_tensorflow_example_tpu.models.mlp import _ACTIVATIONS
+
+    # only one block's five MoE leaves are timed — build them directly
+    # at param_shapes scale instead of tfm.init'ing the full model
+    # (~2 GB transient for the moe_wide spec; values don't matter to
+    # the timing, shapes/dtypes do)
+    shapes = tfm.param_shapes(spec)
+    prng = np.random.RandomState(0)
+    bp = {leaf: jnp.asarray(
+        prng.randn(*shapes[f"L0_{leaf}"]) / np.sqrt(spec.d_model),
+        spec.param_dtype)
+        for leaf in ("Wr", "We1", "be1", "We2", "be2")}
+    t, d = batch * seq, spec.d_model
+    cdt = spec.compute_dtype
+    act = _ACTIVATIONS[spec.activation]
+    x = jnp.asarray(np.random.RandomState(0).randn(t, d), jnp.float32)
+
+    def timed(fn, *args):
+        out = fn(*args)                       # compile + warm
+        jax.block_until_ready(out)
+        walls = []
+        for _ in range(max(1, repeats)):
+            t0 = time.time()
+            jax.block_until_ready(fn(*args))
+            walls.append(time.time() - t0)
+        return statistics.median(walls), out
+
+    route = jax.jit(lambda b_, x_: tfm._sparse_route(spec, x_, b_["Wr"],
+                                                     cdt))
+    t_route, (buf, slot, gates, keep, _p, _i) = timed(route, bp, x)
+
+    def expert_fn(sp):
+        return jax.jit(lambda b_, buf_: tfm._grouped_expert_ffn(
+            sp, buf_, b_["We1"], b_["be1"], b_["We2"], b_["be2"], act,
+            cdt))
+
+    t_exp, h2 = timed(expert_fn(spec), bp, buf)
+    grouped_spec = dataclasses.replace(spec, grouped_moe=True)
+    t_exp_g, _ = timed(expert_fn(grouped_spec), bp, buf)
+    combine = jax.jit(tfm._sparse_combine)
+    t_comb, _ = timed(combine, h2, slot, gates, keep)
+    return {
+        "moe_dispatch_ms": round((t_route + t_comb) * 1000, 2),
+        "moe_expert_ms": round(t_exp * 1000, 2),
+        "moe_expert_grouped_ms": round(t_exp_g * 1000, 2),
+    }
 
 
 def bench_decode(batch: int = 32, seq: int = 1024, d_model: int = 1024,
@@ -1801,13 +1924,24 @@ def main(argv=None) -> int:
         extra["moe_sparse_speedup"] = moe_row["speedup_sparse_vs_dense"]
         if moe_row.get("alltoall_mfu") is not None:
             extra["moe_sparse_mfu"] = moe_row["alltoall_mfu"]
+    # the breakdown keys are peak-independent timings: carry them even
+    # when an unknown chip peak left the row without an mfu (the CPU
+    # container's meaningful reading IS the breakdown)
     moe_wide_row = next(
         (r for r in rows if r.get("config") == "moe_wide"
-         and "mfu" in r), None)
+         and ("mfu" in r or "moe_dispatch_ms" in r)), None)
     if moe_wide_row:
-        extra["moe_wide_mfu"] = moe_wide_row["mfu"]
+        if moe_wide_row.get("mfu") is not None:
+            extra["moe_wide_mfu"] = moe_wide_row["mfu"]
         extra["moe_wide_tokens_per_sec"] = \
             moe_wide_row.get("tokens_per_sec")
+        # dispatch-vs-expert breakdown (ISSUE 6): the measured split
+        # behind the 0.21-MFU diagnosis rides the final line so
+        # --gate holds it (GATE_METRICS: moe_dispatch_ms/moe_expert_ms)
+        if moe_wide_row.get("moe_dispatch_ms") is not None:
+            extra["moe_dispatch_ms"] = moe_wide_row["moe_dispatch_ms"]
+        if moe_wide_row.get("moe_expert_ms") is not None:
+            extra["moe_expert_ms"] = moe_wide_row["moe_expert_ms"]
     pp_row = next(
         (r for r in rows if r.get("config") == "pipeline_bubble"
          and "interleave_speedup_v2_vs_gpipe" in r), None)

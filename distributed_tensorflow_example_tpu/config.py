@@ -99,6 +99,15 @@ class Config:
                                     # loss (E * sum_e f_e*P_e per MoE
                                     # block) to the objective; printed
                                     # cost stays plain CE
+    fused_ln: bool = False          # transformer LayerNorms run the
+                                    # fused Pallas kernel (fwd + bwd;
+                                    # ln2 also fuses the attention
+                                    # residual add) — ops/pallas_fused
+    grouped_moe: bool = False       # sparse-dispatch MoE expert FFN
+                                    # runs the fused grouped Pallas
+                                    # kernel (both matmuls per
+                                    # (expert, capacity-tile) cell,
+                                    # hidden resident in VMEM)
 
     # ---- loss (example.py:92-96) ----
     naive_ce: bool = False          # reproduce the reference's unstable log(softmax) CE
@@ -406,6 +415,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe_aux_weight", type=float, default=d.moe_aux_weight,
                    help="weight of the Switch load-balance auxiliary "
                         "loss (0 = off)")
+    p.add_argument("--fused_ln", action="store_true",
+                   help="transformer only: run every LayerNorm (block "
+                        "ln1/ln2, final lnf, decode) as the fused "
+                        "Pallas kernel with its Pallas backward; ln2 "
+                        "also fuses the attention residual add")
+    p.add_argument("--grouped_moe", action="store_true",
+                   help="MoE alltoall dispatch only: run the grouped "
+                        "expert FFN as one fused Pallas kernel (both "
+                        "matmuls per expert tile, hidden resident in "
+                        "VMEM) instead of two batched XLA einsums")
     p.add_argument("--expert_parallel", type=int, default=d.expert_parallel,
                    help="MoE only: shard expert weights+FLOPs over a "
                         "('data','expert') mesh")

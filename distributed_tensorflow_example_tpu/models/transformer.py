@@ -93,6 +93,17 @@ class TransformerSpec:
                                    # ceil(cf * tokens * k / E); overflow
                                    # tokens are dropped (residual path
                                    # carries them)
+    fused_ln: bool = False         # LayerNorms (block ln1/ln2, final
+                                   # lnf, decode) run the fused Pallas
+                                   # kernel (ops/pallas_fused.
+                                   # fused_layer_norm[_residual]) with
+                                   # its Pallas backward; ln2 also
+                                   # fuses the attention residual add
+    grouped_moe: bool = False      # sparse-dispatch expert FFN runs
+                                   # the fused grouped Pallas kernel
+                                   # (ops/pallas_fused.
+                                   # moe_grouped_matmul) instead of
+                                   # two batched XLA einsums
     param_dtype: jnp.dtype = jnp.float32
     compute_dtype: jnp.dtype = jnp.float32
 
@@ -257,11 +268,43 @@ def param_pspecs(spec: TransformerSpec, expert_axis: str | None = None,
 
 
 def _layer_norm(x, g, b):
+    """Reference LayerNorm (f32 statistics and output; rank-agnostic —
+    rank-2 [N, D] and rank-3 [B, S, D] both normalize axis -1). The
+    oracle the fused Pallas kernel is tested against."""
     x = x.astype(jnp.float32)
     mu = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
     return (x - mu) * jax.lax.rsqrt(var + 1e-6) * g.astype(jnp.float32) \
         + b.astype(jnp.float32)
+
+
+def _ln(spec: TransformerSpec, x, g, b):
+    """The model's LayerNorm dispatch: the fused Pallas kernel
+    (forward AND backward, interpret mode on CPU) under
+    ``spec.fused_ln``, the XLA reference otherwise. Every transformer
+    LN call site (block ln1/ln2, final lnf, the pipeline/1f1b heads
+    and the rank-2 decode sites) routes through here, wrapped in the
+    ``ln`` trace scope so profiler timelines name the op."""
+    with jax.named_scope("ln"):
+        if spec.fused_ln:
+            from ..ops.pallas_fused import fused_layer_norm
+
+            return fused_layer_norm(x, g, b)
+        return _layer_norm(x, g, b)
+
+
+def _ln_residual(spec: TransformerSpec, h, branch, g, b):
+    """Residual add + the LayerNorm that consumes it:
+    ``s = h + branch; return (LN(s), s)``. Under ``spec.fused_ln`` the
+    add rides inside the Pallas kernel (one HBM pass); the reference
+    path computes the identical math with XLA ops."""
+    with jax.named_scope("ln"):
+        if spec.fused_ln:
+            from ..ops.pallas_fused import fused_layer_norm_residual
+
+            return fused_layer_norm_residual(h, branch, g, b)
+        s = h + branch
+        return _layer_norm(s, g, b), s
 
 
 def _attend(spec: TransformerSpec, q, k, v, seq_axis: str | None):
@@ -375,29 +418,32 @@ def _moe_ffn(spec: TransformerSpec, bp: Params, a, act, cdt,
     ``moe_dispatch='alltoall'``; this dense form trades its
     compute/bandwidth savings for exactness.)
     """
-    gate_logits = jnp.dot(
-        a.astype(cdt), bp["Wr"].astype(cdt),
-        preferred_element_type=jnp.float32)               # [B, S, E]
-    probs = jax.nn.softmax(gate_logits, axis=-1)
-    gates, idx = _route_topk(spec, probs)                 # [B, S, k]
-    # gate-weighted selection: sum of k weighted one-hots
-    sel = jnp.sum(
-        jax.nn.one_hot(idx, spec.num_experts, dtype=jnp.float32)
-        * gates[..., None], axis=-2)                      # [B, S, E]
+    with jax.named_scope("moe_dispatch"):
+        gate_logits = jnp.dot(
+            a.astype(cdt), bp["Wr"].astype(cdt),
+            preferred_element_type=jnp.float32)           # [B, S, E]
+        probs = jax.nn.softmax(gate_logits, axis=-1)
+        gates, idx = _route_topk(spec, probs)             # [B, S, k]
+        # gate-weighted selection: sum of k weighted one-hots
+        sel = jnp.sum(
+            jax.nn.one_hot(idx, spec.num_experts, dtype=jnp.float32)
+            * gates[..., None], axis=-2)                  # [B, S, E]
     we1, be1 = bp["We1"], bp["be1"]
     we2, be2 = bp["We2"], bp["be2"]
     if expert_axis is not None:
         off = jax.lax.axis_index(expert_axis) * we1.shape[0]
         sel = jax.lax.dynamic_slice_in_dim(sel, off, we1.shape[0],
                                            axis=2)
-    h1 = jnp.einsum("bsd,edf->bsef", a.astype(cdt), we1.astype(cdt),
-                    preferred_element_type=jnp.float32) \
-        + be1.astype(jnp.float32)
-    h1 = act(h1).astype(cdt)
-    h2 = jnp.einsum("bsef,efd->bsed", h1, we2.astype(cdt),
-                    preferred_element_type=jnp.float32) \
-        + be2.astype(jnp.float32)
-    out = jnp.einsum("bsed,bse->bsd", h2, sel)
+    with jax.named_scope("moe_expert"):
+        h1 = jnp.einsum("bsd,edf->bsef", a.astype(cdt), we1.astype(cdt),
+                        preferred_element_type=jnp.float32) \
+            + be1.astype(jnp.float32)
+        h1 = act(h1).astype(cdt)
+        h2 = jnp.einsum("bsef,efd->bsed", h1, we2.astype(cdt),
+                        preferred_element_type=jnp.float32) \
+            + be2.astype(jnp.float32)
+    with jax.named_scope("moe_dispatch"):
+        out = jnp.einsum("bsed,bse->bsd", h2, sel)
     if expert_axis is not None:
         out = jax.lax.psum(out, expert_axis)
     aux = (_balance_stats(spec, probs, idx[..., 0]) if aux_stats
@@ -405,37 +451,28 @@ def _moe_ffn(spec: TransformerSpec, bp: Params, a, act, cdt,
     return out, aux
 
 
-def _moe_ffn_sparse(spec: TransformerSpec, bp: Params, a, act,
-                    cdt, expert_axis: str | None, aux_axes=(),
-                    aux_stats: bool = False):
-    """Capacity-limited token dispatch for the top-k MoE FFN — the
-    sparse (Switch/GShard-style) realization of the same math as
-    ``_moe_ffn``'s dense dispatch.
+def _sparse_route(spec: TransformerSpec, x, wr, cdt):
+    """Router + slotting + scatter: the DISPATCH half of the sparse
+    MoE FFN, split out so the bench can time it against the expert
+    matmul (the moe_wide dispatch-vs-expert breakdown).
 
-    Each of a token's k routing choices goes to one expert buffer of
-    static capacity ``C = ceil(capacity_factor * T * k / E)``
+    ``x`` [T, d] -> ``(buf [E, C, d], slot [k*T], gates [T, k],
+    keep [k*T], probs [T, E], idx [T, k])`` with capacity
+    ``C = ceil(capacity_factor * T * k / E)``.
+
+    Each of a token's k routing choices goes to one expert buffer
     (position assigned by a stable argsort over the routing choices —
     O(kT·log(kT)), E-independent; tokens past capacity are dropped —
     their FFN contribution is zero and the residual stream carries
-    them, exactly Switch Transformer's overflow semantics). Under
-    expert parallelism the ``[E, C, d]`` buffers are exchanged with ONE
-    ``all_to_all`` each way over the 'expert' axis, so every shard runs
-    only its E/n experts on the tokens routed to them from all data
-    positions: compute AND bandwidth scale with ``capacity_factor``,
-    not with E — the sparse optimization the dense dispatch trades for
-    exactness. With ample capacity (``cf >= E``) nothing drops and the
-    result equals dense dispatch bit-for-near (fp order aside).
-    """
+    them, exactly Switch Transformer's overflow semantics)."""
     import math
 
-    b, s, d = a.shape
-    t = b * s
+    t, d = x.shape
     e = spec.num_experts
     k = spec.moe_topk
     cap = max(1, math.ceil(spec.capacity_factor * t * k / e))
-    x = a.reshape(t, d)
     gate_logits = jnp.dot(
-        x.astype(cdt), bp["Wr"].astype(cdt),
+        x.astype(cdt), wr.astype(cdt),
         preferred_element_type=jnp.float32)                 # [T, E]
     probs = jax.nn.softmax(gate_logits, axis=-1)
     gates, idx = _route_topk(spec, probs)                   # [T, k]
@@ -468,6 +505,72 @@ def _moe_ffn_sparse(spec: TransformerSpec, bp: Params, a, act,
                           (k, t, d)).reshape(k * t, d)
     buf = jnp.zeros((e * cap + 1, d), jnp.float32)
     buf = buf.at[slot].add(xk)[:-1].reshape(e, cap, d)
+    return buf, slot, gates, keep, probs, idx
+
+
+def _grouped_expert_ffn(spec: TransformerSpec, buf, we1, be1, we2, be2,
+                        act, cdt):
+    """The grouped per-expert two-matmul FFN ``[El, C, d] -> [El, C,
+    d]`` (f32 out) — the EXPERT half of the sparse MoE block. Under
+    ``spec.grouped_moe`` it runs the fused Pallas kernel
+    (ops/pallas_fused.moe_grouped_matmul: one kernel loops (expert,
+    capacity-tile) grid cells, weights and the [tile, ff] hidden
+    resident in VMEM); otherwise two batched XLA einsums with the
+    [El, C, ff] hidden round-tripping HBM between them."""
+    if spec.grouped_moe:
+        from ..ops.pallas_fused import moe_grouped_matmul
+
+        return moe_grouped_matmul(spec.activation, cdt, buf,
+                                  we1, be1, we2, be2)
+    h1 = act(jnp.einsum("ecd,edf->ecf", buf.astype(cdt), we1.astype(cdt),
+                        preferred_element_type=jnp.float32)
+             + be1[:, None].astype(jnp.float32)).astype(cdt)
+    return jnp.einsum("ecf,efd->ecd", h1, we2.astype(cdt),
+                      preferred_element_type=jnp.float32) \
+        + be2[:, None].astype(jnp.float32)
+
+
+def _sparse_combine(h2, slot, gates, keep):
+    """Gather each (token, choice)'s processed row from its slot
+    (trash row = 0 for dropped units), gate-weight, and sum over the k
+    choices — the return half of the dispatch. ``h2`` is any
+    [E*C, d]-reshapeable expert output; returns [T, d]."""
+    t, k = gates.shape
+    d = h2.shape[-1]
+    h2_flat = jnp.concatenate(
+        [h2.reshape(-1, d), jnp.zeros((1, d), h2.dtype)])
+    picked = h2_flat[slot].reshape(k, t, d)
+    w = gates.T * keep.astype(jnp.float32).reshape(k, t)
+    return jnp.sum(picked * w[..., None], axis=0)
+
+
+def _moe_ffn_sparse(spec: TransformerSpec, bp: Params, a, act,
+                    cdt, expert_axis: str | None, aux_axes=(),
+                    aux_stats: bool = False):
+    """Capacity-limited token dispatch for the top-k MoE FFN — the
+    sparse (Switch/GShard-style) realization of the same math as
+    ``_moe_ffn``'s dense dispatch, composed from ``_sparse_route`` ->
+    ``_grouped_expert_ffn`` -> ``_sparse_combine`` (each timed
+    separately by the moe_wide bench breakdown and scoped
+    ``moe_dispatch``/``moe_expert`` in profiler traces).
+
+    Under expert parallelism the ``[E, C, d]`` buffers are exchanged
+    with ONE ``all_to_all`` each way over the 'expert' axis, so every
+    shard runs only its E/n experts on the tokens routed to them from
+    all data positions: compute AND bandwidth scale with
+    ``capacity_factor``, not with E — the sparse optimization the
+    dense dispatch trades for exactness. With ample capacity
+    (``cf >= E``) nothing drops and the result equals dense dispatch
+    bit-for-near (fp order aside).
+    """
+    b, s, d = a.shape
+    t = b * s
+    e = spec.num_experts
+    x = a.reshape(t, d)
+    with jax.named_scope("moe_dispatch"):
+        buf, slot, gates, keep, probs, idx = _sparse_route(
+            spec, x, bp["Wr"], cdt)
+    cap = buf.shape[1]
 
     we1, be1 = bp["We1"], bp["be1"]                         # [El, d, ff]
     we2, be2 = bp["We2"], bp["be2"]
@@ -480,24 +583,15 @@ def _moe_ffn_sparse(spec: TransformerSpec, bp: Params, a, act,
         buf = jax.lax.all_to_all(buf.reshape(ep, el, cap, d), expert_axis,
                                  split_axis=0, concat_axis=2, tiled=True)
         buf = buf.reshape(el, ep * cap, d)
-    h1 = act(jnp.einsum("ecd,edf->ecf", buf.astype(cdt), we1.astype(cdt),
-                        preferred_element_type=jnp.float32)
-             + be1[:, None].astype(jnp.float32)).astype(cdt)
-    h2 = jnp.einsum("ecf,efd->ecd", h1, we2.astype(cdt),
-                    preferred_element_type=jnp.float32) \
-        + be2[:, None].astype(jnp.float32)                  # [El, ep*C, d]
+    with jax.named_scope("moe_expert"):
+        h2 = _grouped_expert_ffn(spec, buf, we1, be1, we2, be2, act,
+                                 cdt)                       # [El, ep*C, d]
     if expert_axis is not None and el != e:
         # reverse exchange: hand each shard back its tokens' outputs
         h2 = jax.lax.all_to_all(h2.reshape(el, ep, cap, d), expert_axis,
                                 split_axis=1, concat_axis=0, tiled=True)
-    # gather each (token, choice)'s processed row from its slot (trash
-    # row = 0 for dropped units), gate-weight, and sum over the k
-    # choices
-    h2_flat = jnp.concatenate(
-        [h2.reshape(e * cap, d), jnp.zeros((1, d), h2.dtype)])
-    picked = h2_flat[slot].reshape(k, t, d)
-    w = gates.T * keep.astype(jnp.float32).reshape(k, t)
-    out = jnp.sum(picked * w[..., None], axis=0)
+    with jax.named_scope("moe_dispatch"):
+        out = _sparse_combine(h2, slot, gates, keep)
     aux = (_balance_stats(spec, probs, idx[:, 0]) if aux_stats
            else _load_balance_loss(spec, probs, idx[:, 0], aux_axes))
     return out.reshape(b, s, d), aux
@@ -558,7 +652,7 @@ def _block_forward(spec: TransformerSpec, bp: Params, h, act, cdt,
     slice, W2 its rows — attention and the FFN inner product run on
     1/mp of the width with ONE psum after each row-split matmul."""
     b, s, d = h.shape
-    a = _layer_norm(h, bp["ln1_g"], bp["ln1_b"])
+    a = _ln(spec, h, bp["ln1_g"], bp["ln1_b"])
     # [B, S, 3, dl]: t indexes q/k/v, e the (local) head columns
     qkv = jnp.einsum("bsd,dte->bste", a.astype(cdt),
                      bp["Wqkv"].astype(cdt),
@@ -569,23 +663,30 @@ def _block_forward(spec: TransformerSpec, bp: Params, h, act, cdt,
     shape = (b, s, local_heads, spec.d_head)
     att = _attend(spec, q.reshape(shape), k.reshape(shape),
                   v.reshape(shape), seq_axis)
-    h = h + _dropout(
+    branch = _dropout(
         _row_psum(att.reshape(b, s, -1).astype(cdt), bp["Wo"],
                   bp["bo"], cdt, model_axis),
         spec, dropout_rng, 2 * moe_block)
+    # the attention residual add fuses into ln2 (one kernel pass under
+    # --fused_ln); the pre-normalized activations flow to _ffn_block
+    # so it skips its own LN
+    a2, h = _ln_residual(spec, h, branch, bp["ln2_g"], bp["ln2_b"])
     return _ffn_block(spec, bp, h, act, cdt, model_axis,
                       moe_block, expert_axis, aux_axes, dropout_rng,
-                      aux_stats)
+                      aux_stats, a=a2)
 
 
 def _ffn_block(spec: TransformerSpec, bp: Params, h, act, cdt,
                model_axis=None,
                moe_block: int = 0, expert_axis=None, aux_axes=(),
-               dropout_rng=None, aux_stats: bool = False):
+               dropout_rng=None, aux_stats: bool = False, a=None):
     """The LN2 + FFN (dense or MoE) residual half of a block — shared
     by the training forward and the KV-cached decode step so the two
-    cannot drift. ``h`` [B, S, D] -> (h, aux)."""
-    a = _layer_norm(h, bp["ln2_g"], bp["ln2_b"])
+    cannot drift. ``h`` [B, S, D] -> (h, aux). ``a``: pre-computed
+    ln2 output (_block_forward fuses the attention residual add into
+    it); None computes it here (the decode path)."""
+    if a is None:
+        a = _ln(spec, h, bp["ln2_g"], bp["ln2_b"])
     aux = (jnp.zeros((2, spec.num_experts), jnp.float32) if aux_stats
            else jnp.float32(0.0))
     if spec.num_experts:
@@ -663,7 +764,7 @@ def apply(spec: TransformerSpec, params: Params, x: jnp.ndarray,
                                   aux_axes=aux_axes,
                                   dropout_rng=dropout_rng)
         aux = aux + aux_i
-    h = _layer_norm(h, params["lnf_g"], params["lnf_b"])
+    h = _ln(spec, h, params["lnf_g"], params["lnf_b"])
     if spec.objective == "lm":
         # per-position vocab logits [B, s(local), V] — no pooling; the
         # next-token loss (parallel/step._lm_loss_and_acc) consumes
@@ -908,7 +1009,7 @@ def apply_pipeline(spec: TransformerSpec, params: Params, x: jnp.ndarray,
         head_width = spec.num_classes
 
         def head_fn(params_, h, m):
-            hl = _layer_norm(h, params_["lnf_g"], params_["lnf_b"])
+            hl = _ln(spec, h, params_["lnf_g"], params_["lnf_b"])
             pooled = jnp.mean(hl, axis=1)
             if seq_axis is not None:
                 # complete the global token mean across seq shards
@@ -1144,7 +1245,7 @@ def pipeline_value_and_grad_1f1b(
         head_width = spec.num_classes
 
         def head_fn(prm, h, m):
-            hl = _layer_norm(h, prm["lnf_g"], prm["lnf_b"])
+            hl = _ln(spec, h, prm["lnf_g"], prm["lnf_b"])
             return _mm(prm, jnp.mean(hl, axis=1), "W_head", "b_head", cdt)
     elif head_width is None:
         raise ValueError("custom head_fn needs an explicit head_width")
@@ -1342,7 +1443,10 @@ def decode_step(spec: TransformerSpec, params: Params, cache: Params,
         bp = {k[len(f"L{i}_"):]: v for k, v in params.items()
               if k.startswith(f"L{i}_")}
         hn = bp["Wqkv"].shape[-1] // dh       # LOCAL heads under TP
-        a = _layer_norm(h[:, None], bp["ln1_g"], bp["ln1_b"])[:, 0]
+        # rank-2 direct: _ln (fused kernel AND the reference) both
+        # normalize axis -1, so the old [:, None]...[:, 0] reshape
+        # dance is gone (ISSUE 6 satellite)
+        a = _ln(spec, h, bp["ln1_g"], bp["ln1_b"])
         qkv = jnp.einsum("bd,dte->bte", a.astype(cdt),
                          bp["Wqkv"].astype(cdt),
                          preferred_element_type=jnp.float32) \
@@ -1373,7 +1477,7 @@ def decode_step(spec: TransformerSpec, params: Params, cache: Params,
         h, _aux = _ffn_block(spec, bp, h[:, None], act, cdt,
                              model_axis=model_axis, moe_block=i)
         h = h[:, 0]
-    hf = _layer_norm(h[:, None], params["lnf_g"], params["lnf_b"])[:, 0]
+    hf = _ln(spec, h, params["lnf_g"], params["lnf_b"])
     logits = _mm(params, hf, "W_head", "b_head", cdt).astype(jnp.float32)
     return logits, new_cache
 

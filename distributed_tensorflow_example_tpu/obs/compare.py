@@ -50,6 +50,17 @@ GATE_METRICS: Dict[str, tuple] = {
     "blocking_step_ms": ("lower", 0.15),
     "prefetch_step_ms": ("lower", 0.15),
     "overlap_ratio": ("higher", 0.15),
+    # the fused-kernel MFU line (ISSUE 6): the per-row headline MFUs
+    # that carry the TPU targets (transformer_wide >= 0.60, wide_long
+    # >= 0.52, moe_wide >= 0.35) and the moe_wide dispatch-vs-expert
+    # breakdown. The breakdown medians come from short standalone
+    # component programs — wider 15% default like the input-pipeline
+    # A/B keys above.
+    "transformer_wide_mfu": ("higher", 0.05),
+    "transformer_wide_long_mfu": ("higher", 0.05),
+    "moe_wide_mfu": ("higher", 0.05),
+    "moe_dispatch_ms": ("lower", 0.15),
+    "moe_expert_ms": ("lower", 0.15),
 }
 
 
@@ -129,6 +140,11 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
         put("blocking_step_ms", doc.get("input_pipeline_blocking_step_ms"))
         put("prefetch_step_ms", doc.get("input_pipeline_prefetch_step_ms"))
         put("overlap_ratio", doc.get("input_pipeline_overlap_ratio"))
+        # the fused-kernel MFU keys + the moe_wide breakdown carry
+        # their final-line names verbatim
+        for k in ("transformer_wide_mfu", "transformer_wide_long_mfu",
+                  "moe_wide_mfu", "moe_dispatch_ms", "moe_expert_ms"):
+            put(k, doc.get(k))
         return out
     # last resort: any directly-named gate metrics
     for name in GATE_METRICS:

@@ -23,6 +23,13 @@ lines up with the metrics split (obs/metrics.py buckets).
   names as the metrics buckets (``data_wait``, ``h2d``, ``dispatch``,
   ``device_wait``, ``eval``, ``checkpoint``) and collapse to
   ``nullcontext`` when tracing is off — zero steady-state cost;
+- INSIDE the compiled step the transformer forward carries
+  ``jax.named_scope`` regions — ``ln`` (every LayerNorm, fused or
+  reference), ``moe_dispatch`` (router + scatter/gather slotting +
+  combine) and ``moe_expert`` (the grouped expert matmuls) — which
+  land in the op metadata of the device timeline, so a captured
+  window attributes device time to the exact ops the moe_wide bench
+  breakdown (``moe_dispatch_ms``/``moe_expert_ms``) times standalone;
 - ``--profile_port`` starts the on-demand profiler server
   (``jax.profiler.start_server``) so TensorBoard/perfetto can attach
   to a live run without any flag planned in advance.

@@ -1,4 +1,5 @@
-"""Fused MLP forward as a Pallas TPU kernel.
+"""Fused Pallas TPU kernels: MLP forward, LayerNorm(+residual), and
+the grouped MoE expert matmul.
 
 Reference parity: the reference's forward is four ops dispatched by the
 TF graph executor — matmul, sigmoid, matmul, (softmax)
@@ -113,30 +114,13 @@ def _forward_pallas(spec: mlp.MLPSpec, params, x):
     # Under shard_map's varying-axis checking, outputs must declare how
     # they vary across mesh axes: like the batch input (vma of x). The
     # kernel's inputs must also agree, so lift the (data-replicated)
-    # params to the batch's vma; the custom-VJP backward reduces the
-    # cotangents back down (_match_vma).
-    try:
-        vma = jax.typeof(xp).vma
-    except (AttributeError, TypeError):
-        vma = None
+    # params to the batch's vma (lifting only the axes a param is
+    # still invariant over: FSDP hands in all-gathered params that are
+    # already varying); the custom-VJP backward reduces the cotangents
+    # back down (_match_vma).
+    vma = _vma_of(xp)
     if vma:
-
-        def lift(p):
-            # Lift only the axes a param is still invariant over:
-            # replicated DP params need the full vma, while FSDP hands
-            # in all-gathered params that are already varying.
-            try:
-                have = set(jax.typeof(p).vma)
-            except (AttributeError, TypeError):
-                have = set()
-            missing = tuple(sorted(set(vma) - have))
-            if not missing:
-                return p
-            from .ring_attention import pvary_axes
-
-            return pvary_axes(p, missing)
-
-        flat_params = [lift(p) for p in flat_params]
+        flat_params = [_lift_to(p, vma) for p in flat_params]
     _sds = (
         (lambda shape, dt: jax.ShapeDtypeStruct(shape, dt, vma=vma))
         if vma
@@ -261,3 +245,402 @@ def _bwd(spec, res, g):
 
 
 mlp_forward.defvjp(_fwd, _bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused LayerNorm (+ residual add) — forward AND backward as Pallas
+# kernels (VERDICT r5: the f32 LayerNorms are the first suspect for the
+# transformer_wide MFU gap; ISSUE 6 tentpole (a))
+# ---------------------------------------------------------------------------
+
+_LN_EPS = 1e-6      # matches models/transformer._layer_norm exactly
+_LN_TILE = 128      # rows per grid step (any rank-2/3 input is
+                    # canonicalized to [rows, d] and row-padded)
+
+
+def _ln_rows(x32, g32, b32):
+    """The reference LayerNorm math on f32 rows — the ONE formula the
+    Pallas kernels, the XLA fallback and the oracle share (identical
+    op sequence to transformer._layer_norm)."""
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    return (x32 - mu) * jax.lax.rsqrt(var + _LN_EPS) * g32 + b32
+
+
+def _ln_bwd_rows(dy32, x32, g32):
+    """Closed-form LayerNorm backward on f32 rows: with
+    xh = (x - mu) * rstd and w = dy * g,
+    dx = rstd * (w - mean(w) - xh * mean(w * xh)),
+    dg = sum_rows dy * xh, db = sum_rows dy. Shared by the Pallas
+    backward kernel and the XLA fallback."""
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + _LN_EPS)
+    xh = (x32 - mu) * rstd
+    w = dy32 * g32
+    dx = rstd * (w - jnp.mean(w, axis=-1, keepdims=True)
+                 - xh * jnp.mean(w * xh, axis=-1, keepdims=True))
+    return dx, xh
+
+
+def _ln_fwd_kernel(x_ref, g_ref, b_ref, y_ref):
+    y_ref[:] = _ln_rows(x_ref[:].astype(jnp.float32),
+                        g_ref[:].astype(jnp.float32),
+                        b_ref[:].astype(jnp.float32))
+
+
+def _ln_res_fwd_kernel(x_ref, r_ref, g_ref, b_ref, y_ref, s_ref):
+    # statistics run on the ROUNDED sum (s as emitted), so the kernel
+    # agrees with the unfused `s = x + r; LN(s)` composition, with the
+    # CPU-shard_map fallback, and with the VJP's recompute-from-s —
+    # including sub-f32 result dtypes (a no-op for the model's f32
+    # residual stream)
+    s = (x_ref[:].astype(jnp.float32)
+         + r_ref[:].astype(jnp.float32)).astype(s_ref.dtype)
+    s_ref[:] = s
+    y_ref[:] = _ln_rows(s.astype(jnp.float32),
+                        g_ref[:].astype(jnp.float32),
+                        b_ref[:].astype(jnp.float32))
+
+
+def _ln_bwd_kernel(dy_ref, x_ref, g_ref, dx_ref, dg_ref, db_ref):
+    """One row tile's dx plus its dg/db partials, accumulated across
+    the (sequentially executed) grid into the single [1, d] blocks —
+    the first grid step zero-initializes them. Zero-padded rows are
+    exact no-ops: dy = 0 there, so w, dx and both partial sums vanish."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        dg_ref[:] = jnp.zeros_like(dg_ref)
+        db_ref[:] = jnp.zeros_like(db_ref)
+
+    dy = dy_ref[:].astype(jnp.float32)
+    dx, xh = _ln_bwd_rows(dy, x_ref[:].astype(jnp.float32),
+                          g_ref[:].astype(jnp.float32))
+    dx_ref[:] = dx
+    dg_ref[:] += jnp.sum(dy * xh, axis=0, keepdims=True)
+    db_ref[:] += jnp.sum(dy, axis=0, keepdims=True)
+
+
+def _vma_of(x):
+    """The varying-manual-axes set of ``x`` under shard_map's typing
+    (None on jax versions without it) — shared by every kernel in this
+    module."""
+    try:
+        return jax.typeof(x).vma
+    except (AttributeError, TypeError):
+        return None
+
+
+def _lift_to(p, vma):
+    """Lift a (replicated) param to the activations' varying-axis set —
+    the shard_map typing requirement the MLP kernel documents above.
+    Lifts only the axes ``p`` is still invariant over."""
+    try:
+        have = set(jax.typeof(p).vma)
+    except (AttributeError, TypeError):
+        return p
+    missing = tuple(sorted(set(vma) - have))
+    if not missing:
+        return p
+    from .ring_attention import pvary_axes
+
+    return pvary_axes(p, missing)
+
+
+def _ln_pad_rows(a2, n_pad):
+    n = a2.shape[0]
+    return a2 if n == n_pad else jnp.pad(a2, ((0, n_pad - n), (0, 0)))
+
+
+def _ln_run_fwd(x, g, b, residual=None):
+    """Canonicalize to [rows, d], run the forward kernel, restore the
+    input rank. Returns ``(y, s)`` — y always f32 (as the reference
+    returns), s the residual sum (None without ``residual``)."""
+    shape = x.shape
+    d = shape[-1]
+    x2 = x.reshape(-1, d)
+    vma = _vma_of(x2)
+    if vma:
+        g, b = _lift_to(g, vma), _lift_to(b, vma)
+    if _interpret() and vma:
+        # CPU inside shard_map: the HLO interpreter drops vma from its
+        # loop carries — compute the identical math with XLA ops (the
+        # custom-VJP path incl. _match_vma still exercises; the kernel
+        # itself is covered by the non-shard_map interpret tests).
+        g32, b32 = g.astype(jnp.float32), b.astype(jnp.float32)
+        if residual is None:
+            return _ln_rows(x.astype(jnp.float32), g32, b32), None
+        s = x + residual
+        return _ln_rows(s.astype(jnp.float32), g32, b32), s
+    n = x2.shape[0]
+    n_pad = max(_LN_TILE, ((n + _LN_TILE - 1) // _LN_TILE) * _LN_TILE)
+    xp = _ln_pad_rows(x2, n_pad)
+    g2 = g.reshape(1, d)
+    b2 = b.reshape(1, d)
+    grid = (n_pad // _LN_TILE,)
+    row_spec = pl.BlockSpec((_LN_TILE, d), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((1, d), lambda i: (0, 0))
+    _sds = ((lambda sh, dt: jax.ShapeDtypeStruct(sh, dt, vma=vma)) if vma
+            else (lambda sh, dt: jax.ShapeDtypeStruct(sh, dt)))
+    if residual is None:
+        y = pl.pallas_call(
+            _ln_fwd_kernel, grid=grid,
+            in_specs=[row_spec, vec_spec, vec_spec],
+            out_specs=row_spec,
+            out_shape=_sds((n_pad, d), jnp.float32),
+            interpret=_interpret(),
+        )(xp, g2, b2)
+        return y[:n].reshape(shape).astype(jnp.float32), None
+    r2 = residual.reshape(-1, d)
+    s_dtype = jnp.result_type(x.dtype, residual.dtype)
+    y, s = pl.pallas_call(
+        _ln_res_fwd_kernel, grid=grid,
+        in_specs=[row_spec, row_spec, vec_spec, vec_spec],
+        out_specs=[row_spec, row_spec],
+        out_shape=[_sds((n_pad, d), jnp.float32),
+                   _sds((n_pad, d), s_dtype)],
+        interpret=_interpret(),
+    )(xp, _ln_pad_rows(r2, n_pad), g2, b2)
+    return (y[:n].reshape(shape).astype(jnp.float32),
+            s[:n].reshape(shape))
+
+
+def _ln_run_bwd(dy, x, g):
+    """-> (dx f32 [x.shape], dg f32 [d], db f32 [d]); the statistics
+    are recomputed from the saved normalization input (x, or the
+    residual sum s) — cheaper than stashing an extra [rows, d] xhat."""
+    shape = x.shape
+    d = shape[-1]
+    dy2 = dy.reshape(-1, d)
+    x2 = x.reshape(-1, d)
+    vma = _vma_of(dy2) or _vma_of(x2)
+    if vma:
+        g = _lift_to(g, vma)
+    if _interpret() and vma:
+        dy32 = dy.astype(jnp.float32)
+        dx, xh = _ln_bwd_rows(dy32, x.astype(jnp.float32),
+                              g.astype(jnp.float32))
+        red = tuple(range(dy.ndim - 1))
+        return dx, jnp.sum(dy32 * xh, red), jnp.sum(dy32, red)
+    n = x2.shape[0]
+    n_pad = max(_LN_TILE, ((n + _LN_TILE - 1) // _LN_TILE) * _LN_TILE)
+    grid = (n_pad // _LN_TILE,)
+    row_spec = pl.BlockSpec((_LN_TILE, d), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((1, d), lambda i: (0, 0))
+    _sds = ((lambda sh, dt: jax.ShapeDtypeStruct(sh, dt, vma=vma)) if vma
+            else (lambda sh, dt: jax.ShapeDtypeStruct(sh, dt)))
+    dx, dg, db = pl.pallas_call(
+        _ln_bwd_kernel, grid=grid,
+        in_specs=[row_spec, row_spec, vec_spec],
+        out_specs=[row_spec, vec_spec, vec_spec],
+        out_shape=[_sds((n_pad, d), jnp.float32),
+                   _sds((1, d), jnp.float32),
+                   _sds((1, d), jnp.float32)],
+        interpret=_interpret(),
+    )(_ln_pad_rows(dy2, n_pad), _ln_pad_rows(x2, n_pad), g.reshape(1, d))
+    return dx[:n].reshape(shape), dg[0], db[0]
+
+
+@jax.custom_vjp
+def fused_layer_norm(x, g, b):
+    """Drop-in for models/transformer._layer_norm (rank-2 [N, d] or
+    rank-3 [B, S, d]; f32 statistics and output) as ONE Pallas kernel:
+    the mean/variance/normalize/scale chain runs on the VPU with the
+    row tile resident in VMEM instead of five XLA elementwise passes
+    over HBM. Backward is a second Pallas kernel (dx + accumulated
+    dg/db) via this custom VJP. Interpret mode on CPU."""
+    y, _ = _ln_run_fwd(x, g, b)
+    return y
+
+
+def _fused_ln_fwd(x, g, b):
+    y, _ = _ln_run_fwd(x, g, b)
+    return y, (x, g, b)
+
+
+def _fused_ln_bwd(res, dy):
+    x, g, b = res
+    dx, dg, db = _ln_run_bwd(dy, x, g)
+    return (dx.astype(x.dtype),
+            _match_vma(dg, g).astype(g.dtype),
+            _match_vma(db, b).astype(b.dtype))
+
+
+fused_layer_norm.defvjp(_fused_ln_fwd, _fused_ln_bwd)
+
+
+@jax.custom_vjp
+def fused_layer_norm_residual(x, r, g, b):
+    """Residual-add fused into the LayerNorm that consumes it:
+    ``s = x + r; y = LN(s)`` in one kernel pass — the summed stream
+    never round-trips HBM between the add and the statistics. Returns
+    ``(y, s)``: callers keep ``s`` as the new residual stream. The VJP
+    routes both cotangents (dy through the LN backward kernel, ds
+    directly) to the identical dx == dr."""
+    y, s = _ln_run_fwd(x, g, b, residual=r)
+    return y, s
+
+
+def _fused_ln_res_fwd(x, r, g, b):
+    y, s = _ln_run_fwd(x, g, b, residual=r)
+    # zero-size dtype carriers: custom_vjp residuals must be JAX values
+    return (y, s), (s, g, b, jnp.zeros((0,), x.dtype),
+                    jnp.zeros((0,), r.dtype))
+
+
+def _fused_ln_res_bwd(res, cts):
+    s, g, b, x_proto, r_proto = res
+    dy, ds = cts
+    dx, dg, db = _ln_run_bwd(dy, s, g)
+    d_sum = dx + ds.astype(jnp.float32)
+    return (d_sum.astype(x_proto.dtype), d_sum.astype(r_proto.dtype),
+            _match_vma(dg, g).astype(g.dtype),
+            _match_vma(db, b).astype(b.dtype))
+
+
+fused_layer_norm_residual.defvjp(_fused_ln_res_fwd, _fused_ln_res_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Grouped MoE expert matmul (ragged-dot style) — ISSUE 6 tentpole (b):
+# the sparse dispatch packs each expert's tokens into its capacity
+# buffer [E, C, d]; this kernel runs BOTH expert matmuls fused per
+# (expert, capacity-tile) grid cell, the [C_t, ff] hidden staying in
+# VMEM instead of materializing the [E, C, ff] tensor in HBM between
+# two batched einsums.
+# ---------------------------------------------------------------------------
+
+_MOE_CAP_TILE = 128   # capacity rows per grid step
+
+
+def _moe_kernel(activation: str, with_z1: bool):
+    def kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, out_ref,
+               *z1_refs):
+        # mixed precision exactly as the XLA grouped einsums: matmul
+        # inputs arrive pre-cast to compute_dtype, accumulation/bias/
+        # activation in f32, hidden rounded to compute_dtype between
+        # the two matmuls
+        z1 = jnp.dot(x_ref[:], w1_ref[:],
+                     preferred_element_type=jnp.float32) + b1_ref[:]
+        h1 = _act(activation, z1).astype(x_ref.dtype)
+        out_ref[:] = jnp.dot(h1, w2_ref[:],
+                             preferred_element_type=jnp.float32) + b2_ref[:]
+        if with_z1:
+            # the VJP's residual; primal-only calls skip this output
+            # entirely so the hidden truly never touches HBM
+            z1_refs[0][:] = z1
+
+    return kernel
+
+
+def _moe_grouped_forward(activation, cdt, buf, we1, be1, we2, be2,
+                         want_z1: bool):
+    """(h2 [E, C, d] f32, z1 [E, C, ff] f32 or None): the fused grouped
+    expert FFN plus — only when ``want_z1`` (the VJP forward rule) —
+    the pre-activation residual (gelu has no derivative in the
+    activation OUTPUT, so the saved residual is the pre-activation —
+    one [E, C, ff] f32 buffer, the same thing XLA autodiff stashes for
+    the reference einsum path). Primal-only calls (eval, decode, the
+    bench component timing) skip the z1 output entirely, so the hidden
+    genuinely never round-trips HBM."""
+    e, c, d = buf.shape
+    ff = we1.shape[-1]
+    vma = _vma_of(buf)
+    if vma:
+        we1, be1 = _lift_to(we1, vma), _lift_to(be1, vma)
+        we2, be2 = _lift_to(we2, vma), _lift_to(be2, vma)
+    act = mlp._ACTIVATIONS[activation]
+    if _interpret() and vma:
+        # CPU inside shard_map (see _ln_run_fwd): identical math, XLA
+        # ops (an unused z1 dead-code-eliminates there)
+        z1 = jnp.einsum("ecd,edf->ecf", buf.astype(cdt), we1.astype(cdt),
+                        preferred_element_type=jnp.float32) \
+            + be1[:, None].astype(jnp.float32)
+        h1 = act(z1).astype(cdt)
+        h2 = jnp.einsum("ecf,efd->ecd", h1, we2.astype(cdt),
+                        preferred_element_type=jnp.float32) \
+            + be2[:, None].astype(jnp.float32)
+        return h2, (z1 if want_z1 else None)
+    c_pad = max(_MOE_CAP_TILE,
+                ((c + _MOE_CAP_TILE - 1) // _MOE_CAP_TILE) * _MOE_CAP_TILE)
+    xp = buf.astype(cdt)
+    if c_pad != c:
+        xp = jnp.pad(xp, ((0, 0), (0, c_pad - c), (0, 0)))
+    grid = (e, c_pad // _MOE_CAP_TILE)
+    _sds = ((lambda sh, dt: jax.ShapeDtypeStruct(sh, dt, vma=vma)) if vma
+            else (lambda sh, dt: jax.ShapeDtypeStruct(sh, dt)))
+    out_specs = [
+        pl.BlockSpec((None, _MOE_CAP_TILE, d), lambda i, j: (i, j, 0)),
+    ]
+    out_shape = [_sds((e, c_pad, d), jnp.float32)]
+    if want_z1:
+        out_specs.append(
+            pl.BlockSpec((None, _MOE_CAP_TILE, ff), lambda i, j: (i, j, 0)))
+        out_shape.append(_sds((e, c_pad, ff), jnp.float32))
+    outs = pl.pallas_call(
+        _moe_kernel(activation, want_z1),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, _MOE_CAP_TILE, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, d, ff), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, 1, ff), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, ff, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, 1, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=_interpret(),
+    )(xp, we1.astype(cdt), be1.astype(jnp.float32).reshape(e, 1, ff),
+      we2.astype(cdt), be2.astype(jnp.float32).reshape(e, 1, d))
+    if want_z1:
+        return outs[0][:, :c], outs[1][:, :c]
+    return outs[0][:, :c], None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def moe_grouped_matmul(activation: str, cdt, buf, we1, be1, we2, be2):
+    """Fused grouped expert FFN ``[E, C, d] -> [E, C, d]`` (f32 out,
+    like the XLA einsum path it replaces in
+    models/transformer._grouped_expert_ffn): one Pallas kernel loops
+    (expert, capacity-tile) grid cells with the expert's weight pair
+    resident in VMEM. VMEM budget: ~2·d·ff·sizeof(cdt) for the weights
+    plus the [tile, ff] hidden — d=1024, ff=2048 bf16 fits with room;
+    larger d_ff needs an ff-tiling extension. Backward is XLA einsums
+    in the same mixed precision (matmul inputs cdt, f32 accumulation),
+    with the activation derivative taken exactly via jax.vjp on the
+    saved pre-activation. Interpret mode on CPU."""
+    h2, _ = _moe_grouped_forward(activation, cdt, buf, we1, be1, we2,
+                                 be2, want_z1=False)
+    return h2
+
+
+def _moe_grouped_fwd(activation, cdt, buf, we1, be1, we2, be2):
+    h2, z1 = _moe_grouped_forward(activation, cdt, buf, we1, be1, we2,
+                                  be2, want_z1=True)
+    return h2, (buf, we1, be1, we2, be2, z1)
+
+
+def _moe_grouped_bwd(activation, cdt, res, g):
+    buf, we1, be1, we2, be2, z1 = res
+    act = mlp._ACTIVATIONS[activation]
+    mm = lambda sub, a, b_: jnp.einsum(
+        sub, a.astype(cdt), b_.astype(cdt),
+        preferred_element_type=jnp.float32)
+    h1 = act(z1).astype(cdt)
+    dwe2 = mm("ecf,ecd->efd", h1, g)
+    dbe2 = jnp.sum(g.astype(jnp.float32), axis=1)
+    dh1 = mm("ecd,efd->ecf", g, we2)
+    _, act_vjp = jax.vjp(act, z1)
+    (dz1,) = act_vjp(dh1)
+    dwe1 = mm("ecd,ecf->edf", buf, dz1)
+    dbe1 = jnp.sum(dz1, axis=1)
+    dbuf = mm("ecf,edf->ecd", dz1, we1)
+    out = (dbuf, dwe1, dbe1, dwe2, dbe2)
+    prim = (buf, we1, be1, we2, be2)
+    return tuple(_match_vma(dv, p).astype(p.dtype)
+                 for dv, p in zip(out, prim))
+
+
+moe_grouped_matmul.defvjp(_moe_grouped_fwd, _moe_grouped_bwd)

@@ -88,8 +88,8 @@ def forward_local(spec, params, x, styles, use_pallas: bool = False,
                     microbatches, mb, -1)
 
                 def lm_head(params_, h, m):
-                    hl = transformer._layer_norm(
-                        h, params_["lnf_g"], params_["lnf_b"])
+                    hl = transformer._ln(
+                        spec, h, params_["lnf_g"], params_["lnf_b"])
                     logits = transformer._mm(
                         params_, hl, "W_head", "b_head",
                         spec.compute_dtype).astype(jnp.float32)
@@ -397,8 +397,8 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
                 microbatches, mbs, -1)
 
             def head(prm, h, m):
-                hl = transformer._layer_norm(h, prm["lnf_g"],
-                                             prm["lnf_b"])
+                hl = transformer._ln(spec, h, prm["lnf_g"],
+                                     prm["lnf_b"])
                 logits = transformer._mm(
                     prm, hl, "W_head", "b_head",
                     spec.compute_dtype).astype(jnp.float32)

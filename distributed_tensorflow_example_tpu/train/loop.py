@@ -82,6 +82,8 @@ def make_spec(cfg: Config):
             moe_dispatch=cfg.moe_dispatch,
             capacity_factor=cfg.capacity_factor,
             aux_loss_weight=cfg.moe_aux_weight,
+            fused_ln=cfg.fused_ln,
+            grouped_moe=cfg.grouped_moe,
             param_dtype=jnp.dtype(cfg.param_dtype),
             compute_dtype=jnp.dtype(cfg.compute_dtype),
         )

@@ -25,6 +25,23 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def _stack_available():
+    try:
+        from distributed_tensorflow_example_tpu.train import loop  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+# Shared marker for tests needing the full training stack (import it as
+# `from conftest import needs_stack`): this container's jax may predate
+# the repo's API, in which case train.loop fails to import.
+needs_stack = pytest.mark.skipif(
+    not _stack_available(),
+    reason="training stack needs a newer jax than this environment has")
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()

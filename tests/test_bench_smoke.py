@@ -9,23 +9,9 @@ config must flow through the genuine capture machinery."""
 
 import json
 
-import pytest
-
 import bench
 
-
-def _stack_available():
-    try:
-        from distributed_tensorflow_example_tpu.train import loop  # noqa: F401
-
-        return True
-    except Exception:
-        return False
-
-
-needs_stack = pytest.mark.skipif(
-    not _stack_available(),
-    reason="training stack needs a newer jax than this environment has")
+from conftest import needs_stack  # noqa: E402
 
 # every key main()'s headline block reads off the bench_config row
 _FULL_ROW = {
@@ -64,13 +50,29 @@ def _stub_rows(monkeypatch):
                            "test_accuracy": 0.9})
     for name in ("bench_reference_device_program", "bench_mxu",
                  "bench_pallas_parity", "bench_flash_attention",
-                 "bench_ring_flash", "bench_transformer_wide",
-                 "bench_transformer", "bench_pipeline_bubble",
-                 "bench_pp_memory", "bench_moe_dispatch",
-                 "bench_moe_wide", "bench_lm", "bench_decode"):
+                 "bench_ring_flash", "bench_transformer",
+                 "bench_pipeline_bubble", "bench_pp_memory",
+                 "bench_moe_dispatch", "bench_lm", "bench_decode"):
         monkeypatch.setattr(
             bench, name,
             lambda *a, _n=name, **kw: {"config": _n})
+    # the fused-kernel rows (ISSUE 6): transformer_wide carries its
+    # per-variant MFUs + headline, moe_wide carries the grouped A/B
+    # AND the dispatch-vs-expert breakdown — main() must forward the
+    # breakdown + headline MFU onto the final line for --gate
+    monkeypatch.setattr(
+        bench, "bench_transformer_wide",
+        lambda *a, **kw: {"config": "transformer_wide",
+                          "dense_mfu": 0.5, "flash_mfu": 0.55,
+                          "fused_ln_mfu": 0.62, "mfu": 0.62,
+                          "target_mfu": 0.60})
+    monkeypatch.setattr(
+        bench, "bench_moe_wide",
+        lambda *a, **kw: {"config": "moe_wide", "mfu": 0.36,
+                          "grouped_mfu": 0.36, "target_mfu": 0.35,
+                          "tokens_per_sec": 1000.0,
+                          "moe_dispatch_ms": 12.5, "moe_expert_ms": 40.0,
+                          "moe_expert_grouped_ms": 30.0})
     # transformer_wide_long is the r5 crash site: main() passes name=
     # through guarded(), which must deliver it as a row kwarg
     monkeypatch.setattr(
@@ -134,6 +136,12 @@ def test_bench_main_tpu_rows_no_guarded_collision(monkeypatch, capsys):
     s16k = [r for r in rows
             if r.get("config") == "transformer_wide_long_s16k"]
     assert s16k and "error" not in s16k[0]
+    # the fused-kernel gate keys ride the final line (obs.compare
+    # extract_metrics reads them off a BENCH capture by these names)
+    assert final["transformer_wide_mfu"] == 0.62
+    assert final["moe_wide_mfu"] == 0.36
+    assert final["moe_dispatch_ms"] == 12.5
+    assert final["moe_expert_ms"] == 40.0
 
 
 def test_guarded_isolates_row_failures(monkeypatch, capsys):

@@ -76,6 +76,17 @@ def test_input_pipeline_flags():
             parse_config(bad)
 
 
+def test_fused_kernel_flags():
+    """--fused_ln / --grouped_moe parse onto their Config fields and
+    default off (the reference paths stay the default — the kernels
+    are an opt-in A/B until the TPU targets are recorded)."""
+    cfg = parse_config(["--model=transformer", "--fused_ln",
+                        "--grouped_moe"])
+    assert cfg.fused_ln and cfg.grouped_moe
+    d = parse_config([])
+    assert not d.fused_ln and not d.grouped_moe
+
+
 def test_r3_flag_surface_parses():
     """Every r3 flag parses and lands on its Config field."""
     from distributed_tensorflow_example_tpu.config import parse_config

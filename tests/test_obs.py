@@ -15,19 +15,7 @@ from distributed_tensorflow_example_tpu.obs import heartbeat as hb_lib
 from distributed_tensorflow_example_tpu.obs.metrics import (
     MetricsLogger, WindowTimer, read_metrics, rss_bytes)
 
-
-def _stack_available():
-    try:
-        from distributed_tensorflow_example_tpu.train import loop  # noqa: F401
-
-        return True
-    except Exception:
-        return False
-
-
-needs_stack = pytest.mark.skipif(
-    not _stack_available(),
-    reason="training stack needs a newer jax than this environment has")
+from conftest import needs_stack  # noqa: E402
 
 
 # --- obs.flops: the ONE MFU accounting -----------------------------------

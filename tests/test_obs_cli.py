@@ -270,6 +270,39 @@ def test_compare_understands_input_pipeline_keys():
     assert ms["overlap_ratio"] == 1.25
 
 
+def test_compare_understands_fused_kernel_keys():
+    """The fused-kernel MFU line (ISSUE 6): the moe_wide row's
+    dispatch-vs-expert breakdown gates directly off the row, and the
+    per-row headline MFUs gate off the bench final summary under
+    their final-line names."""
+    # row shape: the breakdown keys are directly named gate metrics
+    row = {"config": "moe_wide", "mfu": 0.36, "grouped_mfu": 0.36,
+           "moe_dispatch_ms": 12.5, "moe_expert_ms": 40.0}
+    m = cmp_lib.extract_metrics(row)
+    assert m["moe_dispatch_ms"] == 12.5
+    assert m["moe_expert_ms"] == 40.0
+    worse = dict(row, moe_dispatch_ms=20.0)
+    verdict = cmp_lib.compare(row, worse)
+    assert not verdict["ok"]
+    assert "moe_dispatch_ms" in verdict["regressions"]
+    # final-summary shape: the MFU headlines + breakdown carry over
+    summary = {"metric": "mnist_20epoch_wall_clock", "value": 0.15,
+               "transformer_wide_mfu": 0.62,
+               "transformer_wide_long_mfu": 0.53,
+               "moe_wide_mfu": 0.36,
+               "moe_dispatch_ms": 12.5, "moe_expert_ms": 40.0}
+    ms = cmp_lib.extract_metrics(summary)
+    assert ms["transformer_wide_mfu"] == 0.62
+    assert ms["transformer_wide_long_mfu"] == 0.53
+    assert ms["moe_wide_mfu"] == 0.36
+    assert ms["moe_dispatch_ms"] == 12.5 and ms["moe_expert_ms"] == 40.0
+    # a doctored MFU regression gates
+    worse_sum = dict(summary, transformer_wide_mfu=0.50)
+    verdict = cmp_lib.compare(summary, worse_sum)
+    assert not verdict["ok"]
+    assert "transformer_wide_mfu" in verdict["regressions"]
+
+
 def test_compare_zero_baseline_stays_strict_json():
     """A zero baseline metric must not fabricate Infinity (non-strict
     JSON) nor gate: it reads as 'incomparable'."""

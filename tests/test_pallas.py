@@ -1,6 +1,8 @@
-"""Pallas fused-forward tests (interpret mode on the CPU backend):
-numerical parity with the XLA forward, custom-VJP gradients, and
-DP-sharded training equivalence through shard_map."""
+"""Pallas fused-kernel tests (interpret mode on the CPU backend):
+numerical parity with the XLA paths they replace, custom-VJP
+gradients, and sharded training equivalence through shard_map — for
+the fused MLP forward, the fused LayerNorm(+residual) fwd+bwd
+kernels, and the grouped MoE expert matmul."""
 
 import jax
 import jax.numpy as jnp
@@ -9,7 +11,10 @@ import pytest
 
 from distributed_tensorflow_example_tpu.config import Config
 from distributed_tensorflow_example_tpu.models import mlp
+from distributed_tensorflow_example_tpu.models import transformer as tfm
 from distributed_tensorflow_example_tpu.ops import pallas_fused
+
+from conftest import needs_stack  # noqa: E402
 
 SPECS = [
     mlp.MLPSpec(input_size=16, hidden_sizes=(8,), num_classes=4),
@@ -99,6 +104,335 @@ def test_grads_match_xla_bfloat16():
         np.testing.assert_allclose(
             np.asarray(g_pal[k]) / scale, ref / scale, atol=2e-2, err_msg=k,
         )
+
+
+# ---------------------------------------------------------------------------
+# Fused LayerNorm (+residual) — oracle parity vs transformer._layer_norm
+# (ISSUE 6 tentpole (a)); interpret mode on CPU, so these are tier-1.
+# ---------------------------------------------------------------------------
+
+# rank-2 (the decode/sampling shape) and rank-3 (the training shape),
+# even and ODD feature widths (odd d exercises the lane-padding path
+# on TPU and the non-tile-aligned interpreter path here)
+_LN_SHAPES = [((6, 64), "rank2_even"), ((5, 33), "rank2_odd"),
+              ((2, 7, 64), "rank3_even"), ((3, 5, 33), "rank3_odd"),
+              ((4, 129, 96), "rank3_multi_tile")]
+
+
+@pytest.mark.parametrize("shape",
+                         [s for s, _ in _LN_SHAPES],
+                         ids=[i for _, i in _LN_SHAPES])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+def test_fused_ln_matches_oracle(shape, dtype):
+    """Forward parity: identical op sequence to _layer_norm (f32
+    statistics, f32 output) over every rank/width/dtype crossing."""
+    rng = np.random.RandomState(0)
+    d = shape[-1]
+    x = jnp.asarray(rng.randn(*shape), dtype)
+    g = jnp.asarray(rng.randn(d), jnp.float32)
+    b = jnp.asarray(rng.randn(d), jnp.float32)
+    want = np.asarray(tfm._layer_norm(x, g, b))
+    got = np.asarray(pallas_fused.fused_layer_norm(x, g, b))
+    assert got.dtype == np.float32
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("shape", [(5, 33), (3, 5, 33), (2, 7, 64)],
+                         ids=["rank2_odd", "rank3_odd", "rank3_even"])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+def test_fused_ln_grads_match_oracle(shape, dtype):
+    """Backward parity: the Pallas backward kernel's dx/dg/db against
+    jax.grad through the XLA reference, for both input dtypes (bf16
+    dx rounds exactly where the reference autodiff rounds)."""
+    rng = np.random.RandomState(1)
+    d = shape[-1]
+    x = jnp.asarray(rng.randn(*shape), dtype)
+    g = jnp.asarray(rng.randn(d), jnp.float32)
+    b = jnp.asarray(rng.randn(d), jnp.float32)
+    w = jnp.asarray(rng.randn(*shape), jnp.float32)
+
+    def loss(fn):
+        return lambda x_, g_, b_: jnp.sum(fn(x_, g_, b_) * w)
+
+    ref = jax.grad(loss(tfm._layer_norm), (0, 1, 2))(x, g, b)
+    got = jax.grad(loss(pallas_fused.fused_layer_norm), (0, 1, 2))(x, g, b)
+    tol = dict(rtol=1e-4, atol=1e-5) if dtype == jnp.float32 \
+        else dict(rtol=2e-2, atol=2e-2)
+    for r, gt, name in zip(ref, got, ("dx", "dg", "db")):
+        assert np.asarray(gt).dtype == np.asarray(r).dtype, name
+        np.testing.assert_allclose(np.asarray(gt), np.asarray(r),
+                                   err_msg=name, **tol)
+
+
+def test_fused_ln_residual_matches_oracle():
+    """The residual-fused variant: (LN(x+r), x+r) with BOTH outputs'
+    cotangents flowing — dy through the LN backward kernel, ds
+    directly — must match the unfused x + r; LN(s) composition in
+    values and all four gradients."""
+    rng = np.random.RandomState(2)
+    shape, d = (3, 6, 48), 48
+    x = jnp.asarray(rng.randn(*shape), jnp.float32)
+    r = jnp.asarray(rng.randn(*shape), jnp.float32)
+    g = jnp.asarray(rng.randn(d), jnp.float32)
+    b = jnp.asarray(rng.randn(d), jnp.float32)
+    w1 = jnp.asarray(rng.randn(*shape), jnp.float32)
+    w2 = jnp.asarray(rng.randn(*shape), jnp.float32)
+
+    y, s = pallas_fused.fused_layer_norm_residual(x, r, g, b)
+    np.testing.assert_allclose(np.asarray(s), np.asarray(x + r),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(tfm._layer_norm(x + r, g, b)),
+        rtol=1e-5, atol=1e-5)
+
+    def loss_ref(x_, r_, g_, b_):
+        s_ = x_ + r_
+        return (jnp.sum(tfm._layer_norm(s_, g_, b_) * w1)
+                + jnp.sum(s_ * w2))
+
+    def loss_fused(x_, r_, g_, b_):
+        y_, s_ = pallas_fused.fused_layer_norm_residual(x_, r_, g_, b_)
+        return jnp.sum(y_ * w1) + jnp.sum(s_ * w2)
+
+    ref = jax.grad(loss_ref, (0, 1, 2, 3))(x, r, g, b)
+    got = jax.grad(loss_fused, (0, 1, 2, 3))(x, r, g, b)
+    for a, c, name in zip(ref, got, ("dx", "dr", "dg", "db")):
+        np.testing.assert_allclose(np.asarray(c), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_fused_ln_residual_bf16_normalizes_rounded_sum():
+    """bf16 inputs: the kernel must normalize the ROUNDED sum it emits
+    (s = bf16(x + r)), exactly like the unfused `s = x + r; LN(s)`
+    composition — statistics on the unrounded f32 sum would disagree
+    with the returned s and with the VJP's recompute-from-s."""
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(4, 48), jnp.bfloat16)
+    r = jnp.asarray(rng.randn(4, 48), jnp.bfloat16)
+    g = jnp.asarray(rng.randn(48), jnp.float32)
+    b = jnp.asarray(rng.randn(48), jnp.float32)
+    y, s = pallas_fused.fused_layer_norm_residual(x, r, g, b)
+    assert np.asarray(s).dtype == jnp.bfloat16
+    s_ref = x + r   # bf16 rounded, the composition's actual stream
+    np.testing.assert_array_equal(np.asarray(s, np.float32),
+                                  np.asarray(s_ref, np.float32))
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(tfm._layer_norm(s_ref, g, b)),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_fused_ln_rank2_decode_site():
+    """The decode path's exact call pattern (rank-2 [B, d] direct —
+    the old ``[:, None]...[:, 0]`` dance is gone): fused and reference
+    agree, and BOTH accept rank-2 without reshaping."""
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(4, 32), jnp.float32)
+    g = jnp.asarray(rng.randn(32), jnp.float32)
+    b = jnp.asarray(rng.randn(32), jnp.float32)
+    direct = np.asarray(tfm._layer_norm(x, g, b))
+    danced = np.asarray(tfm._layer_norm(x[:, None], g, b)[:, 0])
+    np.testing.assert_allclose(direct, danced, rtol=0, atol=0)
+    got = np.asarray(pallas_fused.fused_layer_norm(x, g, b))
+    np.testing.assert_allclose(got, direct, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_ln_param_dtype_bf16():
+    """bf16 gains/biases (param_dtype=bfloat16 runs): cotangents come
+    back in the params' dtype with the reference's values."""
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(6, 64), jnp.float32)
+    g = jnp.asarray(rng.randn(64), jnp.bfloat16)
+    b = jnp.asarray(rng.randn(64), jnp.bfloat16)
+    want = np.asarray(tfm._layer_norm(x, g, b))
+    got = np.asarray(pallas_fused.fused_layer_norm(x, g, b))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    w = jnp.asarray(rng.randn(6, 64), jnp.float32)
+    gref = jax.grad(lambda g_: jnp.sum(tfm._layer_norm(x, g_, b) * w))(g)
+    gpal = jax.grad(
+        lambda g_: jnp.sum(pallas_fused.fused_layer_norm(x, g_, b) * w))(g)
+    assert gpal.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(gpal, np.float32),
+                               np.asarray(gref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+# ---------------------------------------------------------------------------
+# Grouped MoE expert matmul — oracle parity vs the XLA grouped einsums
+# (ISSUE 6 tentpole (b))
+# ---------------------------------------------------------------------------
+
+
+def _moe_ref(act, cdt, buf, we1, be1, we2, be2):
+    """The XLA grouped-einsum path the kernel replaces (the
+    spec.grouped_moe=False branch of transformer._grouped_expert_ffn),
+    inlined as the oracle."""
+    h1 = act(jnp.einsum("ecd,edf->ecf", buf.astype(cdt), we1.astype(cdt),
+                        preferred_element_type=jnp.float32)
+             + be1[:, None].astype(jnp.float32)).astype(cdt)
+    return jnp.einsum("ecf,efd->ecd", h1, we2.astype(cdt),
+                      preferred_element_type=jnp.float32) \
+        + be2[:, None].astype(jnp.float32)
+
+
+@pytest.mark.parametrize("activation", ["gelu", "relu"])
+@pytest.mark.parametrize("cdt", [jnp.float32, jnp.bfloat16],
+                         ids=["f32", "bf16"])
+def test_moe_grouped_matmul_matches_xla(activation, cdt):
+    """Forward parity on a ragged capacity (C=37, off the 128 tile):
+    identical mixed precision to the einsum path — cdt matmul inputs,
+    f32 accumulate/bias, hidden rounded to cdt between the matmuls.
+    gelu matters: its VJP needs the PRE-activation residual the kernel
+    saves (the MLP kernel's output-derivative trick can't cover it)."""
+    rng = np.random.RandomState(0)
+    e, c, d, ff = 4, 37, 16, 24
+    buf = jnp.asarray(rng.randn(e, c, d), jnp.float32)
+    we1 = jnp.asarray(rng.randn(e, d, ff) / np.sqrt(d), jnp.float32)
+    be1 = jnp.asarray(rng.randn(e, ff), jnp.float32)
+    we2 = jnp.asarray(rng.randn(e, ff, d) / np.sqrt(ff), jnp.float32)
+    be2 = jnp.asarray(rng.randn(e, d), jnp.float32)
+    act = mlp._ACTIVATIONS[activation]
+    want = np.asarray(_moe_ref(act, cdt, buf, we1, be1, we2, be2))
+    got = np.asarray(pallas_fused.moe_grouped_matmul(
+        activation, cdt, buf, we1, be1, we2, be2))
+    assert got.dtype == np.float32
+    tol = dict(rtol=1e-5, atol=1e-5) if cdt == jnp.float32 \
+        else dict(rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(got, want, **tol)
+
+
+@pytest.mark.parametrize("activation", ["gelu", "relu"])
+def test_moe_grouped_matmul_grads_match_xla(activation):
+    """Gradient parity for all five inputs against jax.grad through
+    the einsum oracle (the custom VJP recomputes the activation from
+    the saved pre-activation via jax.vjp — exact for gelu too)."""
+    rng = np.random.RandomState(1)
+    e, c, d, ff = 3, 20, 8, 12
+    args = (jnp.asarray(rng.randn(e, c, d), jnp.float32),
+            jnp.asarray(rng.randn(e, d, ff) / np.sqrt(d), jnp.float32),
+            jnp.asarray(rng.randn(e, ff), jnp.float32),
+            jnp.asarray(rng.randn(e, ff, d) / np.sqrt(ff), jnp.float32),
+            jnp.asarray(rng.randn(e, d), jnp.float32))
+    w = jnp.asarray(rng.randn(e, c, d), jnp.float32)
+    act = mlp._ACTIVATIONS[activation]
+    ref = jax.grad(lambda *a: jnp.sum(
+        _moe_ref(act, jnp.float32, *a) * w), tuple(range(5)))(*args)
+    got = jax.grad(lambda *a: jnp.sum(pallas_fused.moe_grouped_matmul(
+        activation, jnp.float32, *a) * w), tuple(range(5)))(*args)
+    names = ("dbuf", "dwe1", "dbe1", "dwe2", "dbe2")
+    for r, gt, name in zip(ref, got, names):
+        np.testing.assert_allclose(np.asarray(gt), np.asarray(r),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_grouped_expert_ffn_dispatches_to_kernel():
+    """transformer._grouped_expert_ffn: the spec switch really selects
+    the kernel (grouped_moe=True) vs the einsums, and both agree."""
+    rng = np.random.RandomState(2)
+    e, c, d, ff = 4, 16, 8, 12
+    spec = tfm.TransformerSpec(input_size=784, seq_len=28, d_model=d,
+                               n_heads=2, num_blocks=1, d_ff=ff,
+                               num_experts=e)
+    buf = jnp.asarray(rng.randn(e, c, d), jnp.float32)
+    we1 = jnp.asarray(rng.randn(e, d, ff), jnp.float32)
+    be1 = jnp.asarray(rng.randn(e, ff), jnp.float32)
+    we2 = jnp.asarray(rng.randn(e, ff, d), jnp.float32)
+    be2 = jnp.asarray(rng.randn(e, d), jnp.float32)
+    act = mlp._ACTIVATIONS[spec.activation]
+    xla = np.asarray(tfm._grouped_expert_ffn(
+        spec, buf, we1, be1, we2, be2, act, jnp.float32))
+    import dataclasses
+
+    kern = np.asarray(tfm._grouped_expert_ffn(
+        dataclasses.replace(spec, grouped_moe=True),
+        buf, we1, be1, we2, be2, act, jnp.float32))
+    np.testing.assert_allclose(kern, xla, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: --fused_ln training equivalence (stack-gated: needs the
+# full mesh/shard_map step; the kernel itself is covered tier-1 above)
+# ---------------------------------------------------------------------------
+
+
+@needs_stack
+def test_fused_ln_training_equivalence(devices8):
+    """--fused_ln training reaches the same final params as the
+    reference path on the tiny transformer config: 4 steps of the real
+    build_train_step on a DP-2 mesh, params compared
+    bit-identical-within-tolerance (the fused forward is the same f32
+    math; only reduction order may differ)."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.loop import make_spec
+    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+    from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(4 * 16, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 4 * 16)]
+
+    def train(fused):
+        cfg = Config(model="transformer", d_model=32, n_heads=2,
+                     num_blocks=2, d_ff=64, learning_rate=0.05,
+                     fused_ln=fused)
+        spec = make_spec(cfg)
+        mesh = mesh_lib.build_mesh(2, 1, devices=devices8[:2])
+        opt = make_optimizer(cfg)
+        state = create_train_state(jax.random.PRNGKey(1), spec, opt)
+        state = mesh_lib.place_state(
+            state, mesh, mesh_lib.state_pspecs(spec, opt, 1))
+        step = step_lib.build_train_step(cfg, mesh, spec, opt)
+        for i in range(4):
+            state, cost, _ = step(state, x[i * 16:(i + 1) * 16],
+                                  y[i * 16:(i + 1) * 16])
+        return jax.tree.map(np.asarray, state.params), float(cost)
+
+    p_ref, c_ref = train(False)
+    p_fus, c_fus = train(True)
+    assert abs(c_ref - c_fus) < 1e-5
+    for k in p_ref:
+        np.testing.assert_allclose(p_fus[k], p_ref[k], rtol=1e-4,
+                                   atol=1e-6, err_msg=k)
+
+
+@needs_stack
+def test_grouped_moe_training_step_equivalence(devices8):
+    """One sparse-MoE training step with --grouped_moe == the XLA
+    einsum step (ample capacity so the two paths see identical
+    buffers), through the real sharded step."""
+    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
+    from distributed_tensorflow_example_tpu.parallel import step as step_lib
+    from distributed_tensorflow_example_tpu.train.loop import make_spec
+    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
+    from distributed_tensorflow_example_tpu.train.state import create_train_state
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 16)]
+
+    def one_step(grouped):
+        cfg = Config(model="transformer", d_model=32, n_heads=2,
+                     num_blocks=2, d_ff=64, num_experts=4,
+                     moe_dispatch="alltoall", capacity_factor=4.0,
+                     learning_rate=0.05, grouped_moe=grouped)
+        spec = make_spec(cfg)
+        mesh = mesh_lib.build_mesh(2, 1, devices=devices8[:2])
+        opt = make_optimizer(cfg)
+        state = create_train_state(jax.random.PRNGKey(1), spec, opt)
+        state = mesh_lib.place_state(
+            state, mesh, mesh_lib.state_pspecs(spec, opt, 1))
+        step = step_lib.build_train_step(cfg, mesh, spec, opt)
+        state, cost, _ = step(state, x, y)
+        return jax.tree.map(np.asarray, state.params), float(cost)
+
+    p_ref, c_ref = one_step(False)
+    p_grp, c_grp = one_step(True)
+    assert abs(c_ref - c_grp) < 1e-5
+    for k in p_ref:
+        np.testing.assert_allclose(p_grp[k], p_ref[k], rtol=1e-4,
+                                   atol=1e-6, err_msg=k)
 
 
 def test_dp8_training_equivalence_with_pallas(devices8):

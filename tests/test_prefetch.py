@@ -17,19 +17,7 @@ from distributed_tensorflow_example_tpu.data import (
     DevicePrefetcher, EpochIterator, EpochPrefetcher, Prefetcher)
 from distributed_tensorflow_example_tpu.data import mnist as M
 
-
-def _stack_available():
-    try:
-        from distributed_tensorflow_example_tpu.train import loop  # noqa: F401
-
-        return True
-    except Exception:
-        return False
-
-
-needs_stack = pytest.mark.skipif(
-    not _stack_available(),
-    reason="training stack needs a newer jax than this environment has")
+from conftest import needs_stack  # noqa: E402
 
 
 # --- Prefetcher (host stage) ----------------------------------------------

@@ -277,19 +277,69 @@ def test_3d_tp_crossings_match_single_device(devices8, flavor):
                                    err_msg=k)
 
 
-def test_moe_alltoall_matches_dense_with_ample_capacity():
+@pytest.mark.parametrize("grouped", [False, True],
+                         ids=["einsum", "grouped_kernel"])
+def test_moe_alltoall_matches_dense_with_ample_capacity(grouped):
     """capacity_factor >= E means no token ever drops, so the sparse
     (capacity-limited, Switch/GShard-style) dispatch computes exactly
     the dense dispatch's math: top-1 expert output scaled by the gate
-    probability."""
+    probability. ``grouped`` runs the same equivalence with the fused
+    Pallas expert kernel (--grouped_moe) in place of the einsums."""
     kw = dict(num_experts=4, n_heads=2)
     sd = _spec(moe_dispatch="dense", **kw)
-    ss = _spec(moe_dispatch="alltoall", capacity_factor=4.0, **kw)
+    ss = _spec(moe_dispatch="alltoall", capacity_factor=4.0,
+               grouped_moe=grouped, **kw)
     params = tfm.init(jax.random.PRNGKey(3), sd)
     x = np.random.RandomState(2).rand(4, 784).astype(np.float32)
     want = np.asarray(jax.jit(lambda p, xx: tfm.apply(sd, p, xx))(params, x))
     got = np.asarray(jax.jit(lambda p, xx: tfm.apply(ss, p, xx))(params, x))
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_ln_apply_matches_reference():
+    """--fused_ln swaps every LayerNorm (block ln1/ln2, final lnf) for
+    the Pallas kernels, with ln2 fusing the attention residual add:
+    the classify forward AND its parameter gradients must match the
+    reference path (same f32 math, kernel-tile reduction order
+    aside)."""
+    spec_ref = _spec()
+    spec_fus = _spec(fused_ln=True)
+    params = tfm.init(jax.random.PRNGKey(3), spec_ref)
+    rng = np.random.RandomState(2)
+    x = rng.rand(4, 784).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.randint(0, 10, 4)]
+    want = np.asarray(jax.jit(
+        lambda p, xx: tfm.apply(spec_ref, p, xx))(params, x))
+    got = np.asarray(jax.jit(
+        lambda p, xx: tfm.apply(spec_fus, p, xx))(params, x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def loss(sp):
+        def f(p):
+            logits = tfm.apply(sp, p, x)
+            return -jnp.mean(jnp.sum(y * jax.nn.log_softmax(logits), -1))
+
+        return f
+
+    g_ref = jax.grad(loss(spec_ref))(params)
+    g_fus = jax.grad(loss(spec_fus))(params)
+    for k in g_ref:
+        np.testing.assert_allclose(np.asarray(g_fus[k]),
+                                   np.asarray(g_ref[k]),
+                                   rtol=1e-4, atol=1e-6, err_msg=k)
+
+
+def test_fused_ln_generate_matches_reference():
+    """Greedy generation (the rank-2 decode LN sites) is token-
+    identical with and without --fused_ln."""
+    spec_ref = _lm_spec(num_blocks=1)
+    spec_fus = _lm_spec(num_blocks=1, fused_ln=True)
+    params = tfm.init(jax.random.PRNGKey(6), spec_ref)
+    prompt = jnp.asarray(np.random.RandomState(1).randint(
+        0, 16, (2, 8)).astype(np.int32))
+    np.testing.assert_array_equal(
+        np.asarray(tfm.generate(spec_ref, params, prompt)),
+        np.asarray(tfm.generate(spec_fus, params, prompt)))
 
 
 def test_moe_alltoall_drops_overflow_tokens():
@@ -307,14 +357,17 @@ def test_moe_alltoall_drops_overflow_tokens():
     assert np.abs(got - want).max() > 1e-4
 
 
-def test_moe_top2_sparse_matches_dense_with_ample_capacity():
+@pytest.mark.parametrize("grouped", [False, True],
+                         ids=["einsum", "grouped_kernel"])
+def test_moe_top2_sparse_matches_dense_with_ample_capacity(grouped):
     """GShard top-2 routing: the sparse per-choice dispatch (2 slots
     per token) must equal the dense gate-weighted combination when
     nothing drops, and top-2 must actually mix two experts (differ
-    from top-1)."""
+    from top-1) — with either expert-matmul realization."""
     kw = dict(num_experts=4, n_heads=2, moe_topk=2)
     sd = _spec(moe_dispatch="dense", **kw)
-    ss = _spec(moe_dispatch="alltoall", capacity_factor=4.0, **kw)
+    ss = _spec(moe_dispatch="alltoall", capacity_factor=4.0,
+               grouped_moe=grouped, **kw)
     s1 = _spec(moe_dispatch="dense", num_experts=4, n_heads=2)
     params = tfm.init(jax.random.PRNGKey(3), sd)
     x = np.random.RandomState(2).rand(4, 784).astype(np.float32)
@@ -826,14 +879,16 @@ def test_dropout_driver_trains(devices8, tmp_path):
     assert np.isfinite(res["final_cost"]), res
 
 
-@pytest.mark.parametrize("variant", ["f32", "bf16", "moe"])
+@pytest.mark.parametrize("variant", ["f32", "bf16", "moe", "fused_ln"])
 def test_lm_decode_matches_teacher_forcing(variant):
     """KV-cached decode_step computes the training forward: feeding a
     full token sequence position by position must reproduce apply()'s
     per-position logits (the cache IS the attention state) — in f32,
     in bfloat16 (the cache stores the same rounded k/v the training
-    attention consumes), and with a MoE FFN (ample-capacity sparse
-    training == the dense routing decode computes)."""
+    attention consumes), with a MoE FFN (ample-capacity sparse
+    training == the dense routing decode computes), and with the
+    fused Pallas LayerNorms (the decode path's rank-2 kernel calls
+    against the training forward's rank-3 ones)."""
     import jax.numpy as jnp2
 
     kw = dict(num_blocks=2)
@@ -844,6 +899,8 @@ def test_lm_decode_matches_teacher_forcing(variant):
     elif variant == "moe":
         kw.update(num_experts=4, moe_dispatch="alltoall",
                   capacity_factor=4.0)   # ample: sparse == dense
+    elif variant == "fused_ln":
+        kw["fused_ln"] = True
     spec = _lm_spec(**kw)
     params = tfm.init(jax.random.PRNGKey(5), spec)
     rng = np.random.RandomState(9)
