@@ -1,0 +1,28 @@
+"""dtx-lint: repo-aware static analysis for SPMD/schema/host-sync
+invariants.
+
+The paper's 183-line TF-1.2 script shipped a stale sync path
+(``replica_id=`` had been removed by TF 1.2) that only a 4-process
+cluster run could have caught; this package catches that class of
+drift for free, at AST level, before anything is imported or run:
+
+- axis names at collective call sites vs the mesh axis registry;
+- host syncs sneaking into the training loop's step window;
+- written telemetry keys vs the ``obs/schema.py`` contracts;
+- ``jax.custom_vjp`` declarations without a complete ``defvjp``;
+- retracing and nondeterminism hazards inside traced code;
+- CLI flags vs ``docs/API.md`` coverage;
+- trace-scope/bucket literals vs the ``obs/buckets.py`` registry.
+
+Pure stdlib + ``ast`` — importing (and running) this package never
+imports jax, so the tier-1 whole-package check stays fast anywhere.
+
+Layout: ``index`` (shared parsed-module index every rule visits),
+``findings`` (Finding + baseline handling), ``rules_spmd`` /
+``rules_loop`` / ``rules_contracts`` (the rule visitors), ``cli``
+(the ``dtx-lint`` console script). See docs/static_analysis.md for
+the rule catalog and suppression syntax.
+"""
+
+from .findings import Finding  # noqa: F401
+from .index import ModuleIndex  # noqa: F401

@@ -1,0 +1,259 @@
+"""Contract rules: schema drift, flag/doc drift, scope registry.
+
+These rules pin the repo's stringly-typed contracts — the telemetry
+schema (``obs/schema.py``), the CLI flag surface vs ``docs/API.md``,
+and the trace-scope/bucket registry (``obs/buckets.py``) — by
+statically extracting the keys each side produces/consumes and
+diffing them. All extraction is AST-only: dict literal keys,
+``x["key"] = ...`` subscript stores and call keyword names, plus the
+bucket-registry expansion for keys built as ``f"{bucket}_s"``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set
+
+from .findings import Finding
+from .index import Module, ModuleIndex
+
+
+def produced_keys(mod: Module) -> Set[str]:
+    """Every string key this module statically produces: dict literal
+    keys, subscript-store keys, and call keyword names."""
+    keys: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value,
+                                                              str):
+                    keys.add(k.value)
+        elif isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg:
+                    keys.add(kw.arg)
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Subscript) and isinstance(
+                        t.slice, ast.Constant) and isinstance(
+                            t.slice.value, str):
+                    keys.add(t.slice.value)
+    return keys
+
+
+def _bucket_expansion(index: ModuleIndex, mod: Module) -> Set[str]:
+    """Keys built as ``f"{bucket}_s"`` over the shared registry: when
+    a writer module imports WINDOW_BUCKETS/HOST_BUCKET, its produced
+    set gains the expanded field names."""
+    refs = set(mod.from_imports) | set(mod.const_nodes)
+    if not ({"WINDOW_BUCKETS", "HOST_BUCKET"} & refs):
+        return set()
+    buckets_mod = index.module_by_suffix("obs/buckets.py")
+    if buckets_mod is None:
+        return set()
+    out: Set[str] = set()
+    for name in ("WINDOW_BUCKETS", "HOST_BUCKET"):
+        node = index.resolve_constant(buckets_mod, name)
+        if node is None:
+            continue
+        lits, _ = index.resolve_strings(buckets_mod, node)
+        out |= {f"{b}_s" for b in lits}
+    return out
+
+
+def _contract_dict(mod: Module, name: str) -> Optional[ast.Dict]:
+    node = mod.const_nodes.get(name)
+    return node if isinstance(node, ast.Dict) else None
+
+
+def _contract_keys(d: ast.Dict) -> List[ast.Constant]:
+    return [k for k in d.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)]
+
+
+class SchemaDriftRule:
+    """rule 3: every field the obs/schema.py contracts promise must
+    have a statically-visible writer, and every obs/compare.py gate
+    metric must have a statically-visible producer."""
+
+    id = "schema-drift"
+    doc = ("obs/schema.py contract fields and obs/compare.py gate "
+           "metrics must have statically-visible writers/producers")
+
+    # contract name -> writer module suffixes
+    CONTRACT_WRITERS = {
+        "METRICS_COMMON": ("obs/metrics.py", "train/loop.py"),
+        "METRICS_WINDOW": ("obs/metrics.py", "train/loop.py"),
+        "METRICS_EVENT": ("obs/metrics.py", "train/loop.py"),
+        "FLIGHT_DUMP": ("obs/flight.py",),
+        "FLIGHT_STEP_RECORD": ("obs/flight.py", "train/loop.py"),
+        "FLIGHT_ANOMALY_RECORD": ("obs/flight.py", "obs/anomaly.py"),
+        "RUN_REPORT": ("obs/aggregate.py",),
+    }
+    GATE_PRODUCERS = ("bench.py", "obs/aggregate.py", "obs/metrics.py",
+                      "obs/schema.py", "train/loop.py")
+
+    def check(self, index: ModuleIndex, ctx) -> List[Finding]:
+        out: List[Finding] = []
+        schema_mod = index.module_by_suffix("obs/schema.py")
+        if schema_mod is not None:
+            out.extend(self._check_contracts(index, schema_mod))
+        compare_mod = index.module_by_suffix("obs/compare.py")
+        if compare_mod is not None:
+            out.extend(self._check_gate(index, compare_mod))
+        return out
+
+    def _writer_keys(self, index: ModuleIndex,
+                     suffixes) -> Optional[Set[str]]:
+        keys: Set[str] = set()
+        found = False
+        for suffix in suffixes:
+            mod = index.module_by_suffix(suffix)
+            if mod is None:
+                continue
+            found = True
+            keys |= produced_keys(mod)
+            keys |= _bucket_expansion(index, mod)
+        return keys if found else None
+
+    def _check_contracts(self, index: ModuleIndex,
+                         schema_mod: Module) -> List[Finding]:
+        findings: List[Finding] = []
+        for contract, suffixes in self.CONTRACT_WRITERS.items():
+            d = _contract_dict(schema_mod, contract)
+            if d is None:
+                continue
+            writers = self._writer_keys(index, suffixes)
+            if writers is None:
+                continue  # writer modules absent from this tree
+            for key_node in _contract_keys(d):
+                key = key_node.value
+                if key not in writers:
+                    findings.append(Finding(
+                        rule=self.id, file=schema_mod.relpath,
+                        line=key_node.lineno,
+                        msg=(f"{contract} field {key!r} has no "
+                             f"statically-visible writer in "
+                             f"{'/'.join(suffixes)}"),
+                        hint=("either the writer renamed/dropped the "
+                              "field (bump SCHEMA_VERSION and update "
+                              "the contract) or the contract promises "
+                              "a field nobody emits")))
+        return findings
+
+    def _check_gate(self, index: ModuleIndex,
+                    compare_mod: Module) -> List[Finding]:
+        d = _contract_dict(compare_mod, "GATE_METRICS")
+        if d is None:
+            return []
+        bench = index.module_by_suffix("bench.py")
+        if bench is None:
+            return []  # no bench driver next to this tree: skip
+        producers = self._writer_keys(index, self.GATE_PRODUCERS) or set()
+        findings: List[Finding] = []
+        for key_node in _contract_keys(d):
+            key = key_node.value
+            if key not in producers:
+                findings.append(Finding(
+                    rule=self.id, file=compare_mod.relpath,
+                    line=key_node.lineno,
+                    msg=(f"GATE_METRICS key {key!r} is produced by "
+                         f"neither bench.py nor the obs writers — the "
+                         f"gate silently stops holding it"),
+                    hint=("re-point the gate at the metric's new name "
+                          "or drop the stale key")))
+        return findings
+
+
+class FlagDriftRule:
+    """rule 7: every argparse flag in config.py must be mentioned in
+    docs/API.md (bare field name or --flag form both count)."""
+
+    id = "flag-drift"
+    doc = "config.py argparse flags must be covered by docs/API.md"
+
+    def check(self, index: ModuleIndex, ctx) -> List[Finding]:
+        cfg = index.module_by_suffix("config.py")
+        api_md = getattr(ctx, "api_md", None)
+        if cfg is None or not api_md or not os.path.isfile(api_md):
+            return []
+        with open(api_md, encoding="utf-8") as f:
+            words = set(re.findall(r"[A-Za-z0-9_]+", f.read()))
+        findings: List[Finding] = []
+        for node in ast.walk(cfg.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add_argument"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            raw = node.args[0].value
+            if not raw.startswith("--"):
+                continue
+            flag = raw.lstrip("-")
+            if flag not in words:
+                findings.append(Finding(
+                    rule=self.id, file=cfg.relpath, line=node.lineno,
+                    msg=(f"flag --{flag} is not mentioned anywhere in "
+                         f"{os.path.basename(api_md)}"),
+                    hint=("add it to the docs/API.md flag coverage (the "
+                          "bare field name anywhere in the file "
+                          "counts)")))
+        return findings
+
+
+class ScopeRegistryRule:
+    """rule 8: tracer.annotate / WindowTimer.charge / jax.named_scope
+    string literals must come from the obs/buckets.py registry."""
+
+    id = "scope-registry"
+    doc = ("annotate()/charge()/named_scope() literals must be "
+           "obs/buckets.py registry names")
+
+    # method name -> (registry constant, label)
+    SITES = {
+        "annotate": ("TRACE_SCOPES", "trace scope"),
+        "charge": ("WINDOW_BUCKETS", "window bucket"),
+        "named_scope": ("NAMED_SCOPES", "named scope"),
+    }
+
+    def check(self, index: ModuleIndex, ctx) -> List[Finding]:
+        buckets_mod = index.module_by_suffix("obs/buckets.py")
+        if buckets_mod is None:
+            return []
+        registries: Dict[str, Optional[Set[str]]] = {}
+        for const, _ in self.SITES.values():
+            vals = index.resolve_string_tuple(buckets_mod, const)
+            registries[const] = set(vals) if vals is not None else None
+        findings: List[Finding] = []
+        for mod in index.modules.values():
+            if mod is buckets_mod:
+                continue
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in self.SITES
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                const, label = self.SITES[node.func.attr]
+                reg = registries.get(const)
+                if reg is None:
+                    continue
+                name = node.args[0].value
+                if name not in reg:
+                    findings.append(Finding(
+                        rule=self.id, file=mod.relpath, line=node.lineno,
+                        msg=(f"{label} {name!r} is not in "
+                             f"obs/buckets.py {const} {sorted(reg)}"),
+                        hint=("add it to the registry (ONE source of "
+                              "truth) or fix the call site's name — a "
+                              "drifted literal splits one cost across "
+                              "two names")))
+        return findings

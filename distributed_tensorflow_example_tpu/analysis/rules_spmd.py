@@ -1,0 +1,449 @@
+"""SPMD-layer rules: collective axis names, custom_vjp completeness,
+retracing and nondeterminism hazards inside traced code.
+
+These are the rules that catch the source paper's failure class: the
+reference script shipped a sync path whose keyword had been removed
+by the TF release it ran on, and only a multi-process cluster run
+could have noticed. Axis names at collective call sites are the same
+kind of stringly-typed contract — a renamed mesh axis, or a typo'd
+literal, produces a program that traces fine and deadlocks (or
+crashes) only on the full mesh.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding
+from .index import Module, ModuleIndex, function_assigns
+
+# collective -> positional index of the axis-name argument
+COLLECTIVES: Dict[str, int] = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "ppermute": 1,
+    "all_gather": 1, "all_to_all": 1, "psum_scatter": 1,
+    "axis_index": 0, "all_gather_invariant": 1,
+}
+
+_AXIS_CONST_RE = re.compile(r"^[A-Z_]*AXIS$")
+_AXISISH_RE = re.compile(r"ax[ei]s", re.IGNORECASE)
+
+
+def _axisish(name: str) -> bool:
+    """The dynamic-argument naming convention: an unresolvable axis
+    expression is accepted iff its name says it is one."""
+    return bool(_AXISISH_RE.search(name))
+
+
+def axis_registry(index: ModuleIndex) -> Set[str]:
+    """Every string bound to a module-level ``*_AXIS`` constant in the
+    linted tree — the mesh axis vocabulary (parallel/mesh.py here)."""
+    reg: Set[str] = set()
+    for mod in index.modules.values():
+        for name, node in mod.const_nodes.items():
+            if _AXIS_CONST_RE.match(name):
+                if isinstance(node, ast.Constant) and isinstance(
+                        node.value, str):
+                    reg.add(node.value)
+    return reg
+
+
+def _is_lax_collective(func: ast.expr) -> Optional[str]:
+    """'psum' when ``func`` is ``lax.psum`` / ``jax.lax.psum``-shaped;
+    None otherwise. A bare Name call (from jax.lax import psum) also
+    counts."""
+    if isinstance(func, ast.Attribute) and func.attr in COLLECTIVES:
+        root = func.value
+        if isinstance(root, ast.Name) and root.id in ("lax", "jlax"):
+            return func.attr
+        if isinstance(root, ast.Attribute) and root.attr == "lax":
+            return func.attr
+        return None
+    if isinstance(func, ast.Name) and func.id in COLLECTIVES:
+        return func.id
+    return None
+
+
+class AxisConsistencyRule:
+    """rule 1: every collective's axis name must resolve into the mesh
+    axis registry, or be a dynamic expression whose NAME follows the
+    *axis*/*axes* convention."""
+
+    id = "axis-consistency"
+    doc = ("lax.psum/pmean/ppermute/all_gather/all_to_all axis names "
+           "must be mesh-registry axes (or conventioned dynamic args)")
+
+    def check(self, index: ModuleIndex, ctx) -> List[Finding]:
+        registry = axis_registry(index)
+        if not registry:
+            return []  # no mesh module in this tree: rule inactive
+        out: List[Finding] = []
+        for mod in index.modules.values():
+            out.extend(self._check_module(index, mod, registry))
+        return out
+
+    def _check_module(self, index: ModuleIndex, mod: Module,
+                      registry: Set[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        func_stack: List[Dict[str, ast.expr]] = []
+
+        def local_lookup() -> Dict[str, ast.expr]:
+            merged: Dict[str, ast.expr] = {}
+            for scope in func_stack:
+                merged.update(scope)
+            return merged
+
+        def check_axis_arg(node: ast.expr, call: ast.Call,
+                           name: str) -> None:
+            locals_ = local_lookup()
+            lits, dyn = index.resolve_strings(mod, node, locals_)
+            top_ok = isinstance(node, ast.Name) and _axisish(node.id)
+            for lit in sorted(lits):
+                if lit not in registry:
+                    findings.append(Finding(
+                        rule=self.id, file=mod.relpath, line=call.lineno,
+                        msg=(f"{name} over unknown axis {lit!r} (mesh "
+                             f"axes: {sorted(registry)})"),
+                        hint=("use a parallel/mesh.py *_AXIS constant; "
+                              "a typo'd axis traces fine and fails only "
+                              "on the full mesh")))
+            if top_ok:
+                return  # conventioned name: unresolved parts accepted
+            for desc in dyn:
+                if not _axisish(desc):
+                    findings.append(Finding(
+                        rule=self.id, file=mod.relpath, line=call.lineno,
+                        msg=(f"{name} axis argument {desc!r} is neither "
+                             f"a registry axis nor named like one"),
+                        hint=("rename the variable to *_axis/*_axes (the "
+                              "convention this rule can verify) or pass "
+                              "a mesh axis constant")))
+
+        def visit(node: ast.AST) -> None:
+            pushed = False
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func_stack.append(function_assigns(node))
+                pushed = True
+            if isinstance(node, ast.Call):
+                coll = _is_lax_collective(node.func)
+                if coll is not None:
+                    pos = COLLECTIVES[coll]
+                    axis_node = None
+                    for kw in node.keywords:
+                        if kw.arg == "axis_name":
+                            axis_node = kw.value
+                    if axis_node is None and len(node.args) > pos:
+                        axis_node = node.args[pos]
+                    if axis_node is not None:
+                        check_axis_arg(axis_node, node, f"lax.{coll}")
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr == "partial") or (
+                          isinstance(node.func, ast.Name)
+                          and node.func.id == "partial"):
+                    # functools.partial(lax.ppermute, axis_name=...)
+                    if node.args and _is_lax_collective(node.args[0]):
+                        coll = _is_lax_collective(node.args[0])
+                        for kw in node.keywords:
+                            if kw.arg == "axis_name":
+                                check_axis_arg(kw.value, node,
+                                               f"lax.{coll}")
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if pushed:
+                func_stack.pop()
+
+        visit(mod.tree)
+        return findings
+
+
+def _decorator_custom_vjp(dec: ast.expr) -> Optional[Tuple[int, ...]]:
+    """() for a bare @jax.custom_vjp, the nondiff_argnums tuple for the
+    partial form, None when the decorator is something else."""
+    def is_cvjp(node: ast.expr) -> bool:
+        return ((isinstance(node, ast.Attribute)
+                 and node.attr == "custom_vjp")
+                or (isinstance(node, ast.Name)
+                    and node.id == "custom_vjp"))
+
+    if is_cvjp(dec):
+        return ()
+    if isinstance(dec, ast.Call):
+        fn = dec.func
+        is_partial = ((isinstance(fn, ast.Attribute)
+                       and fn.attr == "partial")
+                      or (isinstance(fn, ast.Name) and fn.id == "partial"))
+        if is_partial and dec.args and is_cvjp(dec.args[0]):
+            for kw in dec.keywords:
+                if kw.arg == "nondiff_argnums" and isinstance(
+                        kw.value, (ast.Tuple, ast.List)):
+                    vals = []
+                    for elt in kw.value.elts:
+                        if isinstance(elt, ast.Constant) and isinstance(
+                                elt.value, int):
+                            vals.append(elt.value)
+                    return tuple(vals)
+            return ()
+        if is_cvjp(fn):   # @jax.custom_vjp(...) direct-call form
+            for kw in dec.keywords:
+                if kw.arg == "nondiff_argnums" and isinstance(
+                        kw.value, (ast.Tuple, ast.List)):
+                    return tuple(e.value for e in kw.value.elts
+                                 if isinstance(e, ast.Constant))
+            return ()
+    return None
+
+
+def _positional_count(func: ast.FunctionDef) -> int:
+    return len(func.args.posonlyargs) + len(func.args.args)
+
+
+class CustomVjpRule:
+    """rule 4: every jax.custom_vjp has a defvjp whose fwd mirrors the
+    primal signature, whose bwd takes nondiff + residuals + cotangent,
+    and whose bwd actually reads the residuals."""
+
+    id = "vjp-complete"
+    doc = ("jax.custom_vjp declarations need a matching defvjp(fwd, "
+           "bwd) with consistent arity and residual use")
+
+    def check(self, index: ModuleIndex, ctx) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in index.modules.values():
+            out.extend(self._check_module(mod))
+        return out
+
+    def _check_module(self, mod: Module) -> List[Finding]:
+        findings: List[Finding] = []
+        primals: Dict[str, Tuple[ast.FunctionDef, Tuple[int, ...]]] = {}
+        defs: Dict[str, ast.FunctionDef] = {}
+        defvjps: Dict[str, ast.Call] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.FunctionDef):
+                defs.setdefault(node.name, node)
+                for dec in node.decorator_list:
+                    nondiff = _decorator_custom_vjp(dec)
+                    if nondiff is not None:
+                        primals[node.name] = (node, nondiff)
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "defvjp"
+                  and isinstance(node.func.value, ast.Name)):
+                defvjps[node.func.value.id] = node
+
+        for name, (fnode, nondiff) in primals.items():
+            call = defvjps.get(name)
+            if call is None:
+                findings.append(Finding(
+                    rule=self.id, file=mod.relpath, line=fnode.lineno,
+                    msg=(f"custom_vjp function {name!r} has no "
+                         f"{name}.defvjp(fwd, bwd) in this module"),
+                    hint=("without defvjp the first jax.grad through it "
+                          "raises at trace time — exactly the drift a "
+                          "mesh-only test path hides")))
+                continue
+            if len(call.args) != 2 or not all(
+                    isinstance(a, ast.Name) for a in call.args):
+                continue  # computed fwd/bwd: arity not statically known
+            fwd_name, bwd_name = call.args[0].id, call.args[1].id
+            n_primal = _positional_count(fnode)
+            for role, fn_name in (("fwd", fwd_name), ("bwd", bwd_name)):
+                if fn_name not in defs:
+                    findings.append(Finding(
+                        rule=self.id, file=mod.relpath, line=call.lineno,
+                        msg=(f"{name}.defvjp references undefined "
+                             f"{role} function {fn_name!r}"),
+                        hint="define it in this module"))
+            fwd = defs.get(fwd_name)
+            if fwd is not None and _positional_count(fwd) != n_primal:
+                findings.append(Finding(
+                    rule=self.id, file=mod.relpath, line=fwd.lineno,
+                    msg=(f"{fwd_name} takes {_positional_count(fwd)} "
+                         f"args but primal {name!r} takes {n_primal} "
+                         f"(fwd must mirror the primal signature)"),
+                    hint="align the fwd signature with the primal"))
+            bwd = defs.get(bwd_name)
+            if bwd is not None:
+                want = len(nondiff) + 2
+                got = _positional_count(bwd)
+                if got != want:
+                    findings.append(Finding(
+                        rule=self.id, file=mod.relpath, line=bwd.lineno,
+                        msg=(f"{bwd_name} takes {got} args; expected "
+                             f"{want} ({len(nondiff)} nondiff + "
+                             f"residuals + cotangent)"),
+                        hint=("bwd signature is (nondiff..., residuals, "
+                              "cotangent)")))
+                elif got == want:
+                    res_arg = (list(bwd.args.posonlyargs)
+                               + list(bwd.args.args))[len(nondiff)].arg
+                    used = any(isinstance(n, ast.Name) and n.id == res_arg
+                               and isinstance(n.ctx, ast.Load)
+                               for n in ast.walk(bwd))
+                    if not used:
+                        findings.append(Finding(
+                            rule=self.id, file=mod.relpath,
+                            line=bwd.lineno,
+                            msg=(f"{bwd_name} never reads its residuals "
+                                 f"argument {res_arg!r}"),
+                            hint=("either the fwd saves residuals nobody "
+                                  "uses (wasted memory) or the bwd "
+                                  "recomputes what it already has")))
+        return findings
+
+
+def _is_jit_like(func: ast.expr) -> bool:
+    if isinstance(func, ast.Attribute):
+        return func.attr in ("jit", "pmap")
+    if isinstance(func, ast.Name):
+        return func.id in ("jit", "pmap")
+    return False
+
+
+class RetraceRule:
+    """rule 5: jit/pmap wrapping inside a loop body builds a fresh
+    traced callable per iteration — the compile cache never hits and
+    every step retraces."""
+
+    id = "retrace"
+    doc = ("jax.jit/pmap called inside a for/while body defeats the "
+           "compile cache (a new callable per iteration)")
+
+    def check(self, index: ModuleIndex, ctx) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in index.modules.values():
+            loop_depth = 0
+
+            def visit(node: ast.AST) -> None:
+                nonlocal loop_depth
+                is_loop = isinstance(node, (ast.For, ast.While))
+                if isinstance(node, ast.Call) and loop_depth \
+                        and _is_jit_like(node.func):
+                    out.append(Finding(
+                        rule=self.id, file=mod.relpath, line=node.lineno,
+                        msg=("jax.jit/pmap called inside a loop body: "
+                             "every iteration builds (and retraces) a "
+                             "new compiled callable"),
+                        hint=("hoist the jit() out of the loop and call "
+                              "the same wrapped function each "
+                              "iteration")))
+                if is_loop:
+                    # the iterable/condition itself is outside the body
+                    children = node.body + node.orelse
+                    loop_depth += 1
+                    for child in children:
+                        visit(child)
+                    loop_depth -= 1
+                    return
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+
+            visit(mod.tree)
+        return out
+
+
+# call roots that mark their function argument as traced
+_TRACING_ENTRYPOINTS = {
+    "jit", "pmap", "vmap", "grad", "value_and_grad", "shard_map",
+    "scan", "fori_loop", "while_loop", "cond", "checkpoint", "remat",
+    "custom_vjp", "custom_jvp", "named_call",
+}
+
+_NONDET_TIME = {"time", "perf_counter", "monotonic", "time_ns",
+                "perf_counter_ns", "monotonic_ns"}
+
+
+class NondeterminismRule:
+    """rule 6: wall-clock reads and global-RNG draws inside traced
+    functions bake one arbitrary value into the compiled program (or
+    differ per process, splitting the SPMD programs)."""
+
+    id = "nondet"
+    doc = ("time.*/random.*/np.random.* inside traced functions bake "
+           "per-trace values into the program")
+
+    def check(self, index: ModuleIndex, ctx) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in index.modules.values():
+            out.extend(self._check_module(mod))
+        return out
+
+    def _traced_names(self, mod: Module) -> Set[str]:
+        traced: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                base = (fn.attr if isinstance(fn, ast.Attribute)
+                        else fn.id if isinstance(fn, ast.Name) else "")
+                if base in _TRACING_ENTRYPOINTS:
+                    for arg in list(node.args) + [kw.value for kw in
+                                                  node.keywords]:
+                        if isinstance(arg, ast.Name):
+                            traced.add(arg.id)
+        return traced
+
+    def _check_module(self, mod: Module) -> List[Finding]:
+        findings: List[Finding] = []
+        traced_names = self._traced_names(mod)
+
+        def is_traced_def(fn: ast.FunctionDef) -> bool:
+            if fn.name in traced_names:
+                return True
+            for dec in fn.decorator_list:
+                if _decorator_custom_vjp(dec) is not None:
+                    return True
+                base = dec
+                if isinstance(base, ast.Call):
+                    base = base.func
+                name = (base.attr if isinstance(base, ast.Attribute)
+                        else base.id if isinstance(base, ast.Name)
+                        else "")
+                if name in ("jit", "pmap", "partial") and isinstance(
+                        dec, ast.Call) and dec.args:
+                    inner = dec.args[0]
+                    iname = (inner.attr if isinstance(inner, ast.Attribute)
+                             else inner.id if isinstance(inner, ast.Name)
+                             else "")
+                    if iname in _TRACING_ENTRYPOINTS:
+                        return True
+                if name in ("jit", "pmap"):
+                    return True
+            return False
+
+        def scan_traced(fn: ast.FunctionDef) -> None:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if not isinstance(f, ast.Attribute):
+                    continue
+                root = f.value
+                root_name = root.id if isinstance(root, ast.Name) else None
+                bad = None
+                if root_name == "time" and f.attr in _NONDET_TIME:
+                    bad = f"time.{f.attr}()"
+                elif root_name == "random":
+                    bad = f"random.{f.attr}()"
+                elif (isinstance(root, ast.Attribute)
+                      and root.attr == "random"
+                      and isinstance(root.value, ast.Name)
+                      and root.value.id in ("np", "numpy")):
+                    bad = f"np.random.{f.attr}()"
+                elif root_name == "os" and f.attr == "urandom":
+                    bad = "os.urandom()"
+                elif root_name in ("datetime", "dt") and f.attr in (
+                        "now", "utcnow", "today"):
+                    bad = f"datetime.{f.attr}()"
+                if bad is not None:
+                    findings.append(Finding(
+                        rule=self.id, file=mod.relpath, line=node.lineno,
+                        msg=(f"{bad} inside traced function "
+                             f"{fn.name!r}: the value is baked in at "
+                             f"trace time (and can differ per process)"),
+                        hint=("thread the value in as an argument, or "
+                              "use jax.random with an explicit key")))
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.FunctionDef) and is_traced_def(node):
+                scan_traced(node)
+        return findings

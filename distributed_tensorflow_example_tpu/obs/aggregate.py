@@ -39,13 +39,14 @@ from typing import Any, Dict, List, Optional
 
 from . import heartbeat as hb_lib
 from . import schema as schema_lib
+from .buckets import GOODPUT_BUCKETS
 
 # bucket names, in presentation order; "train" is the goodput bucket,
 # "eval"/"sample" are auxiliary useful work, the rest is badput
 # ("h2d" = the host wall spent committing batches to their device
-# layout — overlapped ahead of dispatch under --device_prefetch)
-BUCKETS = ("train", "compile", "data_wait", "h2d", "host", "eval",
-           "sample", "anomaly_skipped", "straggler_idle", "untracked")
+# layout — overlapped ahead of dispatch under --device_prefetch).
+# The names live in the shared registry (obs/buckets.py).
+BUCKETS = GOODPUT_BUCKETS
 
 _METRICS_RE = re.compile(r"metrics\.(\d+)\.jsonl$")
 

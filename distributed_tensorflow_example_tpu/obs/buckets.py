@@ -1,0 +1,52 @@
+"""The shared trace-scope / goodput-bucket name registry.
+
+Before this module the same bucket strings lived in four places —
+``WindowTimer``'s charge sites (obs/metrics.py), the tracer scope
+names (obs/tracer.py docstring + call sites), ``aggregate.BUCKETS``
+and the ``*_s`` fields of ``schema.METRICS_WINDOW`` — and nothing but
+review discipline kept them in lockstep.  A renamed bucket would
+silently split one cost across two names (charged under the new one,
+aggregated/validated under the old).  This module is the ONE source
+of truth; the other four point at it, ``dtx-lint``'s
+``scope-registry`` rule checks every literal call site against it,
+and ``schema.py`` asserts its contract matches at import time.
+
+Names are grouped by the surface they name:
+
+- ``WINDOW_BUCKETS`` — the host-loop wall buckets ``WindowTimer``
+  charges per window; each becomes the ``<name>_s`` field of a
+  metrics window row.  ``host`` is NOT here: it is the computed
+  residual (wall minus every charged bucket), never charged directly.
+- ``TRACE_SCOPES`` — valid ``WindowedTracer.annotate`` names: the
+  window buckets plus the non-step phases (``eval``, ``checkpoint``)
+  that annotate host work outside the step window.
+- ``NAMED_SCOPES`` — ``jax.named_scope`` regions inside the compiled
+  forward (models/transformer.py) that attribute device time in a
+  captured trace to the bench breakdowns.
+- ``GOODPUT_BUCKETS`` — the run-level wall-time decomposition
+  ``aggregate.aggregate`` reports (presentation order; sums to wall).
+"""
+
+from __future__ import annotations
+
+# host-loop per-window charge buckets (field "<name>_s" in every
+# metrics window row; "host" is the residual field computed from them)
+WINDOW_BUCKETS = ("data_wait", "h2d", "dispatch", "device_wait")
+
+# the residual bucket name (field "host_s"): wall not charged above
+HOST_BUCKET = "host"
+
+# valid WindowedTracer.annotate scope names: the charge buckets plus
+# the out-of-step-window host phases
+TRACE_SCOPES = WINDOW_BUCKETS + ("eval", "checkpoint")
+
+# jax.named_scope regions inside the compiled step (transformer
+# forward): device-timeline attribution for the bench breakdowns
+NAMED_SCOPES = ("ln", "moe_dispatch", "moe_expert")
+
+# run-level goodput/badput decomposition, in presentation order
+# ("train" is the goodput bucket, "eval"/"sample" auxiliary useful
+# work, the rest badput); aggregate.BUCKETS re-exports this
+GOODPUT_BUCKETS = ("train", "compile", "data_wait", "h2d", "host",
+                   "eval", "sample", "anomaly_skipped",
+                   "straggler_idle", "untracked")

@@ -33,6 +33,7 @@ import os
 import time
 from typing import Any, Dict, List
 
+from .buckets import HOST_BUCKET, WINDOW_BUCKETS
 from .schema import SCHEMA_VERSION
 
 
@@ -93,6 +94,11 @@ class WindowTimer:
         return len(self.step_times)
 
     def charge(self, bucket: str, seconds: float) -> None:
+        if bucket not in WINDOW_BUCKETS:
+            # one registry (obs/buckets.py) names every bucket; an
+            # unknown name would silently vanish from the window row
+            raise ValueError(f"unknown window bucket {bucket!r}: "
+                             f"expected one of {WINDOW_BUCKETS}")
         self.buckets[bucket] = self.buckets.get(bucket, 0.0) + seconds
 
     def step_done(self) -> None:
@@ -105,24 +111,24 @@ class WindowTimer:
         (step/epoch/cost) and throughput fields then resets."""
         wall = time.perf_counter() - self._t_start
         st = sorted(self.step_times)
-        data_wait = self.buckets.get("data_wait", 0.0)
-        h2d = self.buckets.get("h2d", 0.0)
-        dispatch = self.buckets.get("dispatch", 0.0)
-        device_wait = self.buckets.get("device_wait", 0.0)
-        return {
+        row = {
             "steps": len(st),
             "window_wall_s": round(wall, 6),
             "step_time_p50_ms": round(_percentile(st, 50) * 1e3, 4),
             "step_time_p95_ms": round(_percentile(st, 95) * 1e3, 4),
             "step_time_max_ms": round((st[-1] if st else float("nan"))
                                       * 1e3, 4),
-            "data_wait_s": round(data_wait, 6),
-            "h2d_s": round(h2d, 6),
-            "dispatch_s": round(dispatch, 6),
-            "device_wait_s": round(device_wait, 6),
-            "host_s": round(max(0.0, wall - data_wait - h2d - dispatch
-                                 - device_wait), 6),
         }
+        # bucket fields from the shared registry (obs/buckets.py) —
+        # the "<bucket>_s" naming here, the schema contract and the
+        # aggregate decomposition all walk the same tuple
+        charged = 0.0
+        for bucket in WINDOW_BUCKETS:
+            v = self.buckets.get(bucket, 0.0)
+            charged += v
+            row[f"{bucket}_s"] = round(v, 6)
+        row[f"{HOST_BUCKET}_s"] = round(max(0.0, wall - charged), 6)
+        return row
 
 
 def _scrub_nonfinite(row):

@@ -19,6 +19,8 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List
 
+from .buckets import HOST_BUCKET, WINDOW_BUCKETS
+
 _NUM = (int, float)
 
 # The one version number for everything obs/ writes: stamped as "v"
@@ -68,6 +70,22 @@ METRICS_WINDOW = {
     "rss_bytes": (int, type(None)),
     "device_memory": (dict, type(None)),
 }
+
+# The per-bucket timing fields above are the shared bucket registry
+# (obs/buckets.py) spelled out — a contract stays explicit — and this
+# import-time check keeps the two from drifting: adding a WindowTimer
+# bucket without its schema field (or vice versa) fails the first
+# import, not a consumer months later. dtx-lint's scope-registry rule
+# checks the same statically.
+_BUCKET_FIELDS = {f"{b}_s" for b in WINDOW_BUCKETS + (HOST_BUCKET,)}
+_SCHEMA_BUCKET_FIELDS = {k for k in METRICS_WINDOW
+                         if k.endswith("_s") and k != "window_wall_s"}
+if _SCHEMA_BUCKET_FIELDS != _BUCKET_FIELDS:
+    raise AssertionError(
+        f"METRICS_WINDOW bucket fields {sorted(_SCHEMA_BUCKET_FIELDS)} "
+        f"out of sync with obs/buckets.py WINDOW_BUCKETS "
+        f"{sorted(_BUCKET_FIELDS)}; update both (and bump "
+        f"SCHEMA_VERSION)")
 
 # kind == "event": point events; free-form payload beyond these.
 METRICS_EVENT = {

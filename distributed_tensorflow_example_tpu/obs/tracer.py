@@ -46,6 +46,8 @@ import contextlib
 import os
 from typing import Optional, Tuple
 
+from .buckets import TRACE_SCOPES
+
 
 def parse_profile_steps(s: str) -> Optional[Tuple[int, int]]:
     """``"START:COUNT"`` -> ``(start, count)``; ``""``/None -> None.
@@ -192,10 +194,16 @@ class WindowedTracer:
         return self._prof().StepTraceAnnotation("train", step_num=step)
 
     def annotate(self, name: str):
-        """Named ``TraceAnnotation`` scope; names match the metrics
-        buckets (data_wait / h2d / dispatch / device_wait / eval /
-        checkpoint) so the trace timeline and the JSONL split agree.
-        nullcontext whenever no capture is open (see step_annotation)."""
+        """Named ``TraceAnnotation`` scope; names come from the shared
+        registry (obs/buckets.py TRACE_SCOPES = the metrics buckets +
+        eval/checkpoint) so the trace timeline and the JSONL split
+        agree. nullcontext whenever no capture is open (see
+        step_annotation)."""
+        if name not in TRACE_SCOPES:
+            # validated BEFORE the active check so a drifted scope
+            # name fails in any test run, not only under --profile
+            raise ValueError(f"unknown trace scope {name!r}: expected "
+                             f"one of {TRACE_SCOPES}")
         if not self._active:
             return contextlib.nullcontext()
         return self._prof().TraceAnnotation(name)

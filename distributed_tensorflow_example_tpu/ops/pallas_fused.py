@@ -209,8 +209,8 @@ def _match_vma(val, like):
         want = jax.typeof(like).vma
     except (AttributeError, TypeError):
         return val
-    extra = tuple(sorted(cur - want))
-    return jax.lax.psum(val, extra) if extra else val
+    extra_axes = tuple(sorted(cur - want))
+    return jax.lax.psum(val, extra_axes) if extra_axes else val
 
 
 def _bwd(spec, res, g):

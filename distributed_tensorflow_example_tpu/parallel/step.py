@@ -326,8 +326,8 @@ def make_step_rng(cfg, spec, axes):
             return None
         rng = jax.random.fold_in(
             jax.random.PRNGKey(cfg.seed ^ 0xD0C0), state.step)
-        for ax in axes:
-            rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
+        for axis in axes:
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
         return rng
 
     return step_rng

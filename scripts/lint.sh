@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# dtx-lint over the whole package against the checked-in baseline —
+# the same check tests/test_lint.py pins in tier-1. AST-only (never
+# imports jax), so it runs anywhere in well under a second.
+# Usage: scripts/lint.sh [extra dtx-lint args, e.g. --json]
+cd "$(dirname "$0")/.." || exit 1
+exec python -m distributed_tensorflow_example_tpu.analysis.cli \
+    distributed_tensorflow_example_tpu/ "$@"

@@ -1,0 +1,645 @@
+"""dtx-lint fixture suite — pure python (stdlib + ast only; the
+analyzer never imports the linted tree, and these tests never import
+the jax stack), so every test runs in any container.
+
+Layout: one known-good + one known-bad fixture tree per rule (each
+bad fixture fails if its rule is removed — the rule id is passed
+explicitly so no other rule can mask it), the suppression / baseline
+machinery, the CLI exit-code contract (0 clean / 1 findings / 2 usage
+error), the --json document, and the tier-1 whole-package check:
+dtx-lint over the real package must report zero non-baselined
+findings.
+"""
+
+import json
+import os
+import textwrap
+
+from distributed_tensorflow_example_tpu.analysis import cli as lint_cli
+from distributed_tensorflow_example_tpu.analysis import findings as f_lib
+from distributed_tensorflow_example_tpu.analysis.index import ModuleIndex
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "distributed_tensorflow_example_tpu")
+
+MESH = 'DATA_AXIS = "data"\nMODEL_AXIS = "model"\n'
+
+
+def make_tree(tmp_path, files, root_files=None):
+    """Write a fixture package at tmp_path/pkg (plus optional repo-root
+    files like docs/API.md or bench.py next to it) and return its path."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    for rel, src in (root_files or {}).items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(pkg)
+
+
+def lint(tmp_path, files, root_files=None, rules=None):
+    root = make_tree(tmp_path, files, root_files)
+    _index, _ctx, kept, suppressed = lint_cli.run_lint(root, rules)
+    return kept, suppressed
+
+
+def rules_of(found):
+    return [f.rule for f in found]
+
+
+# ---------------------------------------------------------------- rule 1
+
+AXIS_BAD = """
+    from jax import lax
+    from .mesh import DATA_AXIS
+
+    def reduce(x):
+        return lax.psum(x, "dtaa")
+"""
+
+AXIS_GOOD = """
+    from jax import lax
+    from .mesh import DATA_AXIS
+
+    def reduce(x, reduce_axes):
+        a = lax.psum(x, DATA_AXIS)
+        b = lax.pmean(x, "data")
+        return lax.all_gather(a + b, reduce_axes)
+"""
+
+
+def test_axis_consistency_bad(tmp_path):
+    found, _ = lint(tmp_path, {"mesh.py": MESH, "step.py": AXIS_BAD},
+                    rules=["axis-consistency"])
+    assert rules_of(found) == ["axis-consistency"]
+    assert "'dtaa'" in found[0].msg and found[0].file == "step.py"
+
+
+def test_axis_consistency_good(tmp_path):
+    # registry constants, literal registry axes and *_axes-conventioned
+    # dynamic arguments all pass
+    found, _ = lint(tmp_path, {"mesh.py": MESH, "step.py": AXIS_GOOD},
+                    rules=["axis-consistency"])
+    assert found == []
+
+
+def test_axis_consistency_unconventioned_dynamic(tmp_path):
+    src = """
+        from jax import lax
+        from .mesh import DATA_AXIS
+
+        def reduce(x, a):
+            return lax.psum(x, a)
+    """
+    found, _ = lint(tmp_path, {"mesh.py": MESH, "step.py": src},
+                    rules=["axis-consistency"])
+    assert rules_of(found) == ["axis-consistency"]
+    assert "'a'" in found[0].msg
+
+
+def test_axis_consistency_inactive_without_registry(tmp_path):
+    # no *_AXIS constants anywhere: the rule cannot know the mesh
+    # vocabulary and stays silent rather than flagging everything
+    found, _ = lint(tmp_path, {"step.py": AXIS_BAD.replace(
+        "from .mesh import DATA_AXIS\n", "")}, rules=["axis-consistency"])
+    assert found == []
+
+
+# ---------------------------------------------------------------- rule 2
+
+LOOP_BAD = """
+    def run(feed, tracer, timed_batches, step):
+        inflight = []
+        for batch in timed_batches(feed):
+            cost_dev = step(batch)
+            cost = float(cost_dev)
+"""
+
+LOOP_GOOD = """
+    def run(feed, tracer, timed_batches, step):
+        inflight = []
+        for batch in timed_batches(feed):
+            cost_dev = step(batch)
+            with tracer.annotate("device_wait"):
+                cost = float(cost_dev)
+"""
+
+
+def test_host_sync_bad(tmp_path):
+    found, _ = lint(tmp_path, {"train/loop.py": LOOP_BAD},
+                    rules=["host-sync"])
+    assert rules_of(found) == ["host-sync"]
+    assert "float(<device value>)" in found[0].msg
+
+
+def test_host_sync_sanctioned_by_annotate(tmp_path):
+    found, _ = lint(tmp_path, {"train/loop.py": LOOP_GOOD},
+                    rules=["host-sync"])
+    assert found == []
+
+
+def test_host_sync_transitive_callee(tmp_path):
+    # the hot region includes module-local functions the loop calls
+    src = """
+        def drain(inflight):
+            inflight.pop(0).block_until_ready()
+
+        def run(feed, timed_batches, step):
+            inflight = []
+            for batch in timed_batches(feed):
+                inflight.append(step(batch))
+                drain(inflight)
+    """
+    found, _ = lint(tmp_path, {"train/loop.py": src}, rules=["host-sync"])
+    assert rules_of(found) == ["host-sync"]
+    assert ".block_until_ready()" in found[0].msg
+
+
+def test_host_sync_outside_window_ok(tmp_path):
+    # the same fetch before/after the step window is not hot
+    src = """
+        def run(feed, timed_batches, step, warm_dev):
+            x = float(warm_dev)
+            for batch in timed_batches(feed):
+                step(batch)
+            return float(warm_dev)
+    """
+    found, _ = lint(tmp_path, {"train/loop.py": src}, rules=["host-sync"])
+    assert found == []
+
+
+# ---------------------------------------------------------------- rule 3
+
+SCHEMA_BAD = {
+    "obs/schema.py": """
+        METRICS_COMMON = {"v": (int,), "ghost_field": (int,)}
+    """,
+    "obs/metrics.py": """
+        def row():
+            return {"v": 3}
+    """,
+}
+
+SCHEMA_GOOD = {
+    "obs/schema.py": """
+        METRICS_COMMON = {"v": (int,), "cost": (float,)}
+    """,
+    "obs/metrics.py": """
+        def row(cost):
+            return {"v": 3, "cost": cost}
+    """,
+}
+
+
+def test_schema_drift_bad(tmp_path):
+    found, _ = lint(tmp_path, SCHEMA_BAD, rules=["schema-drift"])
+    assert rules_of(found) == ["schema-drift"]
+    assert "'ghost_field'" in found[0].msg
+    assert found[0].file == "obs/schema.py"
+
+
+def test_schema_drift_good(tmp_path):
+    found, _ = lint(tmp_path, SCHEMA_GOOD, rules=["schema-drift"])
+    assert found == []
+
+
+def test_schema_drift_gate_metrics(tmp_path):
+    # a GATE_METRICS key nobody produces — requires a bench.py aux
+    # file next to the package (like the real repo layout)
+    files = {
+        "obs/compare.py": """
+            GATE_METRICS = {"step_ms": (True, 0.1), "gone_ms": (True, 0.1)}
+        """,
+    }
+    root_files = {"bench.py": 'def row():\n    return {"step_ms": 1.0}\n'}
+    found, _ = lint(tmp_path, files, root_files, rules=["schema-drift"])
+    assert rules_of(found) == ["schema-drift"]
+    assert "'gone_ms'" in found[0].msg
+
+
+# ---------------------------------------------------------------- rule 4
+
+VJP_BAD = """
+    import jax
+
+    @jax.custom_vjp
+    def op(x, y):
+        return x * y
+"""
+
+VJP_GOOD = """
+    import jax
+
+    @jax.custom_vjp
+    def op(x, y):
+        return x * y
+
+    def op_fwd(x, y):
+        return op(x, y), (x, y)
+
+    def op_bwd(res, g):
+        x, y = res
+        return (g * y, g * x)
+
+    op.defvjp(op_fwd, op_bwd)
+"""
+
+
+def test_vjp_missing_defvjp(tmp_path):
+    found, _ = lint(tmp_path, {"ops.py": VJP_BAD}, rules=["vjp-complete"])
+    assert rules_of(found) == ["vjp-complete"]
+    assert "has no op.defvjp" in found[0].msg
+
+
+def test_vjp_complete_good(tmp_path):
+    found, _ = lint(tmp_path, {"ops.py": VJP_GOOD},
+                    rules=["vjp-complete"])
+    assert found == []
+
+
+def test_vjp_arity_and_residual(tmp_path):
+    src = """
+        import jax
+
+        @jax.custom_vjp
+        def op(x, y):
+            return x * y
+
+        def op_fwd(x):
+            return op(x, 1.0), (x,)
+
+        def op_bwd(res, g):
+            return (g, g)
+
+        op.defvjp(op_fwd, op_bwd)
+    """
+    found, _ = lint(tmp_path, {"ops.py": src}, rules=["vjp-complete"])
+    msgs = " | ".join(f.msg for f in found)
+    assert "fwd must mirror the primal signature" in msgs
+    assert "never reads its residuals" in msgs
+
+
+# ---------------------------------------------------------------- rule 5
+
+RETRACE_BAD = """
+    import jax
+
+    def run(xs, f):
+        for x in xs:
+            y = jax.jit(f)(x)
+        return y
+"""
+
+RETRACE_GOOD = """
+    import jax
+
+    def run(xs, f):
+        g = jax.jit(f)
+        for x in xs:
+            y = g(x)
+        return y
+"""
+
+
+def test_retrace_bad(tmp_path):
+    found, _ = lint(tmp_path, {"run.py": RETRACE_BAD}, rules=["retrace"])
+    assert rules_of(found) == ["retrace"]
+
+
+def test_retrace_good(tmp_path):
+    found, _ = lint(tmp_path, {"run.py": RETRACE_GOOD}, rules=["retrace"])
+    assert found == []
+
+
+# ---------------------------------------------------------------- rule 6
+
+NONDET_BAD = """
+    import jax
+    import time
+
+    def step(x):
+        return x * time.time()
+
+    train = jax.jit(step)
+"""
+
+NONDET_GOOD = """
+    import jax
+    import time
+
+    def step(x, now):
+        return x * now
+
+    train = jax.jit(step)
+
+    def host_timer():
+        return time.time()
+"""
+
+
+def test_nondet_bad(tmp_path):
+    found, _ = lint(tmp_path, {"step.py": NONDET_BAD}, rules=["nondet"])
+    assert rules_of(found) == ["nondet"]
+    assert "time.time()" in found[0].msg
+
+
+def test_nondet_good(tmp_path):
+    # the value threaded in as an argument; wall-clock reads confined
+    # to untraced host functions
+    found, _ = lint(tmp_path, {"step.py": NONDET_GOOD}, rules=["nondet"])
+    assert found == []
+
+
+# ---------------------------------------------------------------- rule 7
+
+CONFIG = """
+    import argparse
+
+    def build_parser():
+        p = argparse.ArgumentParser()
+        p.add_argument("--covered", type=int)
+        p.add_argument("--uncovered", type=int)
+        return p
+"""
+
+
+def test_flag_drift_bad(tmp_path):
+    found, _ = lint(tmp_path, {"config.py": CONFIG},
+                    {"docs/API.md": "only `covered` is documented\n"},
+                    rules=["flag-drift"])
+    assert rules_of(found) == ["flag-drift"]
+    assert "--uncovered" in found[0].msg
+
+
+def test_flag_drift_good(tmp_path):
+    found, _ = lint(tmp_path, {"config.py": CONFIG},
+                    {"docs/API.md": "`covered` and `uncovered`\n"},
+                    rules=["flag-drift"])
+    assert found == []
+
+
+# ---------------------------------------------------------------- rule 8
+
+BUCKETS = """
+    WINDOW_BUCKETS = ("data_wait", "dispatch")
+    HOST_BUCKET = "host"
+    TRACE_SCOPES = WINDOW_BUCKETS + ("eval",)
+    NAMED_SCOPES = ("ln",)
+"""
+
+
+def test_scope_registry_bad(tmp_path):
+    files = {
+        "obs/buckets.py": BUCKETS,
+        "timer.py": """
+            def close(timer, t):
+                timer.charge("data_wiat", t)
+        """,
+    }
+    found, _ = lint(tmp_path, files, rules=["scope-registry"])
+    assert rules_of(found) == ["scope-registry"]
+    assert "'data_wiat'" in found[0].msg
+
+
+def test_scope_registry_good(tmp_path):
+    files = {
+        "obs/buckets.py": BUCKETS,
+        "timer.py": """
+            def close(timer, tracer, scope, t):
+                timer.charge("data_wait", t)
+                with tracer.annotate("eval"):
+                    pass
+                with scope.named_scope("ln"):
+                    pass
+        """,
+    }
+    found, _ = lint(tmp_path, files, rules=["scope-registry"])
+    assert found == []
+
+
+# ------------------------------------------------- suppression + meta
+
+def test_noqa_suppresses_with_reason(tmp_path):
+    src = AXIS_BAD.replace(
+        'lax.psum(x, "dtaa")',
+        'lax.psum(x, "dtaa")  '
+        '# dtx: noqa[axis-consistency] intentional fixture')
+    found, suppressed = lint(tmp_path, {"mesh.py": MESH, "step.py": src},
+                             rules=["axis-consistency"])
+    assert found == []
+    assert rules_of(suppressed) == ["axis-consistency"]
+
+
+def test_noqa_without_reason_is_a_finding(tmp_path):
+    src = AXIS_BAD.replace(
+        'lax.psum(x, "dtaa")',
+        'lax.psum(x, "dtaa")  # dtx: noqa[axis-consistency]')
+    found, suppressed = lint(tmp_path, {"mesh.py": MESH, "step.py": src},
+                             rules=["axis-consistency"])
+    # the reasonless noqa does NOT suppress, and is itself reported
+    assert sorted(rules_of(found)) == ["axis-consistency", "noqa-reason"]
+    assert suppressed == []
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    found, _ = lint(tmp_path, {"broken.py": "def f(:\n"}, rules=[])
+    assert rules_of(found) == ["parse-error"]
+
+
+# ------------------------------------------------------------ baseline
+
+def test_baseline_round_trip(tmp_path):
+    finds = [f_lib.Finding("axis-consistency", "a.py", 3, "msg one"),
+             f_lib.Finding("host-sync", "b.py", 7, "msg two", "a hint")]
+    path = str(tmp_path / "baseline.json")
+    f_lib.write_baseline(path, finds)
+    entries = f_lib.load_baseline(path)
+    assert [e["msg"] for e in entries] == ["msg one", "msg two"]
+
+    # same findings at DIFFERENT lines still match (fingerprint is
+    # line-independent); a new finding surfaces; a fixed one is stale
+    moved = [f_lib.Finding("axis-consistency", "a.py", 9, "msg one"),
+             f_lib.Finding("retrace", "c.py", 1, "fresh")]
+    new, baselined, stale = f_lib.split_by_baseline(moved, entries)
+    assert [f.msg for f in new] == ["fresh"]
+    assert [f.msg for f in baselined] == ["msg one"]
+    assert [e["msg"] for e in stale] == ["msg two"]
+
+
+def test_baseline_preserves_reasons(tmp_path):
+    finds = [f_lib.Finding("retrace", "a.py", 1, "kept")]
+    path = str(tmp_path / "baseline.json")
+    f_lib.write_baseline(path, finds)
+    entries = f_lib.load_baseline(path)
+    entries[0]["reason"] = "justified because fixture"
+    with open(path, "w") as f:
+        json.dump({"v": 1, "findings": entries}, f)
+    f_lib.write_baseline(path, finds, f_lib.load_baseline(path))
+    assert f_lib.load_baseline(path)[0]["reason"] == \
+        "justified because fixture"
+
+
+def test_baseline_multiset_semantics():
+    # one baseline entry absorbs ONE identical finding; a duplicate
+    # regression still surfaces as new
+    entries = [{"rule": "retrace", "file": "a.py", "msg": "dup"}]
+    finds = [f_lib.Finding("retrace", "a.py", 1, "dup"),
+             f_lib.Finding("retrace", "a.py", 2, "dup")]
+    new, baselined, stale = f_lib.split_by_baseline(finds, entries)
+    assert len(new) == 1 and len(baselined) == 1 and stale == []
+
+
+def test_corrupt_baseline_rejected(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text('{"v": 99, "findings": []}')
+    try:
+        f_lib.load_baseline(str(path))
+        raise AssertionError("expected ValueError")
+    except ValueError as e:
+        assert "version" in str(e)
+
+
+# ----------------------------------------------------------- CLI layer
+
+def test_cli_exit_codes(tmp_path, capsys):
+    clean = make_tree(tmp_path, {"mesh.py": MESH,
+                                 "good.py": AXIS_GOOD})
+    assert lint_cli.main([clean, "--no-baseline"]) == 0
+
+    (tmp_path / "pkg" / "bad.py").write_text(textwrap.dedent(AXIS_BAD))
+    assert lint_cli.main([clean, "--no-baseline"]) == 1
+
+    assert lint_cli.main([str(tmp_path / "nope")]) == 2
+    assert lint_cli.main([clean, "--rules", "not-a-rule"]) == 2
+
+    corrupt = tmp_path / "corrupt.json"
+    corrupt.write_text("{not json")
+    assert lint_cli.main([clean, "--baseline", str(corrupt)]) == 2
+    capsys.readouterr()
+
+
+def test_cli_write_baseline_then_clean(tmp_path, capsys):
+    root = make_tree(tmp_path, {"mesh.py": MESH, "bad.py": AXIS_BAD})
+    assert lint_cli.main([root, "--write-baseline"]) == 0
+    # the grandfathered finding no longer fails the gate...
+    assert lint_cli.main([root]) == 0
+    # ...but a NEW finding still does, and is the only one reported
+    (tmp_path / "pkg" / "worse.py").write_text(textwrap.dedent(
+        AXIS_BAD.replace("dtaa", "dtbb")))
+    capsys.readouterr()
+    assert lint_cli.main([root]) == 1
+    out = capsys.readouterr().out
+    assert "dtbb" in out and "1 new finding(s), 1 baselined" in out
+
+
+def test_cli_write_baseline_bare_filename(tmp_path, capsys, monkeypatch):
+    # a directory-less --baseline path must not crash on makedirs("")
+    make_tree(tmp_path, {"mesh.py": MESH, "bad.py": AXIS_BAD})
+    monkeypatch.chdir(tmp_path)
+    assert lint_cli.main(["pkg", "--baseline", "bare.json",
+                          "--write-baseline"]) == 0
+    assert os.path.isfile(tmp_path / "bare.json")
+    capsys.readouterr()
+
+
+def test_cli_write_baseline_rejects_rule_subset(tmp_path, capsys):
+    # writing a subset run's findings would drop every other rule's
+    # grandfathered entries — refused as a usage error
+    root = make_tree(tmp_path, {"mesh.py": MESH, "bad.py": AXIS_BAD})
+    assert lint_cli.main([root, "--rules", "retrace",
+                          "--write-baseline"]) == 2
+    assert not os.path.isfile(tmp_path / "pkg" / "analysis"
+                              / "baseline.json")
+    capsys.readouterr()
+
+
+def test_lint_repo_root_still_runs_doc_rules(tmp_path, capsys):
+    # `dtx-lint .` from the repo root: docs/ and bench.py live INSIDE
+    # the lint root, not next to it — flag-drift must still run
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "API.md").write_text("nothing documented\n")
+    (tmp_path / "config.py").write_text(textwrap.dedent(CONFIG))
+    rc = lint_cli.main([str(tmp_path), "--no-baseline",
+                        "--rules", "flag-drift"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "--covered" in out and "--uncovered" in out
+
+
+def test_cli_json_document(tmp_path, capsys):
+    root = make_tree(tmp_path, {"mesh.py": MESH, "bad.py": AXIS_BAD})
+    rc = lint_cli.main([root, "--no-baseline", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["ok"] is False
+    assert doc["v"] == lint_cli.JSON_VERSION
+    assert "axis-consistency" in doc["rules"]
+    [finding] = doc["new"]
+    assert finding["rule"] == "axis-consistency"
+    assert finding["file"] == "bad.py" and finding["line"] > 0
+    assert finding["hint"]
+
+    (tmp_path / "pkg" / "bad.py").unlink()
+    rc = lint_cli.main([root, "--no-baseline", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0 and doc["ok"] is True and doc["new"] == []
+
+
+def test_cli_list_rules(capsys):
+    assert lint_cli.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in lint_cli.ALL_RULES:
+        assert rule.id in out
+    assert len(lint_cli.ALL_RULES) >= 8
+
+
+# ------------------------------------------------------- index details
+
+def test_index_resolves_cross_module_constants(tmp_path):
+    root = make_tree(tmp_path, {
+        "mesh.py": MESH,
+        "use.py": "from .mesh import DATA_AXIS\n",
+    })
+    idx = ModuleIndex.build(root)
+    use = idx.modules["use.py"]
+    import ast as ast_mod
+    node = idx.resolve_constant(use, "DATA_AXIS")
+    assert isinstance(node, ast_mod.Constant) and node.value == "data"
+
+
+def test_index_skips_pycache_and_counts_modules(tmp_path):
+    root = make_tree(tmp_path, {"a.py": "x = 1\n"})
+    cache = tmp_path / "pkg" / "__pycache__"
+    cache.mkdir()
+    (cache / "a.cpython-311.py").write_text("broken(\n")
+    idx = ModuleIndex.build(root)
+    assert list(idx.modules) == ["a.py"]
+
+
+# ------------------------------------------------------ tier-1 gate
+
+def test_whole_package_zero_findings(capsys):
+    """THE CI check: dtx-lint over the real package, against the
+    checked-in baseline, must be clean — any new finding fails tier-1
+    with the finding list in the assertion message."""
+    rc = lint_cli.main([PKG])
+    out = capsys.readouterr().out
+    assert rc == 0, f"dtx-lint found new findings:\n{out}"
+
+
+def test_whole_package_rules_all_active(capsys):
+    """Every rule must have actually RUN over the package (a rule
+    silently deactivating — e.g. the mesh registry moving — would turn
+    the gate into a no-op without failing it)."""
+    index, ctx, _, _ = lint_cli.run_lint(PKG)
+    from distributed_tensorflow_example_tpu.analysis.rules_spmd import (
+        axis_registry)
+    assert axis_registry(index), "mesh axis registry came back empty"
+    assert index.module_by_suffix("obs/schema.py") is not None
+    assert index.module_by_suffix("obs/buckets.py") is not None
+    assert index.module_by_suffix("train/loop.py") is not None
+    assert index.module_by_suffix("config.py") is not None
+    assert os.path.isfile(ctx.api_md)
+    assert "bench.py" in index.aux
