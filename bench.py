@@ -1200,42 +1200,85 @@ print(json.dumps(out))
 
 def bench_pp_memory(p: int = 4, m: int = 16, batch: int = 32,
                     seq: int = 512, d_model: int = 512):
-    """PP memory story (VERDICT r4 next #4): per-schedule HBM demand
-    measured by the TPU COMPILER — each schedule's whole train step is
-    AOT-compiled against an abstract 4-chip v5e topology
-    (jax.experimental.topologies; no 4 real chips needed) and XLA's
-    buffer assignment reports the program's temp/argument bytes.
-    Schedules: gpipe (jax.grad through the tick loop — every
-    microbatch's intra-slot residuals live across the fwd phase),
-    gpipe + per-slot remat (--remat: M input stashes + one slot's
-    residuals), 1f1b (--pp_schedule=1f1b: min(M, 2p-1) input stashes +
-    one slot's residuals — M-independent), and Megatron interleaved
-    (v=2). M=16 >> 2p-1=7 makes the GPipe-vs-1F1B liveness delta
-    visible. Analytic stash counts ride along for the assertion the
-    compiler numbers back."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-    from jax.experimental import topologies
-    from jax.sharding import Mesh, NamedSharding
-    from jax.sharding import PartitionSpec as P
+    """PP memory + bubble story (VERDICT r4 next #4; r8 bubble bench).
 
-    from distributed_tensorflow_example_tpu.config import Config
-    from distributed_tensorflow_example_tpu.models import transformer as tfm
-    from distributed_tensorflow_example_tpu.parallel import mesh as mesh_lib
-    from distributed_tensorflow_example_tpu.parallel import step as step_lib
-    from distributed_tensorflow_example_tpu.train.optim import make_optimizer
-    from distributed_tensorflow_example_tpu.train.state import (
-        create_train_state)
+    Bubble fraction: measured vs ideal tick counts per schedule —
+    gpipe, plain 1f1b, interleaved-1F1B v∈{2,4} — straight from the
+    SAME pure-Python tick tables the kernel loop compiles
+    (parallel/pp_schedule), so the accounting cannot drift from what
+    the program actually emits.  ``measured_ticks`` is the schedule's
+    emitted sub-slot work in full-stage forward-cost units (warmup /
+    drain specialization included: a fwd-only tick costs one sub-slot,
+    not a dead fused pair), ``ideal_ticks`` the zero-bubble bound of m
+    microbatches' fwd+bwd work, ``bubble_fraction = 1 -
+    ideal/measured`` the fraction the hardware idles (lockstep SPMD:
+    computes masked garbage).  These keys are analytic and
+    deterministic — they hold on every backend and gate schedule
+    regressions via obs/compare (pp_bubble_frac_*).
+
+    Memory: per-schedule HBM demand measured by the TPU COMPILER —
+    each schedule's whole train step is AOT-compiled against an
+    abstract 4-chip v5e topology (jax.experimental.topologies; no 4
+    real chips needed) and XLA's buffer assignment reports the
+    program's temp/argument bytes.  Schedules: gpipe (jax.grad through
+    the tick loop — every microbatch's intra-slot residuals live
+    across the fwd phase), gpipe + per-slot remat (--remat: M input
+    stashes + one slot's residuals), 1f1b (--pp_schedule=1f1b:
+    min(M, 2p-1) input stashes + one slot's residuals —
+    M-independent), Megatron interleaved gpipe (v=2), and
+    interleaved-1F1B (--pp_schedule=1f1b --virtual_stages=2: the r8
+    schedule, min(vM, 2pv-1) chunk stashes).  M=16 >> 2p-1=7 makes
+    the GPipe-vs-1F1B liveness delta visible.  Analytic stash counts
+    ride along for the assertion the compiler numbers back."""
+    from distributed_tensorflow_example_tpu.parallel import pp_schedule
 
     row = {"config": "pp_memory",
            "model": f"PP{p} M={m} B={batch} S={seq} d_model={d_model} "
-                    f"(AOT-compiled for an abstract v5e 4-chip "
-                    f"topology; temp bytes = XLA buffer assignment)"}
+                    f"(bubble ticks from parallel/pp_schedule tables; "
+                    f"temp bytes AOT-compiled for an abstract v5e "
+                    f"4-chip topology = XLA buffer assignment)"}
+    # ---- bubble fraction (pure Python — no jax, every backend) ----
+    for name, schedule, v in (("gpipe", "gpipe", 1),
+                              ("1f1b", "1f1b", 1),
+                              ("interleaved_v2", "1f1b", 2),
+                              ("interleaved_v4", "1f1b", 4)):
+        bf = pp_schedule.bubble_fraction(
+            pp_schedule.schedule_table(schedule, p, v, m))
+        row[f"{name}_measured_ticks"] = bf["measured_ticks"]
+        row[f"{name}_ideal_ticks"] = bf["ideal_ticks"]
+        row[f"{name}_bubble_fraction"] = bf["bubble_fraction"]
+
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental import topologies
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from distributed_tensorflow_example_tpu.config import Config
+        from distributed_tensorflow_example_tpu.models import (
+            transformer as tfm)
+        from distributed_tensorflow_example_tpu.parallel import (
+            mesh as mesh_lib)
+        from distributed_tensorflow_example_tpu.parallel import (
+            step as step_lib)
+        from distributed_tensorflow_example_tpu.train.optim import (
+            make_optimizer)
+        from distributed_tensorflow_example_tpu.train.state import (
+            create_train_state)
+    except Exception as e:
+        # bubble keys stay on the row even where the training stack
+        # itself cannot import (pure-python CI)
+        row["error"] = f"stack unavailable for AOT memory: {str(e)[:140]}"
+        return row
+
     try:
         topo = topologies.get_topology_desc(
             platform="tpu", topology_name="v5e:2x2x1")
     except Exception as e:
+        # the bubble keys above are backend-independent: keep them on
+        # the row even where topology AOT is unavailable (CPU CI)
         row["error"] = f"topology AOT unavailable: {str(e)[:140]}"
         return row
     mesh = Mesh(np.array(topo.devices).reshape(1, p), ("data", "stage"))
@@ -1243,11 +1286,15 @@ def bench_pp_memory(p: int = 4, m: int = 16, batch: int = 32,
     row["stash_mb_per_buf"] = round(
         mb * seq * d_model * 4 / 2**20, 2)
     row["gpipe_live_stashes"] = m
-    row["1f1b_live_stashes"] = min(m, 2 * p - 1)
+    row["1f1b_live_stashes"] = pp_schedule.stash_cap(p, 1, m)
+    row["1f1b_v2_live_stashes"] = pp_schedule.stash_cap(p, 2, m)
     for mode, kw in (("gpipe", {}), ("gpipe_remat", dict(remat=True)),
                      ("1f1b", dict(pp_schedule="1f1b")),
                      ("interleaved", dict(virtual_stages=2,
-                                          num_blocks=2 * p))):
+                                          num_blocks=2 * p)),
+                     ("1f1b_v2", dict(pp_schedule="1f1b",
+                                      virtual_stages=2,
+                                      num_blocks=2 * p))):
         nb = kw.pop("num_blocks", p)
         try:
             sp = tfm.TransformerSpec(
@@ -1818,6 +1865,11 @@ def main(argv=None) -> int:
     # need interleaved medians) and a deep sweep need not exceed that.
     guarded("input_pipeline", bench_input_pipeline,
             repeats=min(3, max(1, args.repeats)))
+    # the PP bubble/memory row runs on EVERY backend (r8): its bubble-
+    # fraction keys are pure tick-table accounting (no jax) and gate
+    # the schedule via pp_bubble_frac_*; only the AOT temp-bytes half
+    # needs the TPU compiler and degrades to an error key elsewhere
+    guarded("pp_memory", bench_pp_memory)
     if on_tpu:
         guarded("reference_device_program", bench_reference_device_program)
         # the wide-MXU rows only mean something on a TPU (and in
@@ -1836,7 +1888,6 @@ def main(argv=None) -> int:
                 name="transformer_wide_long_s16k")
         guarded("transformer_flash_long_context", bench_transformer)
         guarded("pipeline_bubble", bench_pipeline_bubble)
-        guarded("pp_memory", bench_pp_memory)
         guarded("moe_dispatch", bench_moe_dispatch)
         guarded("moe_wide", bench_moe_wide)
         guarded("lm_next_token", bench_lm)
@@ -1957,6 +2008,19 @@ def main(argv=None) -> int:
         if mem_row.get("1f1b_temp_saving_vs_gpipe"):
             extra["pp_1f1b_mem_saving"] = \
                 mem_row["1f1b_temp_saving_vs_gpipe"]
+    # bubble fractions ride the final line on every backend (the r8
+    # gate keys: analytic tick-table accounting, deterministic — a
+    # change here IS a schedule regression, obs.compare holds it)
+    bub_row = next(
+        (r for r in rows if r.get("config") == "pp_memory"
+         and "1f1b_bubble_fraction" in r), None)
+    if bub_row:
+        extra["pp_bubble_frac_gpipe"] = bub_row["gpipe_bubble_fraction"]
+        extra["pp_bubble_frac_1f1b"] = bub_row["1f1b_bubble_fraction"]
+        extra["pp_bubble_frac_interleaved_v2"] = \
+            bub_row["interleaved_v2_bubble_fraction"]
+        extra["pp_bubble_frac_interleaved_v4"] = \
+            bub_row["interleaved_v4_bubble_fraction"]
     lm_row = next(
         (r for r in rows if r.get("config") == "lm_next_token"
          and "tokens_per_sec" in r), None)

@@ -151,14 +151,19 @@ class Config:
                                     # stages — each pipeline stage holds
                                     # this many non-contiguous block
                                     # chunks; bubble shrinks ~v-fold
-                                    # (pipeline_parallel > 1 only)
+                                    # (pipeline_parallel > 1 only;
+                                    # composes with BOTH schedules —
+                                    # with 1f1b it is interleaved-1F1B)
     pp_schedule: str = "gpipe"      # gpipe (jax.grad through the tick
                                     # loop; --remat caps residuals per
                                     # slot) | 1f1b (fused fwd/bwd
                                     # ticks: live microbatch stashes
-                                    # cap at 2p-1, M-independent —
-                                    # transformer.pipeline_value_and_
-                                    # grad_1f1b)
+                                    # cap at min(vM, 2pv-1),
+                                    # M-independent; virtual_stages>1
+                                    # = interleaved-1F1B with async
+                                    # stage-hop overlap — schedule
+                                    # from parallel/pp_schedule tick
+                                    # tables)
     expert_parallel: int = 1        # MoE transformer only: shard the expert
                                     # stacks over a ('data','expert') mesh
                                     # (weights, optimizer state and expert
@@ -474,13 +479,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="GPipe microbatches per local batch")
     p.add_argument("--virtual_stages", type=int, default=d.virtual_stages,
                    help="interleaved virtual stages per pipeline stage "
-                        "(>1 shrinks the pipeline bubble ~v-fold)")
+                        "(>1 shrinks the pipeline bubble ~v-fold; "
+                        "composes with both schedules — with "
+                        "--pp_schedule=1f1b it runs interleaved-1F1B)")
     p.add_argument("--pp_schedule", type=str, default=d.pp_schedule,
                    choices=["gpipe", "1f1b"],
                    help="pipeline schedule: gpipe (all-forward then "
                         "all-backward) vs 1f1b (fused ticks; live "
-                        "microbatch activations cap at 2p-1, "
-                        "M-independent)")
+                        "microbatch activations cap at min(vM, 2pv-1), "
+                        "M-independent; with --virtual_stages>1 the "
+                        "interleaved-1F1B schedule with async "
+                        "stage-hop overlap)")
     p.add_argument("--sequence_parallel", type=int, default=d.sequence_parallel,
                    help="transformer only: shard the token axis over a "
                         "('data','seq') mesh (--sp_impl selects the layout)")
@@ -617,6 +626,107 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no_fast_loop", dest="fast_loop", action="store_false")
     p.add_argument("--compilation_cache", type=str, default=d.compilation_cache)
     return p
+
+
+def validate_pipeline_config(cfg: Config) -> None:
+    """The pipeline-parallelism / schedule validation matrix — pure
+    config checks (no jax), raised before any bootstrap work so a bad
+    flag combination fails fast and never strands peer processes.
+    ``train.loop.run`` calls this first; ``tests/test_cli.py`` pins
+    the full matrix without needing the training stack.
+
+    The matrix (r8: the --pp_schedule=1f1b x --virtual_stages > 1
+    combination is REAL support now — the interleaved-1F1B schedule —
+    not a rejection):
+
+    - ``pipeline_parallel`` >= 1; > 1 needs the transformer,
+      divisible ``num_blocks``, ``microbatches`` >= 1, and composes
+      with data/tensor/sequence/expert parallelism only (no fsdp, no
+      local SGD), seq XOR expert;
+    - ``pp_schedule`` in {gpipe, 1f1b}; 1f1b needs >= 2 stages and
+      composes with DP x PP x TP at any ``virtual_stages`` (its manual
+      vjp replication excludes seq/expert token sharding, the MoE
+      balance loss, --grad_accum and --remat — per-slot remat is
+      built in);
+    - ``virtual_stages`` >= 1; > 1 (either schedule) needs >= 2
+      stages, ``num_blocks`` divisible over stages*virtual, and
+      ``microbatches`` divisible by the stage count (the interleaved
+      round structure).
+    """
+    if cfg.pipeline_parallel < 1:
+        raise ValueError(
+            f"pipeline_parallel={cfg.pipeline_parallel} must be >= 1")
+    if cfg.pipeline_parallel > 1:
+        if cfg.model != "transformer":
+            raise ValueError("--pipeline_parallel requires "
+                             "--model=transformer (the MLP has no stages)")
+        if cfg.num_blocks % cfg.pipeline_parallel:
+            raise ValueError(
+                f"num_blocks={cfg.num_blocks} must divide evenly over "
+                f"pipeline_parallel={cfg.pipeline_parallel}")
+        if cfg.microbatches < 1:
+            raise ValueError(f"microbatches={cfg.microbatches} must be >= 1")
+        if cfg.fsdp or cfg.sync_period > 1:
+            raise ValueError("--pipeline_parallel composes with data, "
+                             "tensor, sequence and expert parallelism "
+                             "only (no fsdp, sync_period=1)")
+        if cfg.sequence_parallel > 1 and cfg.expert_parallel > 1:
+            raise ValueError(
+                "--pipeline_parallel composes with EITHER "
+                "--sequence_parallel OR --expert_parallel (plus "
+                "--model_parallel and data), not both at once")
+    if cfg.pp_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"pp_schedule={cfg.pp_schedule!r}: expected 'gpipe' or "
+            f"'1f1b'")
+    if cfg.pp_schedule == "1f1b":
+        # the fused-tick schedule family manages gradient replication
+        # by hand (transformer.pipeline_value_and_grad_1f1b
+        # docstring): it composes with DP x PP x TP at any
+        # --virtual_stages (v > 1 = interleaved-1F1B); seq/expert
+        # token sharding, the MoE balance loss and grad accumulation
+        # keep the jax.grad schedules whose replication rides
+        # shard_map's transpose
+        if cfg.pipeline_parallel < 2:
+            raise ValueError("--pp_schedule=1f1b requires "
+                             "--pipeline_parallel > 1 (no schedule to "
+                             "fuse on one stage)")
+        if cfg.sequence_parallel > 1 or cfg.expert_parallel > 1:
+            raise ValueError("--pp_schedule=1f1b composes with data "
+                             "and tensor parallelism only (no "
+                             "sequence/expert token sharding)")
+        if cfg.moe_aux_weight:
+            raise ValueError("--pp_schedule=1f1b does not carry the "
+                             "MoE balance loss; use the gpipe "
+                             "schedule with --moe_aux_weight")
+        if cfg.grad_accum > 1:
+            raise ValueError("--pp_schedule=1f1b already microbatches "
+                             "the local batch; --grad_accum must be 1")
+        if cfg.remat:
+            # pipe_remat only feeds the jax.grad schedules; silently
+            # ignoring the flag here would misreport the memory story
+            raise ValueError("--remat has no effect under "
+                             "--pp_schedule=1f1b (the fused schedule "
+                             "already rematerializes per slot); drop "
+                             "the flag or use --pp_schedule=gpipe")
+    if cfg.virtual_stages < 1:
+        raise ValueError(
+            f"virtual_stages={cfg.virtual_stages} must be >= 1")
+    if cfg.virtual_stages > 1:
+        if cfg.pipeline_parallel < 2:
+            raise ValueError("--virtual_stages > 1 needs "
+                             "--pipeline_parallel > 1 (nothing to "
+                             "interleave on one stage)")
+        if cfg.num_blocks % (cfg.pipeline_parallel * cfg.virtual_stages):
+            raise ValueError(
+                f"num_blocks={cfg.num_blocks} must divide evenly over "
+                f"pipeline_parallel*virtual_stages="
+                f"{cfg.pipeline_parallel * cfg.virtual_stages}")
+        if cfg.microbatches % cfg.pipeline_parallel:
+            raise ValueError(
+                f"interleaved stages need microbatches "
+                f"({cfg.microbatches}) divisible by pipeline_parallel "
+                f"({cfg.pipeline_parallel})")
 
 
 def parse_config(argv: Sequence[str] | None = None) -> Config:

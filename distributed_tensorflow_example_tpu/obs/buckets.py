@@ -41,8 +41,12 @@ HOST_BUCKET = "host"
 TRACE_SCOPES = WINDOW_BUCKETS + ("eval", "checkpoint")
 
 # jax.named_scope regions inside the compiled step (transformer
-# forward): device-timeline attribution for the bench breakdowns
-NAMED_SCOPES = ("ln", "moe_dispatch", "moe_expert")
+# forward): device-timeline attribution for the bench breakdowns.
+# "pp_comm" names the pipeline stage-hop collectives (the async
+# ppermute start/done pairs in transformer._hop_start) so a profiler
+# capture shows the transfer overlapping the opposite direction's
+# compute instead of folding it into anonymous collective time.
+NAMED_SCOPES = ("ln", "moe_dispatch", "moe_expert", "pp_comm")
 
 # run-level goodput/badput decomposition, in presentation order
 # ("train" is the goodput bucket, "eval"/"sample" auxiliary useful

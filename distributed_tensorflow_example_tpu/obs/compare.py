@@ -61,6 +61,15 @@ GATE_METRICS: Dict[str, tuple] = {
     "moe_wide_mfu": ("higher", 0.05),
     "moe_dispatch_ms": ("lower", 0.15),
     "moe_expert_ms": ("lower", 0.15),
+    # pipeline bubble fractions (ISSUE 8): analytic tick-table
+    # accounting from parallel/pp_schedule — deterministic on every
+    # backend, so ANY upward move is a schedule regression (the tight
+    # threshold is deliberate; these only change when the schedule
+    # derivation itself changes)
+    "pp_bubble_frac_gpipe": ("lower", 0.01),
+    "pp_bubble_frac_1f1b": ("lower", 0.01),
+    "pp_bubble_frac_interleaved_v2": ("lower", 0.01),
+    "pp_bubble_frac_interleaved_v4": ("lower", 0.01),
 }
 
 
@@ -123,6 +132,12 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
         put("overlap_ratio", doc.get("overlap_ratio"))
         put("test_accuracy", doc.get("test_accuracy"))
         return out
+    if "1f1b_bubble_fraction" in doc:           # bench pp_memory row
+        for name in ("gpipe", "1f1b", "interleaved_v2",
+                     "interleaved_v4"):
+            put(f"pp_bubble_frac_{name}",
+                doc.get(f"{name}_bubble_fraction"))
+        return out
     if "wall_clock_20ep_s" in doc:              # bench per-config row
         put("wall_s", doc.get("wall_clock_20ep_s"))
         put("examples_per_sec", doc.get("examples_per_sec"))
@@ -143,7 +158,10 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
         # the fused-kernel MFU keys + the moe_wide breakdown carry
         # their final-line names verbatim
         for k in ("transformer_wide_mfu", "transformer_wide_long_mfu",
-                  "moe_wide_mfu", "moe_dispatch_ms", "moe_expert_ms"):
+                  "moe_wide_mfu", "moe_dispatch_ms", "moe_expert_ms",
+                  "pp_bubble_frac_gpipe", "pp_bubble_frac_1f1b",
+                  "pp_bubble_frac_interleaved_v2",
+                  "pp_bubble_frac_interleaved_v4"):
             put(k, doc.get(k))
         return out
     # last resort: any directly-named gate metrics

@@ -1,22 +1,33 @@
-from .mesh import build_mesh, param_pspecs, state_pspecs, place_state
-from .step import (
-    build_train_step,
-    build_eval_step,
-    build_local_train_step,
-    build_param_sync,
-    stack_state,
-    unstack_params,
-)
+"""Parallelism package: mesh building, SPMD steps, schedules.
 
-__all__ = [
-    "build_mesh",
-    "param_pspecs",
-    "state_pspecs",
-    "place_state",
-    "build_train_step",
-    "build_eval_step",
-    "build_local_train_step",
-    "build_param_sync",
-    "stack_state",
-    "unstack_params",
-]
+Re-exports resolve lazily (PEP 562): importing the package does NOT
+pull in jax, so the pure-Python members (``pp_schedule`` — the
+pipeline tick tables the golden tests consume) stay importable on
+environments whose jax predates the repo's mesh/step API.  Touching
+any re-exported name still imports its (jax-dependent) home module
+with the same error surface as the old eager imports.
+"""
+
+_EXPORTS = {
+    "build_mesh": "mesh",
+    "param_pspecs": "mesh",
+    "state_pspecs": "mesh",
+    "place_state": "mesh",
+    "build_train_step": "step",
+    "build_eval_step": "step",
+    "build_local_train_step": "step",
+    "build_param_sync": "step",
+    "stack_state": "step",
+    "unstack_params": "step",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(f".{_EXPORTS[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -384,13 +384,14 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
                 and getattr(cfg, "pp_schedule", "gpipe") == "1f1b")
 
     def grad_1f1b(params, x, y, rng=None):
-        """(cost, acc), grads via the fused-tick 1F1B schedule
-        (transformer.pipeline_value_and_grad_1f1b) — live microbatch
-        activations cap at 2p-1 instead of jax.grad's M. Objective
-        plumbing mirrors _loss_and_acc's pipeline branch exactly."""
+        """(cost, acc), grads via the fused-tick 1F1B schedule family
+        (transformer.pipeline_value_and_grad_1f1b; virtual > 1 runs
+        the interleaved refinement) — live microbatch activations cap
+        at O(p·v) instead of jax.grad's M. Objective plumbing mirrors
+        _loss_and_acc's pipeline branch exactly."""
         from ..models import transformer
 
-        stage_axis, n_stages, microbatches, _v = pipeline
+        stage_axis, n_stages, microbatches, virt = pipeline
         mbs = x.shape[0] // microbatches
         if getattr(spec, "objective", "classify") == "lm":
             micro_t = transformer.tokenize(spec, x).reshape(
@@ -416,7 +417,7 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
                 spec, params, x, stage_axis, n_stages, microbatches,
                 loss_of, head_fn=head, head_width=2,
                 model_axis=model_axis, dropout_rng=rng,
-                batch_axes=batch_axes)
+                batch_axes=batch_axes, virtual=virt)
             cost = jnp.sum(stats[:, 0]) / count
             acc = jnp.sum(stats[:, 1]) / count
             return (cost, acc), grads
@@ -432,7 +433,7 @@ def make_sync_step_body(cfg, spec: mlp.MLPSpec, styles, dp: int, optimizer,
         (loss, stats), grads = transformer.pipeline_value_and_grad_1f1b(
             spec, params, x, stage_axis, n_stages, microbatches,
             loss_of, model_axis=model_axis, dropout_rng=rng,
-            batch_axes=batch_axes)
+            batch_axes=batch_axes, virtual=virt)
         cost = losses.cross_entropy(stats, y, naive=cfg.naive_ce,
                                     label_smoothing=cfg.label_smoothing)
         acc = metrics.accuracy(stats, y)

@@ -51,11 +51,29 @@ def _stub_rows(monkeypatch):
     for name in ("bench_reference_device_program", "bench_mxu",
                  "bench_pallas_parity", "bench_flash_attention",
                  "bench_ring_flash", "bench_transformer",
-                 "bench_pipeline_bubble", "bench_pp_memory",
+                 "bench_pipeline_bubble",
                  "bench_moe_dispatch", "bench_lm", "bench_decode"):
         monkeypatch.setattr(
             bench, name,
             lambda *a, _n=name, **kw: {"config": _n})
+    # the pp_memory row runs on EVERY backend (r8 bubble bench): its
+    # analytic bubble-fraction keys must reach the final line as
+    # pp_bubble_frac_* so --gate can hold the schedule
+    monkeypatch.setattr(
+        bench, "bench_pp_memory",
+        lambda *a, **kw: {"config": "pp_memory",
+                          "gpipe_measured_ticks": 57.0,
+                          "gpipe_ideal_ticks": 48.0,
+                          "gpipe_bubble_fraction": 0.1579,
+                          "1f1b_measured_ticks": 57.0,
+                          "1f1b_ideal_ticks": 48.0,
+                          "1f1b_bubble_fraction": 0.1579,
+                          "interleaved_v2_measured_ticks": 52.5,
+                          "interleaved_v2_ideal_ticks": 48.0,
+                          "interleaved_v2_bubble_fraction": 0.0857,
+                          "interleaved_v4_measured_ticks": 50.25,
+                          "interleaved_v4_ideal_ticks": 48.0,
+                          "interleaved_v4_bubble_fraction": 0.0448})
     # the fused-kernel rows (ISSUE 6): transformer_wide carries its
     # per-variant MFUs + headline, moe_wide carries the grouped A/B
     # AND the dispatch-vs-expert breakdown — main() must forward the
@@ -98,6 +116,12 @@ def test_bench_main_cpu_stubbed(monkeypatch, capsys):
     assert final["input_pipeline_blocking_step_ms"] == 10.0
     assert final["input_pipeline_prefetch_step_ms"] == 9.0
     assert final["input_pipeline_overlap_ratio"] == 1.1111
+    # the r8 bubble-fraction carriage: analytic tick-table keys from
+    # the pp_memory row reach the final line on the CPU path too
+    assert final["pp_bubble_frac_gpipe"] == 0.1579
+    assert final["pp_bubble_frac_1f1b"] == 0.1579
+    assert final["pp_bubble_frac_interleaved_v2"] == 0.0857
+    assert final["pp_bubble_frac_interleaved_v4"] == 0.0448
 
 
 def test_bench_main_all_configs_stubbed(monkeypatch, capsys):

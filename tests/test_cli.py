@@ -87,6 +87,83 @@ def test_fused_kernel_flags():
     assert not d.fused_ln and not d.grouped_moe
 
 
+def test_pipeline_validation_matrix():
+    """The FULL pipeline/schedule validation matrix, pinned against
+    ``config.validate_pipeline_config`` directly (pure config — no
+    training stack), r8: the --pp_schedule=1f1b x --virtual_stages>1
+    combination is real interleaved-1F1B support, not a rejection."""
+    import pytest
+
+    from distributed_tensorflow_example_tpu.config import (
+        Config, validate_pipeline_config)
+
+    def ok(**kw):
+        validate_pipeline_config(Config(**kw))
+
+    def bad(match, **kw):
+        with pytest.raises(ValueError, match=match):
+            validate_pipeline_config(Config(**kw))
+
+    # ---- valid combinations (each raised nothing) ----
+    ok()                                        # defaults, no pipeline
+    ok(model="transformer", pipeline_parallel=2, num_blocks=4,
+       microbatches=4)                          # gpipe
+    ok(model="transformer", pipeline_parallel=2, num_blocks=4,
+       microbatches=4, virtual_stages=2)        # interleaved gpipe
+    ok(model="transformer", pipeline_parallel=2, num_blocks=4,
+       microbatches=4, pp_schedule="1f1b")      # plain 1f1b
+    # r8 tentpole: interleaved-1F1B is now ACCEPTED (was "interleaving
+    # is a gpipe-schedule refinement" — the lifted rejection)
+    ok(model="transformer", pipeline_parallel=2, num_blocks=4,
+       microbatches=4, pp_schedule="1f1b", virtual_stages=2)
+    ok(model="transformer", pipeline_parallel=2, num_blocks=8,
+       microbatches=8, pp_schedule="1f1b", virtual_stages=4,
+       model_parallel=2)                        # x TP composes
+
+    # ---- pipeline_parallel ----
+    bad("must be >= 1", pipeline_parallel=0)
+    bad("model=transformer", pipeline_parallel=2)
+    bad("divide evenly", model="transformer", pipeline_parallel=3,
+        num_blocks=2)
+    bad("microbatches", model="transformer", pipeline_parallel=2,
+        num_blocks=2, microbatches=0)
+    bad("no fsdp", model="transformer", pipeline_parallel=2,
+        num_blocks=2, fsdp=True)
+    bad("no fsdp", model="transformer", pipeline_parallel=2,
+        num_blocks=2, sync_period=5)
+    bad("not both", model="transformer", pipeline_parallel=2,
+        num_blocks=2, sequence_parallel=2, expert_parallel=2,
+        num_experts=4)
+
+    # ---- pp_schedule ----
+    bad("expected 'gpipe' or '1f1b'", pp_schedule="zb-h1")
+    bad("pipeline_parallel > 1", model="transformer",
+        pp_schedule="1f1b")
+    bad("sequence/expert", model="transformer", pipeline_parallel=2,
+        num_blocks=2, sequence_parallel=2, pp_schedule="1f1b")
+    bad("balance loss", model="transformer", pipeline_parallel=2,
+        num_blocks=2, num_experts=4, moe_aux_weight=0.01,
+        pp_schedule="1f1b")
+    bad("grad_accum", model="transformer", pipeline_parallel=2,
+        num_blocks=2, grad_accum=2, pp_schedule="1f1b")
+    bad("rematerializes per slot", model="transformer",
+        pipeline_parallel=2, num_blocks=2, remat=True,
+        pp_schedule="1f1b")
+
+    # ---- virtual_stages (either schedule) ----
+    bad("must be >= 1", virtual_stages=0)
+    bad("nothing to\\s+interleave", model="transformer",
+        virtual_stages=2)
+    bad("pipeline_parallel\\*virtual_stages", model="transformer",
+        pipeline_parallel=2, num_blocks=2, virtual_stages=2)
+    bad("divisible by pipeline_parallel", model="transformer",
+        pipeline_parallel=2, num_blocks=4, virtual_stages=2,
+        microbatches=3)
+    bad("divisible by pipeline_parallel", model="transformer",
+        pipeline_parallel=2, num_blocks=4, virtual_stages=2,
+        microbatches=3, pp_schedule="1f1b")
+
+
 def test_r3_flag_surface_parses():
     """Every r3 flag parses and lands on its Config field."""
     from distributed_tensorflow_example_tpu.config import parse_config

@@ -1,0 +1,217 @@
+"""Golden tests for the pure-Python pipeline tick tables (ISSUE 8).
+
+parallel/pp_schedule is the ONE derivation of the gpipe / 1f1b /
+interleaved-1F1B schedules: the kernel loop
+(transformer.pipeline_value_and_grad_1f1b) compiles the table
+literally, and the bubble bench (bench_pp_memory) reports its tick
+accounting.  These tests pin the schedule with NO mesh and NO jax —
+tier-1 on every environment — so a schedule bug is caught structurally
+before any numerical test could blame the wrong layer.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from distributed_tensorflow_example_tpu.parallel import pp_schedule as pps
+
+# the (p, v, m) matrix the structural checks sweep: every phase shape
+# (warmup/steady/drain), v == 1 degeneration, deep p, wide m, and the
+# minimum m == p interleaved case
+MATRIX = [
+    (2, 1, 1), (2, 1, 4), (3, 1, 6), (4, 1, 16),
+    (2, 2, 2), (2, 2, 4), (2, 4, 4), (3, 2, 6), (4, 2, 8),
+    (4, 2, 16), (4, 4, 8), (4, 4, 16),
+]
+
+
+def test_import_is_pure_python():
+    """The tick tables import with NO jax anywhere in the process —
+    the property the golden tests and the bench's CPU path lean on
+    (parallel/__init__ resolves its jax members lazily)."""
+    code = (
+        "import sys\n"
+        "from distributed_tensorflow_example_tpu.parallel import "
+        "pp_schedule\n"
+        "pp_schedule.check_table("
+        "pp_schedule.interleaved_1f1b_table(2, 2, 4))\n"
+        "assert 'jax' not in sys.modules, 'pp_schedule pulled in jax'\n"
+    )
+    subprocess.run([sys.executable, "-c", code], check=True, cwd=_REPO)
+
+
+@pytest.mark.parametrize("p,v,m", MATRIX)
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_structural_invariants(schedule, p, v, m):
+    """check_table: exactly-once coverage, one-tick-earlier producer
+    for every hop (incl. the v>1 chunk wrap), backward-after-forward,
+    and the stash-slot reuse discipline under ``min(vM, 2pv-1)``."""
+    if schedule == "1f1b" and p < 2:
+        pytest.skip("1f1b needs p >= 2")
+    pps.check_table(pps.schedule_table(schedule, p, v, m))
+
+
+def test_classic_1f1b_degeneration():
+    """v == 1 collapses to the classic fused 1F1B: ``m + 2(p-1)``
+    ticks, stage s forwards microbatch m at tick ``m + s`` and
+    backwards it at tick ``m + 2(p-1) - s``."""
+    p, m = 3, 6
+    t = pps.interleaved_1f1b_table(p, 1, m)
+    assert t.ticks == m + 2 * (p - 1)
+    assert t.stash_cap == min(m, 2 * p - 1)
+    for tick in range(t.ticks):
+        for s in range(p):
+            frow, brow = t.fwd[tick], t.bwd[tick]
+            if frow is not None and frow[s].live:
+                assert tick == frow[s].microbatch + s
+                assert frow[s].chunk == 0
+            if brow is not None and brow[s].live:
+                assert tick == brow[s].microbatch + 2 * (p - 1) - s
+
+
+def test_interleaved_forward_order_is_megatron():
+    """p=2, v=2, m=4: stage 0's forward execution order round-robins
+    chunks over groups of p microbatches — the Megatron interleaved
+    pattern, pinned exactly."""
+    t = pps.interleaved_1f1b_table(2, 2, 4)
+    order = []
+    for tick in range(t.ticks):
+        row = t.fwd[tick]
+        if row is not None and row[0].live:
+            order.append((row[0].chunk, row[0].microbatch))
+    assert order == [(0, 0), (0, 1), (1, 0), (1, 1),
+                     (0, 2), (0, 3), (1, 2), (1, 3)]
+
+
+def test_warmup_and_drain_specialization():
+    """The first ``pv - 1`` ticks are forward-only and the trailing
+    ``pv - 1`` backward-only — the specialization that makes a
+    lockstep SPMD realization actually cheaper in warmup/drain (a
+    dead fused tick would still cost fwd+bwd compute)."""
+    for p, v, m in [(2, 1, 4), (4, 1, 16), (2, 2, 4), (4, 2, 16),
+                    (4, 4, 16)]:
+        t = pps.interleaved_1f1b_table(p, v, m)
+        c = pps.tick_counts(t)
+        assert c["fwd_only_ticks"] == p * v - 1, (p, v, m)
+        assert c["bwd_only_ticks"] == p * v - 1, (p, v, m)
+        assert (c["fwd_only_ticks"] + c["bwd_only_ticks"]
+                + c["combined_ticks"] == t.ticks)
+        # every tick in the table is emitted (no fully-dead ticks)
+        assert all(f is not None or b is not None
+                   for f, b in zip(t.fwd, t.bwd))
+
+
+@pytest.mark.parametrize("p,v,m", [pvm for pvm in MATRIX
+                                   if pvm[0] >= 2])
+def test_bubble_fraction_closed_form(p, v, m):
+    """Both schedules measure the same closed-form bubble at a given
+    v — ``(p-1)/(vm + p - 1)`` — so interleaving is the lever: v > 1
+    shrinks it ~v-fold (Narayanan et al.)."""
+    for schedule in ("gpipe", "1f1b"):
+        bf = pps.bubble_fraction(pps.schedule_table(schedule, p, v, m))
+        expect = (p - 1) / (v * m + p - 1)
+        assert bf["bubble_fraction"] == pytest.approx(expect, abs=1e-4)
+        assert bf["ideal_ticks"] == pytest.approx(3.0 * m)
+        assert bf["bubble_fraction"] == pytest.approx(
+            1.0 - bf["ideal_ticks"] / bf["measured_ticks"], abs=1e-4)
+
+
+def test_bubble_bench_acceptance_numbers():
+    """The bench row's exact numbers at its default shape (p=4, m=16):
+    interleaved strictly below plain 1f1b, and interleaved
+    measured-vs-ideal within 10% — the ISSUE 8 acceptance line."""
+    p, m = 4, 16
+    plain = pps.bubble_fraction(pps.interleaved_1f1b_table(p, 1, m))
+    v2 = pps.bubble_fraction(pps.interleaved_1f1b_table(p, 2, m))
+    v4 = pps.bubble_fraction(pps.interleaved_1f1b_table(p, 4, m))
+    assert plain["bubble_fraction"] == pytest.approx(0.1579, abs=1e-4)
+    assert v2["bubble_fraction"] == pytest.approx(0.0857, abs=1e-4)
+    assert v4["bubble_fraction"] == pytest.approx(0.0448, abs=1e-4)
+    assert v2["bubble_fraction"] < plain["bubble_fraction"]
+    assert v4["bubble_fraction"] < v2["bubble_fraction"]
+    for bf in (v2, v4):
+        assert bf["measured_ticks"] / bf["ideal_ticks"] < 1.10
+
+
+def test_stash_cap_and_peak_liveness():
+    """``stash_cap = min(vm, 2pv-1)`` is the RING size the kernel's
+    ``unit % cap`` slot addressing needs (a chunk-0 unit's backward
+    retires (v-1)p units later in the reverse traversal, so modulo
+    reuse demands the full 2pv-1 even though fewer stashes are ever
+    simultaneously live); the true peak liveness is ``p(v+1) - 1`` on
+    stage 0 — at v == 1 the two coincide at the classic 2p-1.  Both
+    facts pinned: peak == p(v+1)-1 <= cap, equality exactly at v==1."""
+    for p, v, m in [(2, 1, 4), (4, 1, 16), (2, 2, 4), (4, 2, 16),
+                    (4, 4, 16)]:
+        t = pps.interleaved_1f1b_table(p, v, m)
+        cap = t.stash_cap
+        assert cap == min(v * m, 2 * p * v - 1)
+        peak = 0
+        for s in range(p):
+            live = 0
+            for tick in range(t.ticks):
+                # the kernel writes the stash in the fwd sub-slot and
+                # retires in the SAME tick's bwd sub-slot: count the
+                # write before the read
+                if t.fwd[tick] is not None and t.fwd[tick][s].live:
+                    live += 1
+                peak = max(peak, live)
+                if t.bwd[tick] is not None and t.bwd[tick][s].live:
+                    live -= 1
+        assert peak == min(v * m, p * (v + 1) - 1), (p, v, m, peak)
+        assert peak <= cap
+        if v == 1:
+            assert peak == cap
+
+
+def test_head_marks_exactly_the_loss_units():
+    """Exactly one head unit per microbatch: last stage, last chunk —
+    where the kernel takes the loss and collects the stats row."""
+    t = pps.interleaved_1f1b_table(4, 2, 8)
+    heads = set()
+    for tick in range(t.ticks):
+        row = t.fwd[tick]
+        if row is None:
+            continue
+        for s, e in enumerate(row):
+            if e.live and e.head:
+                assert s == t.n_stages - 1
+                assert e.chunk == t.virtual - 1
+                heads.add(e.microbatch)
+    assert heads == set(range(t.microbatches))
+
+
+def test_unit_maps_roundtrip():
+    for p, v, m in MATRIX:
+        for ts in range(v * m):
+            c, mb = pps.fwd_unit(ts, p, v)
+            assert 0 <= c < v and 0 <= mb < m
+            assert pps.fwd_ts(c, mb, p, v) == ts
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError, match="n_stages=0"):
+        pps.gpipe_table(0, 1, 4)
+    with pytest.raises(ValueError, match="virtual=0"):
+        pps.gpipe_table(2, 0, 4)
+    with pytest.raises(ValueError, match="microbatches=0"):
+        pps.gpipe_table(2, 1, 0)
+    with pytest.raises(ValueError, match="divisible"):
+        pps.interleaved_1f1b_table(2, 2, 3)
+    with pytest.raises(ValueError, match="nothing to interleave"):
+        pps.gpipe_table(1, 2, 2)
+    with pytest.raises(ValueError, match="1f1b needs n_stages >= 2"):
+        pps.interleaved_1f1b_table(1, 1, 4)
+    with pytest.raises(ValueError, match="unknown schedule"):
+        pps.schedule_table("zb-h1", 2, 1, 4)
+
+
+def test_gpipe_table_is_forward_only():
+    t = pps.gpipe_table(4, 2, 8)
+    assert all(b is None for b in t.bwd)
+    assert t.ticks == 2 * 8 + 4 - 1
+    assert t.stash_cap == 2 * 8  # jax.grad holds every microbatch
