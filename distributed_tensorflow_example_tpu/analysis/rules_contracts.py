@@ -93,6 +93,7 @@ class SchemaDriftRule:
         "FLIGHT_STEP_RECORD": ("obs/flight.py", "train/loop.py"),
         "FLIGHT_ANOMALY_RECORD": ("obs/flight.py", "obs/anomaly.py"),
         "RUN_REPORT": ("obs/aggregate.py",),
+        "SERVING_STATS": ("serving/engine.py",),
     }
     GATE_PRODUCERS = ("bench.py", "obs/aggregate.py", "obs/metrics.py",
                       "obs/schema.py", "train/loop.py")

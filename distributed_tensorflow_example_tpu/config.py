@@ -302,6 +302,25 @@ class Config:
                                         # forces the host loop and the
                                         # synchronous step
 
+    # ---- serving (serving/: dtx-serve front door) ----
+    serve_port: int = 0             # dtx-serve: serve POST /generate +
+                                    # /status + /metrics (with
+                                    # dtx_generate_* latency gauges) on
+                                    # this port from the continuous-
+                                    # batching decode engine; required
+                                    # > 0 by dtx-serve, ignored by
+                                    # training
+    decode_page_size: int = 16      # paged KV cache: tokens per page
+                                    # (serving/kv_cache.py block size)
+    decode_pages: int = 0           # KV page-pool size; 0 = sized for
+                                    # decode_max_batch worst-case
+                                    # (max_len) sequences + the scratch
+                                    # page
+    decode_max_batch: int = 8       # concurrent decode slots = the
+                                    # largest batch bucket the engine
+                                    # compiles (shapes are bucketed so
+                                    # admission never recompiles)
+
     # ---- validation / early stopping (beyond-reference) ----
     early_stop_patience: int = 0    # > 0: evaluate the validation split
                                     # every epoch and stop after P
@@ -357,6 +376,18 @@ def _depth(s: str) -> int:
         raise argparse.ArgumentTypeError(
             f"depth {v} must be >= 1 (omit the flag for the "
             f"backend-aware default)")
+    return v
+
+
+def _pages(s: str) -> int:
+    """KV page-pool size: 0 (auto-size for --decode_max_batch) or
+    >= 2 — page 0 is the reserved scratch page, so a 1-page pool has
+    no usable pages (rejected at the CLI, not deep in engine init)."""
+    v = int(s)
+    if v != 0 and v < 2:
+        raise argparse.ArgumentTypeError(
+            f"decode_pages {v} must be 0 (auto) or >= 2 (page 0 is "
+            f"the reserved scratch page)")
     return v
 
 
@@ -605,6 +636,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "learning-rate summaries into the event file "
                         "every --log_every steps (host loop, "
                         "synchronous step only; no per-step host sync)")
+    p.add_argument("--serve_port", type=int, default=d.serve_port,
+                   help="dtx-serve: HTTP port for POST /generate + "
+                        "/status + /metrics (dtx_generate_* latency "
+                        "gauges) backed by the continuous-batching "
+                        "decode engine (serving/); training ignores it")
+    p.add_argument("--decode_page_size", type=_depth,
+                   default=d.decode_page_size,
+                   help="paged KV cache block size in tokens "
+                        "(serving/kv_cache.py; >= 1)")
+    p.add_argument("--decode_pages", type=_pages,
+                   default=d.decode_pages,
+                   help="KV page-pool size (0 = sized for "
+                        "--decode_max_batch worst-case sequences plus "
+                        "the reserved scratch page; explicit values "
+                        "need >= 2: page 0 is the scratch page)")
+    p.add_argument("--decode_max_batch", type=_depth,
+                   default=d.decode_max_batch,
+                   help="concurrent decode slots — the largest batch "
+                        "bucket the serving engine compiles (>= 1; "
+                        "admission/retirement re-bucket, never "
+                        "recompile)")
     p.add_argument("--early_stop_patience", type=int,
                    default=d.early_stop_patience,
                    help="stop after P epochs without validation "

@@ -675,13 +675,17 @@ def _block_forward(spec: TransformerSpec, bp: Params, h, act, cdt,
                    seq_axis: str | None = None,
                    expert_axis: str | None = None, moe_block: int = 0,
                    model_axis: str | None = None, aux_axes=(),
-                   dropout_rng=None, aux_stats: bool = False):
+                   dropout_rng=None, aux_stats: bool = False,
+                   kv_out: list | None = None):
     """One encoder block on ``h`` [B, S(local), D]. ``bp`` holds the
     block's leaves under their UNPREFIXED names (ln1_g, Wqkv, ...) so
     the same body serves the regular forward (dict views of L{i}_*)
     and the pipelined forward (lax.scan over stacked stages). Returns
     ``(h, aux)`` — aux is the block's MoE load-balance loss (0.0 for
-    the dense FFN).
+    the dense FFN).  ``kv_out``: a list to append this block's
+    ``(k, v)`` [B, S, Hl, Dh] to — the serving prefill captures the
+    training forward's exact keys/values into the paged cache this
+    way, so prefill and decode cannot drift.
 
     Under tensor parallelism (``model_axis``) the leaves arrive as
     their Megatron shards: Wqkv/bqkv hold this shard's heads (dl =
@@ -698,6 +702,8 @@ def _block_forward(spec: TransformerSpec, bp: Params, h, act, cdt,
     q, k, v = (qkv[:, :, t].astype(cdt) for t in range(3))
     local_heads = bp["Wqkv"].shape[-1] // spec.d_head
     shape = (b, s, local_heads, spec.d_head)
+    if kv_out is not None:
+        kv_out.append((k.reshape(shape), v.reshape(shape)))
     att = _attend(spec, q.reshape(shape), k.reshape(shape),
                   v.reshape(shape), seq_axis)
     branch = _dropout(
@@ -1512,21 +1518,47 @@ def init_decode_cache(spec: TransformerSpec, batch: int,
     return cache
 
 
-def decode_step(spec: TransformerSpec, params: Params, cache: Params,
-                token: jnp.ndarray, pos, model_axis: str | None = None):
-    """One KV-cached decode step for the lm objective: embed ``token``
-    [B] at position ``pos``, run every block attending to the cached
-    keys/values up to and including ``pos``, and return
-    (vocab logits [B, V], updated cache). O(S) per step instead of the
-    O(S^2) full re-forward; exactly the training forward's math
-    (verified by the greedy-vs-teacher-forcing test).
+class _DenseKV:
+    """KV adapter for the contiguous ``[B, S, H, Dh]`` per-block cache
+    (scalar decode position): writes position ``pos`` with ONE
+    dynamic-index update per leaf and returns the updated views for
+    attention.  Updated leaves replace the originals in ``self.cache``
+    in place of a rebuilt dict — the only copies left are the XLA
+    buffer updates themselves, which alias when the caller donates
+    (``decode_step_fn``) or carries the cache through a scan
+    (``generate``)."""
 
-    ``model_axis`` (inside shard_map): Megatron TP decode — ``Wqkv``
-    arrives with this shard's head columns, the per-head attention and
-    its KV cache stay shard-local, and the two row-split projections
-    (Wo, W2) psum, exactly like the training forward."""
+    def __init__(self, spec: TransformerSpec, cache: Params, pos):
+        self.cache = cache
+        self.pos = pos
+        # mask over cache positions: attend to <= pos only
+        self.valid = (jnp.arange(spec.seq_len) <= pos)[None, None]
+
+    def update(self, i: int, kk, vv):
+        ck = jax.lax.dynamic_update_index_in_dim(
+            self.cache[f"k{i}"], kk, self.pos, axis=1)
+        cv = jax.lax.dynamic_update_index_in_dim(
+            self.cache[f"v{i}"], vv, self.pos, axis=1)
+        self.cache[f"k{i}"], self.cache[f"v{i}"] = ck, cv
+        return ck, cv, self.valid
+
+
+def _decode_forward(spec: TransformerSpec, params: Params, token, pos,
+                    kv, model_axis: str | None = None):
+    """The ONE KV-cached decode forward, shared by the contiguous
+    ``decode_step`` and the paged ``serving.kv_cache.paged_decode_step``
+    (their greedy bit-parity is a tested invariant — the cache LAYOUT
+    is the adapter's business, the math lives here exactly once).
+
+    ``token`` [B]; ``pos`` is a scalar (contiguous, every row at the
+    same position) or [B] (paged, ragged per-sequence positions) —
+    the embedding lookup broadcasts either way.  ``kv`` is the cache
+    adapter: ``update(i, kk, vv) -> (keys, values, mask)`` writes
+    block i's new row(s) and returns the attention operands
+    ([B, S_kv, Hl, Dh] views plus a mask broadcastable to
+    [B, Hl, S_kv])."""
     if spec.objective != "lm":
-        raise ValueError("decode_step serves the lm objective only")
+        raise ValueError("decode serves the lm objective only")
     # host-side numpy params would reject traced indices (token/pos)
     params = {k: jnp.asarray(v) for k, v in params.items()}
     # decode routes MoE with the exact dense dispatch: training's
@@ -1541,9 +1573,6 @@ def decode_step(spec: TransformerSpec, params: Params, cache: Params,
     h = (params["W_emb"].astype(jnp.float32)[token]
          + params["pos"].astype(jnp.float32)[pos])        # [B, D]
     act = _ACTIVATIONS[spec.activation]
-    # mask over cache positions: attend to <= pos only
-    valid = (jnp.arange(spec.seq_len) <= pos)             # [S]
-    new_cache = dict(cache)
     for i in range(spec.num_blocks):
         bp = {k[len(f"L{i}_"):]: v for k, v in params.items()
               if k.startswith(f"L{i}_")}
@@ -1561,11 +1590,7 @@ def decode_step(spec: TransformerSpec, params: Params, cache: Params,
         # stores the rounded values so bf16 runs match training
         q, kk, vv = (qkv[:, t].astype(cdt).reshape(b, hn, dh)
                      for t in range(3))
-        ck = jax.lax.dynamic_update_index_in_dim(
-            new_cache[f"k{i}"], kk, pos, axis=1)
-        cv = jax.lax.dynamic_update_index_in_dim(
-            new_cache[f"v{i}"], vv, pos, axis=1)
-        new_cache[f"k{i}"], new_cache[f"v{i}"] = ck, cv
+        ck, cv, valid = kv.update(i, kk, vv)
         # mirror ops/ring_attention.attention exactly: the score
         # einsum runs in the inputs' dtype and is cast AFTER (bf16
         # rounding included), masked with the same NEG_INF
@@ -1573,7 +1598,7 @@ def decode_step(spec: TransformerSpec, params: Params, cache: Params,
 
         scores = jnp.einsum("bhe,bshe->bhs", q, ck).astype(jnp.float32) \
             / jnp.sqrt(jnp.float32(dh))                   # [B, Hl, S]
-        scores = jnp.where(valid[None, None], scores, NEG_INF)
+        scores = jnp.where(valid, scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1)
         att = jnp.einsum("bhs,bshe->bhe", probs.astype(cv.dtype),
                          cv).reshape(b, hn * dh)
@@ -1584,7 +1609,52 @@ def decode_step(spec: TransformerSpec, params: Params, cache: Params,
         h = h[:, 0]
     hf = _ln(spec, h, params["lnf_g"], params["lnf_b"])
     logits = _mm(params, hf, "W_head", "b_head", cdt).astype(jnp.float32)
-    return logits, new_cache
+    return logits
+
+
+def decode_step(spec: TransformerSpec, params: Params, cache: Params,
+                token: jnp.ndarray, pos, model_axis: str | None = None):
+    """One KV-cached decode step for the lm objective: embed ``token``
+    [B] at position ``pos``, run every block attending to the cached
+    keys/values up to and including ``pos``, and return
+    (vocab logits [B, V], updated cache). O(S) per step instead of the
+    O(S^2) full re-forward; exactly the training forward's math
+    (verified by the greedy-vs-teacher-forcing test).
+
+    ``model_axis`` (inside shard_map): Megatron TP decode — ``Wqkv``
+    arrives with this shard's head columns, the per-head attention and
+    its KV cache stay shard-local, and the two row-split projections
+    (Wo, W2) psum, exactly like the training forward.
+
+    Per-step cache copies: called standalone under a plain jit, every
+    step materializes a fresh cache output.  Use ``decode_step_fn``
+    (donated cache buffers) for step-at-a-time decoding loops —
+    ``generate``'s scan already aliases the cache as its carry."""
+    kv = _DenseKV(spec, dict(cache), pos)
+    logits = _decode_forward(spec, params, token, pos, kv,
+                             model_axis=model_axis)
+    return logits, kv.cache
+
+
+@functools.lru_cache(maxsize=8)
+def decode_step_fn(spec: TransformerSpec, model_axis: str | None = None,
+                   donate: bool | None = None):
+    """Compiled ``(params, cache, token, pos) -> (logits, cache)``
+    step with the cache buffers DONATED (in-place XLA updates), so a
+    step-at-a-time decode loop — the serving engine's shape, where a
+    scan over positions cannot exist — stops paying a full cache copy
+    per emitted token.  ``donate=None`` resolves by backend (the CPU
+    runtime implements no donation and would warn per call); the
+    tokens are bit-identical either way, donation only changes buffer
+    lifetime."""
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
+
+    def step(params, cache, token, pos):
+        return decode_step(spec, params, cache, token, pos,
+                           model_axis=model_axis)
+
+    return jax.jit(step, donate_argnums=(1,) if donate else ())
 
 
 def generate(spec: TransformerSpec, params: Params, prompt: jnp.ndarray,

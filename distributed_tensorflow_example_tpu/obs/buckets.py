@@ -46,7 +46,12 @@ TRACE_SCOPES = WINDOW_BUCKETS + ("eval", "checkpoint")
 # ppermute start/done pairs in transformer._hop_start) so a profiler
 # capture shows the transfer overlapping the opposite direction's
 # compute instead of folding it into anonymous collective time.
-NAMED_SCOPES = ("ln", "moe_dispatch", "moe_expert", "pp_comm")
+# "prefill"/"decode"/"sampling" name the serving engine's phases
+# (serving/engine.py compiled programs): a capture of the decode
+# engine splits prompt ingestion, the paged decode step, and the
+# fused on-device sampling.
+NAMED_SCOPES = ("ln", "moe_dispatch", "moe_expert", "pp_comm",
+                "prefill", "decode", "sampling")
 
 # run-level goodput/badput decomposition, in presentation order
 # ("train" is the goodput bucket, "eval"/"sample" auxiliary useful

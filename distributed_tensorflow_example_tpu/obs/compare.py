@@ -70,6 +70,15 @@ GATE_METRICS: Dict[str, tuple] = {
     "pp_bubble_frac_1f1b": ("lower", 0.01),
     "pp_bubble_frac_interleaved_v2": ("lower", 0.01),
     "pp_bubble_frac_interleaved_v4": ("lower", 0.01),
+    # the serving rows (ISSUE 9): request-latency p99 + aggregate
+    # decode throughput from bench_serving's offered-load sweep (short
+    # CPU-measured loops — wide thresholds like the other A/B rows),
+    # and the decode roofline fraction (achieved vs analytic
+    # weights+KV HBM bytes/step) from bench_decode — the
+    # hardware-limited number VERDICT r5 #7 asked the decode row for
+    "serving_p99_ms": ("lower", 0.25),
+    "serving_tok_s": ("higher", 0.25),
+    "decode_hbm_frac": ("higher", 0.05),
 }
 
 
@@ -138,6 +147,14 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
             put(f"pp_bubble_frac_{name}",
                 doc.get(f"{name}_bubble_fraction"))
         return out
+    # bench serving row — keyed on continuous_ticks, NOT serving_tok_s:
+    # the final summary carries serving_tok_s too, and must fall
+    # through to its own branch below to keep wall_s/mfu/...
+    if "continuous_ticks" in doc:
+        put("serving_p99_ms", doc.get("serving_p99_ms"))
+        put("serving_tok_s", doc.get("serving_tok_s"))
+        put("decode_hbm_frac", doc.get("decode_hbm_frac"))
+        return out
     if "wall_clock_20ep_s" in doc:              # bench per-config row
         put("wall_s", doc.get("wall_clock_20ep_s"))
         put("examples_per_sec", doc.get("examples_per_sec"))
@@ -161,7 +178,11 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
                   "moe_wide_mfu", "moe_dispatch_ms", "moe_expert_ms",
                   "pp_bubble_frac_gpipe", "pp_bubble_frac_1f1b",
                   "pp_bubble_frac_interleaved_v2",
-                  "pp_bubble_frac_interleaved_v4"):
+                  "pp_bubble_frac_interleaved_v4",
+                  # the serving/decode-roofline keys (ISSUE 9) ride
+                  # the final line under their gate names verbatim
+                  "serving_p99_ms", "serving_tok_s",
+                  "decode_hbm_frac"):
             put(k, doc.get(k))
         return out
     # last resort: any directly-named gate metrics

@@ -93,3 +93,88 @@ def mfu(flops_per_step: float, steps_per_sec: float, peak,
     if not peak:
         return None
     return flops_per_step * steps_per_sec / (peak * max(n_devices, 1))
+
+
+# ---- decode roofline (round 9) ----
+#
+# Autoregressive decode at serving batch sizes is BANDWIDTH-bound,
+# not FLOPs-bound: every step streams the full weight set plus the
+# live KV cache through HBM to produce one token per sequence, so the
+# honest utilization number is achieved bytes/s against the chip's
+# HBM bandwidth ("hbm_frac"), not MFU.  VERDICT r5 #7 flagged the
+# decode bench's naked tok/s; these functions provide the analytic
+# denominator, and bench_decode reports achieved-vs-analytic as
+# ``decode_hbm_frac`` (gated — obs/compare.GATE_METRICS).
+
+# HBM bandwidth per chip (bytes/s), by jax device_kind — the decode
+# roofline's denominator, as PEAK_BF16_FLOPS is the MFU's.
+PEAK_HBM_BYTES = {
+    "TPU v5 lite": 819e9,    # v5e
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,        # v5p
+    "TPU v4": 1228e9,
+    "TPU v6 lite": 1640e9,   # v6e / Trillium
+}
+
+
+def chip_peak_hbm_bytes(device=None):
+    """Per-chip HBM bandwidth for ``device`` (default:
+    jax.devices()[0]); None off-TPU or for an unknown device_kind —
+    hbm_frac is then undefined (reported as null, never fabricated)."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    if device.platform != "tpu":
+        return None
+    return PEAK_HBM_BYTES.get(device.device_kind)
+
+
+def decode_weight_bytes(spec) -> float:
+    """Bytes of parameters one decode step streams from HBM: every
+    weight is read once per token (batch-invariant — the term
+    batching amortizes)."""
+    from ..models import transformer
+
+    if not isinstance(spec, transformer.TransformerSpec):
+        raise TypeError(f"no decode accounting for spec type "
+                        f"{type(spec)!r}")
+    import numpy as np
+
+    itemsize = np.dtype(spec.param_dtype).itemsize
+    return float(transformer.num_params(spec)) * itemsize
+
+
+def decode_kv_bytes_per_step(spec, batch: int, kv_len: float,
+                             heads: int | None = None) -> float:
+    """KV-cache traffic of one decode step at ``kv_len`` cached
+    positions per sequence: every block READS its [kv_len, H, Dh] k
+    and v per sequence and WRITES one new row of each, in the compute
+    dtype (what the cache stores).  ``kv_len`` may be fractional (a
+    mean over a decode's positions)."""
+    import numpy as np
+
+    h = heads or spec.n_heads
+    itemsize = np.dtype(spec.compute_dtype).itemsize
+    row = h * spec.d_head * itemsize
+    return 2.0 * spec.num_blocks * batch * (kv_len + 1.0) * row
+
+
+def decode_bytes_per_step(spec, batch: int, kv_len: float,
+                          heads: int | None = None) -> float:
+    """Analytic HBM bytes per decode step: weights (read once) + KV
+    read/write — the roofline's numerator.  Activations are excluded
+    (O(B*d) per block, negligible against both terms at decode
+    shapes)."""
+    return decode_weight_bytes(spec) \
+        + decode_kv_bytes_per_step(spec, batch, kv_len, heads=heads)
+
+
+def hbm_frac(bytes_per_step: float, step_time_s: float, peak,
+             n_devices: int = 1):
+    """Achieved HBM bandwidth as a fraction of the fleet's peak —
+    decode's utilization number; None when the peak is unknown
+    (non-TPU backends)."""
+    if not peak or step_time_s <= 0:
+        return None
+    return bytes_per_step / step_time_s / (peak * max(n_devices, 1))

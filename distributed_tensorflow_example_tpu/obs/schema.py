@@ -116,6 +116,34 @@ FLIGHT_ANOMALY_RECORD = {
     "policy": (str,),
 }
 
+# The serving engine's point-in-time counters
+# (serving/engine.DecodeEngine.stats): the /status "serving" section
+# and the dtx_generate_* Prometheus gauges read exactly these fields,
+# so dashboards scrape a pinned surface.  Percentiles/throughput are
+# nullable — absent before the first completion, never fabricated.
+SERVING_STATS = {
+    "requests_total": (int,),
+    "completed_total": (int,),
+    "inflight": (int,),
+    "queued": (int,),
+    "latency_p50_ms": _NUM + (type(None),),
+    "latency_p99_ms": _NUM + (type(None),),
+    "ttft_p50_ms": _NUM + (type(None),),
+    "tokens_generated_total": (int,),
+    "tokens_per_sec": _NUM + (type(None),),
+    "page_occupancy_frac": _NUM,
+    "decode_ticks_total": (int,),
+    "prefills_total": (int,),
+}
+
+
+def validate_serving_stats(doc: Dict[str, Any],
+                           where: str = "serving") -> List[str]:
+    """Validate a DecodeEngine.stats() document (no version stamp —
+    it is an in-process snapshot, never written to disk by obs/)."""
+    return _check(doc, SERVING_STATS, where)
+
+
 # The run report obs/aggregate.py produces (dtx-obs report emits it,
 # obs/compare.py diffs it). Top-level contract only — the nested
 # goodput bucket names are pinned by aggregate.BUCKETS.

@@ -13,6 +13,20 @@ or offline re-serving via ``dtx-obs serve``):
 - ``/report``  — the full obs/aggregate.py run report (computed per
   request — cheap at these log sizes, and always current).
 
+With a decode engine attached (``StatusServer(logs_path, engine=...)``
+— the ``dtx-serve`` front door, serving/cli.py) the same server also
+exposes:
+
+- ``POST /generate`` — ``{"prompt": [token ids], "max_new_tokens": N,
+  "temperature": t}`` -> ``{"tokens": [...], "latency_ms": ...}``;
+  the handler thread submits into the engine's continuous-batching
+  scheduler and blocks on ITS request only, so concurrent requests
+  share decode steps;
+- request-level latency percentiles as ``dtx_generate_*`` gauges on
+  ``/metrics`` (p50/p99 latency, time-to-first-token, inflight/queue
+  depth, tok/s, KV page occupancy — the obs/schema.SERVING_STATS
+  surface).
+
 The reader side only ever *reads* files the run appends to, so the
 server adds zero overhead to the training loop and the identical code
 serves a finished run's directory offline. Tail reads are bounded
@@ -122,10 +136,13 @@ def collect_status(logs_path: str,
     }
 
 
-def prometheus_text(status: Dict[str, Any]) -> str:
+def prometheus_text(status: Dict[str, Any],
+                    serving: Optional[Dict[str, Any]] = None) -> str:
     """Render a /status document in Prometheus text exposition format
     (version 0.0.4). Gauges only — everything here is a point-in-time
-    read of the run's own counters."""
+    read of the run's own counters. ``serving``: a
+    DecodeEngine.stats() document (schema.SERVING_STATS) appended as
+    the ``dtx_generate_*`` request-latency gauges."""
     out: List[str] = []
 
     def fmt(v) -> str:
@@ -187,7 +204,35 @@ def prometheus_text(status: Dict[str, Any]) -> str:
           [(None, run_end.get("total_time_s"))])
     gauge("dtx_test_accuracy", "final test accuracy (run_end)",
           [(None, run_end.get("test_accuracy"))])
+    if serving:
+        gauge("dtx_generate_requests_total", "requests accepted by "
+              "the decode engine", [(None, serving.get("requests_total"))])
+        gauge("dtx_generate_completed_total", "requests completed",
+              [(None, serving.get("completed_total"))])
+        gauge("dtx_generate_inflight", "requests in the live decode "
+              "batch", [(None, serving.get("inflight"))])
+        gauge("dtx_generate_queued", "requests waiting for admission",
+              [(None, serving.get("queued"))])
+        gauge("dtx_generate_latency_p50_ms", "median request latency",
+              [(None, serving.get("latency_p50_ms"))])
+        gauge("dtx_generate_latency_p99_ms", "p99 request latency",
+              [(None, serving.get("latency_p99_ms"))])
+        gauge("dtx_generate_ttft_p50_ms", "median time to first token",
+              [(None, serving.get("ttft_p50_ms"))])
+        gauge("dtx_generate_tokens_total", "tokens generated",
+              [(None, serving.get("tokens_generated_total"))])
+        gauge("dtx_generate_tokens_per_sec", "aggregate decode "
+              "throughput", [(None, serving.get("tokens_per_sec"))])
+        gauge("dtx_generate_page_occupancy", "KV cache page occupancy "
+              "fraction", [(None, serving.get("page_occupancy_frac"))])
+        gauge("dtx_generate_decode_ticks_total", "decode engine ticks "
+              "executed", [(None, serving.get("decode_ticks_total"))])
     return "\n".join(out) + "\n"
+
+
+# a /generate request that cannot finish in this window is reported
+# as a 504 timeout (the engine keeps decoding it; the CLIENT gave up)
+GENERATE_TIMEOUT_S = 600.0
 
 
 class StatusServer:
@@ -197,16 +242,22 @@ class StatusServer:
     the train loop calls it from its ``finally``, so a crash never
     leaks the socket. Never raises out of start(): a taken port logs
     a NOTE and the run proceeds unobserved (the server must not kill
-    the run it reports on)."""
+    the run it reports on).
 
-    def __init__(self, logs_path: str):
+    ``engine``: a serving/engine.DecodeEngine (or any object with
+    ``submit``/``result``/``stats``) — enables ``POST /generate`` and
+    the ``dtx_generate_*`` gauges (the dtx-serve front door)."""
+
+    def __init__(self, logs_path: str, engine=None):
         self.logs_path = logs_path
+        self.engine = engine
         self.port: Optional[int] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     def start(self, port: int, host: str = "") -> Optional[int]:
         logs_path = self.logs_path
+        engine = self.engine
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # stdout belongs to the run
@@ -225,9 +276,14 @@ class StatusServer:
                 try:
                     if path in ("/", "/status"):
                         doc = collect_status(logs_path)
+                        if engine is not None:
+                            doc["serving"] = engine.stats()
                         self._send(200, json.dumps(doc).encode())
                     elif path == "/metrics":
-                        text = prometheus_text(collect_status(logs_path))
+                        text = prometheus_text(
+                            collect_status(logs_path),
+                            serving=(engine.stats()
+                                     if engine is not None else None))
                         self._send(200, text.encode(),
                                    "text/plain; version=0.0.4")
                     elif path == "/report":
@@ -239,8 +295,59 @@ class StatusServer:
                         self._send(404, json.dumps(
                             {"error": f"unknown path {path!r}",
                              "endpoints": ["/status", "/metrics",
-                                           "/report"]}).encode())
+                                           "/report"]
+                             + (["/generate"] if engine is not None
+                                else [])}).encode())
                 except Exception as e:  # a bad read must not kill serving
+                    self._send(500, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode())
+
+            def do_POST(self):
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                if path != "/generate":
+                    self._send(404, json.dumps(
+                        {"error": f"unknown POST path {path!r}"}).encode())
+                    return
+                if engine is None:
+                    self._send(503, json.dumps(
+                        {"error": "no decode engine attached (start "
+                                  "via dtx-serve)"}).encode())
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    prompt = req.get("prompt")
+                    if not isinstance(prompt, list):
+                        raise ValueError(
+                            "'prompt' must be a list of token ids")
+                    rid = engine.submit(
+                        prompt,
+                        int(req.get("max_new_tokens", 16)),
+                        temperature=float(req.get("temperature", 0.0)))
+                except (ValueError, TypeError, KeyError) as e:
+                    self._send(400, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode())
+                    return
+                except RuntimeError as e:
+                    # the engine loop died (submit refuses after a
+                    # failure): the server is up, generation is not
+                    self._send(503, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode())
+                    return
+                try:
+                    res = engine.result(rid, timeout=GENERATE_TIMEOUT_S)
+                    if res is None:
+                        self._send(504, json.dumps(
+                            {"error": "generation timed out",
+                             "rid": rid}).encode())
+                        return
+                    if "error" in res:
+                        # the engine loop died while THIS request was
+                        # in flight; its event was failed immediately
+                        self._send(500, json.dumps(res).encode())
+                        return
+                    self._send(200, json.dumps(res).encode())
+                except Exception as e:
                     self._send(500, json.dumps(
                         {"error": f"{type(e).__name__}: {e}"}).encode())
 

@@ -1,0 +1,99 @@
+"""Paged KV-cache primitives for the decode engine (vLLM-style).
+
+The serving decode path (serving/kv_cache.py) stores each block's
+keys/values in fixed-size **pages** — ``[num_pages, page_size, H, Dh]``
+pool arrays — and addresses a sequence's cache through a per-sequence
+**block table**: row ``b`` lists, in logical order, the page ids that
+hold sequence ``b``'s positions (logical position ``j`` lives at page
+``table[b, j // page_size]``, row ``j % page_size``).  Ragged
+sequences then pack one decode batch with zero padding waste beyond
+the last partial page, and a finished sequence's pages return to the
+pool immediately (PagedAttention's central idea, reproduced
+TPU-natively with XLA scatter/gather — the layout is Pallas-ready:
+a fused kernel would consume the same pool + table operands).
+
+This module holds the three primitives the adapter composes; the
+attention math itself stays in models/transformer.py's shared decode
+forward so the paged and contiguous paths cannot drift (bit-parity is
+a tested invariant, tests/test_serving.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def scatter_kv_rows(pool: jnp.ndarray, page_ids: jnp.ndarray,
+                    rows: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:
+    """Write one cache row per sequence into the page pool.
+
+    ``pool`` [num_pages, page_size, H, Dh]; ``page_ids``/``rows`` [B]
+    int32 (each sequence's target page and row within it); ``vals``
+    [B, H, Dh].  Distinct sequences own distinct pages (the allocator
+    guarantees it), so the scatter indices never collide — except on
+    the reserved scratch page dead slots write to, whose content is
+    never read (their validity mask is empty)."""
+    return pool.at[page_ids, rows].set(vals)
+
+
+def scatter_prefill_rows(pool: jnp.ndarray, page_ids: jnp.ndarray,
+                         rows: jnp.ndarray,
+                         vals: jnp.ndarray) -> jnp.ndarray:
+    """Write a whole prompt's rows at once: ``page_ids``/``rows``
+    [B, P] address each of the P prefilled positions, ``vals``
+    [B, P, H, Dh] holds the per-position k or v.  Padded positions
+    (>= the sequence's true length) are routed to rows the decode
+    either overwrites before reading (rows above the current position
+    are masked until written) or to the scratch page."""
+    b, p = page_ids.shape
+    return pool.at[page_ids.reshape(b * p), rows.reshape(b * p)].set(
+        vals.reshape((b * p,) + vals.shape[2:]))
+
+
+def gather_kv(pool: jnp.ndarray, block_table: jnp.ndarray) -> jnp.ndarray:
+    """Materialize the batch's logical KV view from the pool:
+    ``block_table`` [B, W] page ids -> [B, W*page_size, H, Dh], where
+    index ``j`` along the gathered axis IS logical position ``j``
+    (pages are listed in order).  W is the *bucketed* live width —
+    the gather touches only the blocks the batch can actually
+    address, not the full max sequence length."""
+    b, w = block_table.shape
+    _, ps, h, dh = pool.shape
+    return pool[block_table].reshape(b, w * ps, h, dh)
+
+
+def length_mask(kv_width: int, pos: jnp.ndarray) -> jnp.ndarray:
+    """Validity over gathered positions: ``[B, kv_width]`` True where
+    logical position ``j`` is readable for sequence ``b`` at decode
+    position ``pos[b]`` (attend to ``<= pos``, exactly the contiguous
+    decode's mask)."""
+    return jnp.arange(kv_width)[None, :] <= pos[:, None]
+
+
+def page_row_index(pos: jnp.ndarray, block_table: jnp.ndarray,
+                   page_size: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(page_ids [B], rows [B]) addressing position ``pos[b]`` of each
+    sequence through its block-table row."""
+    page_slot = pos // page_size
+    page_ids = jnp.take_along_axis(
+        block_table, page_slot[:, None], axis=1)[:, 0]
+    return page_ids, pos % page_size
+
+
+def prefill_page_rows(lengths_width: int, block_table: jnp.ndarray,
+                      page_size: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(page_ids [B, P], rows [B, P]) addressing positions
+    ``0 .. lengths_width-1`` of every sequence — the prefill scatter's
+    index plan (P = the bucketed prompt width)."""
+    j = jnp.arange(lengths_width)
+    pages = jnp.take_along_axis(
+        block_table, jnp.broadcast_to(j[None, :] // page_size,
+                                      (block_table.shape[0],
+                                       lengths_width)), axis=1)
+    rows = jnp.broadcast_to((j % page_size)[None, :],
+                            (block_table.shape[0], lengths_width))
+    return pages, rows
+
+
+__all__ = ["scatter_kv_rows", "scatter_prefill_rows", "gather_kv",
+           "length_mask", "page_row_index", "prefill_page_rows"]

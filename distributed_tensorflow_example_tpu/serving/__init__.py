@@ -1,0 +1,35 @@
+"""Serving package: paged KV cache + continuous-batching decode.
+
+Re-exports resolve lazily (PEP 562, the parallel/ package's
+convention): importing the package does NOT pull in jax, so the
+pure-Python members (``scheduler`` — the continuous-batching tick
+planner the tier-1 tests and the bench's analytic half consume) stay
+importable on environments whose jax predates the repo's API.
+Touching a jax-backed name (``DecodeEngine``, the kv_cache module)
+imports its home module with the usual error surface.
+"""
+
+_EXPORTS = {
+    "BlockAllocator": "scheduler",
+    "ContinuousScheduler": "scheduler",
+    "StaticBatchScheduler": "scheduler",
+    "TickPlan": "scheduler",
+    "simulate": "scheduler",
+    "shape_buckets": "scheduler",
+    "DecodeEngine": "engine",
+    "init_paged_cache": "kv_cache",
+    "paged_decode_step": "kv_cache",
+    "prefill_into_pages": "kv_cache",
+    "sample_tokens": "kv_cache",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(f".{_EXPORTS[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,159 @@
+"""``dtx-serve`` — the serving front door.
+
+Builds the transformer spec from the SAME config.py flag surface as
+training (one vocabulary of ``--d_model``/``--num_blocks``/... for
+both halves of the system), loads params from a training checkpoint
+(``--checkpoint_dir``, the utils/checkpoint .npz format) or falls
+back to a seeded init (demo mode), starts the continuous-batching
+``DecodeEngine``, and serves:
+
+- ``POST /generate`` — ``{"prompt": [ints], "max_new_tokens": N,
+  "temperature": t}`` -> completion + latency (obs/serve.py);
+- ``GET /status`` / ``/metrics`` — the run-status surface plus the
+  ``dtx_generate_*`` serving gauges.
+
+Engine knobs: ``--decode_page_size`` (tokens per KV page),
+``--decode_pages`` (pool size; 0 sizes for ``--decode_max_batch``
+worst-case sequences), ``--decode_max_batch`` (concurrent decode
+slots = the largest batch bucket), ``--serve_port``.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from typing import Optional, Sequence
+
+
+def _params_from_checkpoint(path: str, expect: dict):
+    """Pull the flat transformer params out of a training checkpoint:
+    state leaves are saved under tree-path keys, so match each
+    expected param name against the flattened key tails (shape-checked
+    — optimizer slots share names with neither params nor each
+    other's tails)."""
+    import glob
+    import os
+
+    import numpy as np
+
+    if os.path.isdir(path):
+        cands = sorted(glob.glob(os.path.join(path, "ckpt-*.npz")))
+        if not cands:
+            raise FileNotFoundError(f"no ckpt-*.npz under {path}")
+        path = cands[-1]
+    from ..utils.checkpoint import _decode_leaf
+
+    out = {}
+    with np.load(path) as z:
+        dts = {m.group(1): str(z[k][()])
+               for k in z.files
+               for m in [re.fullmatch(r"__dt_(.+)__", k)] if m}
+        # optimizer slots share every param's name and shape under
+        # their own subtree: visit the params/ paths first so the
+        # weights win, slots only ever fill a gap (older formats)
+        ordered = sorted((k for k in z.files if not k.startswith("__")),
+                         key=lambda k: (0 if "params" in k else 1, k))
+        for k in ordered:
+            tail = k.split("/")[-1]
+            if tail in expect and tuple(z[k].shape) == expect[tail] \
+                    and tail not in out:
+                a = z[k]
+                if k in dts:
+                    a = _decode_leaf(a, dts[k])
+                out[tail] = a
+    missing = sorted(set(expect) - set(out))
+    if missing:
+        raise ValueError(f"{path}: checkpoint lacks params {missing} "
+                         f"(wrong model flags for this checkpoint?)")
+    return out, path
+
+
+def _spec_from_cfg(cfg):
+    """The lm-transformer slice of train/loop.make_spec, inlined so
+    dtx-serve never imports the training stack (the loop pulls the
+    mesh/step machinery, which serving does not need)."""
+    import jax.numpy as jnp
+
+    from ..models.transformer import TransformerSpec
+
+    return TransformerSpec(
+        input_size=cfg.input_size, num_classes=cfg.num_classes,
+        objective="lm", vocab_size=cfg.vocab_size,
+        seq_len=cfg.input_size,      # lm tokenizes every input scalar
+        d_model=cfg.d_model, n_heads=cfg.n_heads,
+        num_blocks=cfg.num_blocks, d_ff=cfg.d_ff,
+        activation=(cfg.activation if cfg.activation != "sigmoid"
+                    else "gelu"),
+        attention="flash" if cfg.pallas else cfg.attention,
+        sp_impl=cfg.sp_impl, causal=True,
+        num_experts=cfg.num_experts, moe_topk=cfg.moe_topk,
+        moe_dispatch=cfg.moe_dispatch,
+        capacity_factor=cfg.capacity_factor,
+        aux_loss_weight=cfg.moe_aux_weight,
+        fused_ln=cfg.fused_ln, grouped_moe=cfg.grouped_moe,
+        param_dtype=jnp.dtype(cfg.param_dtype),
+        compute_dtype=jnp.dtype(cfg.compute_dtype),
+    )
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    from .. import config as config_lib
+
+    cfg = config_lib.parse_config(argv)
+    if cfg.serve_port <= 0:
+        print("dtx-serve: --serve_port is required (> 0)",
+              file=sys.stderr)
+        return 2
+    if cfg.model != "transformer" or cfg.objective != "lm":
+        print("dtx-serve: decoding needs --model=transformer "
+              "--objective=lm", file=sys.stderr)
+        return 2
+
+    import jax
+
+    from ..models import transformer as tfm
+    from .engine import DecodeEngine
+
+    spec = _spec_from_cfg(cfg)
+    if cfg.checkpoint_dir:
+        params, path = _params_from_checkpoint(
+            cfg.checkpoint_dir, tfm.param_shapes(spec))
+        print(f"dtx-serve: params restored from {path}")
+        params = {k: jax.numpy.asarray(v) for k, v in params.items()}
+    else:
+        print("dtx-serve: no --checkpoint_dir — serving a seeded "
+              "random init (demo mode)")
+        params = tfm.init(jax.random.PRNGKey(cfg.seed), spec)
+
+    engine = DecodeEngine(
+        spec, params, page_size=cfg.decode_page_size,
+        num_pages=cfg.decode_pages, max_batch=cfg.decode_max_batch,
+        seed=cfg.seed)
+    engine.start()
+
+    from ..obs.serve import StatusServer
+
+    server = StatusServer(cfg.logs_path, engine=engine)
+    port = server.start(cfg.serve_port)
+    if port is None:
+        engine.stop()
+        return 2
+    print(f"dtx-serve: POST /generate on :{port} "
+          f"(page_size={engine.page_size} pages={engine.num_pages} "
+          f"max_batch={engine.sched.max_batch} "
+          f"max_len={engine.max_len})")
+    try:
+        import time
+
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+        engine.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,440 @@
+"""DecodeEngine: continuous-batching inference over the paged cache.
+
+The execution half of the serving stack: the pure-Python scheduler
+(serving/scheduler.py) decides membership and shapes, this engine
+executes each ``TickPlan`` with compiled programs drawn from a FINITE
+shape set (the no-recompile invariant):
+
+- one **prefill** program per bucketed prompt width — the batched
+  training forward captured into the request's pages, emitting the
+  first generated token;
+- one **decode** program per (batch bucket, block-table width) pair —
+  the shared ragged decode step with sampling FUSED into the program
+  (greedy argmax / temperature categorical selected per sequence on
+  device), so per-token logits never round-trip to the host;
+- the paged cache buffers are DONATED to each call (off-CPU), so a
+  step updates the pool in place instead of copying every page per
+  emitted token — the contiguous path's scan-carry aliasing,
+  reproduced for the step-at-a-time serving shape.
+
+Phases are annotated with the ``prefill`` / ``decode`` / ``sampling``
+trace scopes (obs/buckets.NAMED_SCOPES), so profiler captures
+attribute device time to the serving phases the same way training
+traces name ``ln``/``moe_*``/``pp_comm``.
+
+Thread model: ``submit()`` may be called from any thread (the
+``/generate`` HTTP handlers); ``step()`` — or the ``start()``-ed
+background loop — executes ticks under the engine lock.  Completion
+is signaled per request via an Event; ``stats()`` exposes the
+request-latency percentiles the Prometheus endpoint exports.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import scheduler as sched_lib
+from .scheduler import SCRATCH_PAGE
+
+# rolling window for the latency percentiles stats() reports (the
+# Prometheus gauges are point-in-time reads; an all-history scan would
+# grow every scrape O(N log N) under the engine lock)
+STATS_WINDOW = 2048
+# completed requests retained for result() pickup before the oldest
+# are evicted — bounds a long-running dtx-serve's memory under
+# fire-and-forget clients
+RETAIN_FINISHED = 4096
+
+
+def _percentile(vals: List[float], q: float) -> Optional[float]:
+    # np.percentile (linear interpolation) — the SAME definition the
+    # gated bench_serving row uses, so the dtx_generate_* gauges and
+    # serving_p99_ms agree on identical data
+    if not vals:
+        return None
+    return float(np.percentile(vals, q * 100.0))
+
+
+class _Result:
+    __slots__ = ("event", "prompt", "tokens", "arrival_t", "first_t",
+                 "finish_t", "error")
+
+    def __init__(self, prompt, arrival_t: float):
+        self.event = threading.Event()
+        self.prompt = prompt
+        self.tokens: List[int] = []
+        self.arrival_t = arrival_t
+        self.first_t: Optional[float] = None
+        self.finish_t: Optional[float] = None
+        self.error: Optional[str] = None
+
+
+class DecodeEngine:
+    """Continuous-batching decode over a paged KV cache.
+
+    ``num_pages=0`` sizes the pool for ``max_batch`` concurrent
+    worst-case (``max_len``) sequences plus the scratch page;
+    ``max_len`` (prompt + generated) defaults to — and may never
+    exceed — ``spec.seq_len`` (the positional table's reach).
+    ``donate=None`` resolves by backend (CPU implements no buffer
+    donation and warns per call)."""
+
+    def __init__(self, spec, params, page_size: int = 16,
+                 num_pages: int = 0, max_batch: int = 8,
+                 max_len: int = 0, donate: Optional[bool] = None,
+                 seed: int = 0):
+        import jax
+
+        from . import kv_cache as kvc
+
+        if spec.objective != "lm":
+            raise ValueError("the decode engine serves the lm "
+                             "objective only")
+        self.spec = spec
+        self.params = params
+        self.page_size = int(page_size)
+        self.max_len = int(max_len) or spec.seq_len
+        if self.max_len > spec.seq_len:
+            raise ValueError(
+                f"max_len={self.max_len} exceeds the positional "
+                f"table's seq_len={spec.seq_len}")
+        pages_per_seq = max(1, math.ceil((self.max_len - 1)
+                                         / self.page_size))
+        self.num_pages = int(num_pages) or 1 + max_batch * pages_per_seq
+        self.sched = sched_lib.ContinuousScheduler(
+            self.num_pages, self.page_size, max_batch)
+        self.prompt_buckets = sched_lib.shape_buckets(
+            max(1, self.max_len - 1))
+        self._heads = kvc.local_heads(spec, params)
+        self.cache = kvc.init_paged_cache(
+            spec, self.num_pages, self.page_size, heads=self._heads)
+        self._kvc = kvc
+        self._jax = jax
+        if donate is None:
+            donate = jax.default_backend() != "cpu"
+        self._donate = (1,) if donate else ()
+        self._decode_fns: Dict[Tuple[int, int], object] = {}
+        self._prefill_fns: Dict[int, object] = {}
+        self._base_key = jax.random.PRNGKey(seed)
+        self._lock = threading.RLock()
+        self._results: Dict[int, _Result] = {}
+        self._temps: Dict[int, float] = {}
+        self._last_tok: Dict[int, int] = {}
+        self._finished_order: collections.deque = collections.deque()
+        self._lat_ms: collections.deque = collections.deque(
+            maxlen=STATS_WINDOW)
+        self._ttft_ms: collections.deque = collections.deque(
+            maxlen=STATS_WINDOW)
+        self._completed = 0
+        self._failure: Optional[str] = None
+        self._next_rid = 0
+        self._tick = 0
+        self._prefills = 0
+        self._tokens_out = 0
+        self._started_t: Optional[float] = None
+        self._busy_s = 0.0
+        self.shapes_used: set = set()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._work = threading.Condition()
+
+    # ---- request surface ----
+    def submit(self, prompt, max_new_tokens: int,
+               temperature: float = 0.0) -> int:
+        """Queue a request (``prompt``: iterable of int token ids);
+        returns its rid.  Thread-safe; the background loop (or the
+        next ``step()``) picks it up."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if any(not 0 <= t < self.spec.vocab_size for t in prompt):
+            raise ValueError("prompt token outside the vocabulary")
+        if len(prompt) + int(max_new_tokens) > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_len={self.max_len}")
+        now = time.monotonic()
+        with self._lock:
+            if self._failure is not None:
+                raise RuntimeError(
+                    f"decode engine failed: {self._failure}")
+            rid = self._next_rid
+            # the scheduler may reject (page need > pool): allocate the
+            # rid only on acceptance so requests_total counts accepted
+            # requests, not attempts
+            self.sched.submit(rid, len(prompt), int(max_new_tokens),
+                              arrival=now)
+            self._next_rid += 1
+            self._results[rid] = _Result(prompt, now)
+            self._temps[rid] = float(temperature)
+        with self._work:
+            self._work.notify()
+        return rid
+
+    def result(self, rid: int, timeout: Optional[float] = None):
+        """Block until rid completes; returns
+        ``{"rid", "prompt", "tokens", "latency_ms", "ttft_ms"}``,
+        ``{"rid", "error"}`` if the engine loop died mid-request, or
+        None on timeout.  Results stay retrievable until the engine
+        has finished ``RETAIN_FINISHED`` newer requests (KeyError
+        after eviction — bounded memory for fire-and-forget
+        clients)."""
+        res = self._results[rid]
+        if not res.event.wait(timeout):
+            return None
+        if res.error is not None:
+            return {"rid": rid, "error": res.error}
+        return {
+            "rid": rid,
+            "prompt": list(res.prompt),
+            "tokens": list(res.tokens),
+            "latency_ms": round((res.finish_t - res.arrival_t) * 1e3, 3),
+            "ttft_ms": round((res.first_t - res.arrival_t) * 1e3, 3),
+        }
+
+    # ---- execution ----
+    def step(self) -> bool:
+        """Execute one scheduler tick (admissions' prefills + the
+        shared decode step).  Returns False when there was nothing to
+        do."""
+        with self._lock:
+            t0 = time.monotonic()
+            if self._started_t is None:
+                self._started_t = t0
+            plan = self.sched.plan_tick(now=t0)
+            # the engine keeps its own counters; the scheduler's
+            # finished map is the simulate() surface and would grow
+            # per request forever in a long-running server
+            self.sched.finished.clear()
+            if plan is None:
+                return False
+            for rid in plan.prefills:
+                self._run_prefill(rid)
+            decodes = [r for r in plan.decodes
+                       if not self.sched._seq(r).done]
+            if decodes:
+                self._run_decode(decodes, plan)
+            self._busy_s += time.monotonic() - t0
+            return True
+
+    def run_until_idle(self) -> int:
+        """Drive ticks until every submitted request completed;
+        returns the number of executed ticks (the bench's measured
+        loop)."""
+        n = 0
+        while True:
+            if not self.step():
+                with self._lock:
+                    if self.sched.idle:
+                        return n
+                time.sleep(0.001)
+                continue
+            n += 1
+
+    def _run_prefill(self, rid: int) -> None:
+        jnp = self._jax.numpy
+        seq = self.sched._seq(rid)
+        res = self._results[rid]
+        p = len(res.prompt)
+        pb = sched_lib.bucket_for(p, self.prompt_buckets)
+        wp = max(1, math.ceil(pb / self.page_size))
+        self.shapes_used.add(("prefill", pb, wp))
+        bt = np.full((1, wp), SCRATCH_PAGE, np.int32)
+        own = seq.pages[:wp]
+        bt[0, :len(own)] = own
+        toks = np.zeros((1, pb), np.int32)
+        toks[0, :p] = res.prompt
+        fn = self._prefill_fn(pb, wp)
+        # even/odd split keeps prefill and decode key domains disjoint
+        key = self._jax.random.fold_in(self._base_key, 2 * rid)
+        nxt, self.cache = fn(
+            self.params, self.cache, jnp.asarray(bt),
+            jnp.asarray(toks), jnp.asarray([p], jnp.int32), key,
+            jnp.asarray([self._temps[rid]], jnp.float32))
+        tok = int(np.asarray(nxt)[0])
+        now = time.monotonic()
+        res.tokens.append(tok)
+        res.first_t = now
+        self._last_tok[rid] = tok
+        self._prefills += 1
+        self._tokens_out += 1
+        self.sched.record_prefill(rid, now=now)
+        if seq.done:
+            self._finish(rid, now)
+
+    def _run_decode(self, rids: List[int], plan) -> None:
+        jnp = self._jax.numpy
+        b, w = plan.batch_bucket, plan.kv_pages
+        self.shapes_used.add(("decode", b, w))
+        bt = np.full((b, w), SCRATCH_PAGE, np.int32)
+        tok = np.zeros((b,), np.int32)
+        pos = np.zeros((b,), np.int32)
+        temp = np.zeros((b,), np.float32)
+        for i, rid in enumerate(rids):
+            seq = self.sched._seq(rid)
+            own = seq.pages[:w]
+            bt[i, :len(own)] = own
+            tok[i] = self._last_tok[rid]
+            pos[i] = seq.length - 1
+            temp[i] = self._temps[rid]
+        fn = self._decode_fn(b, w)
+        self._tick += 1
+        key = self._jax.random.fold_in(self._base_key,
+                                       2 * self._tick + 1)
+        nxt, self.cache = fn(
+            self.params, self.cache, jnp.asarray(bt),
+            jnp.asarray(tok), jnp.asarray(pos), key,
+            jnp.asarray(temp))
+        out = np.asarray(nxt)
+        now = time.monotonic()
+        for i, rid in enumerate(rids):
+            t = int(out[i])
+            self._results[rid].tokens.append(t)
+            self._last_tok[rid] = t
+            self._tokens_out += 1
+        self.sched.record_decode(rids, now=now)
+        for rid in rids:
+            if self.sched._seq(rid).done:
+                self._finish(rid, now)
+
+    def _finish(self, rid: int, now: float) -> None:
+        res = self._results[rid]
+        res.finish_t = now
+        self._completed += 1
+        self._lat_ms.append((now - res.arrival_t) * 1e3)
+        if res.first_t is not None:
+            self._ttft_ms.append((res.first_t - res.arrival_t) * 1e3)
+        # per-rid decode state is dead once the sequence finished;
+        # the result itself stays for pickup under a bounded retention
+        self._temps.pop(rid, None)
+        self._last_tok.pop(rid, None)
+        self._finished_order.append(rid)
+        while len(self._finished_order) > RETAIN_FINISHED:
+            self._results.pop(self._finished_order.popleft(), None)
+        res.event.set()
+
+    # ---- compiled-program caches (one per shape bucket) ----
+    def _prefill_fn(self, pb: int, wp: int):
+        fn = self._prefill_fns.get(pb)
+        if fn is None:
+            jax, kvc, spec = self._jax, self._kvc, self.spec
+
+            def prefill(params, cache, bt, toks, lengths, key, temp):
+                with jax.named_scope("prefill"):
+                    logits, cache = kvc.prefill_into_pages(
+                        spec, params, cache, bt, toks, lengths)
+                with jax.named_scope("sampling"):
+                    nxt = kvc.sample_tokens(logits, key, temp)
+                return nxt, cache
+
+            fn = jax.jit(prefill, donate_argnums=self._donate)
+            self._prefill_fns[pb] = fn
+        return fn
+
+    def _decode_fn(self, b: int, w: int):
+        fn = self._decode_fns.get((b, w))
+        if fn is None:
+            jax, kvc, spec = self._jax, self._kvc, self.spec
+
+            def decode(params, cache, bt, tok, pos, key, temp):
+                with jax.named_scope("decode"):
+                    logits, cache = kvc.paged_decode_step(
+                        spec, params, cache, bt, tok, pos)
+                with jax.named_scope("sampling"):
+                    nxt = kvc.sample_tokens(logits, key, temp)
+                return nxt, cache
+
+            fn = jax.jit(decode, donate_argnums=self._donate)
+            self._decode_fns[(b, w)] = fn
+        return fn
+
+    # ---- background loop (the HTTP front door's worker) ----
+    def start(self) -> None:
+        with self._work:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="dtx-decode-engine",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._work:
+            self._running = False
+            self._work.notify()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while True:
+            with self._work:
+                if not self._running:
+                    return
+            try:
+                did = self.step()
+            except Exception as e:   # noqa: BLE001 — the one thread
+                # every request depends on must not die silently
+                self._fail(e)
+                return
+            if not did:
+                with self._work:
+                    if self._running:
+                        self._work.wait(timeout=0.02)
+
+    def _fail(self, e: BaseException) -> None:
+        """A tick raised: record the failure, refuse new submits, and
+        fail every pending request NOW — blocked ``result()`` /
+        ``/generate`` callers get an error immediately instead of
+        hanging until their timeout against a dead worker."""
+        msg = f"{type(e).__name__}: {e}"
+        sys.stderr.write(f"dtx-serve: decode engine loop died: {msg}\n"
+                         f"{traceback.format_exc()}")
+        with self._lock:
+            self._failure = msg
+            for res in self._results.values():
+                if res.finish_t is None and res.error is None:
+                    res.error = msg
+                    res.event.set()
+        with self._work:
+            self._running = False
+
+    # ---- observability ----
+    def stats(self) -> dict:
+        """Point-in-time serving counters + request-latency
+        percentiles (the obs/schema.SERVING_STATS contract; the
+        Prometheus ``dtx_generate_*`` gauges read these).  Percentiles
+        cover the last ``STATS_WINDOW`` completions — a rolling
+        window, so scrape cost stays O(window) under the engine lock
+        however long the server has been up."""
+        with self._lock:
+            lats = list(self._lat_ms)
+            ttfts = list(self._ttft_ms)
+            wall = (time.monotonic() - self._started_t
+                    if self._started_t is not None else 0.0)
+            toks = self._tokens_out
+            occ = self.sched.alloc.in_use / self.sched.alloc.usable
+            return {
+                "requests_total": self._next_rid,
+                "completed_total": self._completed,
+                "inflight": len(self.sched.live),
+                "queued": len(self.sched.waiting),
+                "latency_p50_ms": _percentile(lats, 0.50),
+                "latency_p99_ms": _percentile(lats, 0.99),
+                "ttft_p50_ms": _percentile(ttfts, 0.50),
+                "tokens_generated_total": toks,
+                "tokens_per_sec": (toks / wall if wall > 0 and toks
+                                   else None),
+                "page_occupancy_frac": round(occ, 6),
+                "decode_ticks_total": self._tick,
+                "prefills_total": self._prefills,
+            }

@@ -1,0 +1,180 @@
+"""Paged/block KV cache + the paged decode/prefill programs.
+
+The contiguous decode cache (models/transformer.init_decode_cache)
+preallocates ``[B, seq_len, H, Dh]`` per block per sequence — a
+4K-context request that generates 30 tokens still owns 4K rows, and a
+batch must be torn down and re-padded whenever membership changes.
+This module reproduces vLLM's PagedAttention layout TPU-natively:
+
+- the pool: per block ``k{i}/v{i}`` arrays ``[num_pages, page_size,
+  H, Dh]`` — the ONLY cache allocation, made once;
+- the block table: ``[B, W]`` int32 page ids per sequence, W bucketed
+  to the live maximum (logical position ``j`` of row ``b`` lives at
+  page ``table[b, j // page_size]``, row ``j % page_size``);
+- page 0 is the SCRATCH page: dead batch slots and padded prefill
+  rows write there, nothing ever reads it (the allocator hands out
+  pages 1..num_pages-1).
+
+``paged_decode_step`` runs models/transformer.py's shared
+``_decode_forward`` — the identical math as the contiguous
+``decode_step``, only the cache adapter differs — so greedy decode is
+token-identical across layouts and page sizes (tests/test_serving.py
+pins it, including ragged positions and a TP-sharded cache).
+``prefill_into_pages`` runs the existing batched training forward
+(``_block_forward`` with ``kv_out`` capture) over the whole prompt at
+once and scatters the rows into the pages: prompts cost one program,
+not P sequential steps.  ``sample_tokens`` folds greedy/temperature
+sampling into the same compiled program so logits never round-trip
+to the host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..models import transformer as tfm
+from ..models.mlp import _ACTIVATIONS
+from ..ops import paged_attention as pa
+
+
+def local_heads(spec: tfm.TransformerSpec, params) -> int:
+    """The cache's head count: the LOCAL heads this shard's ``Wqkv``
+    columns hold (== spec.n_heads outside tensor parallelism)."""
+    return int(jnp.shape(params["L0_Wqkv"])[-1]) // spec.d_head
+
+
+def init_paged_cache(spec: tfm.TransformerSpec, num_pages: int,
+                     page_size: int, heads: int | None = None):
+    """The page pool: ``{k{i}/v{i}: [num_pages, page_size, H, Dh]}``
+    in the compute dtype (the cache stores the same rounded k/v the
+    training attention consumes — the contiguous cache's convention)."""
+    shape = (num_pages, page_size, heads or spec.n_heads, spec.d_head)
+    cache = {}
+    for i in range(spec.num_blocks):
+        cache[f"k{i}"] = jnp.zeros(shape, spec.compute_dtype)
+        cache[f"v{i}"] = jnp.zeros(shape, spec.compute_dtype)
+    return cache
+
+
+@dataclasses.dataclass
+class PagedKV:
+    """Cache adapter for ``transformer._decode_forward``: writes each
+    block's new row through the block table and returns the gathered
+    page view + ragged-length mask for attention.  ``pos`` is [B]
+    (per-sequence positions — THE ragged-batch difference from the
+    contiguous adapter's scalar)."""
+
+    page_size: int
+    cache: dict
+    block_table: jnp.ndarray      # [B, W] int32
+    pos: jnp.ndarray              # [B] int32
+
+    def __post_init__(self):
+        self._page_ids, self._rows = pa.page_row_index(
+            self.pos, self.block_table, self.page_size)
+        kvw = self.block_table.shape[1] * self.page_size
+        # [B, 1, S_kv], broadcast over heads in the score mask
+        self.valid = pa.length_mask(kvw, self.pos)[:, None, :]
+
+    def update(self, i: int, kk, vv):
+        k = pa.scatter_kv_rows(self.cache[f"k{i}"], self._page_ids,
+                               self._rows, kk)
+        v = pa.scatter_kv_rows(self.cache[f"v{i}"], self._page_ids,
+                               self._rows, vv)
+        self.cache[f"k{i}"], self.cache[f"v{i}"] = k, v
+        # gather AFTER the write: position pos attends to itself,
+        # exactly like the contiguous dynamic-update-then-attend
+        ck = pa.gather_kv(k, self.block_table)
+        cv = pa.gather_kv(v, self.block_table)
+        return ck, cv, self.valid
+
+
+def paged_decode_step(spec: tfm.TransformerSpec, params, cache,
+                      block_table, token, pos,
+                      model_axis: str | None = None):
+    """One decode step over the paged cache: ``token``/``pos`` [B]
+    (ragged per-sequence positions), gathers keys/values over the
+    block-table's live pages only, returns (logits [B, V], cache).
+    The math is ``transformer._decode_forward`` — shared with the
+    contiguous ``decode_step``, so the layouts cannot drift."""
+    kv = PagedKV(page_size=_page_size(cache),
+                 cache=dict(cache), block_table=block_table, pos=pos)
+    logits = tfm._decode_forward(spec, params, token, pos, kv,
+                                 model_axis=model_axis)
+    return logits, kv.cache
+
+
+def _page_size(cache) -> int:
+    return int(jnp.shape(cache["k0"])[1])
+
+
+def prefill_into_pages(spec: tfm.TransformerSpec, params, cache,
+                       block_table, tokens, lengths,
+                       model_axis: str | None = None):
+    """Prefill whole prompts with ONE batched forward: run the
+    training forward over ``tokens`` [B, P] (P = the bucketed prompt
+    width; rows past ``lengths[b]`` are pad), capture every block's
+    k/v via ``_block_forward(kv_out=...)``, scatter rows
+    ``0..lengths[b]-1`` into the pages, and return
+    (last-position logits [B, V], cache) — the logits at position
+    ``lengths[b]-1``, i.e. the first generated token's distribution.
+
+    Exactness: causal attention means pad rows never influence live
+    positions; pad k/v rows scatter into rows the decode overwrites
+    before any mask exposes them (or into the scratch page).  MoE
+    routes dense like the decode path (the shared convention)."""
+    if spec.objective != "lm":
+        raise ValueError("prefill serves the lm objective only")
+    if not spec.causal:
+        raise ValueError("prefill requires a causal spec (lm decode)")
+    params = {k: jnp.asarray(v) for k, v in params.items()}
+    # dense dispatch + dense attention: the decode path's conventions
+    # (exact MoE routing; ragged prompt widths are never tile-aligned,
+    # and the dense score math is what decode_step mirrors)
+    if spec.moe_dispatch != "dense" or spec.attention != "dense":
+        spec = dataclasses.replace(spec, moe_dispatch="dense",
+                                   attention="dense")
+    cdt = spec.compute_dtype
+    b, p = tokens.shape
+    page_size = _page_size(cache)
+    h = (params["W_emb"].astype(jnp.float32)[tokens]
+         + params["pos"].astype(jnp.float32)[None, :p])   # [B, P, D]
+    act = _ACTIVATIONS[spec.activation]
+    page_ids, rows = pa.prefill_page_rows(p, block_table, page_size)
+    cache = dict(cache)
+    for i in range(spec.num_blocks):
+        bp = {k[len(f"L{i}_"):]: v for k, v in params.items()
+              if k.startswith(f"L{i}_")}
+        kv_out: list = []
+        h, _aux = tfm._block_forward(spec, bp, h, act, cdt,
+                                     model_axis=model_axis, moe_block=i,
+                                     kv_out=kv_out)
+        (kk, vv), = kv_out                                # [B, P, Hl, Dh]
+        cache[f"k{i}"] = pa.scatter_prefill_rows(
+            cache[f"k{i}"], page_ids, rows, kk)
+        cache[f"v{i}"] = pa.scatter_prefill_rows(
+            cache[f"v{i}"], page_ids, rows, vv)
+    # head only at each prompt's LAST position: gather [B, D] then the
+    # rank-2 final LN + vocab projection (the decode sites' shape)
+    last = jnp.take_along_axis(
+        h, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    hf = tfm._ln(spec, last, params["lnf_g"], params["lnf_b"])
+    logits = tfm._mm(params, hf, "W_head", "b_head",
+                     cdt).astype(jnp.float32)
+    return logits, cache
+
+
+def sample_tokens(logits, rng, temperature):
+    """Fused sampling: greedy argmax where ``temperature[b] <= 0``,
+    categorical at ``logits / temperature[b]`` otherwise — ONE
+    program for the whole ragged batch, selected per sequence, so the
+    [B, V] logits never leave the device.  ``temperature`` [B] f32;
+    ``rng`` a single key (categorical draws independently per row)."""
+    greedy = jnp.argmax(logits, axis=-1)
+    safe = jnp.where(temperature > 0, temperature, 1.0)
+    sampled = jax.random.categorical(
+        rng, logits / safe[:, None].astype(jnp.float32), axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

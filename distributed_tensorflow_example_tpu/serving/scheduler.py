@@ -1,0 +1,391 @@
+"""Continuous-batching scheduler — pure Python, no jax import.
+
+Like parallel/pp_schedule.py, the control plane is derived entirely
+off-device: the scheduler decides, tick by tick, WHICH ragged requests
+occupy the shared decode batch and which pages they own; the engine
+(serving/engine.py) merely executes the resulting ``TickPlan`` with
+one compiled program per shape bucket.  Keeping it jax-free makes
+iteration-level scheduling (Orca) and block allocation (vLLM)
+unit-testable in tier-1 on any environment, and lets bench.py count
+decode ticks analytically — the deterministic half of the serving
+bench's evidence.
+
+Semantics:
+
+- **admission** (FIFO, arrival-gated): a waiting request joins the
+  live batch when a slot inside the largest batch bucket AND its full
+  conservative page reservation (``ceil((prompt+max_new-1)/page)``)
+  are both available — no mid-flight OOM, no preemption needed;
+- **retirement**: a sequence that produced its last token frees its
+  pages at the NEXT tick boundary, BEFORE that tick's admissions —
+  finished sequences release capacity immediately and the freed
+  pages/slot are reusable in the same tick;
+- **bucketed shapes** (the no-recompile invariant): the decode batch
+  is padded to the smallest ``batch_bucket`` >= live count, and the
+  block-table width to the smallest power-of-two page count covering
+  the longest live sequence — every (batch, width) pair the engine
+  can see comes from a finite, precomputed set, so membership churn
+  never recompiles or repads live state.
+
+``simulate`` replays a request set through a scheduler counting
+decode ticks (prefill cost is identical across policies for the same
+set), which is how the bench proves continuous batching strictly
+beats static batching on ragged lengths: a static batch decodes
+``max(len)`` ticks per group while continuous backfills retired slots
+the very tick they free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Tuple
+
+SCRATCH_PAGE = 0
+
+
+def shape_buckets(max_value: int, floor: int = 1) -> Tuple[int, ...]:
+    """Power-of-two bucket ladder ``(floor, 2*floor, ...)`` capped at
+    (and always containing) ``max_value`` — the finite shape set both
+    the batch and the block-table width draw from."""
+    if max_value < 1:
+        raise ValueError(f"max_value={max_value} must be >= 1")
+    out: List[int] = []
+    b = max(1, floor)
+    while b < max_value:
+        out.append(b)
+        b *= 2
+    out.append(max_value)
+    return tuple(out)
+
+
+def bucket_for(n: int, buckets: Tuple[int, ...]) -> int:
+    """Smallest bucket >= n (buckets sorted ascending)."""
+    for b in buckets:
+        if b >= n:
+            return b
+    raise ValueError(f"{n} exceeds the largest bucket {buckets[-1]}")
+
+
+class BlockAllocator:
+    """Free-list page allocator over a pool of ``num_pages``. Page 0
+    is reserved as the SCRATCH page (dead batch slots write there), so
+    ``usable`` = num_pages - 1.  LIFO reuse keeps the hot pages hot."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError(f"num_pages={num_pages} must be >= 2 "
+                             f"(page 0 is the reserved scratch page)")
+        if page_size < 1:
+            raise ValueError(f"page_size={page_size} must be >= 1")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._free: List[int] = list(range(num_pages - 1, SCRATCH_PAGE,
+                                           -1))
+
+    @property
+    def usable(self) -> int:
+        return self.num_pages - 1
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.usable - self.free_count
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` pages or None (all-or-nothing: a partial grant would
+        deadlock admission)."""
+        if n > len(self._free):
+            return None
+        got = [self._free.pop() for _ in range(n)]
+        return got
+
+    def free(self, pages: List[int]) -> None:
+        seen = set(self._free)
+        for p in pages:
+            if not (SCRATCH_PAGE < p < self.num_pages):
+                raise ValueError(f"freed page {p} outside the pool")
+            if p in seen:
+                raise ValueError(f"double free of page {p}")
+            seen.add(p)
+        self._free.extend(reversed(pages))
+
+
+@dataclasses.dataclass
+class SeqState:
+    """One request's scheduler-side state. Lengths only — the token
+    arrays live in the engine."""
+
+    rid: int
+    prompt_len: int
+    max_new_tokens: int
+    arrival: float = 0.0
+    pages: List[int] = dataclasses.field(default_factory=list)
+    generated: int = 0
+    finish_t: Optional[float] = None
+
+    @property
+    def length(self) -> int:
+        """Tokens known so far (prompt + generated)."""
+        return self.prompt_len + self.generated
+
+    @property
+    def done(self) -> bool:
+        return self.generated >= self.max_new_tokens
+
+
+@dataclasses.dataclass(frozen=True)
+class TickPlan:
+    """What the engine executes this tick: ``prefills`` are the rids
+    admitted at this boundary (one batched-forward prefill each),
+    ``decodes`` the rids taking a decode step, padded to
+    ``batch_bucket`` slots with the block table ``kv_pages`` pages
+    wide.  Either list may be empty (a pure-prefill or pure-decode
+    tick)."""
+
+    prefills: Tuple[int, ...]
+    decodes: Tuple[int, ...]
+    batch_bucket: int
+    kv_pages: int
+
+
+class ContinuousScheduler:
+    """Iteration-level (Orca-style) scheduler: every tick boundary
+    retires, then admits, then plans one shared decode step over the
+    live ragged batch."""
+
+    def __init__(self, num_pages: int, page_size: int, max_batch: int):
+        if max_batch < 1:
+            raise ValueError(f"max_batch={max_batch} must be >= 1")
+        self.alloc = BlockAllocator(num_pages, page_size)
+        self.page_size = page_size
+        self.max_batch = max_batch
+        self.batch_buckets = shape_buckets(max_batch)
+        # widest table a sequence can need: every usable page
+        self.kv_page_buckets = shape_buckets(self.alloc.usable)
+        self.waiting: List[SeqState] = []
+        self.live: List[SeqState] = []
+        self.finished: Dict[int, SeqState] = {}
+        self.ticks = 0
+        self.decode_slots = 0       # slot-ticks executed (live work)
+        self.occupancy_samples: List[float] = []
+
+    # ---- request surface ----
+    def submit(self, rid: int, prompt_len: int, max_new_tokens: int,
+               arrival: float = 0.0) -> None:
+        if prompt_len < 1 or max_new_tokens < 1:
+            raise ValueError("prompt_len and max_new_tokens must be "
+                             ">= 1")
+        need = self._pages_for(prompt_len, max_new_tokens)
+        if need > self.alloc.usable:
+            raise ValueError(
+                f"request {rid} needs {need} pages; the pool only has "
+                f"{self.alloc.usable} usable")
+        self.waiting.append(SeqState(rid, prompt_len, max_new_tokens,
+                                     arrival=arrival))
+
+    def _pages_for(self, prompt_len: int, max_new: int) -> int:
+        # rows written run 0 .. prompt+max_new-2: the final token is
+        # emitted by writing row total-2, so it never needs its own row
+        return max(1, math.ceil((prompt_len + max_new - 1)
+                                / self.page_size))
+
+    # ---- tick boundary ----
+    def plan_tick(self, now: float = float("inf")) -> Optional[TickPlan]:
+        """Retire finished sequences (freeing their pages), admit
+        arrived waiters while slots and pages last, and return the
+        tick's plan — None when nothing is live or admissible (the
+        engine idles).  ``now``: admission considers requests with
+        ``arrival <= now`` only (tick-count clock in simulation, wall
+        clock live)."""
+        # 1) retire: pages return BEFORE admission looks at the pool
+        for s in [s for s in self.live if s.done]:
+            self.live.remove(s)
+            self.alloc.free(s.pages)
+            s.pages = []
+            self.finished[s.rid] = s
+        # 2) admit FIFO among the arrived
+        prefills: List[int] = []
+        for s in list(self.waiting):
+            if s.arrival > now or len(self.live) >= self.max_batch:
+                continue
+            pages = self.alloc.alloc(
+                self._pages_for(s.prompt_len, s.max_new_tokens))
+            if pages is None:
+                # head-of-line blocks on pages: smaller requests behind
+                # it must not starve it forever — stop admitting
+                break
+            s.pages = pages
+            self.waiting.remove(s)
+            self.live.append(s)
+            prefills.append(s.rid)
+        if not self.live:
+            return None
+        decodes = [s.rid for s in self.live if not s.done]
+        # block-table width covers only the rows this tick can touch
+        # (decode at pos = projected_length - 1): LIVE blocks, not the
+        # full reservation — the paged gather's whole point.  A
+        # max_new_tokens=1 prefill finishes WITHOUT a same-tick decode
+        # (the engine filters done rids), so it projects no extra row —
+        # the +1 would otherwise overflow the reservation (and the
+        # width ladder) when the prompt fills its last page
+        prefset = set(prefills)
+        rows = max(s.length
+                   + (1 if s.rid in prefset and s.max_new_tokens > 1
+                      else 0)
+                   for s in self.live)
+        width = max(1, math.ceil(rows / self.page_size))
+        plan = TickPlan(
+            prefills=tuple(prefills),
+            decodes=tuple(decodes),
+            batch_bucket=bucket_for(len(decodes) or 1,
+                                    self.batch_buckets),
+            kv_pages=bucket_for(width, self.kv_page_buckets),
+        )
+        self.ticks += 1
+        self.decode_slots += len(decodes)
+        self.occupancy_samples.append(
+            self.alloc.in_use / self.alloc.usable)
+        return plan
+
+    def record_prefill(self, rid: int, now: float = 0.0) -> None:
+        """A prefill produced the request's FIRST generated token."""
+        self._seq(rid).generated += 1
+        self._maybe_finish(rid, now)
+
+    def record_decode(self, rids, now: float = 0.0) -> None:
+        """One decode tick produced one token for each rid."""
+        for rid in rids:
+            self._seq(rid).generated += 1
+            self._maybe_finish(rid, now)
+
+    def _maybe_finish(self, rid: int, now: float) -> None:
+        s = self._seq(rid)
+        if s.done and s.finish_t is None:
+            s.finish_t = now
+
+    def _seq(self, rid: int) -> SeqState:
+        for s in self.live:
+            if s.rid == rid:
+                return s
+        raise KeyError(f"rid {rid} is not live")
+
+    @property
+    def idle(self) -> bool:
+        return not self.live and not self.waiting
+
+    def occupancy(self) -> float:
+        """Mean cache-page occupancy over the ticks planned so far."""
+        if not self.occupancy_samples:
+            return 0.0
+        return sum(self.occupancy_samples) / len(self.occupancy_samples)
+
+
+class StaticBatchScheduler(ContinuousScheduler):
+    """The baseline policy: admit in groups of up to ``max_batch`` and
+    hold the group until EVERY member finishes (classic offline
+    batching — what ``generate_dp`` does today).  Same allocator, same
+    plan surface, so ``simulate`` compares the two policies on the
+    identical request set."""
+
+    def plan_tick(self, now: float = float("inf")) -> Optional[TickPlan]:
+        # retire pages as sequences finish (memory is freed either
+        # way; the STATIC restriction is about slots, not pages)
+        for s in [s for s in self.live if s.done and s.pages]:
+            self.alloc.free(s.pages)
+            s.pages = []
+        if self.live and all(s.done for s in self.live):
+            for s in self.live:
+                self.finished[s.rid] = s
+            self.live = []
+        prefills: List[int] = []
+        if not self.live:
+            # next group: fill up to max_batch from the arrived queue
+            for s in list(self.waiting):
+                if s.arrival > now or len(self.live) >= self.max_batch:
+                    continue
+                pages = self.alloc.alloc(
+                    self._pages_for(s.prompt_len, s.max_new_tokens))
+                if pages is None:
+                    break
+                s.pages = pages
+                self.waiting.remove(s)
+                self.live.append(s)
+                prefills.append(s.rid)
+        if not self.live:
+            return None
+        decodes = [s.rid for s in self.live if not s.done]
+        if not decodes and not prefills:
+            return None
+        prefset = set(prefills)
+        rows = max(s.length
+                   + (1 if s.rid in prefset and s.max_new_tokens > 1
+                      else 0)
+                   for s in self.live if not s.done)
+        width = max(1, math.ceil(rows / self.page_size))
+        plan = TickPlan(
+            prefills=tuple(prefills), decodes=tuple(decodes),
+            # static batching pads every tick to the FULL group bucket:
+            # finished members keep their slot until the group retires
+            batch_bucket=bucket_for(max(len(self.live), 1),
+                                    self.batch_buckets),
+            kv_pages=bucket_for(max(width, 1), self.kv_page_buckets),
+        )
+        self.ticks += 1
+        self.decode_slots += len(decodes)
+        self.occupancy_samples.append(
+            self.alloc.in_use / self.alloc.usable)
+        return plan
+
+
+@dataclasses.dataclass(frozen=True)
+class SimResult:
+    """Deterministic tick-count accounting for one policy over one
+    request set (latencies in TICKS — the analytic, gateable number;
+    the engine measures wall-clock on top)."""
+
+    decode_ticks: int
+    total_ticks: int
+    finish_ticks: Dict[int, float]
+    latency_ticks: Dict[int, float]
+    occupancy: float
+    shapes: Tuple[Tuple[int, int], ...]   # (batch_bucket, kv_pages) seen
+
+
+def simulate(scheduler: ContinuousScheduler,
+             requests) -> SimResult:
+    """Drive ``scheduler`` over ``requests`` (iterable of
+    ``(rid, prompt_len, max_new_tokens[, arrival])``) counting ticks:
+    each planned tick costs 1 (its prefills + the shared decode step),
+    matching the engine's execution shape.  Pure Python — the bench's
+    continuous-vs-static comparison and the tier-1 scheduler tests
+    run this without jax."""
+    for req in requests:
+        scheduler.submit(*req)
+    t = 0.0
+    shapes = set()
+    guard = 0
+    while not scheduler.idle:
+        plan = scheduler.plan_tick(now=t)
+        t += 1.0
+        if plan is None:
+            continue
+        shapes.add((plan.batch_bucket, plan.kv_pages))
+        for rid in plan.prefills:
+            scheduler.record_prefill(rid, now=t)
+        scheduler.record_decode(
+            [r for r in plan.decodes
+             if not scheduler._seq(r).done], now=t)
+        guard += 1
+        if guard > 10_000_000:
+            raise RuntimeError("simulation did not converge")
+    finish = {rid: s.finish_t for rid, s in scheduler.finished.items()}
+    latency = {rid: s.finish_t - s.arrival
+               for rid, s in scheduler.finished.items()}
+    return SimResult(
+        decode_ticks=scheduler.ticks, total_ticks=int(t),
+        finish_ticks=finish, latency_ticks=latency,
+        occupancy=scheduler.occupancy(), shapes=tuple(sorted(shapes)))

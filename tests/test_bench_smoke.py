@@ -52,10 +52,34 @@ def _stub_rows(monkeypatch):
                  "bench_pallas_parity", "bench_flash_attention",
                  "bench_ring_flash", "bench_transformer",
                  "bench_pipeline_bubble",
-                 "bench_moe_dispatch", "bench_lm", "bench_decode"):
+                 "bench_moe_dispatch", "bench_lm"):
         monkeypatch.setattr(
             bench, name,
             lambda *a, _n=name, **kw: {"config": _n})
+    # the decode row (r9): tok/s plus the HBM roofline — main() must
+    # carry decode_hbm_frac onto the final line under its gate name
+    monkeypatch.setattr(
+        bench, "bench_decode",
+        lambda *a, **kw: {"config": "decode_throughput",
+                          "tokens_per_sec": 26900.0,
+                          "decode_step_ms": 1.19,
+                          "decode_bytes_per_step": 3.2e8,
+                          "decode_achieved_gbps": 270.0,
+                          "decode_hbm_frac": 0.33})
+    # the serving row (r9) runs on EVERY backend: analytic
+    # continuous-vs-static tick accounting + the measured engine sweep
+    monkeypatch.setattr(
+        bench, "bench_serving",
+        lambda *a, **kw: {"config": "serving",
+                          "continuous_ticks": 53,
+                          "static_ticks": 85,
+                          "tick_speedup_continuous_vs_static": 1.604,
+                          "continuous_beats_static": True,
+                          "cache_occupancy_frac": 0.35,
+                          "serving_p50_ms": 109.3,
+                          "serving_p99_ms": 214.2,
+                          "serving_tok_s": 950.1,
+                          "serving_requests": 24})
     # the pp_memory row runs on EVERY backend (r8 bubble bench): its
     # analytic bubble-fraction keys must reach the final line as
     # pp_bubble_frac_* so --gate can hold the schedule
@@ -122,6 +146,12 @@ def test_bench_main_cpu_stubbed(monkeypatch, capsys):
     assert final["pp_bubble_frac_1f1b"] == 0.1579
     assert final["pp_bubble_frac_interleaved_v2"] == 0.0857
     assert final["pp_bubble_frac_interleaved_v4"] == 0.0448
+    # the r9 serving carriage (every backend): the gate keys + the
+    # analytic continuous-vs-static evidence reach the final line
+    assert final["serving_p99_ms"] == 214.2
+    assert final["serving_tok_s"] == 950.1
+    assert final["serving_tick_speedup"] == 1.604
+    assert final["serving_continuous_beats_static"] is True
 
 
 def test_bench_main_all_configs_stubbed(monkeypatch, capsys):
@@ -166,6 +196,12 @@ def test_bench_main_tpu_rows_no_guarded_collision(monkeypatch, capsys):
     assert final["moe_wide_mfu"] == 0.36
     assert final["moe_dispatch_ms"] == 12.5
     assert final["moe_expert_ms"] == 40.0
+    # the r9 decode-roofline carriage (TPU row): achieved-vs-peak HBM
+    # bytes/s reaches the final line under its gate name
+    assert final["decode_tokens_per_sec"] == 26900.0
+    assert final["decode_hbm_frac"] == 0.33
+    assert final["decode_achieved_gbps"] == 270.0
+    assert final["serving_p99_ms"] == 214.2
 
 
 def test_guarded_isolates_row_failures(monkeypatch, capsys):

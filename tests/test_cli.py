@@ -76,6 +76,33 @@ def test_input_pipeline_flags():
             parse_config(bad)
 
 
+def test_serving_flags():
+    """r9 serving knobs parse onto their Config fields; the two
+    ladder-shaping sizes (--decode_page_size / --decode_max_batch)
+    reject values below 1 at the CLI (the _depth type), and
+    --decode_pages rejects 1 and negatives (0 = auto, else >= 2:
+    page 0 is the reserved scratch page)."""
+    import pytest
+
+    cfg = parse_config(["--serve_port=8000", "--decode_page_size=32",
+                        "--decode_pages=129", "--decode_max_batch=16"])
+    assert cfg.serve_port == 8000
+    assert cfg.decode_page_size == 32
+    assert cfg.decode_pages == 129
+    assert cfg.decode_max_batch == 16
+    d = parse_config([])
+    assert d.serve_port == 0          # training ignores serving
+    assert d.decode_page_size == 16
+    assert d.decode_pages == 0        # auto-sized pool
+    assert d.decode_max_batch == 8
+    for bad in (["--decode_page_size=0"], ["--decode_max_batch=0"],
+                ["--decode_max_batch=-2"], ["--decode_pages=1"],
+                ["--decode_pages=-5"]):
+        with pytest.raises(SystemExit):
+            parse_config(bad)
+    assert parse_config(["--decode_pages=2"]).decode_pages == 2
+
+
 def test_fused_kernel_flags():
     """--fused_ln / --grouped_moe parse onto their Config fields and
     default off (the reference paths stay the default — the kernels

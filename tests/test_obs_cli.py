@@ -303,6 +303,42 @@ def test_compare_understands_fused_kernel_keys():
     assert "transformer_wide_mfu" in verdict["regressions"]
 
 
+def test_compare_understands_serving_keys():
+    """The serving row + decode roofline (ISSUE 9): the bench_serving
+    row gates on p99 latency and aggregate tok/s, and the final
+    summary carries those plus decode_hbm_frac under their gate names
+    — WITHOUT the serving keys hijacking the summary's other metrics
+    (the row branch keys on continuous_ticks, which only the row
+    has)."""
+    row = {"config": "serving", "continuous_ticks": 53,
+           "static_ticks": 85, "continuous_beats_static": True,
+           "serving_p50_ms": 109.3, "serving_p99_ms": 214.2,
+           "serving_tok_s": 950.1}
+    m = cmp_lib.extract_metrics(row)
+    assert m["serving_p99_ms"] == 214.2
+    assert m["serving_tok_s"] == 950.1
+    worse = dict(row, serving_p99_ms=300.0, serving_tok_s=600.0)
+    verdict = cmp_lib.compare(row, worse)
+    assert not verdict["ok"]
+    assert "serving_p99_ms" in verdict["regressions"]
+    assert "serving_tok_s" in verdict["regressions"]
+    # final-summary shape: serving keys ride ALONGSIDE wall_s/mfu —
+    # the summary must not be mistaken for a serving row
+    summary = {"metric": "mnist_20epoch_wall_clock", "value": 0.15,
+               "serving_p99_ms": 214.2, "serving_tok_s": 950.1,
+               "decode_hbm_frac": 0.33}
+    ms = cmp_lib.extract_metrics(summary)
+    assert ms["wall_s"] == 0.15
+    assert ms["serving_p99_ms"] == 214.2
+    assert ms["serving_tok_s"] == 950.1
+    assert ms["decode_hbm_frac"] == 0.33
+    # a doctored hbm_frac regression gates off the summary
+    verdict = cmp_lib.compare(summary, dict(summary,
+                                            decode_hbm_frac=0.20))
+    assert not verdict["ok"]
+    assert "decode_hbm_frac" in verdict["regressions"]
+
+
 def test_compare_zero_baseline_stays_strict_json():
     """A zero baseline metric must not fabricate Infinity (non-strict
     JSON) nor gate: it reads as 'incomparable'."""

@@ -1,0 +1,677 @@
+"""Decode engine v2 (ISSUE 9): paged KV cache + continuous batching.
+
+Two halves, mirroring the serving stack's own split:
+
+- **scheduler** (pure Python, no jax anywhere in the process): block
+  allocator discipline, admission/retirement ordering, the
+  no-recompile bucket invariant, and the continuous-vs-static tick
+  accounting the bench gates on;
+- **engine/kv_cache** (CPU jax): paged==contiguous greedy bit-parity
+  across page sizes — including ragged lengths and mid-flight
+  admission churn — prefill-vs-stepwise consistency, fused sampling,
+  the donated contiguous step, the stats schema, and the ``/generate``
+  HTTP front door.
+
+The TP-sharded cache parity test rides the mesh and skips on
+environments whose jax predates the repo's API (the PR-5/7 precedent).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from conftest import needs_stack  # noqa: E402
+
+from distributed_tensorflow_example_tpu.serving import (  # noqa: E402
+    scheduler as sl,
+)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from distributed_tensorflow_example_tpu.models import (  # noqa: E402
+    transformer as tfm,
+)
+from distributed_tensorflow_example_tpu.serving import (  # noqa: E402
+    kv_cache as kvc,
+)
+from distributed_tensorflow_example_tpu.serving.engine import (  # noqa: E402
+    DecodeEngine,
+)
+
+
+# --- pure-Python scheduler -----------------------------------------------
+
+
+def test_scheduler_import_is_pure_python():
+    """The scheduler (and the package __init__ resolving it) imports
+    with NO jax in the process — what keeps the tier-1 scheduler tests
+    and bench_serving's analytic half runnable everywhere."""
+    code = (
+        "import sys\n"
+        "from distributed_tensorflow_example_tpu.serving import "
+        "scheduler as sl\n"
+        "from distributed_tensorflow_example_tpu import serving\n"
+        "r = sl.simulate(serving.ContinuousScheduler(9, 4, 2),"
+        " [(0, 3, 2), (1, 5, 4)])\n"
+        "assert r.decode_ticks > 0\n"
+        "assert 'jax' not in sys.modules, 'scheduler pulled in jax'\n"
+    )
+    subprocess.run([sys.executable, "-c", code], check=True, cwd=_REPO)
+
+
+def test_shape_buckets_ladder():
+    assert sl.shape_buckets(1) == (1,)
+    assert sl.shape_buckets(8) == (1, 2, 4, 8)
+    assert sl.shape_buckets(6) == (1, 2, 4, 6)       # cap always present
+    assert sl.shape_buckets(8, floor=2) == (2, 4, 8)
+    with pytest.raises(ValueError):
+        sl.shape_buckets(0)
+
+
+def test_bucket_for_picks_smallest_cover():
+    buckets = sl.shape_buckets(8)
+    assert sl.bucket_for(1, buckets) == 1
+    assert sl.bucket_for(3, buckets) == 4
+    assert sl.bucket_for(8, buckets) == 8
+    with pytest.raises(ValueError):
+        sl.bucket_for(9, buckets)
+
+
+def test_block_allocator_discipline():
+    a = sl.BlockAllocator(num_pages=6, page_size=4)
+    assert a.usable == 5 and a.free_count == 5 and a.in_use == 0
+    got = a.alloc(3)
+    assert got is not None and len(got) == 3
+    assert all(sl.SCRATCH_PAGE < p < 6 for p in got)   # scratch reserved
+    assert a.in_use == 3
+    # all-or-nothing: a partial grant would deadlock admission
+    assert a.alloc(3) is None
+    assert a.free_count == 2                           # nothing leaked
+    a.free(got)
+    assert a.free_count == 5
+    # LIFO reuse keeps hot pages hot
+    assert a.alloc(1) == [got[0]]
+    with pytest.raises(ValueError):                    # double free
+        a.free([got[0], got[0]])
+    with pytest.raises(ValueError):                    # outside the pool
+        a.free([sl.SCRATCH_PAGE])
+    with pytest.raises(ValueError):
+        sl.BlockAllocator(num_pages=1, page_size=4)
+    with pytest.raises(ValueError):
+        sl.BlockAllocator(num_pages=4, page_size=0)
+
+
+def test_submit_validation():
+    s = sl.ContinuousScheduler(num_pages=5, page_size=4, max_batch=2)
+    with pytest.raises(ValueError):
+        s.submit(0, prompt_len=0, max_new_tokens=1)
+    with pytest.raises(ValueError):
+        s.submit(0, prompt_len=4, max_new_tokens=0)
+    with pytest.raises(ValueError):                    # pool can't ever fit
+        s.submit(0, prompt_len=30, max_new_tokens=4)
+    with pytest.raises(ValueError):
+        sl.ContinuousScheduler(5, 4, max_batch=0)
+
+
+def test_retirement_frees_pages_before_admission():
+    """A finishing sequence's pages return at the NEXT tick boundary
+    BEFORE that tick's admissions, so a waiter blocked on pages is
+    admitted the very tick the pages free."""
+    # pool: 4 usable pages; each request needs ceil((4+4-1)/4)=2 pages
+    s = sl.ContinuousScheduler(num_pages=5, page_size=4, max_batch=4)
+    s.submit(0, 4, 4)
+    s.submit(1, 4, 4)
+    s.submit(2, 4, 4)                     # blocked: 0 pages left
+    plan = s.plan_tick()
+    assert plan.prefills == (0, 1)
+    assert s.alloc.free_count == 0
+    assert [x.rid for x in s.waiting] == [2]
+    # run 0 to completion, keep 1 alive
+    s.record_prefill(0)
+    s.record_prefill(1)
+    s.record_decode([0, 1])
+    s.record_decode([0, 1])
+    s.record_decode([0])                  # rid 0 done (4 tokens)
+    assert s._seq(0).done and not s._seq(1).done
+    plan = s.plan_tick()                  # retire 0 -> admit 2, same tick
+    assert plan.prefills == (2,)
+    assert 0 not in plan.decodes and 0 in s.finished
+    assert sorted(plan.decodes) == [1, 2]
+
+
+def test_fifo_head_of_line_blocks_admission():
+    """When the FIFO head cannot get its pages, later (smaller)
+    requests must NOT jump it — admission stops so the head cannot
+    starve forever."""
+    s = sl.ContinuousScheduler(num_pages=5, page_size=4, max_batch=4)
+    s.submit(0, 4, 4)                     # takes 2 of 4 pages
+    s.submit(1, 8, 5)                     # needs 3: blocked
+    s.submit(2, 2, 2)                     # would fit (1 page) but waits
+    plan = s.plan_tick()
+    assert plan.prefills == (0,)
+    assert [x.rid for x in s.waiting] == [1, 2]
+
+
+def test_arrival_gating():
+    s = sl.ContinuousScheduler(num_pages=9, page_size=4, max_batch=4)
+    s.submit(0, 4, 2, arrival=0.0)
+    s.submit(1, 4, 2, arrival=5.0)
+    plan = s.plan_tick(now=1.0)
+    assert plan.prefills == (0,)          # rid 1 hasn't arrived
+    plan = s.plan_tick(now=5.0)
+    assert plan.prefills == (1,)
+
+
+def test_no_recompile_bucket_invariant():
+    """Every TickPlan shape the scheduler can emit comes from the
+    finite precomputed (batch bucket, page-width bucket) set — the
+    invariant that keeps membership churn from ever recompiling."""
+    s = sl.ContinuousScheduler(num_pages=17, page_size=4, max_batch=6)
+    rng = np.random.RandomState(0)
+    reqs = [(i, int(rng.randint(1, 12)), int(rng.randint(1, 9)),
+             float(i) * 0.7) for i in range(40)]
+    res = sl.simulate(s, reqs)
+    allowed = {(b, w) for b in s.batch_buckets
+               for w in s.kv_page_buckets}
+    assert set(res.shapes) <= allowed
+    # the ladder is tiny against the raw (batch x width) churn
+    assert len(res.shapes) <= len(s.batch_buckets) * 4
+    assert set(res.finish_ticks) == {r[0] for r in reqs}  # all served
+
+
+def test_continuous_strictly_beats_static_on_ragged():
+    """THE acceptance invariant (deterministic, every backend):
+    continuous batching backfills retired slots the tick they free, so
+    on ragged lengths with more requests than slots it finishes the
+    same request set in strictly fewer decode ticks than the static
+    baseline."""
+    rng = np.random.RandomState(3)
+    reqs = [(i, int(rng.randint(2, 20)), int(rng.randint(2, 16)))
+            for i in range(24)]
+    cont = sl.simulate(sl.ContinuousScheduler(33, 4, 4), reqs)
+    stat = sl.simulate(sl.StaticBatchScheduler(33, 4, 4), reqs)
+    assert set(cont.finish_ticks) == set(stat.finish_ticks)
+    assert cont.decode_ticks < stat.decode_ticks
+    assert 0.0 < cont.occupancy <= 1.0
+    # and the per-request latencies are well-formed
+    assert all(v > 0 for v in cont.latency_ticks.values())
+
+
+def test_page_filling_prompt_with_one_new_token():
+    """A max_new_tokens=1 request whose prompt fills its last reserved
+    page must plan cleanly: the prefill finishes WITHOUT a same-tick
+    decode, so plan_tick projects no extra row — the old +1 pushed the
+    width past the reservation (and past the kv_page_buckets ladder
+    when the pool is exactly one sequence wide), crashing plan_tick
+    for a validly admitted request."""
+    for scheduler_cls in (sl.ContinuousScheduler,
+                          sl.StaticBatchScheduler):
+        s = scheduler_cls(num_pages=2, page_size=4, max_batch=1)
+        s.submit(0, prompt_len=4, max_new_tokens=1)
+        plan = s.plan_tick()
+        assert plan.prefills == (0,)
+        assert plan.kv_pages == 1            # within the 1-page ladder
+        s.record_prefill(0)
+        assert s._seq(0).done                # finished by the prefill
+    # a >1 max_new request still projects the same-tick decode row
+    s2 = sl.ContinuousScheduler(num_pages=3, page_size=4, max_batch=1)
+    s2.submit(1, prompt_len=4, max_new_tokens=2)
+    plan2 = s2.plan_tick()
+    assert plan2.kv_pages == 2               # rows = prompt + 1
+
+
+def test_uniform_single_group_policies_tie():
+    """With one group of uniform requests there is nothing to
+    backfill: both policies must plan the identical tick count (the
+    continuous win is ragged-lengths churn, not magic)."""
+    reqs = [(i, 4, 6) for i in range(4)]
+    cont = sl.simulate(sl.ContinuousScheduler(17, 4, 4), reqs)
+    stat = sl.simulate(sl.StaticBatchScheduler(17, 4, 4), reqs)
+    assert cont.decode_ticks == stat.decode_ticks
+
+
+def test_static_holds_slots_until_group_retires():
+    """The static baseline keeps finished members' slots (its defining
+    waste): the batch bucket stays at the group size while stragglers
+    run, and no admission happens mid-group."""
+    s = sl.StaticBatchScheduler(num_pages=17, page_size=4, max_batch=2)
+    s.submit(0, 2, 2)
+    s.submit(1, 2, 6)
+    s.submit(2, 2, 2)
+    plan = s.plan_tick()
+    assert plan.prefills == (0, 1)
+    s.record_prefill(0)
+    s.record_prefill(1)
+    while True:
+        plan = s.plan_tick()
+        if plan is None or 0 in s.finished and 1 in s.finished:
+            break
+        # rid 2 never joins mid-group, the bucket stays group-sized
+        assert plan.prefills == ()
+        assert plan.batch_bucket == 2
+        s.record_decode(list(plan.decodes))
+    assert 2 not in s.finished
+
+
+# --- kv_cache / engine (CPU jax) -----------------------------------------
+
+
+def _spec(**kw):
+    base = dict(input_size=32, num_classes=10, seq_len=32, d_model=32,
+                n_heads=2, num_blocks=2, d_ff=64, objective="lm",
+                vocab_size=50, causal=True)
+    base.update(kw)
+    return tfm.TransformerSpec(**base)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    spec = _spec()
+    return spec, tfm.init(jax.random.PRNGKey(0), spec)
+
+
+@pytest.mark.parametrize("page_size", [4, 8, 16])
+def test_paged_engine_matches_contiguous_generate(lm, page_size):
+    """THE parity acceptance test: greedy decode through the full
+    serving stack (prefill -> paged cache -> continuous-batching
+    decode with fused sampling) is token-identical to the contiguous
+    ``generate`` path, across page sizes, ragged prompt lengths, and
+    mid-flight admission churn (6 requests through 3 slots)."""
+    spec, params = lm
+    rng = np.random.RandomState(1)
+    lens = (3, 7, 5, 11, 2, 8)
+    prompts = [rng.randint(0, 50, size=n).tolist() for n in lens]
+    n_new = 6
+    refs = []
+    for p in prompts:
+        out = tfm.generate(spec, params, jnp.asarray([p], jnp.int32))
+        refs.append(np.asarray(out)[0, len(p):len(p) + n_new].tolist())
+    eng = DecodeEngine(spec, params, page_size=page_size, max_batch=3)
+    rids = [eng.submit(p, n_new) for p in prompts]
+    ticks = eng.run_until_idle()
+    assert ticks > 0
+    for rid, ref, p in zip(rids, refs, prompts):
+        res = eng.result(rid, timeout=10.0)
+        assert res is not None
+        assert res["tokens"] == ref
+        assert res["prompt"] == p
+        assert res["latency_ms"] >= res["ttft_ms"] >= 0.0
+
+
+def test_paged_decode_step_bit_parity(lm):
+    """paged_decode_step == contiguous decode_step BITWISE on the
+    same batch (the two paths share ``_decode_forward``; only the
+    cache adapter differs), chained over several positions and both
+    page sizes straddling the position count."""
+    spec, params = lm
+    b, steps = 3, 9
+    rng = np.random.RandomState(2)
+    toks = rng.randint(0, 50, size=(steps, b)).astype(np.int32)
+    for page_size in (4, 16):
+        dense = tfm.init_decode_cache(spec, b)
+        npages = 1 + b * (steps // page_size + 1)
+        paged = kvc.init_paged_cache(spec, npages, page_size)
+        # per-sequence page chains: seq i owns pages i*k+1 ...
+        per = steps // page_size + 1
+        bt = jnp.asarray([[1 + i * per + j for j in range(per)]
+                          for i in range(b)], jnp.int32)
+        for pos in range(steps):
+            ld, dense = tfm.decode_step(spec, params, dense,
+                                        jnp.asarray(toks[pos]), pos)
+            lp, paged = kvc.paged_decode_step(
+                spec, params, paged, bt, jnp.asarray(toks[pos]),
+                jnp.full((b,), pos, jnp.int32))
+            np.testing.assert_array_equal(np.asarray(ld),
+                                          np.asarray(lp))
+
+
+def test_paged_decode_ragged_matches_per_sequence(lm):
+    """One ragged paged batch (different positions per row) produces
+    the same greedy tokens as each sequence decoded alone through the
+    contiguous path — the padding-free claim."""
+    spec, params = lm
+    rng = np.random.RandomState(3)
+    page_size, b = 4, 3
+    hist = [rng.randint(0, 50, size=n).astype(np.int32)
+            for n in (2, 5, 3)]
+    # contiguous per-sequence references: feed the history, then the
+    # greedy continuation's next token
+    want = []
+    for h in hist:
+        cache = tfm.init_decode_cache(spec, 1)
+        for pos, t in enumerate(h):
+            logits, cache = tfm.decode_step(
+                spec, params, cache, jnp.asarray([t], jnp.int32), pos)
+        want.append(int(np.argmax(np.asarray(logits)[0])))
+    # paged ragged batch: replay the same histories through one pool
+    paged = kvc.init_paged_cache(spec, 10, page_size)
+    bt = jnp.asarray([[1, 2], [3, 4], [5, 6]], jnp.int32)
+    maxlen = max(len(h) for h in hist)
+    got_last = [None] * b
+    for step in range(maxlen):
+        rows = [i for i in range(b) if step < len(hist[i])]
+        tok = np.zeros((b,), np.int32)
+        pos = np.zeros((b,), np.int32)
+        for i in rows:
+            tok[i] = hist[i][step]
+            pos[i] = step
+        # dead rows re-write their row-0 scratch position; their
+        # logits are ignored — the engine's dead-slot convention
+        logits, paged = kvc.paged_decode_step(
+            spec, params, paged, bt, jnp.asarray(tok),
+            jnp.asarray(pos))
+        for i in rows:
+            if step == len(hist[i]) - 1:
+                got_last[i] = int(np.argmax(np.asarray(logits)[i]))
+    assert got_last == want
+
+
+def test_prefill_matches_stepwise_decode(lm):
+    """prefill_into_pages (ONE batched forward scattered into pages)
+    agrees with token-by-token contiguous decoding of the same
+    prompts: same next-token argmax, logits equal to float tolerance
+    (batched attention sums in a different order), and the paged rows
+    it wrote support bit-identical continuation."""
+    spec, params = lm
+    rng = np.random.RandomState(4)
+    page_size = 4
+    lens = (3, 6)
+    prompts = [rng.randint(0, 50, size=n).astype(np.int32)
+               for n in lens]
+    pb = 8
+    toks = np.zeros((2, pb), np.int32)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p)] = p
+    paged = kvc.init_paged_cache(spec, 7, page_size)
+    bt = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    logits, paged = kvc.prefill_into_pages(
+        spec, params, paged, bt, jnp.asarray(toks),
+        jnp.asarray(lens, jnp.int32))
+    for i, p in enumerate(prompts):
+        cache = tfm.init_decode_cache(spec, 1)
+        for pos, t in enumerate(p):
+            ref, cache = tfm.decode_step(
+                spec, params, cache, jnp.asarray([t], jnp.int32), pos)
+        ref = np.asarray(ref)[0]
+        got = np.asarray(logits)[i]
+        assert int(np.argmax(got)) == int(np.argmax(ref))
+        np.testing.assert_allclose(got, ref, atol=1e-4)
+
+
+def test_sample_tokens_fused_selection():
+    """Greedy rows take the argmax, temperature rows draw from the
+    scaled categorical — selected PER ROW in one program, and
+    deterministic per key."""
+    rng = np.random.RandomState(5)
+    logits = jnp.asarray(rng.randn(6, 64).astype(np.float32))
+    temp = jnp.asarray([0.0, 0.0, 1.0, 0.7, 0.0, 1.3], jnp.float32)
+    key = jax.random.PRNGKey(0)
+    out1 = np.asarray(kvc.sample_tokens(logits, key, temp))
+    out2 = np.asarray(kvc.sample_tokens(logits, key, temp))
+    np.testing.assert_array_equal(out1, out2)
+    greedy = np.argmax(np.asarray(logits), axis=-1)
+    np.testing.assert_array_equal(out1[temp == 0.0],
+                                  greedy[temp == 0.0])
+    # all-greedy temperature ignores the key entirely
+    out3 = np.asarray(kvc.sample_tokens(
+        logits, jax.random.PRNGKey(9), jnp.zeros((6,), jnp.float32)))
+    np.testing.assert_array_equal(out3, greedy)
+    # a different key re-draws the sampled rows (64-way flat logits:
+    # 3 rows all colliding is ~1e-5)
+    out4 = np.asarray(kvc.sample_tokens(
+        logits, jax.random.PRNGKey(9), temp))
+    assert (out4[temp > 0] != out1[temp > 0]).any()
+
+
+def test_decode_step_fn_matches_decode_step(lm):
+    """The donated-buffer compiled step (the no-copy satellite) is
+    bit-identical to the plain decode_step, and the lru cache hands
+    back the same program for the same (spec, axis, donate)."""
+    spec, params = lm
+    fn = tfm.decode_step_fn(spec, donate=False)
+    assert tfm.decode_step_fn(spec, donate=False) is fn
+    # compiled reference WITHOUT donation: the comparison isolates the
+    # donation plumbing (eager-vs-jit would differ in fusion noise)
+    ref = jax.jit(lambda p, c, t, pos: tfm.decode_step(spec, p, c, t,
+                                                       pos))
+    cache = tfm.init_decode_cache(spec, 2)
+    cache2 = tfm.init_decode_cache(spec, 2)
+    rng = np.random.RandomState(6)
+    for pos in range(5):
+        tok = jnp.asarray(rng.randint(0, 50, size=2), jnp.int32)
+        la, cache = ref(params, cache, tok, jnp.asarray(pos))
+        lb, cache2 = fn(params, cache2, tok, jnp.asarray(pos))
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    for k in cache:
+        np.testing.assert_array_equal(np.asarray(cache[k]),
+                                      np.asarray(cache2[k]))
+
+
+def test_engine_stats_contract_and_counters(lm):
+    """stats() satisfies obs/schema.SERVING_STATS (what /status and
+    the dtx_generate_* gauges export) and its counters add up."""
+    from distributed_tensorflow_example_tpu.obs import schema
+
+    spec, params = lm
+    eng = DecodeEngine(spec, params, page_size=8, max_batch=2)
+    rids = [eng.submit([1, 2, 3], 4, temperature=t)
+            for t in (0.0, 0.8, 0.0)]
+    eng.run_until_idle()
+    for rid in rids:
+        assert eng.result(rid, timeout=10.0) is not None
+    st = eng.stats()
+    assert schema.validate_serving_stats(st) == []
+    assert st["requests_total"] == st["completed_total"] == 3
+    assert st["inflight"] == st["queued"] == 0
+    assert st["tokens_generated_total"] == 3 * 4
+    assert st["latency_p99_ms"] >= st["latency_p50_ms"] > 0
+    assert st["page_occupancy_frac"] == 0.0      # everything freed
+    assert st["prefills_total"] == 3
+
+
+def test_engine_no_recompile_invariant(lm):
+    """Every compiled program the engine built is keyed by a shape
+    from the finite bucket ladders — admission/retirement churn can
+    re-bucket but never invent a shape."""
+    spec, params = lm
+    eng = DecodeEngine(spec, params, page_size=4, max_batch=3)
+    rng = np.random.RandomState(7)
+    rids = [eng.submit(rng.randint(0, 50, size=int(n)).tolist(),
+                       int(m))
+            for n, m in rng.randint(1, 9, size=(7, 2))]
+    eng.run_until_idle()
+    for rid in rids:
+        assert eng.result(rid, timeout=10.0) is not None
+    sched = eng.sched
+    for kind, a, b in eng.shapes_used:
+        if kind == "decode":
+            assert a in sched.batch_buckets
+            assert b in sched.kv_page_buckets
+        else:
+            assert a in eng.prompt_buckets
+    decode_shapes = {(a, b) for k, a, b in eng.shapes_used
+                     if k == "decode"}
+    assert set(eng._decode_fns) == decode_shapes
+
+
+def test_engine_loop_failure_fails_pending_fast(lm, monkeypatch):
+    """A tick raising inside the background loop must not strand the
+    server: pending results fail IMMEDIATELY (no 600s timeout against
+    a dead worker), new submits are refused, and the failure names
+    the original exception."""
+    spec, params = lm
+    eng = DecodeEngine(spec, params, page_size=8, max_batch=2)
+    monkeypatch.setattr(
+        eng, "step",
+        lambda: (_ for _ in ()).throw(RuntimeError("boom tick")))
+    eng.start()
+    rid = eng.submit([1, 2, 3], 4)
+    res = eng.result(rid, timeout=10.0)
+    assert res is not None and "boom tick" in res["error"]
+    with pytest.raises(RuntimeError, match="boom tick"):
+        eng.submit([1], 1)
+    eng.stop()
+
+
+def test_engine_retention_is_bounded(lm, monkeypatch):
+    """Completed-request state is evicted beyond the retention cap
+    and per-rid decode state dies at finish, so a long-running server
+    does not grow per request forever; counters and the rolling
+    latency window keep reporting."""
+    import distributed_tensorflow_example_tpu.serving.engine as eng_mod
+
+    monkeypatch.setattr(eng_mod, "RETAIN_FINISHED", 3)
+    spec, params = lm
+    eng = DecodeEngine(spec, params, page_size=8, max_batch=2)
+    rids = [eng.submit([1 + i % 4], 2) for i in range(8)]
+    eng.run_until_idle()
+    assert len(eng._results) == 3                 # oldest 5 evicted
+    assert not eng._temps and not eng._last_tok
+    assert not eng.sched.finished
+    with pytest.raises(KeyError):
+        eng.result(rids[0], timeout=0.1)          # evicted
+    assert eng.result(rids[-1], timeout=10.0)["tokens"]
+    st = eng.stats()
+    assert st["requests_total"] == st["completed_total"] == 8
+    assert st["latency_p99_ms"] > 0
+
+
+def test_engine_rejects_bad_requests(lm):
+    spec, params = lm
+    eng = DecodeEngine(spec, params, page_size=8, max_batch=2)
+    with pytest.raises(ValueError):
+        eng.submit([], 4)
+    with pytest.raises(ValueError):
+        eng.submit([1, 99], 4)                    # outside the vocab
+    with pytest.raises(ValueError):
+        eng.submit([1] * 30, 8)                   # past max_len
+    with pytest.raises(ValueError):
+        DecodeEngine(_spec(objective="classify", causal=False),
+                     params, page_size=8)
+    with pytest.raises(ValueError):
+        DecodeEngine(spec, params, max_len=64)    # > seq_len
+
+
+def test_generate_endpoint_round_trip(lm, tmp_path):
+    """POST /generate through the obs StatusServer front door: the
+    handler blocks on ITS request while the engine's background loop
+    shares decode ticks, /status grows a serving section, /metrics
+    the dtx_generate_* gauges, and malformed posts are 400s."""
+    from distributed_tensorflow_example_tpu.obs.serve import StatusServer
+
+    spec, params = lm
+    eng = DecodeEngine(spec, params, page_size=8, max_batch=2)
+    eng.start()
+    srv = StatusServer(str(tmp_path), engine=eng)
+    port = srv.start(0)
+    assert port
+    try:
+        prompt = [5, 4, 3]
+        ref = tfm.generate(spec, params,
+                           jnp.asarray([prompt], jnp.int32))
+        want = np.asarray(ref)[0, 3:3 + 5].tolist()
+        body = json.dumps({"prompt": prompt,
+                           "max_new_tokens": 5}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            res = json.loads(r.read())
+        assert r.status == 200 if hasattr(r, "status") else True
+        assert res["tokens"] == want
+        assert res["latency_ms"] >= res["ttft_ms"] >= 0.0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/status", timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["serving"]["completed_total"] == 1
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "dtx_generate_completed_total 1" in text
+        assert "dtx_generate_latency_p99_ms" in text
+        # malformed: prompt must be a token-id list
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"prompt": "hi"}).encode())
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=10)
+        assert ei.value.code == 400
+    finally:
+        srv.close()
+        eng.stop()
+
+
+def test_generate_endpoint_requires_engine(tmp_path):
+    """Without an attached engine the POST surface reports 503 (the
+    plain training status server shape is unchanged)."""
+    from distributed_tensorflow_example_tpu.obs.serve import StatusServer
+
+    srv = StatusServer(str(tmp_path))
+    port = srv.start(0)
+    assert port
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"prompt": [1]}).encode())
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 503
+    finally:
+        srv.close()
+
+
+@needs_stack
+def test_tp_sharded_paged_cache_parity(lm, devices8):
+    """Paged decode with the KV pool's heads split Megatron-style over
+    a ('model',) mesh: each shard writes/gathers its local heads'
+    pages, the row-split projections psum, and the logits — hence the
+    greedy chain — match the unsharded paged decode exactly (the
+    generate_sharded precedent, on the paged layout)."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_tensorflow_example_tpu.parallel import (
+        mesh as mesh_lib,
+    )
+
+    spec = _spec(n_heads=4)
+    params = tfm.init(jax.random.PRNGKey(8), spec)
+    mesh = mesh_lib.build_mesh(1, 2)
+    pspecs = tfm.param_pspecs(spec, model_axis="model")
+    placed = jax.device_put(
+        params, mesh_lib.shardings_for(mesh, pspecs))
+    page_size, steps, b = 4, 6, 2
+    bt = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    cache_specs = {k: P(None, None, "model")
+                   for k in kvc.init_paged_cache(spec, 5, page_size)}
+
+    def run(p, cache, tok, pos):
+        logits, cache = kvc.paged_decode_step(
+            spec, p, cache, bt, tok, pos, model_axis="model")
+        return logits, cache
+
+    fn = jax.jit(jax.shard_map(
+        run, mesh=mesh,
+        in_specs=(pspecs, cache_specs, P(), P()),
+        out_specs=(P(), cache_specs)))
+    ref_cache = kvc.init_paged_cache(spec, 5, page_size)
+    tp_cache = jax.device_put(
+        kvc.init_paged_cache(spec, 5, page_size),
+        mesh_lib.shardings_for(mesh, cache_specs))
+    tok = jnp.asarray([7, 11], jnp.int32)
+    for pos in range(steps):
+        posv = jnp.full((b,), pos, jnp.int32)
+        ref_logits, ref_cache = kvc.paged_decode_step(
+            spec, params, ref_cache, bt, tok, posv)
+        tp_logits, tp_cache = fn(placed, tp_cache, tok, posv)
+        tok = jnp.argmax(ref_logits, -1).astype(jnp.int32)
+        np.testing.assert_array_equal(
+            np.asarray(jnp.argmax(tp_logits, -1)), np.asarray(tok))
