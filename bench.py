@@ -1772,6 +1772,175 @@ def _bench_serving_measured(reqs, rng, page_size: int, max_batch: int,
     return best or {}
 
 
+def bench_local_sgd(rounds: int = 6, batch: int = 64, seq: int = 64,
+                    seed: int = 0):
+    """Multi-site local-SGD (DiLoCo) bench (ISSUE 10), two halves:
+
+    1. ANALYTIC (pure obs/flops closed forms, every backend — the
+       gateable evidence): per-replica all-reduce bytes for the
+       sync-DP gradient psum vs the local-SGD outer pseudo-gradient
+       psum amortized over H inner steps, per trained token, on the
+       measured half's LM transformer at 8 replicas/sites.  The
+       H-fold reduction is the whole point of the recipe; the H=8
+       per-token figure is gated (``local_sgd_comm_bytes_per_token``,
+       obs/compare.GATE_METRICS, tight 1% — deterministic closed
+       form, any upward move is an algorithm regression).
+
+    2. MEASURED (the real training stack on the current backend):
+       the same token budget through synchronous DP and through
+       ``--sites``/H=8 rounds (parallel/local_sgd.py) — per-inner-
+       step wall and final cost.  ``local_sgd_final_cost`` is gated
+       wide (short CPU A/B).  Degrades to ``local_sgd_measured_error``
+       where the stack or the devices are unavailable (the
+       bench_pp_memory precedent) — the analytic half stands alone.
+    """
+    from distributed_tensorflow_example_tpu.models import (
+        transformer as tfm)
+    from distributed_tensorflow_example_tpu.obs import flops as fl
+
+    h_gate, h_deep, n_rep = 8, 64, 8
+    spec = tfm.TransformerSpec(
+        input_size=seq, num_classes=10, seq_len=seq, d_model=64,
+        n_heads=4, num_blocks=2, d_ff=128, objective="lm",
+        vocab_size=64, causal=True)
+    n_params = fl.num_params(spec)
+    sync_step_bytes = fl.sync_dp_comm_bytes_per_step(spec, n_rep)
+    round_bytes = fl.local_sgd_comm_bytes_per_round(spec, n_rep)
+    toks = fl.tokens_per_example(spec)
+    sync_tok = fl.comm_bytes_per_token(sync_step_bytes, batch, toks)
+    h8_tok = fl.comm_bytes_per_token(round_bytes / h_gate, batch, toks)
+    h64_tok = fl.comm_bytes_per_token(round_bytes / h_deep, batch,
+                                      toks)
+    row = {
+        "config": "local_sgd",
+        "model": f"lm transformer d64x2 S={seq} ({n_params} params), "
+                 f"{n_rep} replicas/sites, global batch {batch} per "
+                 f"inner step (ring all-reduce accounting, "
+                 f"obs/flops.py)",
+        "n_params": n_params,
+        "sync_comm_bytes_per_step": round(sync_step_bytes, 1),
+        "local_sgd_outer_sync_bytes": round(round_bytes, 1),
+        "sync_comm_bytes_per_token": round(sync_tok, 3),
+        "local_sgd_comm_bytes_per_token": round(h8_tok, 3),
+        "local_sgd_comm_bytes_per_token_h64": round(h64_tok, 3),
+        "comm_reduction_h8": round(sync_tok / h8_tok, 2),
+        "comm_reduction_h64": round(sync_tok / h64_tok, 2),
+        "inner_steps_gated": h_gate,
+    }
+    try:
+        row.update(_bench_local_sgd_measured(spec, rounds, batch,
+                                             h_gate, seed))
+    except Exception as e:   # noqa: BLE001 — degrade, don't void
+        row["local_sgd_measured_error"] = str(e)[:200]
+    return row
+
+
+def _bench_local_sgd_measured(spec, rounds: int, batch: int, h: int,
+                              seed: int) -> dict:
+    """The measured half of bench_local_sgd: the same token budget
+    through sync DP and through H=8 multi-site rounds, on whatever
+    devices the backend offers (sites x 1-device groups)."""
+    import jax
+    import numpy as np
+
+    from distributed_tensorflow_example_tpu.config import Config
+    from distributed_tensorflow_example_tpu.parallel import (
+        local_sgd as ls)
+    from distributed_tensorflow_example_tpu.parallel import (
+        mesh as mesh_lib)
+    from distributed_tensorflow_example_tpu.parallel import (
+        step as step_lib)
+    from distributed_tensorflow_example_tpu.train.optim import (
+        make_optimizer)
+    from distributed_tensorflow_example_tpu.train.state import (
+        create_train_state)
+
+    n_dev = len(jax.devices())
+    sites = 8 if n_dev >= 8 else 2
+    if n_dev < 2:
+        raise RuntimeError(
+            f"multi-site measured A/B needs >= 2 devices, have "
+            f"{n_dev} (the analytic half stands alone)")
+    if batch % sites:
+        raise ValueError(f"sites={sites} must divide the per-inner-"
+                         f"step batch {batch}")
+    rng = np.random.RandomState(seed)
+    # one round consumes h inner-step batches of `batch` examples
+    xs = rng.rand(rounds, h * batch, spec.input_size).astype(np.float32)
+    ys = np.zeros((rounds, h * batch, spec.num_classes), np.float32)
+
+    def timed(step_fn, state, feed):
+        t0 = time.time()
+        cost = None
+        for x, y in feed:
+            state, cost, _acc = step_fn(state, x, y)
+        cost = float(cost)          # block: drains the dispatch queue
+        return time.time() - t0, cost, state
+
+    out = {"measured_sites": sites, "measured_rounds": rounds}
+    # --- sync-DP baseline: rounds*h steps of `batch` over all devices
+    cfg_s = Config(model="transformer", objective="lm",
+                   input_size=spec.input_size, vocab_size=spec.vocab_size,
+                   d_model=spec.d_model, n_heads=spec.n_heads,
+                   num_blocks=spec.num_blocks, d_ff=spec.d_ff,
+                   optimizer="sgd", learning_rate=0.05, summaries=False)
+    mesh_s = mesh_lib.build_mesh(sites, 1)
+    opt_s = make_optimizer(cfg_s)
+    st_s = create_train_state(jax.random.PRNGKey(seed), spec, opt_s)
+    st_s = mesh_lib.place_state(st_s, mesh_s,
+                                mesh_lib.state_pspecs(spec, opt_s, 1))
+    step_s = step_lib.build_train_step(cfg_s, mesh_s, spec, opt_s)
+    sync_feed = [(xs[r, i * batch:(i + 1) * batch],
+                  ys[r, i * batch:(i + 1) * batch])
+                 for r in range(rounds) for i in range(h)]
+    timed(step_s, st_s, sync_feed[:1])       # compile warm-up
+    st_s = create_train_state(jax.random.PRNGKey(seed), spec, opt_s)
+    st_s = mesh_lib.place_state(st_s, mesh_s,
+                                mesh_lib.state_pspecs(spec, opt_s, 1))
+    wall_s, cost_s, _ = timed(step_s, st_s, sync_feed)
+    out["sync_step_ms"] = round(wall_s / (rounds * h) * 1e3, 3)
+    out["sync_final_cost"] = round(cost_s, 4)
+
+    # --- multi-site: the same data as H-step rounds over `sites`
+    cfg_l = cfg_s.replace(sites=sites, inner_steps=h,
+                          outer_optimizer="nesterov", outer_lr=0.7,
+                          outer_momentum=0.9)
+    mesh_l = mesh_lib.build_site_mesh(sites, 1)
+    opt_l = make_optimizer(cfg_l)
+    outer = ls.outer_optimizer_from_config(cfg_l)
+    st_l = ls.site_state(
+        create_train_state(jax.random.PRNGKey(seed), spec, opt_l),
+        sites, outer)
+    st_l = mesh_lib.place_state(st_l, mesh_l, ls.site_specs(st_l))
+    step_l = ls.build_local_sgd_step(cfg_l, mesh_l, spec, opt_l,
+                                     outer, st_l)
+    # round layout: the ('site','data') in_spec hands device d rows
+    # [d*h*b_site : (d+1)*h*b_site], which the round program reshapes
+    # to [h, b_site] chunks — so device d's chunk i must be inner-step
+    # batch i's site-d slice for the two paths to train on the same
+    # per-step example assignment
+    def round_xy(r):
+        b_site = batch // sites
+        stepped = xs[r].reshape(h, batch, -1)
+        x = np.concatenate([
+            stepped[:, d * b_site:(d + 1) * b_site]
+            .reshape(h * b_site, -1) for d in range(sites)])
+        y = np.zeros((x.shape[0], spec.num_classes), np.float32)
+        return x, y
+
+    local_feed = [round_xy(r) for r in range(rounds)]
+    timed(step_l, st_l, local_feed[:1])      # compile warm-up
+    st_l = ls.site_state(
+        create_train_state(jax.random.PRNGKey(seed), spec, opt_l),
+        sites, outer)
+    st_l = mesh_lib.place_state(st_l, mesh_l, ls.site_specs(st_l))
+    wall_l, cost_l, _ = timed(step_l, st_l, local_feed)
+    out["local_sgd_step_ms"] = round(wall_l / (rounds * h) * 1e3, 3)
+    out["local_sgd_final_cost"] = round(cost_l, 4)
+    out["final_cost_ratio"] = round(cost_l / max(cost_s, 1e-9), 4)
+    return out
+
+
 def bench_ring_flash(s: int = 4096, b: int = 2, h: int = 8, d: int = 64,
                      repeats: int = 3):
     """Ring+flash composition with REAL Pallas kernels on hardware
@@ -2015,6 +2184,11 @@ def main(argv=None) -> int:
     # measured engine sweep (p50/p99 latency + tok/s) is CPU-viable at
     # its tiny model size; its gate keys ride the final summary
     guarded("serving", bench_serving)
+    # the multi-site local-SGD row runs on EVERY backend (r10): the
+    # comm-volume half is pure obs/flops closed forms and gates the
+    # H-fold reduction claim; the measured sync-vs-H=8 A/B degrades
+    # to an error key where the stack or devices are missing
+    guarded("local_sgd", bench_local_sgd)
     if on_tpu:
         guarded("reference_device_program", bench_reference_device_program)
         # the wide-MXU rows only mean something on a TPU (and in
@@ -2198,6 +2372,24 @@ def main(argv=None) -> int:
             srv_row["tick_speedup_continuous_vs_static"]
         extra["serving_continuous_beats_static"] = \
             srv_row["continuous_beats_static"]
+    lsgd_row = next(
+        (r for r in rows if r.get("config") == "local_sgd"
+         and "sync_comm_bytes_per_token" in r), None)
+    if lsgd_row:
+        # multi-site gate keys (obs.compare reads them off the final
+        # line): analytic comm bytes per token at H=8 + the measured
+        # final cost, plus the headline reduction factors
+        extra["local_sgd_comm_bytes_per_token"] = \
+            lsgd_row["local_sgd_comm_bytes_per_token"]
+        extra["local_sgd_comm_reduction_h8"] = \
+            lsgd_row["comm_reduction_h8"]
+        extra["local_sgd_comm_reduction_h64"] = \
+            lsgd_row["comm_reduction_h64"]
+        if lsgd_row.get("local_sgd_final_cost") is not None:
+            extra["local_sgd_final_cost"] = \
+                lsgd_row["local_sgd_final_cost"]
+            extra["local_sgd_sync_final_cost"] = \
+                lsgd_row.get("sync_final_cost")
     ip_row = next(
         (r for r in rows if r.get("config") == "input_pipeline"
          and "prefetch_step_ms" in r), None)

@@ -178,7 +178,16 @@ class Config:
                                     # ops/ulysses_attention; needs
                                     # n_heads % sequence_parallel == 0)
     sync_period: int = 1            # 1 = fully synchronous psum every step;
-                                    # K>1 = local SGD, params averaged every K.
+                                    # K>1 = legacy local SGD, params
+                                    # averaged every K (TPU-native
+                                    # async-staleness analog, SURVEY.md
+                                    # §7). The first-class multi-site
+                                    # path is --sites/--inner_steps
+                                    # (parallel/local_sgd.py: 'site'
+                                    # mesh axis + outer optimizer);
+                                    # --sync_period K with outer
+                                    # SGD(lr=1, momentum=0) is its
+                                    # exact degenerate case.
                                     # PER-UPDATE BATCH: each divergent
                                     # replica steps on its 1/dp slice of
                                     # --batch_size, while each reference
@@ -188,8 +197,30 @@ class Config:
                                     # reference's per-update semantics
                                     # (oracle-pinned in tests/
                                     # test_oracle.py's staleness test)
-                                    # steps (TPU-native async-staleness analog,
-                                    # SURVEY.md §7 semantic mapping)
+    sites: int = 1                  # > 1: DiLoCo-style multi-site
+                                    # training over a ('site','data')
+                                    # mesh — each site is a sync-DP
+                                    # group running --inner_steps local
+                                    # optimizer steps per round, with
+                                    # ONE outer pseudo-gradient psum
+                                    # crossing 'site' per round
+                                    # (parallel/local_sgd.py; host
+                                    # loop; docs/multi_site.md)
+    inner_steps: int = 1            # H: local optimizer steps per
+                                    # outer sync (--sites > 1). Each
+                                    # round consumes one --batch_size
+                                    # batch split into H equal chunks,
+                                    # so the per-inner-step global
+                                    # batch is batch_size/H; synced
+                                    # bytes drop ~H-fold vs sync DP
+    outer_optimizer: str = "nesterov"  # outer update over pseudo-
+                                    # gradients: nesterov | sgd
+                                    # (sgd = momentum pinned 0; at
+                                    # outer_lr=1 that degenerates to
+                                    # parameter averaging)
+    outer_lr: float = 0.7           # outer learning rate (DiLoCo's
+                                    # recipe value)
+    outer_momentum: float = 0.9     # outer Nesterov momentum
     grad_reduce: str = "mean"       # mean | sum over the data axis
     fsdp: bool = False              # ZeRO-3 sharding: params + optimizer
                                     # state split 1/dp per device, gathered
@@ -529,12 +560,42 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sequence-parallel attention: ppermute ring vs "
                         "head<->seq all_to_all (DeepSpeed-Ulysses style)")
     p.add_argument("--sync_period", type=int, default=d.sync_period,
-                   help="K>1 = local-SGD async analog: divergent "
-                        "replicas averaged every K steps; each "
-                        "replica's per-update batch is batch_size/dp "
-                        "(the reference gave each async worker a FULL "
-                        "batch per update — use batch_size = dp*100 "
-                        "to match)")
+                   help="K>1 = the LEGACY local-SGD async analog: "
+                        "divergent replicas averaged every K steps "
+                        "(each replica's per-update batch is "
+                        "batch_size/dp; the reference gave each async "
+                        "worker a FULL batch per update — use "
+                        "batch_size = dp*100 to match). The "
+                        "first-class multi-site path is --sites + "
+                        "--inner_steps over a ('site','data') mesh "
+                        "with an outer optimizer "
+                        "(parallel/local_sgd.py); K with outer "
+                        "SGD(lr=1, momentum=0) reproduces this flag "
+                        "exactly")
+    p.add_argument("--sites", type=int, default=d.sites,
+                   help="multi-site local SGD (DiLoCo-style): train "
+                        "N independent sync-DP sites over a "
+                        "('site','data') mesh, one outer "
+                        "pseudo-gradient psum crossing 'site' per "
+                        "--inner_steps local steps (model_parallel=1; "
+                        "host loop)")
+    p.add_argument("--inner_steps", type=int, default=d.inner_steps,
+                   help="H: local optimizer steps per outer sync "
+                        "under --sites > 1; one --batch_size batch "
+                        "per round, split into H chunks (comm bytes "
+                        "drop ~H-fold vs per-step sync DP)")
+    p.add_argument("--outer_optimizer", type=str,
+                   default=d.outer_optimizer,
+                   choices=["nesterov", "sgd"],
+                   help="multi-site outer update over pseudo-"
+                        "gradients (sgd = momentum 0; outer_lr=1 "
+                        "sgd = plain parameter averaging)")
+    p.add_argument("--outer_lr", type=float, default=d.outer_lr,
+                   help="outer learning rate for --sites > 1 "
+                        "(DiLoCo recipe default 0.7)")
+    p.add_argument("--outer_momentum", type=float,
+                   default=d.outer_momentum,
+                   help="outer Nesterov momentum for --sites > 1")
     p.add_argument("--grad_reduce", type=str, default=d.grad_reduce,
                    choices=["mean", "sum"])
     p.add_argument("--fsdp", action="store_true",
@@ -779,6 +840,68 @@ def validate_pipeline_config(cfg: Config) -> None:
                 f"interleaved stages need microbatches "
                 f"({cfg.microbatches}) divisible by pipeline_parallel "
                 f"({cfg.pipeline_parallel})")
+
+
+def validate_local_sgd_config(cfg: Config) -> None:
+    """The multi-site (--sites) validation matrix — pure config
+    checks, raised before any bootstrap work (the
+    validate_pipeline_config pattern; ``tests/test_cli.py`` pins it
+    without the training stack).
+
+    ``sites`` > 1 selects the DiLoCo-style path
+    (parallel/local_sgd.py): a ('site','data') mesh of independent
+    sync-DP groups, H=``inner_steps`` local steps per outer sync. It
+    composes with within-site data parallelism only — no TP/PP/SP/EP,
+    no fsdp/zero, and not the legacy ``--sync_period`` analog it
+    supersedes. It runs on the host loop (the compiled round IS the
+    dispatched step), so the host-fetch features that need compiled
+    extra outputs (--histograms, --on_anomaly=skip) are rejected, as
+    is dropout (the sync-step restriction, kept symmetric with
+    ``--sync_period``)."""
+    if cfg.sites < 1:
+        raise ValueError(f"sites={cfg.sites} must be >= 1")
+    if cfg.inner_steps < 1:
+        raise ValueError(f"inner_steps={cfg.inner_steps} must be >= 1")
+    if cfg.outer_optimizer not in ("nesterov", "sgd"):
+        raise ValueError(
+            f"outer_optimizer={cfg.outer_optimizer!r}: expected "
+            f"'nesterov' or 'sgd'")
+    if cfg.sites == 1:
+        if cfg.inner_steps > 1:
+            raise ValueError("--inner_steps > 1 needs --sites > 1 "
+                             "(no outer sync to amortize on one site)")
+        return
+    if cfg.model != "mlp" and cfg.model != "transformer":
+        raise ValueError(f"unknown model {cfg.model!r}")
+    if cfg.model_parallel > 1:
+        raise ValueError("--sites composes with data parallelism "
+                         "inside each site only (model_parallel=1)")
+    if cfg.sync_period > 1:
+        raise ValueError("--sites supersedes the legacy --sync_period "
+                         "local-SGD analog; use one of the two "
+                         "(--sites N --inner_steps K --outer_optimizer "
+                         "sgd --outer_lr 1 reproduces --sync_period K)")
+    if (cfg.fsdp or cfg.zero_opt or cfg.pipeline_parallel > 1
+            or cfg.sequence_parallel > 1 or cfg.expert_parallel > 1):
+        raise ValueError("--sites composes with within-site data "
+                         "parallelism only (no fsdp/zero_opt/"
+                         "pipeline/sequence/expert parallelism)")
+    if cfg.outer_lr <= 0:
+        raise ValueError(f"outer_lr={cfg.outer_lr} must be > 0")
+    if not 0.0 <= cfg.outer_momentum < 1.0:
+        raise ValueError(
+            f"outer_momentum={cfg.outer_momentum} must be in [0, 1)")
+    if cfg.dropout_rate:
+        raise ValueError("--dropout_rate runs on the synchronous step "
+                         "(sites=1); the multi-site round keeps its "
+                         "own per-site objectives")
+    if cfg.histograms:
+        raise ValueError("--histograms rides the synchronous step's "
+                         "norm outputs (sites=1)")
+    if cfg.on_anomaly == "skip":
+        raise ValueError("--on_anomaly=skip rides the synchronous "
+                         "step's compiled update mask (sites=1); "
+                         "halt/dump work on the multi-site path")
 
 
 def parse_config(argv: Sequence[str] | None = None) -> Config:

@@ -50,8 +50,12 @@ TRACE_SCOPES = WINDOW_BUCKETS + ("eval", "checkpoint")
 # (serving/engine.py compiled programs): a capture of the decode
 # engine splits prompt ingestion, the paged decode step, and the
 # fused on-device sampling.
+# "outer_sync" names the multi-site round's one cross-site collective
+# (parallel/local_sgd.py: the pseudo-gradient psum + outer optimizer
+# update), so a profiler capture shows exactly how much of a round
+# the slow-axis sync costs.
 NAMED_SCOPES = ("ln", "moe_dispatch", "moe_expert", "pp_comm",
-                "prefill", "decode", "sampling")
+                "prefill", "decode", "sampling", "outer_sync")
 
 # run-level goodput/badput decomposition, in presentation order
 # ("train" is the goodput bucket, "eval"/"sample" auxiliary useful

@@ -79,6 +79,13 @@ GATE_METRICS: Dict[str, tuple] = {
     "serving_p99_ms": ("lower", 0.25),
     "serving_tok_s": ("higher", 0.25),
     "decode_hbm_frac": ("higher", 0.05),
+    # the multi-site local-SGD row (ISSUE 10): comm bytes per trained
+    # token at H=8 is ANALYTIC (obs/flops.py closed form — like the
+    # bubble fractions, any upward move is an algorithm regression,
+    # hence the tight 1%); the final cost is a short measured CPU A/B
+    # run, wide like the serving latencies
+    "local_sgd_comm_bytes_per_token": ("lower", 0.01),
+    "local_sgd_final_cost": ("lower", 0.25),
 }
 
 
@@ -147,6 +154,14 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
             put(f"pp_bubble_frac_{name}",
                 doc.get(f"{name}_bubble_fraction"))
         return out
+    # bench local-SGD row — keyed on sync_comm_bytes_per_token, a
+    # row-only key (the final summary carries the two GATE keys too
+    # and must fall through to its own branch — the serving lesson)
+    if "sync_comm_bytes_per_token" in doc:
+        put("local_sgd_comm_bytes_per_token",
+            doc.get("local_sgd_comm_bytes_per_token"))
+        put("local_sgd_final_cost", doc.get("local_sgd_final_cost"))
+        return out
     # bench serving row — keyed on continuous_ticks, NOT serving_tok_s:
     # the final summary carries serving_tok_s too, and must fall
     # through to its own branch below to keep wall_s/mfu/...
@@ -182,7 +197,10 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
                   # the serving/decode-roofline keys (ISSUE 9) ride
                   # the final line under their gate names verbatim
                   "serving_p99_ms", "serving_tok_s",
-                  "decode_hbm_frac"):
+                  "decode_hbm_frac",
+                  # the multi-site local-SGD keys (ISSUE 10) likewise
+                  "local_sgd_comm_bytes_per_token",
+                  "local_sgd_final_cost"):
             put(k, doc.get(k))
         return out
     # last resort: any directly-named gate metrics

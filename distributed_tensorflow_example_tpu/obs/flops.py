@@ -178,3 +178,70 @@ def hbm_frac(bytes_per_step: float, step_time_s: float, peak,
     if not peak or step_time_s <= 0:
         return None
     return bytes_per_step / step_time_s / (peak * max(n_devices, 1))
+
+
+# ---- cross-replica communication volume (round 10) ----
+#
+# The multi-site local-SGD claim is a COMMUNICATION claim: H inner
+# steps per outer sync cut the bytes crossing the slow inter-site
+# link ~H-fold vs per-step synchronous DP.  These helpers are the
+# analytic accounting behind it — per-replica all-reduce traffic for
+# the sync-DP gradient psum vs the local-SGD outer pseudo-gradient
+# psum, amortized per trained token — surfaced by bench_local_sgd as
+# ``local_sgd_comm_bytes_per_token`` and gated via obs/compare.
+# Deterministic closed forms (like the pp bubble fractions): they
+# hold on every backend and change only when the algorithm changes.
+
+def num_params(spec) -> int:
+    """Parameter count for any model spec the train loop builds
+    (make_spec): dispatches to the family's own accounting."""
+    from ..models import mlp
+
+    if isinstance(spec, mlp.MLPSpec):
+        return mlp.num_params(spec)
+    from ..models import transformer
+
+    if isinstance(spec, transformer.TransformerSpec):
+        return transformer.num_params(spec)
+    raise TypeError(f"no parameter accounting for spec type "
+                    f"{type(spec)!r}")
+
+
+def allreduce_bytes_per_replica(payload_bytes: float, n: int) -> float:
+    """Bytes one replica moves (send + receive) in a bandwidth-optimal
+    ring all-reduce of ``payload_bytes`` across ``n`` replicas:
+    ``2 * (n-1)/n * payload`` (reduce-scatter + all-gather, each
+    (n-1)/n of the payload). 0 for n <= 1 — nothing crosses a link."""
+    if n <= 1:
+        return 0.0
+    return 2.0 * (n - 1) / n * float(payload_bytes)
+
+
+def sync_dp_comm_bytes_per_step(spec, dp: int,
+                                itemsize: int | None = None) -> float:
+    """Per-replica bytes the synchronous-DP gradient psum moves every
+    step: one all-reduce of the full gradient set (param-shaped, in
+    the param dtype unless ``itemsize`` overrides)."""
+    import numpy as np
+
+    if itemsize is None:
+        itemsize = np.dtype(getattr(spec, "param_dtype",
+                                    np.float32)).itemsize
+    return allreduce_bytes_per_replica(num_params(spec) * itemsize, dp)
+
+
+def local_sgd_comm_bytes_per_round(spec, sites: int) -> float:
+    """Per-site bytes one multi-site outer sync moves: the f32
+    pseudo-gradient psum across 'site' (parallel/local_sgd.py
+    extracts deltas in f32 regardless of param dtype; inner optimizer
+    slots stay per-site and never cross the axis). Amortize over
+    ``inner_steps`` for a per-inner-step figure."""
+    return allreduce_bytes_per_replica(num_params(spec) * 4, sites)
+
+
+def comm_bytes_per_token(bytes_per_step: float, batch: int,
+                         tokens_each: int | None) -> float:
+    """Collective bytes amortized per trained token (``tokens_each``
+    from tokens_per_example; token-less families count one "token"
+    per example)."""
+    return bytes_per_step / (batch * (tokens_each or 1))

@@ -310,7 +310,11 @@ def _build_local_run_to_completion(
     local view is leaf[0].
     """
     if mesh.shape[MODEL_AXIS] != 1:
-        raise ValueError("local-SGD (async) mode requires model_parallel=1")
+        raise ValueError(
+            "local SGD (--sync_period K>1, the async analog) requires "
+            "model_parallel=1 — as does the first-class multi-site "
+            "path, --sites with a ('site','data') mesh "
+            "(parallel/local_sgd.py)")
     dp = mesh.shape[DATA_AXIS]
     K = max(1, cfg.sync_period)
     styles = mesh_lib.layer_styles(spec, 1)

@@ -36,6 +36,9 @@ MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
 STAGE_AXIS = "stage"
+SITE_AXIS = "site"      # multi-site local-SGD/DiLoCo: the slow (DCN)
+                        # inter-cluster axis parallel/local_sgd.py's
+                        # outer sync crosses once per H inner steps
 
 
 def build_mesh(data_parallel: int = -1, model_parallel: int = 1, devices=None) -> Mesh:
@@ -114,6 +117,22 @@ def build_stage_mesh(data_parallel: int, pipeline_parallel: int,
     if model_parallel > 1:
         axes[MODEL_AXIS] = model_parallel
     return build_nd_mesh(axes, devices)
+
+
+def build_site_mesh(sites: int, data_parallel: int,
+                    devices=None) -> Mesh:
+    """('site', 'data') mesh for low-communication multi-site training
+    (parallel/local_sgd.py): each site is a self-contained sync-DP
+    group of ``data_parallel`` devices; the ONLY parameter-sized
+    collective crossing 'site' is the outer pseudo-gradient psum, once
+    per ``--inner_steps`` local steps. 'site' is OUTERMOST — on real
+    fleets those are the DCN links between pods, the slowest hops —
+    while the per-step gradient psum stays inside each site's 'data'
+    axis (ICI)."""
+    if sites < 1:
+        raise ValueError(f"sites={sites} must be >= 1")
+    return build_nd_mesh({SITE_AXIS: sites, DATA_AXIS: data_parallel},
+                         devices)
 
 
 def build_nd_mesh(axes: Dict[str, int], devices=None) -> Mesh:

@@ -605,6 +605,13 @@ def batch_layout(mesh, spec):
     """(batch_axes, total_batch_shards, x_pspec, y_pspec) for the mesh —
     the one source of truth for how the global batch maps onto it."""
     dp = mesh.shape[DATA_AXIS]
+    site_axis = mesh_lib.axis_if_present(mesh, mesh_lib.SITE_AXIS)
+    if site_axis:
+        # multi-site local SGD (parallel/local_sgd.py): every site
+        # trains on its own slice, so the batch shards over BOTH the
+        # site and the within-site data axis
+        axes = (site_axis, DATA_AXIS)
+        return axes, mesh.shape[site_axis] * dp, P(axes), P(axes)
     seq_axis = mesh_lib.axis_if_present(mesh, mesh_lib.SEQ_AXIS)
     if sparse_ep_mode(mesh, spec):
         ep = mesh.shape[mesh_lib.EXPERT_AXIS]
@@ -769,10 +776,18 @@ def build_local_train_step(cfg, mesh, spec: mlp.MLPSpec, optimizer, state_templa
     No cross-shard collective at all — the reference's unlocked
     ps-apply (example.py:101, 111) with staleness made explicit.
     Requires model_parallel == 1 (the reference has no TP to compose
-    with its async path either).
+    with its async path either). This is the legacy parameter-
+    averaging analog; the first-class multi-site path — H inner steps
+    per site, an outer Nesterov over pseudo-gradients on a 'site'
+    mesh axis — is --sites (parallel/local_sgd.py), which --sync_period
+    K>1 with outer SGD(lr=1, momentum=0) exactly reproduces.
     """
     if mesh.shape[MODEL_AXIS] != 1:
-        raise ValueError("local-SGD (async) mode requires model_parallel=1")
+        raise ValueError(
+            "local SGD (--sync_period K>1, the async analog) requires "
+            "model_parallel=1 — as does the first-class multi-site "
+            "path, --sites with a ('site','data') mesh "
+            "(parallel/local_sgd.py)")
     styles = mesh_lib.layer_styles(spec, 1)
     sspecs = _stacked_specs(state_template)
 

@@ -39,7 +39,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import cluster
-from ..config import Config, validate_pipeline_config
+from ..config import (Config, validate_local_sgd_config,
+                      validate_pipeline_config)
 from ..data import EpochIterator, load_datasets
 from ..models.mlp import MLPSpec
 from ..parallel import epoch as epoch_lib
@@ -183,6 +184,8 @@ def run(cfg: Config) -> Dict[str, Any]:
     # virtual_stages>1 combination real (interleaved-1F1B) instead of
     # a rejection
     validate_pipeline_config(cfg)
+    # the multi-site (--sites) matrix likewise lives in config.py
+    validate_local_sgd_config(cfg)
     if cfg.objective == "lm":
         if cfg.model != "transformer":
             raise ValueError("--objective=lm requires --model=transformer")
@@ -348,7 +351,15 @@ def run(cfg: Config) -> Dict[str, Any]:
         mirrors=cfg.mnist_mirrors,
         input_size=cfg.input_size,
     )
-    if cfg.pipeline_parallel > 1:
+    if cfg.sites > 1:
+        # ('site', 'data') — multi-site local SGD: each site is an
+        # independent sync-DP group; the outer pseudo-gradient psum is
+        # the one parameter-sized hop across 'site'
+        # (parallel/local_sgd.py)
+        dp_req = (len(jax.devices()) // cfg.sites
+                  if cfg.data_parallel == -1 else cfg.data_parallel)
+        mesh = mesh_lib.build_site_mesh(cfg.sites, max(dp_req, 1))
+    elif cfg.pipeline_parallel > 1:
         # ('data', 'stage'[, 'seq' | 'expert'][, 'model']) — r5: every
         # inner axis composes (DP x PP x SP x TP / DP x PP x EP x TP);
         # ring/Ulysses attention, the MoE expert exchange and the
@@ -376,7 +387,8 @@ def run(cfg: Config) -> Dict[str, Any]:
     n_devices = (dp * mesh.shape.get(mesh_lib.MODEL_AXIS, 1)
                  * mesh.shape.get(mesh_lib.SEQ_AXIS, 1)
                  * mesh.shape.get(mesh_lib.EXPERT_AXIS, 1)
-                 * mesh.shape.get(mesh_lib.STAGE_AXIS, 1))
+                 * mesh.shape.get(mesh_lib.STAGE_AXIS, 1)
+                 * mesh.shape.get(mesh_lib.SITE_AXIS, 1))
 
     # total batch shards: dp, times ep under sparse-dispatch expert
     # parallelism (tokens shard over the expert axis too — the GShard
@@ -493,6 +505,23 @@ def run(cfg: Config) -> Dict[str, Any]:
     try:
 
         pp_mode = cfg.pipeline_parallel > 1
+        site_mode = cfg.sites > 1
+        if site_mode:
+            # one dispatch = one ROUND: the per-shard batch splits
+            # into H inner-step chunks inside the compiled program
+            # (grad_accum further splits each chunk)
+            per_shard = global_batch // batch_shards
+            if per_shard % cfg.inner_steps:
+                raise ValueError(
+                    f"per-shard batch {per_shard} must divide into "
+                    f"inner_steps={cfg.inner_steps} chunks (global "
+                    f"batch {global_batch} over {batch_shards} "
+                    f"site x data shards)")
+            if (per_shard // cfg.inner_steps) % cfg.grad_accum:
+                raise ValueError(
+                    f"per-shard inner-step batch "
+                    f"{per_shard // cfg.inner_steps} must divide into "
+                    f"grad_accum={cfg.grad_accum} microbatches")
         if pp_mode:
             # the pipeline schedule sees one grad-accum chunk at a time;
             # batch_shards counts EVERY batch-sharding axis (dp, plus
@@ -509,6 +538,9 @@ def run(cfg: Config) -> Dict[str, Any]:
                     f"microbatches={cfg.microbatches}")
         async_mode = cfg.sync_period > 1
         fsdp_mode = cfg.fsdp
+        # modes whose training-state layout needs get_params() before
+        # eval/sampling (stacked replicas or sharded leaves)
+        unstack_mode = async_mode or fsdp_mode or site_mode
         fast = (
             cfg.fast_loop and proc_cnt == 1
             and (cfg.shard_data or dp == 1)
@@ -526,6 +558,11 @@ def run(cfg: Config) -> Dict[str, Any]:
             # layout is a host-path feature
             and cfg.sequence_parallel == 1 and cfg.expert_parallel == 1
             and cfg.pipeline_parallel == 1 and not cfg.zero_opt
+            # multi-site rounds run on the host loop: the compiled
+            # round program IS the dispatched step (H inner steps +
+            # outer sync), and the scan runners' P('data') dataset
+            # layout doesn't express the ('site','data') batch
+            and not site_mode
             # async fast path runs the whole program on-device; periodic
             # host-side checkpoints and early stopping need the host loop
             and not (async_mode and (cfg.checkpoint_every or cfg.model_parallel > 1
@@ -558,6 +595,21 @@ def run(cfg: Config) -> Dict[str, Any]:
             get_params = fsdp_lib.build_gather_params(mesh, full_template,
                                                       spec)
             sspecs = fsdp_lib.fsdp_specs(state, mp_f)
+        elif site_mode:
+            # multi-site local SGD (parallel/local_sgd.py): params +
+            # inner slots site-stacked [sites, ...] over 'site', outer
+            # optimizer state replicated; the train step is one ROUND
+            # (H inner steps + the outer pseudo-gradient sync)
+            from ..parallel import local_sgd as local_sgd_lib
+
+            outer_opt = local_sgd_lib.outer_optimizer_from_config(cfg)
+            state = local_sgd_lib.site_state(state, cfg.sites, outer_opt)
+            train_step = local_sgd_lib.build_local_sgd_step(
+                cfg, mesh, spec, optimizer, outer_opt, state)
+            param_sync = None
+            get_params = local_sgd_lib.build_site_unstack_params(
+                mesh, state)
+            sspecs = local_sgd_lib.site_specs(state)
         elif async_mode:
             state = step_lib.stack_state(state, dp)
             train_step = (
@@ -653,6 +705,29 @@ def run(cfg: Config) -> Dict[str, Any]:
                             f"same --pipeline_parallel when virtual > 1) — "
                             f"the stacked block order is pinned to that "
                             f"layout")
+                if site_mode or "sites" in resumed_extras:
+                    # site-stacked layout: the leading [sites] axis and
+                    # the outer-state tree are both pinned; restoring a
+                    # mismatched layout would fail deep in tree
+                    # rebuild, so reject it with the flag to change
+                    saved_sites = int(resumed_extras.get("sites", 0))
+                    saved_m = int(resumed_extras.get(
+                        "outer_has_momentum", 0))
+                    want_m = int(site_mode
+                                 and cfg.outer_optimizer == "nesterov"
+                                 and cfg.outer_momentum > 0)
+                    if (saved_sites != (cfg.sites if site_mode else 0)
+                            or saved_m != want_m):
+                        raise ValueError(
+                            f"checkpoint {path} was written with "
+                            f"sites={saved_sites}, outer momentum "
+                            f"state={'yes' if saved_m else 'no'}: "
+                            f"resume needs the same --sites and a "
+                            f"momentum-compatible --outer_optimizer/"
+                            f"--outer_momentum (this run: sites="
+                            f"{cfg.sites if site_mode else 0}, "
+                            f"momentum state="
+                            f"{'yes' if want_m else 'no'})")
                 if fsdp_mode and os.path.isdir(path):
                     # sharded-FSDP checkpoint: leaves are the SAVED run's
                     # flat [.., dp_old, chunk] layout — reassemble,
@@ -722,6 +797,9 @@ def run(cfg: Config) -> Dict[str, Any]:
         # worker's update (≈3x per round under 3 async workers, SURVEY.md
         # §3.3); in local-SGD mode each of the dp shards applies one update
         # per round, so the printed step advances by dp per round.
+        # Multi-site (--sites) prints ROUNDS: one dispatch = one round
+        # of sites x inner_steps local updates, and state.step counts
+        # the inner optimizer steps (rounds x inner_steps).
         step_scale = dp if async_mode else 1
 
         early = cfg.early_stop_patience > 0
@@ -800,6 +878,14 @@ def run(cfg: Config) -> Dict[str, Any]:
                 # validation above)
                 extras.update(pp_stages=cfg.pipeline_parallel,
                               pp_virtual=cfg.virtual_stages)
+            if site_mode:
+                # the site-stacked leading axis and the outer-state
+                # tree shape are both layout-pinned; resume validates
+                # (outer momentum state exists iff momentum > 0)
+                extras.update(sites=cfg.sites,
+                              outer_has_momentum=int(
+                                  cfg.outer_optimizer == "nesterov"
+                                  and cfg.outer_momentum > 0))
             if cfg.zero_opt:
                 # flat slot chunking is dp-shaped; resume validates it
                 extras.update(zero_dp=dp)
@@ -981,7 +1067,7 @@ def run(cfg: Config) -> Dict[str, Any]:
                 # tunnel costs a full round trip
                 with tracer.annotate("eval"):
                     eval_pending = fast_eval.dispatch(
-                        get_params(state) if (async_mode or fsdp_mode)
+                        get_params(state) if unstack_mode
                         else state.params
                     )
                 # NO phase_s["eval"] charge here: on the whole-run
@@ -1044,7 +1130,7 @@ def run(cfg: Config) -> Dict[str, Any]:
                     # then replays the same early-stop trajectory
                     stop_now = False
                     if early:
-                        p_eval = (get_params(state) if (async_mode or fsdp_mode)
+                        p_eval = (get_params(state) if unstack_mode
                                   else state.params)
                         t_ev = time.perf_counter()
                         with tracer.annotate("eval"):
@@ -1118,7 +1204,8 @@ def run(cfg: Config) -> Dict[str, Any]:
             # the async/FSDP builders don't — there the policy runs
             # host-side only (loss watchdog at the fetch points)
             want_anomaly = (policy is not None
-                            and not (fsdp_mode or async_mode))
+                            and not (fsdp_mode or async_mode
+                                     or site_mode))
             anom_dev = None
             anom_pending: list = []  # (step_id, cost_dev, anom_dev)
             # drain depth: bounded by the dispatch queue AND the
@@ -1423,7 +1510,7 @@ def run(cfg: Config) -> Dict[str, Any]:
                         straggler_event(epoch)
                     if early:
                         p_eval = (get_params(state)
-                                  if (async_mode or fsdp_mode)
+                                  if unstack_mode
                                   else state.params)
                         if note_validation(host_eval_accuracy(
                                 p_eval, dataset.validation.images,
@@ -1459,7 +1546,7 @@ def run(cfg: Config) -> Dict[str, Any]:
             test_acc = float(eval_pending) / fast_eval.n
         else:
             params = eval_params = (
-                get_params(state) if (async_mode or fsdp_mode) else state.params
+                get_params(state) if unstack_mode else state.params
             )
             if fast:                        # fast per-epoch path
                 t_ev = time.perf_counter()
@@ -1512,7 +1599,7 @@ def run(cfg: Config) -> Dict[str, Any]:
                 # chief-host numpy decode loop
                 sample_params = (
                     eval_params if eval_params is not None
-                    else get_params(state) if (async_mode or fsdp_mode)
+                    else get_params(state) if unstack_mode
                     else state.params
                 )
                 if proc_cnt > 1:

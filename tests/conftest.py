@@ -42,6 +42,14 @@ needs_stack = pytest.mark.skipif(
     reason="training stack needs a newer jax than this environment has")
 
 
+def pytest_configure(config):
+    # tier-1 runs -m 'not slow' (ROADMAP.md): register the mark so
+    # slow-gated acceptance tests don't warn
+    config.addinivalue_line(
+        "markers", "slow: long-running acceptance test, excluded "
+        "from the tier-1 sweep (-m 'not slow')")
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()

@@ -80,6 +80,25 @@ def _stub_rows(monkeypatch):
                           "serving_p99_ms": 214.2,
                           "serving_tok_s": 950.1,
                           "serving_requests": 24})
+    # the multi-site local-SGD row (r10) runs on EVERY backend: the
+    # analytic comm-volume keys + the measured A/B must reach the
+    # final line under their gate names
+    monkeypatch.setattr(
+        bench, "bench_local_sgd",
+        lambda *a, **kw: {"config": "local_sgd",
+                          "n_params": 79424,
+                          "sync_comm_bytes_per_step": 555968.0,
+                          "local_sgd_outer_sync_bytes": 555968.0,
+                          "sync_comm_bytes_per_token": 135.734,
+                          "local_sgd_comm_bytes_per_token": 16.967,
+                          "local_sgd_comm_bytes_per_token_h64": 2.121,
+                          "comm_reduction_h8": 8.0,
+                          "comm_reduction_h64": 64.0,
+                          "inner_steps_gated": 8,
+                          "sync_step_ms": 144.6, "sync_final_cost": 4.31,
+                          "local_sgd_step_ms": 115.5,
+                          "local_sgd_final_cost": 4.16,
+                          "final_cost_ratio": 0.966})
     # the pp_memory row runs on EVERY backend (r8 bubble bench): its
     # analytic bubble-fraction keys must reach the final line as
     # pp_bubble_frac_* so --gate can hold the schedule
@@ -152,6 +171,13 @@ def test_bench_main_cpu_stubbed(monkeypatch, capsys):
     assert final["serving_tok_s"] == 950.1
     assert final["serving_tick_speedup"] == 1.604
     assert final["serving_continuous_beats_static"] is True
+    # the r10 multi-site carriage (every backend): the analytic H=8
+    # comm bytes/token + reductions + the measured final-cost A/B
+    assert final["local_sgd_comm_bytes_per_token"] == 16.967
+    assert final["local_sgd_comm_reduction_h8"] == 8.0
+    assert final["local_sgd_comm_reduction_h64"] == 64.0
+    assert final["local_sgd_final_cost"] == 4.16
+    assert final["local_sgd_sync_final_cost"] == 4.31
 
 
 def test_bench_main_all_configs_stubbed(monkeypatch, capsys):

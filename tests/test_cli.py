@@ -191,6 +191,69 @@ def test_pipeline_validation_matrix():
         microbatches=3, pp_schedule="1f1b")
 
 
+def test_multi_site_flags():
+    """--sites/--inner_steps/--outer_* parse onto their Config fields
+    and default off (sites=1, H=1, DiLoCo's nesterov 0.7/0.9)."""
+    cfg = parse_config(["--sites=4", "--inner_steps=8",
+                        "--outer_optimizer=sgd", "--outer_lr=1.0",
+                        "--outer_momentum=0.0"])
+    assert cfg.sites == 4 and cfg.inner_steps == 8
+    assert cfg.outer_optimizer == "sgd"
+    assert cfg.outer_lr == 1.0 and cfg.outer_momentum == 0.0
+    d = parse_config([])
+    assert d.sites == 1 and d.inner_steps == 1
+    assert d.outer_optimizer == "nesterov"
+    assert d.outer_lr == 0.7 and d.outer_momentum == 0.9
+
+
+def test_multi_site_validation_matrix():
+    """The multi-site (--sites) validation matrix, pinned against
+    ``config.validate_local_sgd_config`` directly (pure config — no
+    training stack), the validate_pipeline_config pattern."""
+    import pytest
+
+    from distributed_tensorflow_example_tpu.config import (
+        Config, validate_local_sgd_config)
+
+    def ok(**kw):
+        validate_local_sgd_config(Config(**kw))
+
+    def bad(match, **kw):
+        with pytest.raises(ValueError, match=match):
+            validate_local_sgd_config(Config(**kw))
+
+    # ---- valid combinations ----
+    ok()                                         # defaults: off
+    ok(sites=2, inner_steps=8)                   # DiLoCo recipe
+    ok(sites=8, inner_steps=1, outer_optimizer="sgd",
+       outer_lr=1.0, outer_momentum=0.0)         # sync-DP degenerate
+    ok(model="transformer", objective="lm", sites=2, inner_steps=64,
+       grad_accum=2)                             # LM + accum compose
+    ok(sites=2, inner_steps=4, on_anomaly="halt")  # host-side policy
+
+    # ---- rejections ----
+    bad("must be >= 1", sites=0)
+    bad("must be >= 1", sites=2, inner_steps=0)
+    bad("needs --sites > 1", inner_steps=4)
+    bad("'nesterov' or 'sgd'", sites=2, outer_optimizer="adam")
+    bad("model_parallel=1", sites=2, model_parallel=2)
+    bad("supersedes", sites=2, sync_period=5)
+    bad("within-site data", sites=2, fsdp=True)
+    bad("within-site data", sites=2, zero_opt=True)
+    bad("within-site data", model="transformer", sites=2,
+        pipeline_parallel=2)
+    bad("within-site data", model="transformer", sites=2,
+        sequence_parallel=2)
+    bad("within-site data", model="transformer", sites=2,
+        expert_parallel=2, num_experts=4)
+    bad("outer_lr", sites=2, outer_lr=0.0)
+    bad("outer_momentum", sites=2, outer_momentum=1.0)
+    bad("dropout_rate", model="transformer", sites=2,
+        dropout_rate=0.1)
+    bad("histograms", sites=2, histograms=True)
+    bad("on_anomaly=skip", sites=2, on_anomaly="skip")
+
+
 def test_r3_flag_surface_parses():
     """Every r3 flag parses and lands on its Config field."""
     from distributed_tensorflow_example_tpu.config import parse_config

@@ -1,0 +1,445 @@
+"""Multi-site local-SGD (DiLoCo-style) tests — parallel/local_sgd.py.
+
+Two families:
+
+- PURE (run everywhere, no mesh): the outer Nesterov/SGD update
+  against a numpy oracle, its parameter-averaging degenerate case,
+  and the obs/flops comm-volume closed forms behind the
+  ``local_sgd_comm_bytes_per_token`` gate.
+- STACK-GATED (needs_stack, 8 virtual devices): the H=1 + outer
+  SGD(lr=1, momentum=0) equivalence with synchronous DP, the
+  old ``--sync_period`` path cross-test, round-boundary consensus
+  with per-site inner state, the checkpoint round-trip of the outer
+  state, and the end-to-end LM driver run. Site meshes here use
+  1-device sites so the only collectives are the module's own
+  explicit psums (exactly the slow-axis traffic the recipe bounds).
+"""
+
+import numpy as np
+import pytest
+
+from conftest import needs_stack  # noqa: E402
+
+from distributed_tensorflow_example_tpu.config import Config
+from distributed_tensorflow_example_tpu.obs import flops as flops_lib
+from distributed_tensorflow_example_tpu.parallel import local_sgd as ls
+
+# ---------------------------------------------------------------------------
+# pure: outer optimizer oracle + comm accounting (no mesh, no stack)
+# ---------------------------------------------------------------------------
+
+
+def _tree(seed, shapes=((3, 4), (5,))):
+    rng = np.random.RandomState(seed)
+    return {f"p{i}": rng.randn(*s).astype(np.float32)
+            for i, s in enumerate(shapes)}
+
+
+@pytest.mark.parametrize("mu,nesterov", [(0.9, True), (0.5, True)])
+def test_outer_nesterov_matches_numpy_oracle(mu, nesterov):
+    """The outer update over pseudo-gradients == a step-by-step numpy
+    Nesterov oracle (PyTorch convention: m <- mu*m + d, applied step
+    d + mu*m), over several rounds."""
+    lr = 0.7
+    outer = ls.make_outer_optimizer("nesterov", lr, mu)
+    params = _tree(0)
+    state = outer.init(params)
+    m_ref = {k: np.zeros_like(v) for k, v in params.items()}
+    p_ref = {k: v.copy() for k, v in params.items()}
+    for t in range(4):
+        delta = _tree(10 + t)
+        params, state = outer.update(delta, state, params)
+        for k in p_ref:
+            m_ref[k] = mu * m_ref[k] + delta[k]
+            p_ref[k] = p_ref[k] - lr * (delta[k] + mu * m_ref[k])
+            np.testing.assert_allclose(np.asarray(params[k]), p_ref[k],
+                                       rtol=1e-6, atol=1e-7, err_msg=k)
+            np.testing.assert_allclose(np.asarray(state["m"][k]),
+                                       m_ref[k], rtol=1e-6, atol=1e-7)
+
+
+def test_outer_sgd_lr1_is_parameter_averaging():
+    """outer SGD at lr=1: p - 1*(p - mean(p_after)) == mean(p_after) —
+    the degenerate case that reproduces the legacy --sync_period
+    parameter averaging (and, at H=1, synchronous DP)."""
+    outer = ls.make_outer_optimizer("sgd", 1.0, 0.9)  # momentum pinned 0
+    assert outer.momentum == 0.0 and outer.init(_tree(0)) == ()
+    params = _tree(1)
+    after = _tree(2)
+    delta = {k: params[k] - after[k] for k in params}
+    new_p, state = outer.update(delta, (), params)
+    assert state == ()
+    for k in params:
+        np.testing.assert_allclose(np.asarray(new_p[k]), after[k],
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_outer_optimizer_rejects_unknown():
+    with pytest.raises(ValueError, match="outer_optimizer"):
+        ls.make_outer_optimizer("adam", 0.1)
+
+
+def test_outer_momentum_zero_nesterov_equals_sgd():
+    a = ls.make_outer_optimizer("nesterov", 0.5, 0.0)
+    b = ls.make_outer_optimizer("sgd", 0.5)
+    params, delta = _tree(3), _tree(4)
+    pa, _ = a.update(delta, a.init(params), params)
+    pb, _ = b.update(delta, b.init(params), params)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(pa[k]),
+                                      np.asarray(pb[k]))
+
+
+def test_comm_volume_closed_forms():
+    """The analytic accounting behind the gated
+    local_sgd_comm_bytes_per_token: ring all-reduce per-replica bytes,
+    the sync-vs-outer payload identity (f32 grads vs f32 deltas), and
+    the exactly-H-fold amortized reduction the bench row gates >= 4x."""
+    # ring all-reduce: 2*(n-1)/n of the payload; nothing at n=1
+    assert flops_lib.allreduce_bytes_per_replica(100.0, 1) == 0.0
+    assert flops_lib.allreduce_bytes_per_replica(100.0, 2) == 100.0
+    assert flops_lib.allreduce_bytes_per_replica(800.0, 8) == 1400.0
+
+    from distributed_tensorflow_example_tpu.models import transformer
+    spec = transformer.TransformerSpec(
+        input_size=32, num_classes=10, seq_len=32, d_model=32,
+        n_heads=2, num_blocks=2, d_ff=64, objective="lm",
+        vocab_size=32, causal=True)
+    n = flops_lib.num_params(spec)
+    assert n == transformer.num_params(spec) and n > 0
+    sync = flops_lib.sync_dp_comm_bytes_per_step(spec, 8)
+    outer = flops_lib.local_sgd_comm_bytes_per_round(spec, 8)
+    # f32 params: the per-step grad psum and the per-round f32 delta
+    # psum move the same bytes — the reduction is purely the H-fold
+    # amortization
+    assert sync == outer == flops_lib.allreduce_bytes_per_replica(
+        4 * n, 8)
+    batch, toks = 64, flops_lib.tokens_per_example(spec)
+    sync_tok = flops_lib.comm_bytes_per_token(sync, batch, toks)
+    for h in (8, 64):
+        h_tok = flops_lib.comm_bytes_per_token(outer / h, batch, toks)
+        assert sync_tok / h_tok == pytest.approx(h)
+    assert sync_tok / flops_lib.comm_bytes_per_token(
+        outer / 8, batch, toks) >= 4.0  # the gated claim
+
+    from distributed_tensorflow_example_tpu.models.mlp import MLPSpec
+    mspec = MLPSpec(input_size=16, hidden_sizes=(8,), num_classes=4)
+    assert flops_lib.num_params(mspec) == 16 * 8 + 8 + 8 * 4 + 4
+    # token-less family: one "token" per example
+    assert flops_lib.comm_bytes_per_token(80.0, 10,
+                                          None) == pytest.approx(8.0)
+
+
+# ---------------------------------------------------------------------------
+# stack-gated: the mesh path (8 virtual devices)
+# ---------------------------------------------------------------------------
+
+SPEC_KW = dict(input_size=16, hidden_sizes=(8,), num_classes=4)
+
+
+def _data(batch, input_size=16, num_classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(batch, input_size).astype(np.float32)
+    y = np.eye(num_classes, dtype=np.float32)[
+        rng.randint(0, num_classes, batch)]
+    return x, y
+
+
+def _site_setup(cfg, spec, sites, data=1):
+    import jax
+
+    from distributed_tensorflow_example_tpu.parallel import (
+        mesh as mesh_lib)
+    from distributed_tensorflow_example_tpu.train.optim import (
+        make_optimizer)
+    from distributed_tensorflow_example_tpu.train.state import (
+        create_train_state)
+
+    mesh = mesh_lib.build_site_mesh(sites, data)
+    opt = make_optimizer(cfg)
+    outer = ls.outer_optimizer_from_config(cfg)
+    state = ls.site_state(
+        create_train_state(jax.random.PRNGKey(1), spec, opt),
+        sites, outer)
+    state = mesh_lib.place_state(state, mesh, ls.site_specs(state))
+    step = ls.build_local_sgd_step(cfg, mesh, spec, opt, outer, state)
+    get_p = ls.build_site_unstack_params(mesh, state)
+    return mesh, opt, state, step, get_p
+
+
+@needs_stack
+def test_site_axis_matches_mesh_registry(devices8):
+    """local_sgd's import-safe SITE_AXIS mirror must equal the mesh
+    registry constant dtx-lint's axis rule resolves."""
+    from distributed_tensorflow_example_tpu.parallel import (
+        mesh as mesh_lib)
+
+    assert ls.SITE_AXIS == mesh_lib.SITE_AXIS == "site"
+
+
+@needs_stack
+def test_h1_outer_sgd_equals_sync_dp(devices8):
+    """THE equivalence anchor: H=1 with the trivial outer step (SGD
+    lr=1, momentum=0) over 8 one-device sites == synchronous DP (the
+    single-device full-batch step, which the §4 psum tests pin as the
+    sync-DP ground truth) — exact up to fp reassociation of the one
+    pseudo-gradient mean vs the batch-mean gradient."""
+    import jax
+
+    from distributed_tensorflow_example_tpu.models.mlp import MLPSpec
+    from distributed_tensorflow_example_tpu.parallel import (
+        mesh as mesh_lib)
+    from distributed_tensorflow_example_tpu.parallel import (
+        step as step_lib)
+    from distributed_tensorflow_example_tpu.train.optim import (
+        make_optimizer)
+    from distributed_tensorflow_example_tpu.train.state import (
+        create_train_state)
+
+    spec = MLPSpec(**SPEC_KW)
+    cfg = Config(optimizer="sgd", learning_rate=0.05, sites=8,
+                 inner_steps=1, outer_optimizer="sgd", outer_lr=1.0,
+                 outer_momentum=0.0)
+    _mesh, _opt, state, step, get_p = _site_setup(cfg, spec, 8)
+    for i in range(3):
+        x, y = _data(96, seed=i)
+        state, cost_ms, _ = step(state, x, y)
+    p_ms = jax.device_get(get_p(state))
+
+    cfg1 = Config(optimizer="sgd", learning_rate=0.05)
+    mesh1 = mesh_lib.build_mesh(1, 1)
+    opt1 = make_optimizer(cfg1)
+    s1 = create_train_state(jax.random.PRNGKey(1), spec, opt1)
+    s1 = mesh_lib.place_state(s1, mesh1,
+                              mesh_lib.state_pspecs(spec, opt1, 1))
+    step1 = step_lib.build_train_step(cfg1, mesh1, spec, opt1)
+    for i in range(3):
+        x, y = _data(96, seed=i)
+        s1, cost1, _ = step1(s1, x, y)
+    p1 = jax.device_get(s1.params)
+    assert abs(float(cost_ms) - float(cost1)) < 1e-5
+    for k in p1:
+        np.testing.assert_allclose(np.asarray(p_ms[k]),
+                                   np.asarray(p1[k]),
+                                   rtol=2e-5, atol=2e-6, err_msg=k)
+
+
+@needs_stack
+def test_new_sites_path_equals_old_sync_period(devices8):
+    """Cross-test (the stale-surface satellite): --sites 8
+    --inner_steps K --outer_optimizer sgd --outer_lr 1 reproduces the
+    legacy --sync_period K path at matching settings — same data
+    assignment, same final consensus params."""
+    import jax
+
+    from distributed_tensorflow_example_tpu.models.mlp import MLPSpec
+    from distributed_tensorflow_example_tpu.parallel import (
+        mesh as mesh_lib)
+    from distributed_tensorflow_example_tpu.parallel import (
+        step as step_lib)
+    from distributed_tensorflow_example_tpu.train.optim import (
+        make_optimizer)
+    from distributed_tensorflow_example_tpu.train.state import (
+        create_train_state)
+
+    spec = MLPSpec(**SPEC_KW)
+    K = 2
+    # legacy path: 2 divergent-replica steps over 'data', then average
+    cfg_old = Config(optimizer="sgd", learning_rate=0.05,
+                     sync_period=K)
+    mesh8 = mesh_lib.build_mesh(8, 1)
+    opt_o = make_optimizer(cfg_old)
+    stacked = step_lib.stack_state(
+        create_train_state(jax.random.PRNGKey(1), spec, opt_o), 8)
+    stacked = mesh_lib.place_state(stacked, mesh8,
+                                   step_lib._stacked_specs(stacked))
+    lstep = step_lib.build_local_train_step(cfg_old, mesh8, spec,
+                                            opt_o, stacked)
+    sync = step_lib.build_param_sync(mesh8, stacked)
+    batches = [_data(96, seed=i) for i in range(K)]
+    for x, y in batches:
+        stacked, _, _ = lstep(stacked, x, y)
+    stacked = sync(stacked)
+    p_old = jax.device_get(
+        step_lib.build_unstack_params(mesh8, stacked)(stacked))
+
+    # new path: ONE round, H=K, trivial outer step; device d's [H, 12]
+    # chunk sequence must be shard d's slice of each legacy batch
+    cfg_new = Config(optimizer="sgd", learning_rate=0.05, sites=8,
+                     inner_steps=K, outer_optimizer="sgd",
+                     outer_lr=1.0, outer_momentum=0.0)
+    _m, _o, st, rstep, get_p = _site_setup(cfg_new, spec, 8)
+    xn = np.concatenate([
+        np.concatenate([b[0][12 * d:12 * (d + 1)] for b in batches])
+        for d in range(8)])
+    yn = np.concatenate([
+        np.concatenate([b[1][12 * d:12 * (d + 1)] for b in batches])
+        for d in range(8)])
+    st, _, _ = rstep(st, xn, yn)
+    p_new = jax.device_get(get_p(st))
+    for k in p_old:
+        np.testing.assert_allclose(np.asarray(p_new[k]),
+                                   np.asarray(p_old[k]),
+                                   rtol=2e-5, atol=2e-6, err_msg=k)
+
+
+@needs_stack
+def test_round_ends_in_consensus_inner_state_stays_per_site(devices8):
+    """After a round every site holds identical params (the outer
+    update reconciled them) while the INNER momentum slots differ per
+    site (DiLoCo: inner state never crosses the site axis)."""
+    import jax
+
+    from distributed_tensorflow_example_tpu.models.mlp import MLPSpec
+
+    spec = MLPSpec(**SPEC_KW)
+    cfg = Config(optimizer="momentum", learning_rate=0.1, sites=8,
+                 inner_steps=4, outer_optimizer="nesterov",
+                 outer_lr=0.7, outer_momentum=0.9)
+    _m, _o, st, step, _g = _site_setup(cfg, spec, 8)
+    x, y = _data(8 * 4 * 4, seed=0)
+    st, cost, acc = step(st, x, y)
+    assert np.isfinite(float(cost))
+    w = np.asarray(jax.device_get(st.params["W1"]))       # [8, 16, 8]
+    np.testing.assert_allclose(w, np.broadcast_to(w[0:1], w.shape),
+                               rtol=1e-6, atol=1e-7)
+    m = np.asarray(jax.device_get(st.opt_state["inner"]["m"]["W1"]))
+    assert np.abs(m - m[0:1]).max() > 1e-7, \
+        "per-site inner momentum should have diverged"
+    # the outer momentum buffer exists, is replicated, and moved
+    om = np.asarray(jax.device_get(st.opt_state["outer"]["m"]["W1"]))
+    assert om.shape == (16, 8) and np.abs(om).max() > 0
+    # step counts the inner optimizer steps: H per round
+    assert int(st.step) == 4
+
+
+@needs_stack
+def test_site_state_checkpoint_roundtrip(tmp_path, devices8):
+    """The site-stacked state — outer momentum included — survives a
+    save/restore cycle (the checkpoint satellite)."""
+    import jax
+
+    from distributed_tensorflow_example_tpu.models.mlp import MLPSpec
+    from distributed_tensorflow_example_tpu.utils import (
+        checkpoint as ckpt_lib)
+
+    spec = MLPSpec(**SPEC_KW)
+    cfg = Config(optimizer="adam", learning_rate=0.01, sites=4,
+                 inner_steps=2, outer_optimizer="nesterov",
+                 outer_lr=0.7, outer_momentum=0.9)
+    _m, _o, st, step, _g = _site_setup(cfg, spec, 4, data=2)
+    x, y = _data(4 * 2 * 2 * 3, seed=0)
+    st, _, _ = step(st, x, y)
+    st_host = jax.device_get(st)
+    ckpt_lib.save_checkpoint(str(tmp_path), st_host, int(st_host.step),
+                             1, {"sites": 4, "outer_has_momentum": 1})
+    path = ckpt_lib.latest_checkpoint(str(tmp_path))
+    assert path is not None
+    assert ckpt_lib.load_extras(path)["sites"] == 4
+    restored, step_n, epoch = ckpt_lib.restore_checkpoint(path, st_host)
+    assert (step_n, epoch) == (int(st_host.step), 1)
+    flat_a = jax.tree_util.tree_leaves_with_path(st_host)
+    flat_b = dict(
+        (jax.tree_util.keystr(kp), leaf)
+        for kp, leaf in jax.tree_util.tree_leaves_with_path(restored))
+    for kp, leaf in flat_a:
+        np.testing.assert_array_equal(
+            np.asarray(leaf), np.asarray(flat_b[jax.tree_util.keystr(kp)]),
+            err_msg=jax.tree_util.keystr(kp))
+
+
+@needs_stack
+def test_loop_e2e_multi_site_lm(devices8, tmp_path):
+    """End-to-end driver run on the transformer LM workload (the
+    tentpole's 'not just the MLP path'): 2 sites x 4-way DP inside
+    each, Adam inner + Nesterov outer, host loop forced, steps count
+    rounds x inner_steps."""
+    from distributed_tensorflow_example_tpu.train.loop import run
+
+    cfg = Config(model="transformer", objective="lm", input_size=16,
+                 vocab_size=32, d_model=32, n_heads=2, num_blocks=2,
+                 d_ff=64, dataset="synthetic",
+                 synthetic_train_size=256, synthetic_test_size=32,
+                 batch_size=64, training_epochs=1, sites=2,
+                 inner_steps=4, optimizer="adam", learning_rate=1e-3,
+                 outer_optimizer="nesterov", outer_lr=0.7,
+                 outer_momentum=0.9, summaries=False,
+                 logs_path=str(tmp_path), compilation_cache="")
+    r = run(cfg)
+    assert not r["fast_loop"]
+    assert r["epochs_completed"] == 1
+    rounds = 256 // 64
+    assert r["steps"] == rounds * 4
+    assert np.isfinite(r["final_cost"])
+
+
+@needs_stack
+@pytest.mark.slow
+def test_lm_h8_loss_within_tolerance_of_sync(devices8):
+    """The loss-curve acceptance (slow): the LM workload at H=8 over 8
+    one-device sites reaches a final cost within tolerance of the
+    synchronous baseline on the SAME per-inner-step batches, while
+    the analytic comm accounting shows the >= 4x synced-bytes
+    reduction the bench row gates."""
+    import jax
+
+    from distributed_tensorflow_example_tpu.models import (
+        transformer as tfm)
+    from distributed_tensorflow_example_tpu.parallel import (
+        mesh as mesh_lib)
+    from distributed_tensorflow_example_tpu.parallel import (
+        step as step_lib)
+    from distributed_tensorflow_example_tpu.train.optim import (
+        make_optimizer)
+    from distributed_tensorflow_example_tpu.train.state import (
+        create_train_state)
+
+    spec = tfm.TransformerSpec(
+        input_size=16, num_classes=10, seq_len=16, d_model=32,
+        n_heads=2, num_blocks=2, d_ff=64, objective="lm",
+        vocab_size=32, causal=True)
+    H, sites, rounds, batch = 8, 8, 8, 32
+    rng = np.random.RandomState(0)
+    # rounds x H inner-step batches of `batch` examples
+    data = rng.rand(rounds, H, batch, 16).astype(np.float32)
+    y0 = np.zeros((batch, 10), np.float32)
+
+    base = dict(model="transformer", objective="lm", input_size=16,
+                vocab_size=32, d_model=32, n_heads=2, num_blocks=2,
+                d_ff=64, optimizer="sgd", learning_rate=0.5)
+    # sync baseline: single device (the pinned sync-DP ground truth),
+    # one step per inner batch
+    cfg_s = Config(**base)
+    mesh1 = mesh_lib.build_mesh(1, 1)
+    opt_s = make_optimizer(cfg_s)
+    st_s = create_train_state(jax.random.PRNGKey(2), spec, opt_s)
+    st_s = mesh_lib.place_state(st_s, mesh1,
+                                mesh_lib.state_pspecs(spec, opt_s, 1))
+    sstep = step_lib.build_train_step(cfg_s, mesh1, spec, opt_s)
+    for r in range(rounds):
+        for i in range(H):
+            st_s, cost_s, _ = sstep(st_s, data[r, i], y0)
+    cost_s = float(cost_s)
+
+    cfg_l = Config(sites=sites, inner_steps=H,
+                   outer_optimizer="nesterov", outer_lr=0.7,
+                   outer_momentum=0.9, **base)
+    _m, _o, st_l, rstep, _g = _site_setup(cfg_l, spec, sites)
+    b_site = batch // sites
+    for r in range(rounds):
+        x = np.concatenate([
+            data[r, :, d * b_site:(d + 1) * b_site]
+            .reshape(H * b_site, -1) for d in range(sites)])
+        y = np.zeros((x.shape[0], 10), np.float32)
+        st_l, cost_l, _ = rstep(st_l, x, y)
+    cost_l = float(cost_l)
+
+    init_cost = float(np.log(32))  # uniform next-token nll
+    assert cost_s < init_cost and cost_l < init_cost, \
+        (cost_s, cost_l)  # both actually learned
+    assert cost_l <= cost_s * 1.25, (cost_l, cost_s)
+
+    from distributed_tensorflow_example_tpu.obs import flops as fl
+    sync_b = fl.sync_dp_comm_bytes_per_step(spec, sites)
+    outer_b = fl.local_sgd_comm_bytes_per_round(spec, sites) / H
+    assert sync_b / outer_b >= 4.0

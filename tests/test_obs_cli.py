@@ -339,6 +339,44 @@ def test_compare_understands_serving_keys():
     assert "decode_hbm_frac" in verdict["regressions"]
 
 
+def test_compare_understands_local_sgd_keys():
+    """The multi-site local-SGD row (ISSUE 10): the bench_local_sgd
+    row gates on the analytic H=8 comm bytes/token and the measured
+    final cost, and the final summary carries both under their gate
+    names — without hijacking the summary's other metrics (the row
+    branch keys on sync_comm_bytes_per_token, which only the row
+    has)."""
+    row = {"config": "local_sgd",
+           "sync_comm_bytes_per_token": 135.734,
+           "local_sgd_comm_bytes_per_token": 16.967,
+           "local_sgd_comm_bytes_per_token_h64": 2.121,
+           "comm_reduction_h8": 8.0, "comm_reduction_h64": 64.0,
+           "local_sgd_final_cost": 4.16, "sync_final_cost": 4.31}
+    m = cmp_lib.extract_metrics(row)
+    assert m == {"local_sgd_comm_bytes_per_token": 16.967,
+                 "local_sgd_final_cost": 4.16}
+    # a doctored candidate whose outer sync got heavier gates (the
+    # analytic key is tight: 1%)
+    worse = dict(row, local_sgd_comm_bytes_per_token=17.5)
+    verdict = cmp_lib.compare(row, worse)
+    assert not verdict["ok"]
+    assert "local_sgd_comm_bytes_per_token" in verdict["regressions"]
+    # a doctored final-cost regression gates too (wide threshold)
+    verdict = cmp_lib.compare(row, dict(row, local_sgd_final_cost=6.0))
+    assert not verdict["ok"]
+    assert "local_sgd_final_cost" in verdict["regressions"]
+    assert cmp_lib.compare(row, row)["ok"]
+    # final-summary shape: the keys ride ALONGSIDE wall_s/mfu — the
+    # summary must not be mistaken for a local-SGD row
+    summary = {"metric": "mnist_20epoch_wall_clock", "value": 0.15,
+               "local_sgd_comm_bytes_per_token": 16.967,
+               "local_sgd_final_cost": 4.16}
+    ms = cmp_lib.extract_metrics(summary)
+    assert ms["wall_s"] == 0.15
+    assert ms["local_sgd_comm_bytes_per_token"] == 16.967
+    assert ms["local_sgd_final_cost"] == 4.16
+
+
 def test_compare_zero_baseline_stays_strict_json():
     """A zero baseline metric must not fabricate Infinity (non-strict
     JSON) nor gate: it reads as 'incomparable'."""
