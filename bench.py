@@ -759,6 +759,11 @@ def bench_flash_attention(s: int = 4096, b: int = 4, h: int = 8,
 
     flash_fn = lambda q_, k_, v_: fa.flash_attention(q_, k_, v_, True)
     dense_fn = lambda q_, k_, v_: ra.attention(q_, k_, v_, causal=True)
+    # ONE jitted wrapper each, hoisted out of the per-dtype/per-shape
+    # loops below (dtx-lint retrace): jit caches per input signature,
+    # so each dtype still compiles exactly once — but through the same
+    # wrapped callable instead of a fresh wrapper per iteration
+    flash_jit, dense_jit = jax.jit(flash_fn), jax.jit(dense_fn)
     fwd_step, grad_step = _fwd_carry_step, _grad_carry_step
 
     def ref_kernels():
@@ -853,8 +858,8 @@ def bench_flash_attention(s: int = 4096, b: int = 4, h: int = 8,
                 row[f"{tag}_vs_ref_kernel_train"] = round(
                     statistics.median(train), 2)
         row[f"max_abs_diff_{tag}"] = float(np.max(np.abs(
-            np.asarray(jax.jit(flash_fn)(q, k, v)).astype(np.float32)
-            - np.asarray(jax.jit(dense_fn)(q, k, v)).astype(np.float32))))
+            np.asarray(flash_jit(q, k, v)).astype(np.float32)
+            - np.asarray(dense_jit(q, k, v)).astype(np.float32))))
     # max-context probe: S=16384, [2,S,8,64] (distinct random q/k/v —
     # identical tensors would make the softmax degenerately peaked),
     # where dense would need a 17 GB score tensor — reported as an
@@ -868,8 +873,7 @@ def bench_flash_attention(s: int = 4096, b: int = 4, h: int = 8,
                 (rng2.randn(b2, s2, h, d) * 0.3).astype(
                     np.float32).astype(dt))
                 for _ in range(3)]
-            out = np.asarray(jax.jit(flash_fn)(q2, k2, v2)).astype(
-                np.float32)
+            out = np.asarray(flash_jit(q2, k2, v2)).astype(np.float32)
             row[f"s16384_{tag}_ok"] = bool(np.isfinite(out).all())
             t16 = _delta_chain(fwd_step(flash_fn), (q2, k2, v2), n1=4,
                                n2=20, reps=repeats)
@@ -1010,10 +1014,16 @@ def bench_transformer_wide(repeats: int = 3, d_model: int = 2048,
     # LN passes VERDICT r5 named as the first suspect for this row's
     # MFU gap — measured as a third variant so the win (or its
     # absence) is a recorded A/B, not an assumption
+    # fp8_ffn (ISSUE 11 leg b): the FFN matmuls — the bulk of this
+    # row's FLOPs at S=512 — on fp8-rounded operands, stacked on the
+    # best bf16 variant (flash + fused_ln) so the A/B isolates the
+    # fp8 increment
     for label, kw in (("dense", dict(attention="dense")),
                       ("flash", dict(attention="flash")),
                       ("fused_ln", dict(attention="flash",
-                                        fused_ln=True))):
+                                        fused_ln=True)),
+                      ("fp8_ffn", dict(attention="flash",
+                                       fused_ln=True, fp8_ffn=True))):
         cfg = Config(
             model="transformer",
             input_size=4 * seq, seq_len=seq, d_model=d_model,
@@ -1034,7 +1044,8 @@ def bench_transformer_wide(repeats: int = 3, d_model: int = 2048,
     # the row's headline mfu = the best variant (feeds best_mfu);
     # only when some variant produced one — an unknown chip peak must
     # not fabricate a gated mfu=0 (spurious --gate regression)
-    mfus = [row[k] for k in ("dense_mfu", "flash_mfu", "fused_ln_mfu")
+    mfus = [row[k] for k in ("dense_mfu", "flash_mfu", "fused_ln_mfu",
+                             "fp8_ffn_mfu")
             if row.get(k) is not None]
     if mfus:
         row["mfu"] = max(mfus)
@@ -1500,10 +1511,25 @@ def bench_moe_wide(e: int = 64, seq: int = 1024, batch: int = 32,
     row["grouped_tokens_per_sec"] = round(batch * seq / step_g, 1)
     row.update({f"grouped_{kk}": v
                 for kk, v in _rate(flops, step_g, peak).items()})
+    # --fp8_ffn A/B (ISSUE 11 leg b): the same grouped expert kernel
+    # on fp8-e4m3-rounded operands — the next step past the bf16 MFU
+    # this row still sits lowest on.  Same analytic FLOPs (fp8 does
+    # not change the MAC count), so the fp8_mfu key is directly
+    # comparable to grouped_mfu
+    cfg_8 = cfg.replace(grouped_moe=True, fp8_ffn=True)
+    spec_8 = make_spec(cfg_8)
+    step_8 = _steady_state_step_time(cfg_8, spec_8, mesh, img_d, lbl_d,
+                                     spe, 1, repeats)
+    row["fp8_step_time_ms"] = round(step_8 * 1000, 2)
+    row["fp8_tokens_per_sec"] = round(batch * seq / step_8, 1)
+    row.update({f"fp8_{kk}": v
+                for kk, v in _rate(flops, step_8, peak).items()})
     if row.get("grouped_mfu") is not None:
         # headline = best variant; never fabricate mfu=0 when the
         # chip peak is unknown (_rate omits the key entirely then)
         row["mfu"] = max(row.get("mfu") or 0, row["grouped_mfu"])
+    if row.get("fp8_mfu") is not None:
+        row["mfu"] = max(row.get("mfu") or 0, row["fp8_mfu"])
     row["target_mfu"] = 0.35   # ISSUE 6 row contract (TPU claim)
     # dispatch-vs-expert breakdown: VERDICT r5 SUSPECTED the
     # scatter/gather dispatch dominates this row's 0.21 MFU — measure
@@ -1649,7 +1675,133 @@ def bench_decode(batch: int = 32, seq: int = 1024, d_model: int = 1024,
         # fabricated off-TPU — the mfu convention
         row["decode_hbm_frac"] = round(flops_lib.hbm_frac(
             bytes_per_step, step_s, peak_hbm), 4)
+    # int8-KV roofline context (ISSUE 11 leg a): what this measured
+    # step time projects once the KV half of the analytic bytes
+    # shrinks to the --kv_quant=int8 pool — weights term untouched,
+    # and the int8 pool's full cost counted: payload PLUS the f32
+    # scale planes (4/Dh of the payload), matching bench_kv_quant's
+    # accounting.  The GATED closed forms themselves live in
+    # bench_kv_quant, which runs on EVERY backend — this TPU row only
+    # adds the projection that needs its measured step_s.
+    if peak_hbm:
+        kv_base = flops_lib.decode_kv_bytes_per_step(spec, batch,
+                                                     seq / 2.0)
+        kv_int8 = flops_lib.decode_kv_bytes_per_step(
+            spec, batch, seq / 2.0, kv_dtype_bytes=1) \
+            + flops_lib.decode_kv_scale_bytes_per_step(spec, batch,
+                                                       seq / 2.0)
+        row["decode_hbm_frac_int8_projected"] = round(
+            flops_lib.hbm_frac(
+                bytes_per_step - kv_base + kv_int8, step_s, peak_hbm),
+            4)
     return row
+
+
+def bench_kv_quant(batch: int = 32, seq: int = 1024,
+                   d_model: int = 1024, n_heads: int = 8,
+                   blocks: int = 4, d_ff: int = 4096,
+                   repeats: int = 3):
+    """int8 KV pages (ISSUE 11 leg a), two halves — every backend
+    (the bench_pp_memory/bench_local_sgd precedent: the analytic half
+    is the gateable evidence and must not hide in the TPU-only
+    sweep):
+
+    1. ANALYTIC (obs/flops closed forms on bench_decode's exact
+       shapes): KV bytes per decode step at the bf16 pool's itemsize
+       vs the --kv_quant=int8 pool's 1 byte/element — the int8
+       bytes/step and the exactly-2x reduction are gated tight
+       (``decode_kv_bytes_per_step_int8`` /
+       ``decode_kv_reduction_int8``, obs/compare GATE_METRICS, 1%).
+       The scale planes (one f32 per row/head) are their own term:
+       4/Dh of the int8 payload, outside the gated halving so it
+       stays exact.
+
+    2. MEASURED (tiny engine A/B on the current backend): the same
+       request set through a base-pool and an int8-pool DecodeEngine
+       — tok/s each plus ``kv_quant_greedy_match`` (token-identical
+       greedy completions, the serving parity suite's invariant as
+       recorded evidence).  Degrades to an error key (the
+       bench_pp_memory precedent)."""
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_example_tpu.models import transformer as tfm
+    from distributed_tensorflow_example_tpu.obs import flops as flops_lib
+
+    spec = tfm.TransformerSpec(
+        input_size=seq, num_classes=10, seq_len=seq, d_model=d_model,
+        n_heads=n_heads, num_blocks=blocks, d_ff=d_ff, objective="lm",
+        vocab_size=256, causal=True, attention="dense",
+        compute_dtype=jnp.bfloat16)
+    kv_base = flops_lib.decode_kv_bytes_per_step(spec, batch, seq / 2.0)
+    kv_int8 = flops_lib.decode_kv_bytes_per_step(spec, batch, seq / 2.0,
+                                                 kv_dtype_bytes=1)
+    row = {
+        "config": "kv_quant",
+        "model": f"B={batch} S={seq} d_model={d_model} blocks={blocks} "
+                 f"bf16 pool vs int8 pool (decode-roofline shapes, "
+                 f"mean kv_len S/2; obs/flops.py)",
+        "decode_kv_bytes_per_step": round(kv_base, 1),
+        "decode_kv_bytes_per_step_int8": round(kv_int8, 1),
+        "decode_kv_scale_bytes_per_step": round(
+            flops_lib.decode_kv_scale_bytes_per_step(spec, batch,
+                                                     seq / 2.0), 1),
+        "decode_kv_reduction_int8": round(kv_base / kv_int8, 3),
+    }
+    try:
+        row.update(_bench_decode_kv_quant_measured(repeats=repeats))
+    except Exception as e:   # noqa: BLE001 — degrade, don't void
+        row["kv_quant_measured_error"] = str(e)[:200]
+    return row
+
+
+def _bench_decode_kv_quant_measured(page_size: int = 8,
+                                    max_batch: int = 4, seed: int = 0,
+                                    repeats: int = 3) -> dict:
+    """The measured half of the int8-KV A/B: the same ragged request
+    set through two DecodeEngines — base (compute-dtype) pool vs
+    --kv_quant=int8 pool — on the current backend.  Reports tok/s for
+    both plus ``kv_quant_greedy_match``: whether the int8 pool emitted
+    TOKEN-IDENTICAL greedy completions (the serving parity suite pins
+    this as an invariant; here it is recorded evidence)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_example_tpu.models import transformer as tfm
+    from distributed_tensorflow_example_tpu.serving.engine import DecodeEngine
+
+    seq = 128
+    spec = tfm.TransformerSpec(
+        input_size=seq, num_classes=10, seq_len=seq, d_model=64,
+        n_heads=4, num_blocks=2, d_ff=128, objective="lm",
+        vocab_size=64, causal=True, compute_dtype=jnp.bfloat16)
+    params = tfm.init(jax.random.PRNGKey(0), spec)
+    rng = np.random.RandomState(seed)
+    reqs = [(rng.randint(4, 24), rng.randint(4, 18)) for _ in range(16)]
+    prompts = [rng.randint(0, 64, size=p).tolist() for p, _n in reqs]
+    out = {}
+    tokens = {}
+    for quant in ("", "int8"):
+        engine = DecodeEngine(spec, params, page_size=page_size,
+                              max_batch=max_batch, seed=seed,
+                              kv_quant=quant)
+        best = None
+        for attempt in range(max(1, repeats) + 1):
+            t0 = time.time()
+            rids = [engine.submit(p, n)
+                    for p, (_pl, n) in zip(prompts, reqs)]
+            engine.run_until_idle()
+            wall = time.time() - t0
+            res = [engine.result(r, timeout=1.0) for r in rids]
+            toks = sum(len(r["tokens"]) for r in res)
+            # attempt 0 warms every shape bucket's compile
+            if attempt > 0 and (best is None or toks / wall > best):
+                best = toks / wall
+            tokens[quant] = [r["tokens"] for r in res]
+        out["kv_quant_tok_s_base" if not quant
+            else "kv_quant_tok_s_int8"] = round(best or 0.0, 1)
+    out["kv_quant_greedy_match"] = tokens[""] == tokens["int8"]
+    return out
 
 
 def bench_serving(n_requests: int = 24, max_batch: int = 4,
@@ -1811,6 +1963,14 @@ def bench_local_sgd(rounds: int = 6, batch: int = 64, seq: int = 64,
     h8_tok = fl.comm_bytes_per_token(round_bytes / h_gate, batch, toks)
     h64_tok = fl.comm_bytes_per_token(round_bytes / h_deep, batch,
                                       toks)
+    # --outer_quant=int8 (ISSUE 11 leg c): the same outer sync as
+    # int8 wire values + one f32 scale per leaf — ~4x fewer bytes on
+    # the slow axis, gated >= 3.5x (obs/compare GATE_METRICS,
+    # analytic 1%)
+    q_round_bytes = fl.local_sgd_outer_quant_bytes_per_round(spec,
+                                                             n_rep)
+    h8_q_tok = fl.comm_bytes_per_token(q_round_bytes / h_gate, batch,
+                                       toks)
     row = {
         "config": "local_sgd",
         "model": f"lm transformer d64x2 S={seq} ({n_params} params), "
@@ -1826,6 +1986,9 @@ def bench_local_sgd(rounds: int = 6, batch: int = 64, seq: int = 64,
         "comm_reduction_h8": round(sync_tok / h8_tok, 2),
         "comm_reduction_h64": round(sync_tok / h64_tok, 2),
         "inner_steps_gated": h_gate,
+        "local_sgd_outer_quant_sync_bytes": round(q_round_bytes, 1),
+        "local_sgd_outer_quant_bytes_per_token": round(h8_q_tok, 3),
+        "local_sgd_outer_quant_reduction": round(h8_tok / h8_q_tok, 2),
     }
     try:
         row.update(_bench_local_sgd_measured(spec, rounds, batch,
@@ -1938,6 +2101,28 @@ def _bench_local_sgd_measured(spec, rounds: int, batch: int, h: int,
     out["local_sgd_step_ms"] = round(wall_l / (rounds * h) * 1e3, 3)
     out["local_sgd_final_cost"] = round(cost_l, 4)
     out["final_cost_ratio"] = round(cost_l / max(cost_s, 1e-9), 4)
+
+    # --- quantized outer sync (--outer_quant=int8): the same rounds
+    # with the int8 + error-feedback compressed pseudo-gradient —
+    # the measured "compression is free" evidence next to the
+    # analytic byte reduction
+    cfg_q = cfg_l.replace(outer_quant="int8")
+    st_q = ls.site_state(
+        create_train_state(jax.random.PRNGKey(seed), spec, opt_l),
+        sites, outer, outer_quant="int8")
+    st_q = mesh_lib.place_state(st_q, mesh_l, ls.site_specs(st_q))
+    step_q = ls.build_local_sgd_step(cfg_q, mesh_l, spec, opt_l,
+                                     outer, st_q)
+    timed(step_q, st_q, local_feed[:1])      # compile warm-up
+    st_q = ls.site_state(
+        create_train_state(jax.random.PRNGKey(seed), spec, opt_l),
+        sites, outer, outer_quant="int8")
+    st_q = mesh_lib.place_state(st_q, mesh_l, ls.site_specs(st_q))
+    wall_q, cost_q, _ = timed(step_q, st_q, local_feed)
+    out["outer_quant_step_ms"] = round(wall_q / (rounds * h) * 1e3, 3)
+    out["outer_quant_final_cost"] = round(cost_q, 4)
+    out["outer_quant_cost_ratio"] = round(cost_q / max(cost_l, 1e-9),
+                                          4)
     return out
 
 
@@ -2013,6 +2198,11 @@ def bench_pallas_parity():
     from distributed_tensorflow_example_tpu.ops import pallas_fused
 
     out = {"config": "pallas_parity", "backend": jax.default_backend()}
+    # jitted ONCE with the spec static (dtx-lint retrace: each spec
+    # still traces exactly once, through one wrapper instead of a
+    # fresh jit per loop iteration)
+    want_fn = jax.jit(mlp.apply, static_argnums=0)
+    got_fn = jax.jit(pallas_fused.mlp_forward, static_argnums=0)
     for tag, spec, batch in (
         ("f32_784_100_10",
          mlp.MLPSpec(input_size=784, hidden_sizes=(100,), num_classes=10), 100),
@@ -2022,10 +2212,8 @@ def bench_pallas_parity():
     ):
         params = mlp.init(jax.random.PRNGKey(1), spec)
         x = np.random.RandomState(0).rand(batch, spec.input_size).astype(np.float32)
-        want = np.asarray(jax.jit(
-            lambda p, xx, s=spec: mlp.apply(s, p, xx))(params, x))
-        got = np.asarray(jax.jit(
-            lambda p, xx, s=spec: pallas_fused.mlp_forward(s, p, xx))(params, x))
+        want = np.asarray(want_fn(spec, params, x))
+        got = np.asarray(got_fn(spec, params, x))
         out[f"max_abs_diff_{tag}"] = float(np.max(np.abs(got - want)))
     return out
 
@@ -2189,6 +2377,12 @@ def main(argv=None) -> int:
     # H-fold reduction claim; the measured sync-vs-H=8 A/B degrades
     # to an error key where the stack or devices are missing
     guarded("local_sgd", bench_local_sgd)
+    # the int8-KV row runs on EVERY backend (r11): the halved-bytes
+    # closed forms are the gated evidence (bench_decode itself is
+    # TPU-only — hiding the analytic half there would silently drop
+    # the gate off-TPU, the pp_memory lesson), and the tiny engine
+    # A/B is CPU-viable
+    guarded("kv_quant", bench_kv_quant)
     if on_tpu:
         guarded("reference_device_program", bench_reference_device_program)
         # the wide-MXU rows only mean something on a TPU (and in
@@ -2357,6 +2551,20 @@ def main(argv=None) -> int:
             extra["decode_hbm_frac"] = dec_row["decode_hbm_frac"]
         if dec_row.get("decode_achieved_gbps") is not None:
             extra["decode_achieved_gbps"] = dec_row["decode_achieved_gbps"]
+    # the int8-KV closed forms (ISSUE 11, every backend): the
+    # quantized pool's bytes/step and the exactly-2x reduction ride
+    # the final line under their gate names (analytic, gated at 1%)
+    kvq_row = next(
+        (r for r in rows if r.get("config") == "kv_quant"
+         and "decode_kv_reduction_int8" in r), None)
+    if kvq_row:
+        extra["decode_kv_bytes_per_step_int8"] = \
+            kvq_row["decode_kv_bytes_per_step_int8"]
+        extra["decode_kv_reduction_int8"] = \
+            kvq_row["decode_kv_reduction_int8"]
+        if kvq_row.get("kv_quant_greedy_match") is not None:
+            extra["kv_quant_greedy_match"] = \
+                kvq_row["kv_quant_greedy_match"]
     srv_row = next(
         (r for r in rows if r.get("config") == "serving"
          and "continuous_ticks" in r), None)
@@ -2385,11 +2593,23 @@ def main(argv=None) -> int:
             lsgd_row["comm_reduction_h8"]
         extra["local_sgd_comm_reduction_h64"] = \
             lsgd_row["comm_reduction_h64"]
+        # the quantized-outer closed forms (ISSUE 11): int8+EF sync
+        # bytes/token and the >= 3.5x reduction, under their gate names
+        if lsgd_row.get("local_sgd_outer_quant_bytes_per_token") \
+                is not None:
+            extra["local_sgd_outer_quant_bytes_per_token"] = \
+                lsgd_row["local_sgd_outer_quant_bytes_per_token"]
+        if lsgd_row.get("local_sgd_outer_quant_reduction") is not None:
+            extra["local_sgd_outer_quant_reduction"] = \
+                lsgd_row["local_sgd_outer_quant_reduction"]
         if lsgd_row.get("local_sgd_final_cost") is not None:
             extra["local_sgd_final_cost"] = \
                 lsgd_row["local_sgd_final_cost"]
             extra["local_sgd_sync_final_cost"] = \
                 lsgd_row.get("sync_final_cost")
+        if lsgd_row.get("outer_quant_final_cost") is not None:
+            extra["local_sgd_outer_quant_final_cost"] = \
+                lsgd_row["outer_quant_final_cost"]
     ip_row = next(
         (r for r in rows if r.get("config") == "input_pipeline"
          and "prefetch_step_ms" in r), None)

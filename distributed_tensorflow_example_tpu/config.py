@@ -108,6 +108,12 @@ class Config:
                                     # kernel (both matmuls per
                                     # (expert, capacity-tile) cell,
                                     # hidden resident in VMEM)
+    fp8_ffn: bool = False           # transformer FFN matmuls run on
+                                    # fp8-e4m3-rounded operands with
+                                    # pow2 scales (bf16/f32 master
+                                    # weights; dense FFN + the sparse
+                                    # grouped expert kernel; ops/
+                                    # pallas_fused + ops/quant)
 
     # ---- loss (example.py:92-96) ----
     naive_ce: bool = False          # reproduce the reference's unstable log(softmax) CE
@@ -221,6 +227,14 @@ class Config:
     outer_lr: float = 0.7           # outer learning rate (DiLoCo's
                                     # recipe value)
     outer_momentum: float = 0.9     # outer Nesterov momentum
+    outer_quant: str = ""           # "" | int8: compress the cross-
+                                    # site outer pseudo-gradient sync
+                                    # (symmetric per-leaf int8 with
+                                    # per-site error feedback — the
+                                    # residual rides the opt state, so
+                                    # compression error never
+                                    # accumulates; ~4x fewer bytes on
+                                    # the slow 'site' axis)
     grad_reduce: str = "mean"       # mean | sum over the data axis
     fsdp: bool = False              # ZeRO-3 sharding: params + optimizer
                                     # state split 1/dp per device, gathered
@@ -351,6 +365,13 @@ class Config:
                                     # largest batch bucket the engine
                                     # compiles (shapes are bucketed so
                                     # admission never recompiles)
+    kv_quant: str = ""              # "" | int8: store the paged KV
+                                    # pools as int8 with per-row/
+                                    # per-head f32 scales (halves the
+                                    # KV bytes a decode step streams;
+                                    # serving/kv_cache.py — the
+                                    # contiguous training/sampling
+                                    # cache is untouched)
 
     # ---- validation / early stopping (beyond-reference) ----
     early_stop_patience: int = 0    # > 0: evaluate the validation split
@@ -492,6 +513,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "expert FFN as one fused Pallas kernel (both "
                         "matmuls per expert tile, hidden resident in "
                         "VMEM) instead of two batched XLA einsums")
+    p.add_argument("--fp8_ffn", action="store_true",
+                   help="transformer only: run the FFN matmuls (dense "
+                        "W1/W2 and the sparse grouped expert kernel) "
+                        "on fp8-e4m3-rounded operands with power-of-"
+                        "two scales — bf16/f32 master weights, exact "
+                        "fp8-MXU numerics through the fused kernels "
+                        "(ops/quant.py; no tensor parallelism, MoE "
+                        "needs --moe_dispatch=alltoall)")
     p.add_argument("--expert_parallel", type=int, default=d.expert_parallel,
                    help="MoE only: shard expert weights+FLOPs over a "
                         "('data','expert') mesh")
@@ -596,6 +625,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--outer_momentum", type=float,
                    default=d.outer_momentum,
                    help="outer Nesterov momentum for --sites > 1")
+    p.add_argument("--outer_quant", type=str, default=d.outer_quant,
+                   choices=["", "int8"],
+                   help="compress the multi-site outer pseudo-"
+                        "gradient sync to symmetric per-leaf int8 "
+                        "with per-site error feedback (~4x fewer "
+                        "bytes across 'site' per round; needs "
+                        "--sites > 1)")
     p.add_argument("--grad_reduce", type=str, default=d.grad_reduce,
                    choices=["mean", "sum"])
     p.add_argument("--fsdp", action="store_true",
@@ -718,6 +754,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "bucket the serving engine compiles (>= 1; "
                         "admission/retirement re-bucket, never "
                         "recompile)")
+    p.add_argument("--kv_quant", type=str, default=d.kv_quant,
+                   choices=["", "int8"],
+                   help="paged KV cache storage format: int8 pools "
+                        "with per-row/per-head f32 scales halve the "
+                        "KV bytes each decode step streams from HBM "
+                        "(serving only — needs --model=transformer "
+                        "--objective=lm)")
     p.add_argument("--early_stop_patience", type=int,
                    default=d.early_stop_patience,
                    help="stop after P epochs without validation "
@@ -902,6 +945,63 @@ def validate_local_sgd_config(cfg: Config) -> None:
         raise ValueError("--on_anomaly=skip rides the synchronous "
                          "step's compiled update mask (sites=1); "
                          "halt/dump work on the multi-site path")
+
+
+def validate_quant_config(cfg: Config) -> None:
+    """The quantization (--kv_quant / --fp8_ffn / --outer_quant)
+    validation matrix — pure config checks, raised before any
+    bootstrap work (the validate_pipeline_config pattern;
+    ``tests/test_cli.py`` pins it without the training stack).
+
+    Each flag gates one leg of the ISSUE-11 stack and only composes
+    with the path that implements it:
+
+    - ``kv_quant`` reshapes the PAGED serving cache
+      (serving/kv_cache.py) — it needs the lm transformer the decode
+      engine serves; the contiguous training/sampling cache never
+      quantizes, so any other family/objective is an incoherent ask;
+    - ``fp8_ffn`` rounds the transformer FFN matmul operands — the
+      MLP family has no FFN blocks, tensor parallelism row-splits the
+      very contraction the per-tensor scales cover, and a
+      dense-dispatch MoE never reaches the grouped expert kernel the
+      fp8 path rides;
+    - ``outer_quant`` compresses the cross-site outer sync — without
+      ``--sites > 1`` there is no outer sync to compress.
+    """
+    if cfg.kv_quant not in ("", "int8"):
+        raise ValueError(f"kv_quant={cfg.kv_quant!r}: expected '' or "
+                         f"'int8'")
+    if cfg.outer_quant not in ("", "int8"):
+        raise ValueError(f"outer_quant={cfg.outer_quant!r}: expected "
+                         f"'' or 'int8'")
+    if cfg.kv_quant:
+        if cfg.model != "transformer" or cfg.objective != "lm":
+            raise ValueError(
+                "--kv_quant quantizes the PAGED serving KV cache "
+                "(serving/kv_cache.py), which decodes the lm "
+                "transformer only — it needs --model=transformer "
+                "--objective=lm")
+    if cfg.fp8_ffn:
+        if cfg.model != "transformer":
+            raise ValueError(
+                "--fp8_ffn rounds the transformer FFN matmul "
+                "operands; the MLP family has no FFN blocks "
+                "(--model=transformer)")
+        if cfg.model_parallel > 1:
+            raise ValueError(
+                "--fp8_ffn does not compose with --model_parallel: "
+                "tensor parallelism row-splits the FFN contraction "
+                "the per-tensor fp8 scales cover")
+        if cfg.num_experts and cfg.moe_dispatch != "alltoall":
+            raise ValueError(
+                "--fp8_ffn quantizes the MoE expert FFN through the "
+                "sparse grouped kernel; use --moe_dispatch=alltoall "
+                "(dense dispatch computes every expert on every "
+                "token and never reaches it)")
+    if cfg.outer_quant and cfg.sites <= 1:
+        raise ValueError(
+            "--outer_quant compresses the cross-site outer "
+            "pseudo-gradient sync; it needs --sites > 1")
 
 
 def parse_config(argv: Sequence[str] | None = None) -> Config:

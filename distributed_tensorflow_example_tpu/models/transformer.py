@@ -141,6 +141,14 @@ class TransformerSpec:
                                    # (ops/pallas_fused.
                                    # moe_grouped_matmul) instead of
                                    # two batched XLA einsums
+    fp8_ffn: bool = False          # FFN matmuls (dense W1/W2 and the
+                                   # sparse grouped expert kernel)
+                                   # run on fp8-e4m3-rounded operands
+                                   # with pow2 scales (ops/
+                                   # pallas_fused.fp8_dense_ffn /
+                                   # fp8_grouped_matmul; bf16/f32
+                                   # master weights, straight-through
+                                   # gradients)
     param_dtype: jnp.dtype = jnp.float32
     compute_dtype: jnp.dtype = jnp.float32
 
@@ -553,7 +561,16 @@ def _grouped_expert_ffn(spec: TransformerSpec, buf, we1, be1, we2, be2,
     (ops/pallas_fused.moe_grouped_matmul: one kernel loops (expert,
     capacity-tile) grid cells, weights and the [tile, ff] hidden
     resident in VMEM); otherwise two batched XLA einsums with the
-    [El, C, ff] hidden round-tripping HBM between them."""
+    [El, C, ff] hidden round-tripping HBM between them.  Under
+    ``spec.fp8_ffn`` the SAME fused kernel consumes fp8-e4m3-rounded
+    operands with per-expert pow2 scales (ops/pallas_fused.
+    fp8_grouped_matmul) — exact fp8-MXU numerics, straight-through
+    gradients to the master weights."""
+    if spec.fp8_ffn:
+        from ..ops.pallas_fused import fp8_grouped_matmul
+
+        return fp8_grouped_matmul(spec.activation, cdt, buf,
+                                  we1, be1, we2, be2)
     if spec.grouped_moe:
         from ..ops.pallas_fused import moe_grouped_matmul
 
@@ -743,6 +760,23 @@ def _ffn_block(spec: TransformerSpec, bp: Params, h, act, cdt,
                 f"'dense' or 'alltoall'")
         ffn, aux = moe(spec, bp, a, act, cdt, expert_axis, aux_axes,
                        aux_stats)
+        h = h + _dropout(ffn, spec, dropout_rng, 2 * moe_block + 1)
+    elif spec.fp8_ffn:
+        # fp8-rounded operands through the fused grouped kernel
+        # (ops/pallas_fused.fp8_dense_ffn); the per-tensor pow2 scales
+        # cover the FULL d/d_ff contraction, which tensor parallelism
+        # would row-split — config.validate_quant_config rejects the
+        # combination, and this guard keeps direct callers honest
+        if model_axis is not None:
+            raise ValueError("fp8_ffn does not compose with tensor "
+                             "parallelism (the row-split FFN shards "
+                             "the contraction its scales cover)")
+        from ..ops.pallas_fused import fp8_dense_ffn
+
+        bsz, s, d = a.shape
+        ffn = fp8_dense_ffn(spec.activation, cdt, a.reshape(bsz * s, d),
+                            bp["W1"], bp["b1"], bp["W2"],
+                            bp["b2"]).reshape(bsz, s, -1)
         h = h + _dropout(ffn, spec, dropout_rng, 2 * moe_block + 1)
     else:
         a = act(_mm(bp, a, "W1", "b1", cdt)).astype(cdt)

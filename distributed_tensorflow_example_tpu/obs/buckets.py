@@ -54,8 +54,14 @@ TRACE_SCOPES = WINDOW_BUCKETS + ("eval", "checkpoint")
 # (parallel/local_sgd.py: the pseudo-gradient psum + outer optimizer
 # update), so a profiler capture shows exactly how much of a round
 # the slow-axis sync costs.
+# "quant" names the quantize/dequantize edges (ops/quant.py callers:
+# the int8 KV-page adapter in serving/kv_cache.py, the fp8 operand
+# rounding in ops/pallas_fused.py, the compressed outer sync in
+# parallel/local_sgd.py) so a capture attributes the low-precision
+# conversion cost separately from the compute it feeds.
 NAMED_SCOPES = ("ln", "moe_dispatch", "moe_expert", "pp_comm",
-                "prefill", "decode", "sampling", "outer_sync")
+                "prefill", "decode", "sampling", "outer_sync",
+                "quant")
 
 # run-level goodput/badput decomposition, in presentation order
 # ("train" is the goodput bucket, "eval"/"sample" auxiliary useful

@@ -86,6 +86,15 @@ GATE_METRICS: Dict[str, tuple] = {
     # run, wide like the serving latencies
     "local_sgd_comm_bytes_per_token": ("lower", 0.01),
     "local_sgd_final_cost": ("lower", 0.25),
+    # the quantization keys (ISSUE 11) — ALL analytic closed forms
+    # (obs/flops.py), deterministic on every backend, tight 1% like
+    # the bubble fractions: the int8 KV pool's bytes/step must stay
+    # half the bf16 pool's, and the int8+error-feedback outer sync
+    # must stay >= 3.5x below the f32 form
+    "decode_kv_bytes_per_step_int8": ("lower", 0.01),
+    "decode_kv_reduction_int8": ("higher", 0.01),
+    "local_sgd_outer_quant_bytes_per_token": ("lower", 0.01),
+    "local_sgd_outer_quant_reduction": ("higher", 0.01),
 }
 
 
@@ -161,6 +170,27 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
         put("local_sgd_comm_bytes_per_token",
             doc.get("local_sgd_comm_bytes_per_token"))
         put("local_sgd_final_cost", doc.get("local_sgd_final_cost"))
+        put("local_sgd_outer_quant_bytes_per_token",
+            doc.get("local_sgd_outer_quant_bytes_per_token"))
+        put("local_sgd_outer_quant_reduction",
+            doc.get("local_sgd_outer_quant_reduction"))
+        return out
+    # bench decode row — keyed on decode_step_ms, a row-only key (the
+    # final summary carries decode_hbm_frac too and must fall through
+    # to its own branch — the serving lesson)
+    if "decode_step_ms" in doc:
+        put("decode_hbm_frac", doc.get("decode_hbm_frac"))
+        put("tokens_per_sec", doc.get("tokens_per_sec"))
+        put("wall_s", doc.get("wall_s"))
+        return out
+    # bench kv-quant row (every backend) — keyed on the scale-plane
+    # term, a row-only key (the final summary carries the two gate
+    # keys too and must fall through — the serving lesson)
+    if "decode_kv_scale_bytes_per_step" in doc:
+        put("decode_kv_bytes_per_step_int8",
+            doc.get("decode_kv_bytes_per_step_int8"))
+        put("decode_kv_reduction_int8",
+            doc.get("decode_kv_reduction_int8"))
         return out
     # bench serving row — keyed on continuous_ticks, NOT serving_tok_s:
     # the final summary carries serving_tok_s too, and must fall
@@ -200,7 +230,13 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
                   "decode_hbm_frac",
                   # the multi-site local-SGD keys (ISSUE 10) likewise
                   "local_sgd_comm_bytes_per_token",
-                  "local_sgd_final_cost"):
+                  "local_sgd_final_cost",
+                  # the quantization closed forms (ISSUE 11): int8 KV
+                  # pool bytes/step + the compressed outer sync
+                  "decode_kv_bytes_per_step_int8",
+                  "decode_kv_reduction_int8",
+                  "local_sgd_outer_quant_bytes_per_token",
+                  "local_sgd_outer_quant_reduction"):
             put(k, doc.get(k))
         return out
     # last resort: any directly-named gate metrics

@@ -146,28 +146,47 @@ def decode_weight_bytes(spec) -> float:
 
 
 def decode_kv_bytes_per_step(spec, batch: int, kv_len: float,
-                             heads: int | None = None) -> float:
+                             heads: int | None = None,
+                             kv_dtype_bytes: float | None = None) -> float:
     """KV-cache traffic of one decode step at ``kv_len`` cached
     positions per sequence: every block READS its [kv_len, H, Dh] k
-    and v per sequence and WRITES one new row of each, in the compute
-    dtype (what the cache stores).  ``kv_len`` may be fractional (a
-    mean over a decode's positions)."""
+    and v per sequence and WRITES one new row of each, at
+    ``kv_dtype_bytes`` per element — default: the compute dtype's
+    itemsize (what the unquantized cache stores).  ``kv_dtype_bytes=1``
+    is the ``--kv_quant=int8`` pool (exactly half of bf16 — the gated
+    ISSUE-11 claim; the per-row/per-head f32 scale planes are a
+    separate ``decode_kv_scale_bytes_per_step`` term, 4/Dh of the
+    payload, kept out of this closed form so the halving is exact and
+    auditable).  ``kv_len`` may be fractional (a mean over a decode's
+    positions)."""
     import numpy as np
 
     h = heads or spec.n_heads
-    itemsize = np.dtype(spec.compute_dtype).itemsize
-    row = h * spec.d_head * itemsize
+    if kv_dtype_bytes is None:
+        kv_dtype_bytes = np.dtype(spec.compute_dtype).itemsize
+    row = h * spec.d_head * float(kv_dtype_bytes)
     return 2.0 * spec.num_blocks * batch * (kv_len + 1.0) * row
 
 
+def decode_kv_scale_bytes_per_step(spec, batch: int, kv_len: float,
+                                   heads: int | None = None) -> float:
+    """The int8 pools' scale-plane traffic per decode step: one f32
+    per cached (row, head) on each of the k/v planes — ``4 / Dh`` of
+    the int8 payload (3% at Dh=128)."""
+    h = heads or spec.n_heads
+    return 2.0 * spec.num_blocks * batch * (kv_len + 1.0) * h * 4.0
+
+
 def decode_bytes_per_step(spec, batch: int, kv_len: float,
-                          heads: int | None = None) -> float:
+                          heads: int | None = None,
+                          kv_dtype_bytes: float | None = None) -> float:
     """Analytic HBM bytes per decode step: weights (read once) + KV
     read/write — the roofline's numerator.  Activations are excluded
     (O(B*d) per block, negligible against both terms at decode
     shapes)."""
     return decode_weight_bytes(spec) \
-        + decode_kv_bytes_per_step(spec, batch, kv_len, heads=heads)
+        + decode_kv_bytes_per_step(spec, batch, kv_len, heads=heads,
+                                   kv_dtype_bytes=kv_dtype_bytes)
 
 
 def hbm_frac(bytes_per_step: float, step_time_s: float, peak,
@@ -237,6 +256,34 @@ def local_sgd_comm_bytes_per_round(spec, sites: int) -> float:
     slots stay per-site and never cross the axis). Amortize over
     ``inner_steps`` for a per-inner-step figure."""
     return allreduce_bytes_per_replica(num_params(spec) * 4, sites)
+
+
+def num_param_leaves(spec) -> int:
+    """Leaf count of the model's parameter tree (the per-leaf scale
+    overhead term of the compressed outer sync)."""
+    from ..models import mlp
+
+    if isinstance(spec, mlp.MLPSpec):
+        # W1..WL + b1..bL
+        return 2 * (len(spec.layer_sizes) - 1)
+    from ..models import transformer
+
+    if isinstance(spec, transformer.TransformerSpec):
+        return len(transformer.param_shapes(spec))
+    raise TypeError(f"no parameter accounting for spec type "
+                    f"{type(spec)!r}")
+
+
+def local_sgd_outer_quant_bytes_per_round(spec, sites: int) -> float:
+    """Per-site bytes of the ``--outer_quant=int8`` outer sync: the
+    pseudo-gradient crosses 'site' as int8 wire values (1 byte/param)
+    plus one f32 scale per parameter leaf (symmetric per-leaf
+    quantization, ops/quant.py — the error-feedback residual stays
+    per-site and never crosses the axis).  ~4x below the f32 form
+    above; the exact ratio is ``4N / (N + 4*leaves)``, which the
+    bench row gates >= 3.5x."""
+    payload = num_params(spec) * 1 + num_param_leaves(spec) * 4
+    return allreduce_bytes_per_replica(payload, sites)
 
 
 def comm_bytes_per_token(bytes_per_step: float, batch: int,

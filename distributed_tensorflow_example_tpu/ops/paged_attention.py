@@ -56,10 +56,12 @@ def gather_kv(pool: jnp.ndarray, block_table: jnp.ndarray) -> jnp.ndarray:
     index ``j`` along the gathered axis IS logical position ``j``
     (pages are listed in order).  W is the *bucketed* live width —
     the gather touches only the blocks the batch can actually
-    address, not the full max sequence length."""
+    address, not the full max sequence length.  Generic over the
+    trailing dims: the int8 pools' [num_pages, page_size, H] scale
+    planes gather through the same table to [B, W*page_size, H]."""
     b, w = block_table.shape
-    _, ps, h, dh = pool.shape
-    return pool[block_table].reshape(b, w * ps, h, dh)
+    ps = pool.shape[1]
+    return pool[block_table].reshape((b, w * ps) + pool.shape[2:])
 
 
 def length_mask(kv_width: int, pos: jnp.ndarray) -> jnp.ndarray:

@@ -644,3 +644,84 @@ def _moe_grouped_bwd(activation, cdt, res, g):
 
 
 moe_grouped_matmul.defvjp(_moe_grouped_fwd, _moe_grouped_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fp8 FFN matmuls (ISSUE 11 tentpole (b)): the dense and grouped-MoE
+# expert FFNs on fp8-e4m3-rounded operands.
+#
+# The fp8 path REUSES the fused grouped kernel above: operands are
+# rounded onto the scaled fp8 grid first (ops/quant.fp8_round —
+# power-of-two per-expert scales, so the scaled-back values are exact
+# in bf16/f32), then flow through the identical Pallas kernel /
+# interpret-mode / shard_map-vma fallbacks.  With pow2 scales this is
+# bit-what-an-fp8-MXU computes — (q_x·s_x)@(q_w·s_w) == s_x·s_w·
+# (q_x@q_w) with f32 accumulation — without a second kernel body to
+# keep in sync.  The inter-matmul hidden stays in the compute dtype:
+# inside the fused kernel it never leaves VMEM, so quantizing it
+# would spend precision on bandwidth that is not being moved (the
+# HBM-resident operands are where fp8 pays).
+#
+# Gradients are straight-through to the bf16/f32 MASTER weights: the
+# backward is the grouped kernel's XLA-einsum backward evaluated on
+# the saved QUANTIZED residuals (what real fp8 training differentiates
+# — the rounded operands the forward actually used), with d(round)/dx
+# treated as identity.  Scales are just-in-time per call; the
+# delayed-scaling amax-history helpers (ops/quant.amax_history_*) are
+# oracle-tested and available to callers that thread aux state, and a
+# length-1 history degenerates to exactly this scaling.
+# ---------------------------------------------------------------------------
+
+
+def _fp8_operands(buf, we1, we2):
+    """Round the three matmul operands onto their per-expert fp8
+    grids (axis (1, 2) = everything but the leading expert dim)."""
+    from .quant import fp8_round
+
+    with jax.named_scope("quant"):
+        return (fp8_round(buf, axis=(1, 2)), fp8_round(we1, axis=(1, 2)),
+                fp8_round(we2, axis=(1, 2)))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def fp8_grouped_matmul(activation: str, cdt, buf, we1, be1, we2, be2):
+    """``moe_grouped_matmul`` on fp8-e4m3-rounded operands: the fused
+    grouped expert FFN ``[E, C, d] -> [E, C, d]`` (f32 out) with
+    ``buf``/``we1``/``we2`` rounded per expert onto pow2-scaled fp8
+    grids before the two fused matmuls (biases and accumulation stay
+    f32 — the e4m3 recipe).  Selected by ``TransformerSpec.fp8_ffn``
+    for the sparse-dispatch expert FFN; drop-in for the bf16 kernel,
+    within the oracle-tested error bounds (tests/test_pallas.py)."""
+    bq, w1q, w2q = _fp8_operands(buf, we1, we2)
+    h2, _ = _moe_grouped_forward(activation, cdt, bq, w1q, be1, w2q,
+                                 be2, want_z1=False)
+    return h2
+
+
+def _fp8_grouped_fwd(activation, cdt, buf, we1, be1, we2, be2):
+    bq, w1q, w2q = _fp8_operands(buf, we1, we2)
+    h2, z1 = _moe_grouped_forward(activation, cdt, bq, w1q, be1, w2q,
+                                  be2, want_z1=True)
+    # residuals are the QUANTIZED operands: the backward differentiates
+    # the computation the forward ran; the quantizer itself is
+    # straight-through (cotangents land on the master weights as-is)
+    return h2, (bq, w1q, be1, w2q, be2, z1)
+
+
+def _fp8_grouped_bwd(activation, cdt, res, g):
+    return _moe_grouped_bwd(activation, cdt, res, g)
+
+
+fp8_grouped_matmul.defvjp(_fp8_grouped_fwd, _fp8_grouped_bwd)
+
+
+def fp8_dense_ffn(activation: str, cdt, x2, w1, b1, w2, b2):
+    """The DENSE FFN (``act(x @ W1 + b1) @ W2 + b2``) on fp8-rounded
+    operands: ``x2`` [T, d] -> [T, d] f32, routed through
+    ``fp8_grouped_matmul`` as a single-expert group (E=1) so the
+    dense and MoE fp8 paths share one kernel, one VJP and one oracle
+    suite.  Selected by ``TransformerSpec.fp8_ffn`` at every dense
+    FFN site (training forward and the KV-cached decode)."""
+    out = fp8_grouped_matmul(activation, cdt, x2[None], w1[None],
+                             b1[None], w2[None], b2[None])
+    return out[0]

@@ -1,0 +1,180 @@
+"""Low-precision representation with per-tile scales — the ONE
+quantization core the stack shares (ISSUE 11).
+
+Three memory-bandwidth walls, one technique: the decode roofline
+streams the KV cache through HBM every step (int8 pages halve
+``decode_kv_bytes_per_step``), the dense/MoE FFN matmuls stream
+weights and activations (fp8 operands halve them again past the bf16
+MXU rows), and the multi-site outer sync moves a full f32
+pseudo-gradient across the slow DCN axis per round (int8 +
+error-feedback compression is another ~4x on the gated
+``local_sgd_comm_bytes_per_token``).  Each consumer quantizes with
+THIS module's helpers so the formats, the scale conventions and the
+numerics are defined exactly once and oracle-tested once
+(tests/test_quant.py pins every function against a numpy reference).
+
+Conventions:
+
+- **int8 is symmetric per-axis**: ``scale = amax / 127`` over the
+  reduced axes (kept as size-1 dims so it broadcasts back),
+  ``q = clip(round(x / scale), -127, 127)``.  No zero-point — the
+  KV rows and pseudo-gradients this repo quantizes are zero-centered,
+  and symmetric scales make dequantize a single multiply.
+- **fp8 is e4m3 with power-of-two scales**: a pow2 scale only shifts
+  the exponent, so the scaled-back values sit EXACTLY on an fp8 grid
+  that bf16/f32 represent losslessly (3-bit mantissa <= bf16's 8) —
+  the fused Pallas kernels (ops/pallas_fused.py) consume the rounded
+  operands unchanged and compute bit-what-an-fp8-MXU-matmul-computes:
+  ``(q_x * s_x) @ (q_w * s_w) == s_x * s_w * (q_x @ q_w)`` with f32
+  accumulation.
+- **delayed scaling** keeps a rolling amax history per tensor and
+  derives the scale from the history max (the Transformer-Engine
+  recipe); a length-1 history degenerates to just-in-time (current)
+  scaling, which is what the ``--fp8_ffn`` model switch uses (the
+  history-threading API is here for callers that carry aux state).
+- **error feedback** makes the compressed outer sync unbiased over
+  time: the residual ``(delta + ef) - dequantized`` is carried to the
+  next round, so quantization error never accumulates
+  (parallel/local_sgd.py stores it per-site in the opt-state).
+
+Everything here is plain jnp (elementwise + reductions): it runs on
+every backend, inside shard_map, and under the Pallas interpret-mode
+fallbacks unchanged.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# symmetric int8: q in [-127, 127] (no -128 — symmetric range keeps
+# dequantize a single multiply and the format sign-stable)
+INT8_MAX = 127.0
+
+# largest finite float8_e4m3fn magnitude (the OCP e4m3 format jax
+# ships; casts SATURATE to nan above it, hence the explicit scaling)
+FP8_E4M3_MAX = 448.0
+
+
+def _amax(x, axis):
+    """max |x| over ``axis`` (None = all), keepdims so the result
+    broadcasts back over ``x``."""
+    return jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis,
+                   keepdims=True)
+
+
+def int8_scale(amax):
+    """Symmetric int8 scale for a tensor (tile) whose largest
+    magnitude is ``amax``: ``amax / 127``, floored to 1.0 where the
+    tile is all-zero (q is then exactly 0 regardless of scale)."""
+    return jnp.where(amax > 0.0, amax / INT8_MAX, 1.0)
+
+
+def quantize_int8(x, axis=None):
+    """Symmetric per-axis int8 quantization: returns ``(q int8,
+    scale f32)``; ``axis`` = the axis/axes the scale REDUCES over
+    (None = one per-tensor scale), kept as size-1 dims so
+    ``q * scale`` broadcasts.  Round-to-nearest-even (jnp.round ==
+    np.round), clipped to the symmetric [-127, 127] range."""
+    scale = int8_scale(_amax(x, axis))
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                 -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8(q, scale, dtype=jnp.float32):
+    """``q * scale`` in f32, cast to ``dtype`` — scale must broadcast
+    (quantize_int8 keeps its reduced dims)."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def int8_roundtrip(x, axis=None):
+    """``dequantize(quantize(x))`` — the values an int8 wire carries,
+    in f32.  Worst-case per-element error is scale/2 = amax/254 (the
+    bound tests/test_quant.py pins)."""
+    q, scale = quantize_int8(x, axis)
+    return dequantize_int8(q, scale)
+
+
+def ef_compress_int8(x, ef, axis=None):
+    """One error-feedback compression step: add the carried residual,
+    quantize the sum, return ``(dequantized, new_residual)``.  The
+    residual makes the compressor unbiased over time — the sum of
+    transmitted values tracks the sum of inputs to within one
+    quantization step, however many rounds run (EF-SGD; the numpy
+    oracle in tests/test_quant.py pins the telescoping identity)."""
+    c = x.astype(jnp.float32) + ef.astype(jnp.float32)
+    dq = int8_roundtrip(c, axis)
+    return dq, c - dq
+
+
+# ---------------------------------------------------------------------------
+# fp8 (e4m3) with power-of-two scales + delayed scaling
+# ---------------------------------------------------------------------------
+
+
+def pow2_scale(amax, fmt_max=FP8_E4M3_MAX):
+    """The smallest power-of-two ``s`` with ``amax / s <= fmt_max``
+    (1.0 for an all-zero tile).  A pow2 scale only shifts the
+    exponent: ``x / s`` and ``q * s`` are EXACT in any binary float
+    format, so fp8-grid values scaled back remain exactly
+    representable in bf16/f32 — the property the fused kernels'
+    operand-rounding emulation rests on."""
+    amax = jnp.asarray(amax, jnp.float32)
+    e = jnp.ceil(jnp.log2(jnp.where(amax > 0.0, amax, fmt_max)
+                          / fmt_max))
+    # ldexp(1, e) with an INTEGER exponent: exactly 2^e (jnp.exp2
+    # lowers through exp(x*ln2) on some backends and misses the exact
+    # power of two by an ulp — enough to break the exactness the
+    # fp8-grid emulation depends on)
+    s = jnp.ldexp(jnp.ones_like(amax), e.astype(jnp.int32))
+    return jnp.where(amax > 0.0, s, 1.0)
+
+
+def fp8_round(x, axis=None, scale=None):
+    """Round ``x`` onto the float8_e4m3 grid: scale down by the pow2
+    per-``axis`` scale (or the caller's ``scale`` — delayed-scaling
+    callers pass scale_from_history), cast to f8e4m3 and back, scale
+    up.  Returns values in ``x.dtype`` sitting exactly on the scaled
+    fp8 grid — feed them to any matmul and the result is what an
+    fp8-input MXU computes with f32 accumulation."""
+    if scale is None:
+        scale = pow2_scale(_amax(x, axis))
+    x32 = x.astype(jnp.float32) / scale
+    # the pow2 ceiling guarantees |x32| <= 448 already; the clip is a
+    # belt against caller-provided (stale delayed) scales — e4m3
+    # saturates to nan, not to the max finite value
+    x32 = jnp.clip(x32, -FP8_E4M3_MAX, FP8_E4M3_MAX)
+    q = x32.astype(jnp.float8_e4m3fn).astype(jnp.float32)
+    return (q * scale).astype(x.dtype)
+
+
+def amax_history_init(length: int):
+    """A fresh rolling amax history (all zero — the first update
+    fills slot 0)."""
+    if length < 1:
+        raise ValueError(f"amax history length {length} must be >= 1")
+    return jnp.zeros((int(length),), jnp.float32)
+
+
+def amax_history_update(hist, x):
+    """Record ``max |x|`` into the history's newest slot, evicting the
+    oldest (roll-and-write; O(length))."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    return jnp.roll(hist, 1).at[0].set(amax)
+
+
+def scale_from_history(hist, fmt_max=FP8_E4M3_MAX):
+    """The delayed-scaling scale: pow2 over the HISTORY max — stale by
+    up to ``length`` steps, which is the recipe's point (no
+    same-step amax sync); a length-1 history is just-in-time
+    scaling."""
+    return pow2_scale(jnp.max(hist), fmt_max)
+
+
+__all__ = [
+    "INT8_MAX", "FP8_E4M3_MAX",
+    "int8_scale", "quantize_int8", "dequantize_int8", "int8_roundtrip",
+    "ef_compress_int8",
+    "pow2_scale", "fp8_round",
+    "amax_history_init", "amax_history_update", "scale_from_history",
+]

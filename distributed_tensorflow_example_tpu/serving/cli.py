@@ -91,6 +91,7 @@ def _spec_from_cfg(cfg):
         capacity_factor=cfg.capacity_factor,
         aux_loss_weight=cfg.moe_aux_weight,
         fused_ln=cfg.fused_ln, grouped_moe=cfg.grouped_moe,
+        fp8_ffn=cfg.fp8_ffn,
         param_dtype=jnp.dtype(cfg.param_dtype),
         compute_dtype=jnp.dtype(cfg.compute_dtype),
     )
@@ -107,6 +108,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if cfg.model != "transformer" or cfg.objective != "lm":
         print("dtx-serve: decoding needs --model=transformer "
               "--objective=lm", file=sys.stderr)
+        return 2
+    try:
+        config_lib.validate_quant_config(cfg)
+    except ValueError as e:
+        print(f"dtx-serve: {e}", file=sys.stderr)
         return 2
 
     import jax
@@ -128,7 +134,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     engine = DecodeEngine(
         spec, params, page_size=cfg.decode_page_size,
         num_pages=cfg.decode_pages, max_batch=cfg.decode_max_batch,
-        seed=cfg.seed)
+        seed=cfg.seed, kv_quant=cfg.kv_quant)
     engine.start()
 
     from ..obs.serve import StatusServer
@@ -141,7 +147,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     print(f"dtx-serve: POST /generate on :{port} "
           f"(page_size={engine.page_size} pages={engine.num_pages} "
           f"max_batch={engine.sched.max_batch} "
-          f"max_len={engine.max_len})")
+          f"max_len={engine.max_len}"
+          + (f" kv_quant={engine.kv_quant}" if engine.kv_quant else "")
+          + ")")
     try:
         import time
 

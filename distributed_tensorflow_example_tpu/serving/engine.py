@@ -90,7 +90,7 @@ class DecodeEngine:
     def __init__(self, spec, params, page_size: int = 16,
                  num_pages: int = 0, max_batch: int = 8,
                  max_len: int = 0, donate: Optional[bool] = None,
-                 seed: int = 0):
+                 seed: int = 0, kv_quant: str = ""):
         import jax
 
         from . import kv_cache as kvc
@@ -101,6 +101,7 @@ class DecodeEngine:
         self.spec = spec
         self.params = params
         self.page_size = int(page_size)
+        self.kv_quant = str(kv_quant or "")
         self.max_len = int(max_len) or spec.seq_len
         if self.max_len > spec.seq_len:
             raise ValueError(
@@ -115,7 +116,8 @@ class DecodeEngine:
             max(1, self.max_len - 1))
         self._heads = kvc.local_heads(spec, params)
         self.cache = kvc.init_paged_cache(
-            spec, self.num_pages, self.page_size, heads=self._heads)
+            spec, self.num_pages, self.page_size, heads=self._heads,
+            quant=self.kv_quant)
         self._kvc = kvc
         self._jax = jax
         if donate is None:

@@ -38,6 +38,10 @@ import jax.numpy as jnp
 from ..models import transformer as tfm
 from ..models.mlp import _ACTIVATIONS
 from ..ops import paged_attention as pa
+from ..ops import quant as quant_lib
+
+# valid --kv_quant values ("" = the compute-dtype pool)
+KV_QUANTS = ("", "int8")
 
 
 def local_heads(spec: tfm.TransformerSpec, params) -> int:
@@ -47,15 +51,35 @@ def local_heads(spec: tfm.TransformerSpec, params) -> int:
 
 
 def init_paged_cache(spec: tfm.TransformerSpec, num_pages: int,
-                     page_size: int, heads: int | None = None):
+                     page_size: int, heads: int | None = None,
+                     quant: str = ""):
     """The page pool: ``{k{i}/v{i}: [num_pages, page_size, H, Dh]}``
     in the compute dtype (the cache stores the same rounded k/v the
-    training attention consumes — the contiguous cache's convention)."""
+    training attention consumes — the contiguous cache's convention).
+
+    ``quant='int8'`` (ISSUE 11 leg a) stores the pools as int8 with a
+    per-row/per-head f32 scale PLANE per pool
+    (``k{i}_s``/``v{i}_s`` [num_pages, page_size, H]): every cached
+    row is quantized symmetrically over its Dh lane
+    (ops/quant.quantize_int8), halving the KV bytes a decode step
+    streams (obs/flops.decode_kv_bytes_per_step at kv_dtype_bytes=1)
+    for a 4/Dh scale overhead.  The adapter dequantizes the gathered
+    view back to the compute dtype, so the attention math in
+    ``transformer._decode_forward`` is untouched."""
+    if quant not in KV_QUANTS:
+        raise ValueError(f"kv quant {quant!r}: expected one of "
+                         f"{list(KV_QUANTS)}")
     shape = (num_pages, page_size, heads or spec.n_heads, spec.d_head)
     cache = {}
     for i in range(spec.num_blocks):
-        cache[f"k{i}"] = jnp.zeros(shape, spec.compute_dtype)
-        cache[f"v{i}"] = jnp.zeros(shape, spec.compute_dtype)
+        if quant == "int8":
+            cache[f"k{i}"] = jnp.zeros(shape, jnp.int8)
+            cache[f"v{i}"] = jnp.zeros(shape, jnp.int8)
+            cache[f"k{i}_s"] = jnp.zeros(shape[:3], jnp.float32)
+            cache[f"v{i}_s"] = jnp.zeros(shape[:3], jnp.float32)
+        else:
+            cache[f"k{i}"] = jnp.zeros(shape, spec.compute_dtype)
+            cache[f"v{i}"] = jnp.zeros(shape, spec.compute_dtype)
     return cache
 
 
@@ -65,12 +89,18 @@ class PagedKV:
     block's new row through the block table and returns the gathered
     page view + ragged-length mask for attention.  ``pos`` is [B]
     (per-sequence positions — THE ragged-batch difference from the
-    contiguous adapter's scalar)."""
+    contiguous adapter's scalar).
+
+    An int8 pool (``k{i}_s`` scale planes present) quantizes each new
+    row per head on the way in and dequantizes the gathered view back
+    to ``dequant_dtype`` on the way out — the attention math never
+    sees the wire format."""
 
     page_size: int
     cache: dict
     block_table: jnp.ndarray      # [B, W] int32
     pos: jnp.ndarray              # [B] int32
+    dequant_dtype: jnp.dtype = jnp.float32
 
     def __post_init__(self):
         self._page_ids, self._rows = pa.page_row_index(
@@ -78,17 +108,36 @@ class PagedKV:
         kvw = self.block_table.shape[1] * self.page_size
         # [B, 1, S_kv], broadcast over heads in the score mask
         self.valid = pa.length_mask(kvw, self.pos)[:, None, :]
+        self.quantized = "k0_s" in self.cache
+
+    def _put(self, name: str, vals):
+        """Scatter one row per sequence into pool ``name`` (values +
+        scale plane when quantized); returns the gathered, dequantized
+        [B, S_kv, H, Dh] view."""
+        if not self.quantized:
+            pool = pa.scatter_kv_rows(self.cache[name], self._page_ids,
+                                      self._rows, vals)
+            self.cache[name] = pool
+            return pa.gather_kv(pool, self.block_table)
+        with jax.named_scope("quant"):
+            q, s = quant_lib.quantize_int8(vals, axis=-1)   # [B,H,(1)]
+        pool = pa.scatter_kv_rows(self.cache[name], self._page_ids,
+                                  self._rows, q)
+        splane = pa.scatter_kv_rows(self.cache[f"{name}_s"],
+                                    self._page_ids, self._rows,
+                                    s[..., 0])
+        self.cache[name], self.cache[f"{name}_s"] = pool, splane
+        cq = pa.gather_kv(pool, self.block_table)           # int8
+        cs = pa.gather_kv(splane, self.block_table)         # [B,S,H]
+        with jax.named_scope("quant"):
+            return quant_lib.dequantize_int8(cq, cs[..., None],
+                                             self.dequant_dtype)
 
     def update(self, i: int, kk, vv):
-        k = pa.scatter_kv_rows(self.cache[f"k{i}"], self._page_ids,
-                               self._rows, kk)
-        v = pa.scatter_kv_rows(self.cache[f"v{i}"], self._page_ids,
-                               self._rows, vv)
-        self.cache[f"k{i}"], self.cache[f"v{i}"] = k, v
         # gather AFTER the write: position pos attends to itself,
         # exactly like the contiguous dynamic-update-then-attend
-        ck = pa.gather_kv(k, self.block_table)
-        cv = pa.gather_kv(v, self.block_table)
+        ck = self._put(f"k{i}", kk)
+        cv = self._put(f"v{i}", vv)
         return ck, cv, self.valid
 
 
@@ -101,7 +150,8 @@ def paged_decode_step(spec: tfm.TransformerSpec, params, cache,
     The math is ``transformer._decode_forward`` — shared with the
     contiguous ``decode_step``, so the layouts cannot drift."""
     kv = PagedKV(page_size=_page_size(cache),
-                 cache=dict(cache), block_table=block_table, pos=pos)
+                 cache=dict(cache), block_table=block_table, pos=pos,
+                 dequant_dtype=spec.compute_dtype)
     logits = tfm._decode_forward(spec, params, token, pos, kv,
                                  model_axis=model_axis)
     return logits, kv.cache
@@ -145,6 +195,23 @@ def prefill_into_pages(spec: tfm.TransformerSpec, params, cache,
     act = _ACTIVATIONS[spec.activation]
     page_ids, rows = pa.prefill_page_rows(p, block_table, page_size)
     cache = dict(cache)
+    quantized = "k0_s" in cache
+
+    def put(name, vals):
+        """[B, P, Hl, Dh] rows into pool ``name`` (+ the scale plane
+        when the pool is int8 — same per-row/per-head convention as
+        the decode adapter, so prefill and decode cannot drift)."""
+        if not quantized:
+            cache[name] = pa.scatter_prefill_rows(cache[name],
+                                                  page_ids, rows, vals)
+            return
+        with jax.named_scope("quant"):
+            q, s = quant_lib.quantize_int8(vals, axis=-1)
+        cache[name] = pa.scatter_prefill_rows(cache[name], page_ids,
+                                              rows, q)
+        cache[f"{name}_s"] = pa.scatter_prefill_rows(
+            cache[f"{name}_s"], page_ids, rows, s[..., 0])
+
     for i in range(spec.num_blocks):
         bp = {k[len(f"L{i}_"):]: v for k, v in params.items()
               if k.startswith(f"L{i}_")}
@@ -153,10 +220,8 @@ def prefill_into_pages(spec: tfm.TransformerSpec, params, cache,
                                      model_axis=model_axis, moe_block=i,
                                      kv_out=kv_out)
         (kk, vv), = kv_out                                # [B, P, Hl, Dh]
-        cache[f"k{i}"] = pa.scatter_prefill_rows(
-            cache[f"k{i}"], page_ids, rows, kk)
-        cache[f"v{i}"] = pa.scatter_prefill_rows(
-            cache[f"v{i}"], page_ids, rows, vv)
+        put(f"k{i}", kk)
+        put(f"v{i}", vv)
     # head only at each prompt's LAST position: gather [B, D] then the
     # rank-2 final LN + vocab projection (the decode sites' shape)
     last = jnp.take_along_axis(

@@ -40,7 +40,7 @@ import numpy as np
 
 from .. import cluster
 from ..config import (Config, validate_local_sgd_config,
-                      validate_pipeline_config)
+                      validate_pipeline_config, validate_quant_config)
 from ..data import EpochIterator, load_datasets
 from ..models.mlp import MLPSpec
 from ..parallel import epoch as epoch_lib
@@ -85,6 +85,7 @@ def make_spec(cfg: Config):
             aux_loss_weight=cfg.moe_aux_weight,
             fused_ln=cfg.fused_ln,
             grouped_moe=cfg.grouped_moe,
+            fp8_ffn=cfg.fp8_ffn,
             param_dtype=jnp.dtype(cfg.param_dtype),
             compute_dtype=jnp.dtype(cfg.compute_dtype),
         )
@@ -186,6 +187,8 @@ def run(cfg: Config) -> Dict[str, Any]:
     validate_pipeline_config(cfg)
     # the multi-site (--sites) matrix likewise lives in config.py
     validate_local_sgd_config(cfg)
+    # ... and the quantization (--kv_quant/--fp8_ffn/--outer_quant) one
+    validate_quant_config(cfg)
     if cfg.objective == "lm":
         if cfg.model != "transformer":
             raise ValueError("--objective=lm requires --model=transformer")
@@ -603,7 +606,8 @@ def run(cfg: Config) -> Dict[str, Any]:
             from ..parallel import local_sgd as local_sgd_lib
 
             outer_opt = local_sgd_lib.outer_optimizer_from_config(cfg)
-            state = local_sgd_lib.site_state(state, cfg.sites, outer_opt)
+            state = local_sgd_lib.site_state(state, cfg.sites, outer_opt,
+                                             outer_quant=cfg.outer_quant)
             train_step = local_sgd_lib.build_local_sgd_step(
                 cfg, mesh, spec, optimizer, outer_opt, state)
             param_sync = None
@@ -716,18 +720,30 @@ def run(cfg: Config) -> Dict[str, Any]:
                     want_m = int(site_mode
                                  and cfg.outer_optimizer == "nesterov"
                                  and cfg.outer_momentum > 0)
+                    saved_q = int(resumed_extras.get(
+                        "outer_quant_int8", 0))
+                    want_q = int(site_mode
+                                 and cfg.outer_quant == "int8")
                     if (saved_sites != (cfg.sites if site_mode else 0)
-                            or saved_m != want_m):
+                            or saved_m != want_m
+                            or saved_q != want_q):
                         raise ValueError(
                             f"checkpoint {path} was written with "
                             f"sites={saved_sites}, outer momentum "
-                            f"state={'yes' if saved_m else 'no'}: "
-                            f"resume needs the same --sites and a "
+                            f"state={'yes' if saved_m else 'no'}, "
+                            f"outer_quant="
+                            f"{'int8' if saved_q else 'off'}: "
+                            f"resume needs the same --sites, a "
                             f"momentum-compatible --outer_optimizer/"
-                            f"--outer_momentum (this run: sites="
+                            f"--outer_momentum and the same "
+                            f"--outer_quant (the error-feedback "
+                            f"residual is part of the state tree; "
+                            f"this run: sites="
                             f"{cfg.sites if site_mode else 0}, "
                             f"momentum state="
-                            f"{'yes' if want_m else 'no'})")
+                            f"{'yes' if want_m else 'no'}, "
+                            f"outer_quant="
+                            f"{'int8' if want_q else 'off'})")
                 if fsdp_mode and os.path.isdir(path):
                     # sharded-FSDP checkpoint: leaves are the SAVED run's
                     # flat [.., dp_old, chunk] layout — reassemble,
@@ -885,7 +901,9 @@ def run(cfg: Config) -> Dict[str, Any]:
                 extras.update(sites=cfg.sites,
                               outer_has_momentum=int(
                                   cfg.outer_optimizer == "nesterov"
-                                  and cfg.outer_momentum > 0))
+                                  and cfg.outer_momentum > 0),
+                              outer_quant_int8=int(
+                                  cfg.outer_quant == "int8"))
             if cfg.zero_opt:
                 # flat slot chunking is dp-shaped; resume validates it
                 extras.update(zero_dp=dp)

@@ -65,7 +65,21 @@ def _stub_rows(monkeypatch):
                           "decode_step_ms": 1.19,
                           "decode_bytes_per_step": 3.2e8,
                           "decode_achieved_gbps": 270.0,
-                          "decode_hbm_frac": 0.33})
+                          "decode_hbm_frac": 0.33,
+                          "decode_hbm_frac_int8_projected": 0.21})
+    # the kv-quant row (r11) runs on EVERY backend: the int8-KV
+    # closed forms are the gated evidence and must reach the final
+    # line off-TPU too (the pp_memory lesson)
+    monkeypatch.setattr(
+        bench, "bench_kv_quant",
+        lambda *a, **kw: {"config": "kv_quant",
+                          "decode_kv_bytes_per_step": 2.68e8,
+                          "decode_kv_bytes_per_step_int8": 1.34e8,
+                          "decode_kv_scale_bytes_per_step": 4.2e6,
+                          "decode_kv_reduction_int8": 2.0,
+                          "kv_quant_tok_s_base": 1196.3,
+                          "kv_quant_tok_s_int8": 1432.3,
+                          "kv_quant_greedy_match": True})
     # the serving row (r9) runs on EVERY backend: analytic
     # continuous-vs-static tick accounting + the measured engine sweep
     monkeypatch.setattr(
@@ -95,10 +109,16 @@ def _stub_rows(monkeypatch):
                           "comm_reduction_h8": 8.0,
                           "comm_reduction_h64": 64.0,
                           "inner_steps_gated": 8,
+                          "local_sgd_outer_quant_sync_bytes": 139202.0,
+                          "local_sgd_outer_quant_bytes_per_token": 4.248,
+                          "local_sgd_outer_quant_reduction": 3.99,
                           "sync_step_ms": 144.6, "sync_final_cost": 4.31,
                           "local_sgd_step_ms": 115.5,
                           "local_sgd_final_cost": 4.16,
-                          "final_cost_ratio": 0.966})
+                          "final_cost_ratio": 0.966,
+                          "outer_quant_step_ms": 115.8,
+                          "outer_quant_final_cost": 4.16,
+                          "outer_quant_cost_ratio": 1.0})
     # the pp_memory row runs on EVERY backend (r8 bubble bench): its
     # analytic bubble-fraction keys must reach the final line as
     # pp_bubble_frac_* so --gate can hold the schedule
@@ -125,12 +145,15 @@ def _stub_rows(monkeypatch):
         bench, "bench_transformer_wide",
         lambda *a, **kw: {"config": "transformer_wide",
                           "dense_mfu": 0.5, "flash_mfu": 0.55,
-                          "fused_ln_mfu": 0.62, "mfu": 0.62,
-                          "target_mfu": 0.60})
+                          "fused_ln_mfu": 0.62, "fp8_ffn_mfu": 0.66,
+                          "mfu": 0.66, "target_mfu": 0.60})
     monkeypatch.setattr(
         bench, "bench_moe_wide",
-        lambda *a, **kw: {"config": "moe_wide", "mfu": 0.36,
-                          "grouped_mfu": 0.36, "target_mfu": 0.35,
+        lambda *a, **kw: {"config": "moe_wide", "mfu": 0.38,
+                          "grouped_mfu": 0.36, "fp8_mfu": 0.38,
+                          "fp8_step_time_ms": 90.0,
+                          "fp8_tokens_per_sec": 1100.0,
+                          "target_mfu": 0.35,
                           "tokens_per_sec": 1000.0,
                           "moe_dispatch_ms": 12.5, "moe_expert_ms": 40.0,
                           "moe_expert_grouped_ms": 30.0})
@@ -178,6 +201,16 @@ def test_bench_main_cpu_stubbed(monkeypatch, capsys):
     assert final["local_sgd_comm_reduction_h64"] == 64.0
     assert final["local_sgd_final_cost"] == 4.16
     assert final["local_sgd_sync_final_cost"] == 4.31
+    # the r11 quantized-outer carriage (every backend): the int8+EF
+    # closed forms + the measured quantized final cost, gate-named
+    assert final["local_sgd_outer_quant_bytes_per_token"] == 4.248
+    assert final["local_sgd_outer_quant_reduction"] == 3.99
+    assert final["local_sgd_outer_quant_final_cost"] == 4.16
+    # the r11 int8-KV carriage runs on the CPU path too (the gated
+    # closed forms must not hide behind the TPU-only decode row)
+    assert final["decode_kv_bytes_per_step_int8"] == 1.34e8
+    assert final["decode_kv_reduction_int8"] == 2.0
+    assert final["kv_quant_greedy_match"] is True
 
 
 def test_bench_main_all_configs_stubbed(monkeypatch, capsys):
@@ -218,8 +251,6 @@ def test_bench_main_tpu_rows_no_guarded_collision(monkeypatch, capsys):
     assert s16k and "error" not in s16k[0]
     # the fused-kernel gate keys ride the final line (obs.compare
     # extract_metrics reads them off a BENCH capture by these names)
-    assert final["transformer_wide_mfu"] == 0.62
-    assert final["moe_wide_mfu"] == 0.36
     assert final["moe_dispatch_ms"] == 12.5
     assert final["moe_expert_ms"] == 40.0
     # the r9 decode-roofline carriage (TPU row): achieved-vs-peak HBM
@@ -228,6 +259,14 @@ def test_bench_main_tpu_rows_no_guarded_collision(monkeypatch, capsys):
     assert final["decode_hbm_frac"] == 0.33
     assert final["decode_achieved_gbps"] == 270.0
     assert final["serving_p99_ms"] == 214.2
+    # the r11 int8-KV carriage (from the every-backend kv_quant row)
+    assert final["decode_kv_bytes_per_step_int8"] == 1.34e8
+    assert final["decode_kv_reduction_int8"] == 2.0
+    assert final["kv_quant_greedy_match"] is True
+    # the r11 fp8 headline: the best moe_wide/transformer_wide variant
+    # (fp8 in the stubs) carries the row mfu the gate reads
+    assert final["transformer_wide_mfu"] == 0.66
+    assert final["moe_wide_mfu"] == 0.38
 
 
 def test_guarded_isolates_row_failures(monkeypatch, capsys):

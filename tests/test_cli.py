@@ -254,6 +254,64 @@ def test_multi_site_validation_matrix():
     bad("on_anomaly=skip", sites=2, on_anomaly="skip")
 
 
+def test_quant_flags():
+    """--kv_quant / --fp8_ffn / --outer_quant (ISSUE 11) parse onto
+    their Config fields, default off, and reject unknown formats at
+    the CLI (argparse choices)."""
+    import pytest
+
+    cfg = parse_config(["--model=transformer", "--objective=lm",
+                        "--kv_quant=int8", "--fp8_ffn",
+                        "--sites=2", "--outer_quant=int8"])
+    assert cfg.kv_quant == "int8"
+    assert cfg.fp8_ffn
+    assert cfg.outer_quant == "int8"
+    d = parse_config([])
+    assert d.kv_quant == "" and d.outer_quant == "" and not d.fp8_ffn
+    for bad in (["--kv_quant=int4"], ["--outer_quant=fp8"]):
+        with pytest.raises(SystemExit):
+            parse_config(bad)
+
+
+def test_quant_validation_matrix():
+    """The quantization validation matrix, pinned against
+    ``config.validate_quant_config`` directly (pure config — no
+    training stack), the validate_pipeline_config pattern."""
+    import pytest
+
+    from distributed_tensorflow_example_tpu.config import (
+        Config, validate_quant_config)
+
+    def ok(**kw):
+        validate_quant_config(Config(**kw))
+
+    def bad(match, **kw):
+        with pytest.raises(ValueError, match=match):
+            validate_quant_config(Config(**kw))
+
+    # ---- valid combinations ----
+    ok()                                          # defaults: all off
+    ok(model="transformer", objective="lm", kv_quant="int8")
+    ok(model="transformer", fp8_ffn=True)         # dense FFN
+    ok(model="transformer", fp8_ffn=True, num_experts=4,
+       moe_dispatch="alltoall")                   # grouped experts
+    ok(sites=2, inner_steps=8, outer_quant="int8")
+    ok(model="transformer", objective="lm", kv_quant="int8",
+       fp8_ffn=True, sites=2, outer_quant="int8")  # all three legs
+
+    # ---- rejections ----
+    bad("expected '' or\\s+'int8'", kv_quant="int4")
+    bad("expected\\s+'' or 'int8'", outer_quant="fp8")
+    bad("model=transformer", kv_quant="int8")      # the MLP default
+    bad("objective=lm", model="transformer", kv_quant="int8")
+    bad("no FFN blocks", fp8_ffn=True)             # the MLP family
+    bad("model_parallel", model="transformer", fp8_ffn=True,
+        model_parallel=2)
+    bad("alltoall", model="transformer", fp8_ffn=True,
+        num_experts=4)                             # dense dispatch
+    bad("sites > 1", outer_quant="int8")
+
+
 def test_r3_flag_surface_parses():
     """Every r3 flag parses and lands on its Config field."""
     from distributed_tensorflow_example_tpu.config import parse_config

@@ -377,6 +377,74 @@ def test_compare_understands_local_sgd_keys():
     assert ms["local_sgd_final_cost"] == 4.16
 
 
+def test_compare_understands_quant_keys():
+    """The quantization closed forms (ISSUE 11): the every-backend
+    kv_quant row gates the int8-KV bytes/step + reduction (keyed on
+    decode_kv_scale_bytes_per_step, a row-only key — the final
+    summary carries the gate names too and must fall through to its
+    own branch), the decode row keeps its roofline keys (keyed on
+    decode_step_ms), the local-SGD row gains the quantized-outer
+    pair, and the final summary carries all four under their gate
+    names."""
+    kvq_row = {"config": "kv_quant",
+               "decode_kv_bytes_per_step": 2.68e8,
+               "decode_kv_bytes_per_step_int8": 1.34e8,
+               "decode_kv_scale_bytes_per_step": 4.2e6,
+               "decode_kv_reduction_int8": 2.0,
+               "kv_quant_tok_s_base": 1196.3,
+               "kv_quant_greedy_match": True}
+    m = cmp_lib.extract_metrics(kvq_row)
+    assert m == {"decode_kv_bytes_per_step_int8": 1.34e8,
+                 "decode_kv_reduction_int8": 2.0}
+    # a doctored candidate whose int8 pool got heavier gates tight
+    worse = dict(kvq_row, decode_kv_bytes_per_step_int8=1.37e8,
+                 decode_kv_reduction_int8=1.96)
+    verdict = cmp_lib.compare(kvq_row, worse)
+    assert not verdict["ok"]
+    assert "decode_kv_bytes_per_step_int8" in verdict["regressions"]
+    assert "decode_kv_reduction_int8" in verdict["regressions"]
+    assert cmp_lib.compare(kvq_row, kvq_row)["ok"]
+    # the decode row still yields its roofline keys (row-only branch)
+    dec_row = {"config": "decode_throughput", "decode_step_ms": 1.19,
+               "tokens_per_sec": 26900.0, "wall_s": 1.2,
+               "decode_hbm_frac": 0.33}
+    md = cmp_lib.extract_metrics(dec_row)
+    assert md["decode_hbm_frac"] == 0.33
+    assert md["tokens_per_sec"] == 26900.0
+
+    lsgd_row = {"config": "local_sgd",
+                "sync_comm_bytes_per_token": 135.734,
+                "local_sgd_comm_bytes_per_token": 16.967,
+                "local_sgd_final_cost": 4.16,
+                "local_sgd_outer_quant_bytes_per_token": 4.248,
+                "local_sgd_outer_quant_reduction": 3.99}
+    m = cmp_lib.extract_metrics(lsgd_row)
+    assert m["local_sgd_outer_quant_bytes_per_token"] == 4.248
+    assert m["local_sgd_outer_quant_reduction"] == 3.99
+    verdict = cmp_lib.compare(
+        lsgd_row, dict(lsgd_row,
+                       local_sgd_outer_quant_bytes_per_token=4.4,
+                       local_sgd_outer_quant_reduction=3.85))
+    assert not verdict["ok"]
+    assert "local_sgd_outer_quant_bytes_per_token" \
+        in verdict["regressions"]
+    assert "local_sgd_outer_quant_reduction" in verdict["regressions"]
+
+    # final-summary shape: all four ride ALONGSIDE wall_s — the
+    # summary must not be mistaken for either row
+    summary = {"metric": "mnist_20epoch_wall_clock", "value": 0.15,
+               "decode_kv_bytes_per_step_int8": 1.34e8,
+               "decode_kv_reduction_int8": 2.0,
+               "local_sgd_outer_quant_bytes_per_token": 4.248,
+               "local_sgd_outer_quant_reduction": 3.99}
+    ms = cmp_lib.extract_metrics(summary)
+    assert ms["wall_s"] == 0.15
+    assert ms["decode_kv_bytes_per_step_int8"] == 1.34e8
+    assert ms["decode_kv_reduction_int8"] == 2.0
+    assert ms["local_sgd_outer_quant_bytes_per_token"] == 4.248
+    assert ms["local_sgd_outer_quant_reduction"] == 3.99
+
+
 def test_compare_zero_baseline_stays_strict_json():
     """A zero baseline metric must not fabricate Infinity (non-strict
     JSON) nor gate: it reads as 'incomparable'."""

@@ -1,0 +1,198 @@
+"""ops/quant.py — the shared quantization core (ISSUE 11).
+
+Every helper is pinned against a step-by-step numpy reference with
+explicit error bounds: symmetric per-axis int8 round-trip, the pow2
+fp8-e4m3 grid (including the exact-in-bf16 property the fused-kernel
+emulation rests on), delayed-scaling amax histories, and the
+error-feedback compressor's telescoping identity.  Pure CPU jnp —
+runs everywhere, no mesh, no stack.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from distributed_tensorflow_example_tpu.ops import quant
+
+
+def _x(seed=0, shape=(4, 3, 16), scale=3.0):
+    return (np.random.RandomState(seed).randn(*shape) * scale).astype(
+        np.float32)
+
+
+# ---------------------------------------------------------------------------
+# int8
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("axis", [None, -1, (1, 2)])
+def test_int8_roundtrip_error_bound(axis):
+    """Symmetric int8: |dequantize(quantize(x)) - x| <= amax/254 per
+    element (half a quantization step of the per-tile scale), against
+    the numpy closed form."""
+    x = _x(0)
+    q, s = quant.quantize_int8(jnp.asarray(x), axis=axis)
+    assert np.asarray(q).dtype == np.int8
+    # numpy oracle: same scale, same round-half-even, same clip
+    amax = np.max(np.abs(x), axis=axis, keepdims=True)
+    s_ref = np.where(amax > 0, amax / 127.0, 1.0)
+    q_ref = np.clip(np.round(x / s_ref), -127, 127).astype(np.int8)
+    np.testing.assert_array_equal(np.asarray(q), q_ref)
+    np.testing.assert_allclose(np.asarray(s), s_ref, rtol=1e-6)
+    dq = np.asarray(quant.dequantize_int8(q, s))
+    assert np.all(np.abs(dq - x) <= amax / 254.0 + 1e-7)
+    # round-trip helper == the two calls composed
+    np.testing.assert_array_equal(
+        np.asarray(quant.int8_roundtrip(jnp.asarray(x), axis=axis)), dq)
+
+
+def test_int8_all_zero_tile_is_exact():
+    """An all-zero tile must quantize to exact zeros (scale floors to
+    1.0 instead of dividing by zero)."""
+    x = jnp.zeros((3, 8))
+    q, s = quant.quantize_int8(x, axis=-1)
+    assert np.all(np.asarray(q) == 0)
+    assert np.all(np.asarray(s) == 1.0)
+    assert np.all(np.asarray(quant.dequantize_int8(q, s)) == 0.0)
+
+
+def test_int8_range_is_symmetric():
+    """The extreme magnitudes land on +/-127 — never -128 (symmetric
+    range keeps dequantize a single multiply)."""
+    x = jnp.asarray([-5.0, 5.0, -2.5, 0.0])
+    q, _ = quant.quantize_int8(x)
+    assert int(np.asarray(q).min()) == -127
+    assert int(np.asarray(q).max()) == 127
+
+
+def test_ef_compress_telescopes():
+    """Error feedback: over T rounds, the SUM of transmitted values
+    tracks the sum of inputs to within one quantization step — the
+    compression error never accumulates (the residual IS the gap),
+    pinned against a numpy re-implementation."""
+    rng = np.random.RandomState(1)
+    ef = jnp.zeros((12,))
+    ef_ref = np.zeros(12, np.float32)
+    tot_in = np.zeros(12, np.float64)
+    tot_out = np.zeros(12, np.float64)
+    for _ in range(40):
+        d = rng.randn(12).astype(np.float32)
+        dq, ef = quant.ef_compress_int8(jnp.asarray(d), ef)
+        # numpy oracle for one step
+        c = d + ef_ref
+        amax = np.max(np.abs(c))
+        s = amax / 127.0 if amax > 0 else 1.0
+        dq_ref = np.clip(np.round(c / s), -127, 127) * s
+        np.testing.assert_allclose(np.asarray(dq), dq_ref, rtol=1e-5,
+                                   atol=1e-6)
+        ef_ref = c - dq_ref
+        tot_in += d
+        tot_out += np.asarray(dq)
+    # the telescoping identity: sum(in) - sum(out) == final residual
+    np.testing.assert_allclose(tot_in - tot_out, np.asarray(ef),
+                               rtol=1e-4, atol=1e-5)
+    # ... which is bounded by one quantization step, NOT by T steps
+    assert float(np.max(np.abs(np.asarray(ef)))) < 0.1
+
+
+# ---------------------------------------------------------------------------
+# fp8 (e4m3) + pow2 scales
+# ---------------------------------------------------------------------------
+
+
+def test_pow2_scale_properties():
+    """pow2_scale: exact powers of two, smallest with amax/s <= 448,
+    1.0 for an all-zero tile."""
+    for amax in (0.3, 1.0, 447.9, 448.0, 449.0, 1e4, 1e-6):
+        s = float(quant.pow2_scale(jnp.asarray(amax)))
+        assert s == 2.0 ** round(np.log2(s))          # a power of two
+        assert amax / s <= quant.FP8_E4M3_MAX + 1e-6  # covers amax
+        assert amax / (s / 2.0) > quant.FP8_E4M3_MAX - 1e-3 or s == 1.0
+    assert float(quant.pow2_scale(jnp.asarray(0.0))) == 1.0
+
+
+def test_fp8_round_matches_ml_dtypes_grid():
+    """fp8_round == scale down by the pow2 scale, cast through
+    ml_dtypes' float8_e4m3fn, scale back — the exact grid an fp8
+    input register holds."""
+    import ml_dtypes
+
+    x = _x(2, shape=(5, 7))
+    got = np.asarray(quant.fp8_round(jnp.asarray(x)))
+    s = float(quant.pow2_scale(np.max(np.abs(x))))
+    ref = (x / s).astype(ml_dtypes.float8_e4m3fn).astype(np.float32) * s
+    np.testing.assert_array_equal(got, ref)
+    # e4m3 has a 3-bit mantissa: relative error <= 2^-4 per element
+    # (normal range), the bound the fp8 FFN docs quote
+    nz = np.abs(x) > 1e-3
+    assert np.all(np.abs(got - x)[nz] <= np.abs(x)[nz] * (2.0 ** -3))
+
+
+def test_fp8_rounded_values_exact_in_bf16():
+    """THE emulation property: pow2-scaled fp8-grid values are exactly
+    representable in bf16 (3 mantissa bits <= bf16's 8, pow2 scale
+    only shifts the exponent) — so the fused kernels consume them
+    losslessly and compute what an fp8-MXU matmul computes."""
+    x = _x(3, shape=(64,), scale=50.0)
+    xr = np.asarray(quant.fp8_round(jnp.asarray(x)))
+    via_bf16 = np.asarray(jnp.asarray(xr, jnp.bfloat16), np.float32)
+    np.testing.assert_array_equal(via_bf16, xr)
+
+
+def test_fp8_round_per_axis_scales():
+    """axis=(1, 2) gives one scale per leading index (the per-expert
+    convention the grouped kernel uses)."""
+    x = np.stack([_x(4, (3, 4), 0.1)[0:3], 100.0 * _x(5, (3, 4), 1.0)[0:3]])
+    got = np.asarray(quant.fp8_round(jnp.asarray(x), axis=(1, 2)))
+    for e in range(2):
+        s = float(quant.pow2_scale(np.max(np.abs(x[e]))))
+        import ml_dtypes
+        ref = (x[e] / s).astype(ml_dtypes.float8_e4m3fn).astype(
+            np.float32) * s
+        np.testing.assert_array_equal(got[e], ref)
+
+
+def test_fp8_round_stale_scale_saturates_finite():
+    """A caller-provided (stale delayed-scaling) scale that is too
+    small must CLIP to the max finite fp8 value, never produce the
+    nan e4m3 saturates to."""
+    x = jnp.asarray([1000.0, -1000.0, 1.0])
+    got = np.asarray(quant.fp8_round(x, scale=jnp.asarray(1.0)))
+    assert np.isfinite(got).all()
+    assert got[0] == quant.FP8_E4M3_MAX and got[1] == -quant.FP8_E4M3_MAX
+
+
+# ---------------------------------------------------------------------------
+# delayed scaling
+# ---------------------------------------------------------------------------
+
+
+def test_amax_history_roll_and_scale():
+    """The rolling history keeps the last N amaxes (newest first) and
+    the delayed scale covers the history max."""
+    h = quant.amax_history_init(3)
+    assert np.all(np.asarray(h) == 0.0)
+    with pytest.raises(ValueError):
+        quant.amax_history_init(0)
+    seen = []
+    for i, mag in enumerate((1.0, 5.0, 2.0, 3.0)):
+        h = quant.amax_history_update(h, jnp.asarray([mag, -0.5 * mag]))
+        seen.append(mag)
+        want = list(reversed(seen[-3:])) + [0.0] * max(0, 3 - len(seen))
+        np.testing.assert_allclose(np.asarray(h), want)
+        s = float(quant.scale_from_history(h))
+        assert max(want) / s <= quant.FP8_E4M3_MAX
+    # after 5.0 leaves the window the scale may tighten again
+    h = quant.amax_history_update(h, jnp.asarray([0.1]))
+    np.testing.assert_allclose(np.asarray(h), [0.1, 3.0, 2.0])
+
+
+def test_history_length_one_is_just_in_time():
+    """A length-1 history == current scaling — the degenerate case the
+    --fp8_ffn model switch uses."""
+    x = _x(6, shape=(9,))
+    h = quant.amax_history_update(quant.amax_history_init(1),
+                                  jnp.asarray(x))
+    assert float(quant.scale_from_history(h)) == float(
+        quant.pow2_scale(np.max(np.abs(x))))

@@ -629,6 +629,130 @@ def test_generate_endpoint_requires_engine(tmp_path):
         srv.close()
 
 
+# --- int8 KV pages (ISSUE 11 leg a) --------------------------------------
+
+
+def test_init_paged_cache_int8_layout():
+    """quant='int8': pools flip to int8 and gain f32 per-row/per-head
+    scale planes [num_pages, page_size, H]; unknown formats are
+    rejected at init."""
+    spec = _spec()
+    c = kvc.init_paged_cache(spec, 6, 4, quant="int8")
+    for i in range(spec.num_blocks):
+        assert np.asarray(c[f"k{i}"]).dtype == np.int8
+        assert np.asarray(c[f"v{i}"]).dtype == np.int8
+        assert np.asarray(c[f"k{i}_s"]).dtype == np.float32
+        assert c[f"k{i}_s"].shape == (6, 4, spec.n_heads)
+        assert c[f"v{i}_s"].shape == (6, 4, spec.n_heads)
+    # the unquantized pool carries no scale planes
+    assert "k0_s" not in kvc.init_paged_cache(spec, 6, 4)
+    with pytest.raises(ValueError, match="int8"):
+        kvc.init_paged_cache(spec, 6, 4, quant="int4")
+
+
+@pytest.mark.parametrize("page_size", [4, 8, 16])
+def test_int8_engine_matches_unquantized_greedy(lm, page_size):
+    """THE kv-quant acceptance: greedy decode through the full
+    DecodeEngine with --kv_quant=int8 is TOKEN-IDENTICAL to the
+    unquantized pool, across page sizes, ragged prompt lengths, and
+    admission churn (6 requests through 3 slots) — int8 rounding
+    perturbs the logits within a bound that never flips the argmax
+    on this suite."""
+    spec, params = lm
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, 50, size=n).tolist()
+               for n in (3, 7, 5, 11, 2, 8)]
+    n_new = 6
+    outs = {}
+    for quant in ("", "int8"):
+        eng = DecodeEngine(spec, params, page_size=page_size,
+                           max_batch=3, kv_quant=quant)
+        rids = [eng.submit(p, n_new) for p in prompts]
+        eng.run_until_idle()
+        outs[quant] = [eng.result(r, timeout=10.0)["tokens"]
+                       for r in rids]
+    assert outs["int8"] == outs[""]
+
+
+def test_int8_paged_decode_logit_error_bounded(lm):
+    """Chained int8 paged decode vs the unquantized pool on identical
+    token streams: logits within a small absolute bound AND the
+    greedy argmax identical at every step — the 'bounded logit error'
+    half of the acceptance, at two page sizes straddling the
+    position count."""
+    spec, params = lm
+    b, steps = 3, 9
+    rng = np.random.RandomState(8)
+    toks = rng.randint(0, 50, size=(steps, b)).astype(np.int32)
+    for page_size in (4, 16):
+        per = steps // page_size + 1
+        npages = 1 + b * per
+        bt = jnp.asarray([[1 + i * per + j for j in range(per)]
+                          for i in range(b)], jnp.int32)
+        ref = kvc.init_paged_cache(spec, npages, page_size)
+        q = kvc.init_paged_cache(spec, npages, page_size, quant="int8")
+        for pos in range(steps):
+            posv = jnp.full((b,), pos, jnp.int32)
+            lr, ref = kvc.paged_decode_step(
+                spec, params, ref, bt, jnp.asarray(toks[pos]), posv)
+            lq, q = kvc.paged_decode_step(
+                spec, params, q, bt, jnp.asarray(toks[pos]), posv)
+            err = float(np.max(np.abs(np.asarray(lr) - np.asarray(lq))))
+            assert err < 0.1, (page_size, pos, err)
+            np.testing.assert_array_equal(
+                np.argmax(np.asarray(lr), -1),
+                np.argmax(np.asarray(lq), -1))
+
+
+def test_int8_prefill_matches_stepwise_int8_decode(lm):
+    """Prefill into int8 pages vs token-by-token int8 decode of the
+    same prompt: block 0's quantized rows AND scale planes are
+    BITWISE identical (block-0 k/v depend only on the embedded
+    tokens, so equality pins the shared per-row/per-head quantization
+    convention of the two write paths), and the first generated
+    token's argmax agrees (deeper blocks read dequantized history
+    stepwise vs exact history batched, so their logits drift within a
+    small bound rather than matching bitwise)."""
+    spec, params = lm
+    rng = np.random.RandomState(9)
+    page_size = 4
+    lens = (3, 6)
+    prompts = [rng.randint(0, 50, size=n).astype(np.int32)
+               for n in lens]
+    pb = 8
+    toks = np.zeros((2, pb), np.int32)
+    for i, p in enumerate(prompts):
+        toks[i, :len(p)] = p
+    paged = kvc.init_paged_cache(spec, 7, page_size, quant="int8")
+    bt = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    logits, paged = kvc.prefill_into_pages(
+        spec, params, paged, bt, jnp.asarray(toks),
+        jnp.asarray(lens, jnp.int32))
+    for i, p in enumerate(prompts):
+        # stepwise int8 reference for this prompt alone
+        ref = kvc.init_paged_cache(spec, 3, page_size, quant="int8")
+        rbt = jnp.asarray([[1, 2]], jnp.int32)
+        for pos, t in enumerate(p):
+            rl, ref = kvc.paged_decode_step(
+                spec, params, ref, rbt, jnp.asarray([t], jnp.int32),
+                jnp.asarray([pos], jnp.int32))
+        assert int(np.argmax(np.asarray(logits)[i])) == int(
+            np.argmax(np.asarray(rl)[0]))
+        np.testing.assert_allclose(np.asarray(logits)[i],
+                                   np.asarray(rl)[0], atol=0.1)
+        # block-0 convention pin: prompt i's rows in the shared pool
+        # == the stepwise pool's rows, values AND scales, bitwise
+        for name in ("k0", "v0", "k0_s", "v0_s"):
+            for pos in range(len(p)):
+                page, rowi = divmod(pos, page_size)
+                mine = np.asarray(paged[name])[
+                    int(bt[i, page]), rowi]
+                theirs = np.asarray(ref[name])[
+                    int(rbt[0, page]), rowi]
+                np.testing.assert_array_equal(mine, theirs,
+                                              err_msg=(name, i, pos))
+
+
 @needs_stack
 def test_tp_sharded_paged_cache_parity(lm, devices8):
     """Paged decode with the KV pool's heads split Megatron-style over
