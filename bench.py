@@ -2218,6 +2218,37 @@ def bench_pallas_parity():
     return out
 
 
+def _gate_rolling_verdict(history_path: str, n: int,
+                          candidate: dict,
+                          prior_entries: list) -> int:
+    """--gate-rolling N: compare the final summary against the
+    rolling MEDIAN of the last N recorded history entries (the ones
+    present BEFORE this run appended its own — a run must not gate
+    against itself).  Same placement discipline as --gate: strictly
+    after every row and the final line, so a failing gate changes
+    only the exit code.  Exit: 0 pass, 3 regression, 2 unusable
+    history (empty, or nothing comparable)."""
+    from distributed_tensorflow_example_tpu.obs import compare as cmp_lib
+    from distributed_tensorflow_example_tpu.obs import history as hist_lib
+
+    if not prior_entries:
+        print(json.dumps({"gate_rolling": n, "history": history_path,
+                          "gate_error": "history has no prior "
+                          "entries (seed it: dtx-obs history FILE "
+                          "--import BENCH_r0*.json)"}))
+        return 2
+    baseline = hist_lib.rolling_baseline(prior_entries, n)
+    verdict = cmp_lib.compare(baseline, candidate)
+    print(json.dumps({"gate_rolling": n, "history": history_path,
+                      "baseline_entries": baseline["entries"],
+                      **verdict}))
+    if not verdict["compared"]:
+        print(f"[bench] gate-rolling: no overlapping metrics with "
+              f"{history_path}", file=sys.stderr)
+        return 2
+    return 0 if verdict["ok"] else 3
+
+
 def _gate_verdict(gate_path: str, candidate: dict) -> int:
     """--gate: compare the final summary against a recorded baseline
     (BASELINE.json, a BENCH_*.json capture, a saved final summary or
@@ -2263,7 +2294,27 @@ def main(argv=None) -> int:
                         "BENCH_*.json capture / a saved summary / an "
                         "obs run report) and exit 3 on regression — "
                         "every row is still printed first")
+    p.add_argument("--history", type=str, default="",
+                   metavar="FILE",
+                   help="append this run's final summary (reduced to "
+                        "its gate metrics) to the rolling "
+                        "history.jsonl (obs/history.py; seed it from "
+                        "committed captures via dtx-obs history FILE "
+                        "--import BENCH_r0*.json)")
+    p.add_argument("--gate-rolling", type=int, default=0,
+                   metavar="N",
+                   help="gate against the rolling MEDIAN of the last "
+                        "N --history entries recorded before this "
+                        "run (same thresholds and exit codes as "
+                        "--gate; requires --history; 0 = off, the "
+                        "default)")
     args = p.parse_args(argv)
+    if args.gate_rolling and not args.history:
+        p.error("--gate-rolling needs --history FILE (the rolling "
+                "baseline lives there)")
+    if args.gate_rolling < 0:
+        p.error(f"--gate-rolling {args.gate_rolling} must be >= 1 "
+                f"(0/omitted = off)")
     # forwarded only when set: the row stubs in the smoke tests (and
     # any external bench_config monkeypatch) keep their old signature
     prof_kw = ({"profile_steps": args.profile_steps}
@@ -2647,11 +2698,27 @@ def main(argv=None) -> int:
         **extra,
     }
     print(json.dumps(final))
+    rc = 0
+    if args.history:
+        # record THIS run before any gating (evidence first: a
+        # regressing run still lands in the trajectory), but gate
+        # against the entries that preceded it
+        from distributed_tensorflow_example_tpu.obs import (
+            history as hist_lib,
+        )
+
+        prior_entries = hist_lib.read_history(args.history)
+        hist_lib.append_entry(
+            args.history, final,
+            label=time.strftime("%Y%m%d-%H%M%S"), source="bench")
     if args.gate:
         # strictly after every row and the final line: the gate only
         # decides the exit code, it cannot truncate the evidence
-        return _gate_verdict(args.gate, final)
-    return 0
+        rc = max(rc, _gate_verdict(args.gate, final))
+    if args.gate_rolling:
+        rc = max(rc, _gate_rolling_verdict(
+            args.history, args.gate_rolling, final, prior_entries))
+    return rc
 
 
 if __name__ == "__main__":

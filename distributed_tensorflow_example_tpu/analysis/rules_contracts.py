@@ -94,6 +94,12 @@ class SchemaDriftRule:
         "FLIGHT_ANOMALY_RECORD": ("obs/flight.py", "obs/anomaly.py"),
         "RUN_REPORT": ("obs/aggregate.py",),
         "SERVING_STATS": ("serving/engine.py",),
+        # span rows: the envelope is written by the recorder, the
+        # payload fields by the two emitting layers (the scheduler's
+        # admission narration + the engine's execution milestones)
+        "SPAN_COMMON": ("obs/spans.py",),
+        "SPAN_FIELDS": ("serving/scheduler.py", "serving/engine.py"),
+        "HISTORY_ENTRY": ("obs/history.py",),
     }
     GATE_PRODUCERS = ("bench.py", "obs/aggregate.py", "obs/metrics.py",
                       "obs/schema.py", "train/loop.py")

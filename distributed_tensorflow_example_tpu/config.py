@@ -372,6 +372,21 @@ class Config:
                                     # serving/kv_cache.py — the
                                     # contiguous training/sampling
                                     # cache is untouched)
+    trace_spans: bool = False       # dtx-serve: record every accepted
+                                    # request's lifecycle (submit/
+                                    # blocked/admit/prefill/
+                                    # first_token/decode ticks/retire)
+                                    # to <logs_path>/spans.<proc>.jsonl
+                                    # (obs/spans.py; host-side appends
+                                    # only — greedy outputs identical
+                                    # on/off); feeds /trace, /slo and
+                                    # dtx-obs slo/trace
+    slo: str = ""                   # serving SLO specs evaluated by
+                                    # /slo + the dtx_slo_* gauges:
+                                    # "NAME<=VALUE,..." with NAME in
+                                    # ttft_p99_ms / latency_p99_ms /
+                                    # error_rate (obs/slo.py; "" =
+                                    # the documented defaults)
 
     # ---- validation / early stopping (beyond-reference) ----
     early_stop_patience: int = 0    # > 0: evaluate the validation split
@@ -761,6 +776,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "KV bytes each decode step streams from HBM "
                         "(serving only — needs --model=transformer "
                         "--objective=lm)")
+    p.add_argument("--trace_spans", action="store_true",
+                   help="dtx-serve: record request-lifecycle spans to "
+                        "<logs_path>/spans.<proc>.jsonl (obs/spans.py "
+                        "— submit/blocked/admit/prefill/first_token/"
+                        "tick/retire), feeding /trace, /slo and the "
+                        "dtx-obs slo/trace verbs; host-side appends "
+                        "only, greedy outputs token-identical on/off")
+    p.add_argument("--slo", type=str, default=d.slo,
+                   help="serving SLO specs for /slo + the dtx_slo_* "
+                        "gauges: comma-separated NAME<=VALUE with "
+                        "NAME one of ttft_p99_ms / latency_p99_ms / "
+                        "error_rate (obs/slo.py; empty = defaults)")
     p.add_argument("--early_stop_patience", type=int,
                    default=d.early_stop_patience,
                    help="stop after P epochs without validation "

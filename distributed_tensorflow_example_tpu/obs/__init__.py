@@ -50,7 +50,24 @@ and the read side that consumes all of the above (PR 4):
                 /metrics Prometheus text, /report — started on the
                 chief via --status_port, or offline re-serving
     cli         the ``dtx-obs`` console script: report / compare /
-                tail / serve / validate
+                tail / serve / validate / slo / trace / history
+
+and the serving request-lifecycle layer (PR 12):
+
+    spans       SpanRecorder: strict-JSON span stream
+                (spans.<proc>.jsonl) narrating every accepted
+                request's lifecycle through the decode engine
+                (submit/blocked/admit/prefill/first_token/tick/
+                retire), plus reconstruct() — the exactly-once
+                per-request record /trace and dtx-obs trace serve
+    slo         declarative SLO specs (ttft/latency/error-rate) with
+                multi-window burn-rate evaluation over the span
+                stream's tick index: /slo, the dtx_slo_* gauges and
+                dtx-obs slo (exit 3 on breach)
+    history     append-only bench history (history.jsonl): final
+                summaries reduced to gate metrics, the rolling-median
+                baseline behind bench.py --gate-rolling, and the
+                dtx-obs history trend table / --import backfill
 
 Enabled by ``--metrics`` (with ``--log_every`` windows); grad/param
 norm histograms ride the event file via ``--histograms``
@@ -87,10 +104,16 @@ from .schema import (  # noqa: F401
     SCHEMA_VERSION,
     validate_flight_dump,
     validate_flight_file,
+    validate_history_entry,
+    validate_history_file,
     validate_metrics_file,
     validate_metrics_row,
     validate_run_report,
+    validate_span_file,
+    validate_span_row,
     validate_version,
 )
 from .serve import StatusServer, collect_status, prometheus_text  # noqa: F401
+from .slo import DEFAULT_SLOS, SLOSpec, parse_specs  # noqa: F401
+from .spans import SpanRecorder, read_spans, reconstruct, span_files  # noqa: F401
 from .tracer import WindowedTracer, parse_profile_steps  # noqa: F401

@@ -69,3 +69,16 @@ NAMED_SCOPES = ("ln", "moe_dispatch", "moe_expert", "pp_comm",
 GOODPUT_BUCKETS = ("train", "compile", "data_wait", "h2d", "host",
                    "eval", "sample", "anomaly_skipped",
                    "straggler_idle", "untracked")
+
+# serving request-lifecycle span events (obs/spans.py): the ONE
+# vocabulary for the spans.<proc>.jsonl stream.  The exactly-once
+# milestones (submit/admit/prefill/first_token/retire) plus the
+# repeatable records (blocked — once per tick a waiter stays blocked,
+# with its reason; tick — one per shared decode step, carrying batch
+# occupancy; error — the engine loop died with the request in
+# flight).  SpanRecorder.emit validates against this tuple (the
+# WindowTimer.charge discipline) and obs/schema.py pins the per-event
+# field contract, so a drifted event name fails at the emit site, not
+# in a consumer months later.
+SPAN_EVENTS = ("submit", "blocked", "admit", "prefill", "first_token",
+               "tick", "retire", "error")

@@ -12,15 +12,23 @@ Subcommands (``dtx-obs <cmd> --help`` for flags):
 - ``tail LOGS``     — one line per metrics window (plus anomaly/
   run_end events), ``-f`` to follow a live run;
 - ``serve LOGS``    — (re-)serve a run directory over HTTP: /status,
-  /metrics (Prometheus), /report (obs/serve.py). Works identically
-  on a finished run and alongside a live one;
+  /metrics (Prometheus), /report, /slo, /trace (obs/serve.py). Works
+  identically on a finished run and alongside a live one;
 - ``validate PATH...`` — run the obs/schema.py validators over
-  metrics JSONL files / flight dumps / run reports / whole logs
-  dirs; exit 1 on drift, 2 on unreadable input, with the precise
-  schema-version diagnosis for old-format logs.
+  metrics/span/history JSONL files / flight dumps / run reports /
+  whole logs dirs; exit 1 on drift, 2 on unreadable input, with the
+  precise schema-version diagnosis for old-format logs;
+- ``slo LOGS``      — evaluate the obs/slo.py specs over the serving
+  span stream; exit 3 on breach (the compare regression convention);
+- ``trace LOGS RID`` — one request's reconstructed lifecycle from the
+  span stream (submit → blocked/admit → prefill → first_token →
+  decode ticks → retire), with the raw events;
+- ``history FILE``  — the rolling bench history (obs/history.py):
+  trend table by default, ``--import`` backfills from committed
+  BENCH captures, ``--append`` records any comparison document.
 
 Exit codes: 0 ok; 1 validation failure; 2 bad input (missing files,
-no metrics stream); 3 regression verdict (compare).
+no metrics stream); 3 regression/SLO-breach verdict (compare, slo).
 """
 
 from __future__ import annotations
@@ -49,9 +57,27 @@ def _fmt(v, nd=4) -> str:
 
 def format_row(row: Dict[str, Any]) -> Optional[str]:
     """One terminal line per window row; anomaly/stragglers/run_end
-    events ride along; other rows (compile etc.) map to None."""
+    events and serving span rows ride along; other rows (compile
+    etc.) map to None."""
     kind = row.get("kind")
     proc = row.get("proc", "?")
+    if kind == "span":
+        ev = row.get("event")
+        if ev == "tick":
+            return (f"[p{proc}] tick {_fmt(row.get('tick'))} "
+                    f"batch {_fmt(row.get('batch'))}/"
+                    f"{_fmt(row.get('batch_bucket'))} "
+                    f"kv_pages {_fmt(row.get('kv_pages'))} "
+                    f"occ {_fmt(row.get('occupancy'))}")
+        bits = [f"[p{proc}] rid {_fmt(row.get('rid'))} {ev}"]
+        for key, label in (("reason", ""), ("pages_held", "pages="),
+                           ("bucket", "bucket="),
+                           ("ttft_ms", "ttft_ms="),
+                           ("generated", "generated="),
+                           ("tick", "tick=")):
+            if row.get(key) is not None:
+                bits.append(f"{label}{_fmt(row[key])}")
+        return " ".join(bits)
     if kind == "window":
         return (f"[p{proc}] step {_fmt(row.get('step'))} "
                 f"ep {_fmt(row.get('epoch'))} "
@@ -79,6 +105,15 @@ def format_row(row: Dict[str, Any]) -> Optional[str]:
 
 def _metrics_files(logs_path: str) -> List[str]:
     return [path for _pid, path in agg_lib.metrics_files(logs_path)]
+
+
+def _stream_files(logs_path: str) -> List[str]:
+    """Every JSONL stream tail/validate watch: the metrics streams
+    plus the serving span streams (same whole-line discipline)."""
+    from . import spans as spans_lib
+
+    return _metrics_files(logs_path) + [
+        path for _pid, path in spans_lib.span_files(logs_path)]
 
 
 def cmd_report(args) -> int:
@@ -135,10 +170,11 @@ def cmd_compare(args) -> int:
 
 
 def cmd_tail(args) -> int:
-    files = _metrics_files(args.logs_path)
+    files = _stream_files(args.logs_path)
     if not files and not args.follow:
-        print(f"dtx-obs tail: no metrics.<proc>.jsonl under "
-              f"{args.logs_path!r}", file=sys.stderr)
+        print(f"dtx-obs tail: no metrics.<proc>.jsonl or "
+              f"spans.<proc>.jsonl under {args.logs_path!r}",
+              file=sys.stderr)
         return 2
     # print the last -n formatted lines across streams, then follow
     offsets: Dict[str, int] = {}
@@ -158,7 +194,7 @@ def cmd_tail(args) -> int:
     try:
         while True:
             time.sleep(args.interval)
-            for path in _metrics_files(args.logs_path):
+            for path in _stream_files(args.logs_path):
                 off = offsets.get(path, 0)
                 try:
                     size = os.path.getsize(path)
@@ -209,6 +245,33 @@ def _validate_one(path: str) -> List[str]:
     """Route one file to the right obs/schema.py validator by shape."""
     base = os.path.basename(path)
     if base.endswith(".jsonl"):
+        if base.startswith("spans."):
+            return schema_lib.validate_span_file(path)
+        if base.startswith("metrics."):
+            return schema_lib.validate_metrics_file(path)
+        # an unnamed JSONL: route by its first WELL-FORMED row's kind
+        # (history files travel under arbitrary names; a torn first
+        # line — a crashed writer — must not misroute the rest)
+        kind = None
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(row, dict):
+                        kind = row.get("kind")
+                        break
+        except OSError as e:
+            return [f"{path}: unreadable ({e})"]
+        if kind == "span":
+            return schema_lib.validate_span_file(path)
+        if kind == "bench_history":
+            return schema_lib.validate_history_file(path)
         return schema_lib.validate_metrics_file(path)
     try:
         with open(path) as f:
@@ -228,7 +291,7 @@ def cmd_validate(args) -> int:
     targets: List[str] = []
     for path in args.paths:
         if os.path.isdir(path):
-            targets += _metrics_files(path)
+            targets += _stream_files(path)
             targets += sorted(glob.glob(os.path.join(path, "flight",
                                                      "*.json")))
         elif os.path.exists(path):
@@ -253,6 +316,89 @@ def cmd_validate(args) -> int:
         else:
             print(f"OK   {path}")
     return 1 if failed else 0
+
+
+def cmd_slo(args) -> int:
+    from . import slo as slo_lib
+    from . import spans as spans_lib
+
+    try:
+        specs = slo_lib.parse_specs(args.spec)
+    except ValueError as e:
+        print(f"dtx-obs slo: {e}", file=sys.stderr)
+        return 2
+    rows = spans_lib.load_spans(args.logs_path)
+    if not rows:
+        print(f"dtx-obs slo: no spans.<proc>.jsonl under "
+              f"{args.logs_path!r} — was the engine started with "
+              f"--trace_spans?", file=sys.stderr)
+        return 2
+    doc = slo_lib.evaluate(slo_lib.records_from_spans(rows),
+                           specs=specs)
+    print(json.dumps(doc, indent=None if args.compact else 1))
+    if doc["breaches"]:
+        print(f"dtx-obs slo: BREACH {','.join(doc['breaches'])}",
+              file=sys.stderr)
+        return 3
+    return 0
+
+
+def cmd_trace(args) -> int:
+    from . import spans as spans_lib
+
+    rows = spans_lib.load_spans(args.logs_path)
+    if not rows:
+        print(f"dtx-obs trace: no spans.<proc>.jsonl under "
+              f"{args.logs_path!r}", file=sys.stderr)
+        return 2
+    doc = spans_lib.trace_record(rows, args.rid)
+    if doc is None:
+        print(f"dtx-obs trace: rid {args.rid} not in the span stream",
+              file=sys.stderr)
+        return 2
+    print(json.dumps(doc, indent=None if args.compact else 1))
+    return 0
+
+
+def cmd_history(args) -> int:
+    from . import history as hist_lib
+
+    rc = 0
+    if args.imports:
+        appended, skipped = hist_lib.import_captures(args.history,
+                                                     args.imports)
+        print(f"dtx-obs history: imported {appended} capture(s), "
+              f"skipped {len(skipped)}", file=sys.stderr)
+        for msg in skipped:
+            print(f"  {msg}", file=sys.stderr)
+    if args.append:
+        try:
+            doc = cmp_lib.load_doc(args.append)
+        except (OSError, ValueError) as e:
+            print(f"dtx-obs history: {e}", file=sys.stderr)
+            return 2
+        entry = hist_lib.append_entry(
+            args.history, doc,
+            label=os.path.splitext(os.path.basename(args.append))[0],
+            source=args.append)
+        if not entry["metrics"]:
+            print(f"dtx-obs history: {args.append}: no gate metrics "
+                  f"extractable (recorded an empty entry)",
+                  file=sys.stderr)
+            rc = 1
+    entries = hist_lib.read_history(args.history)
+    if not entries:
+        print(f"dtx-obs history: no entries in {args.history!r}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(entries, indent=1))
+    else:
+        metrics = ([m.strip() for m in args.metrics.split(",")
+                    if m.strip()] if args.metrics else None)
+        print(hist_lib.trend_table(entries, metrics=metrics,
+                                   last=args.last))
+    return rc
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -309,12 +455,55 @@ def build_parser() -> argparse.ArgumentParser:
     s.set_defaults(fn=cmd_serve)
 
     v = sub.add_parser("validate", help="schema-validate metrics/"
-                                        "flight/report files or a "
-                                        "whole logs dir")
+                                        "spans/history/flight/report "
+                                        "files or a whole logs dir")
     v.add_argument("paths", nargs="+")
     v.add_argument("--max-errors", type=int, default=10,
                    help="errors printed per file")
     v.set_defaults(fn=cmd_validate)
+
+    o = sub.add_parser("slo", help="evaluate the serving SLOs over "
+                                   "the span stream; exit 3 on "
+                                   "breach")
+    o.add_argument("logs_path")
+    o.add_argument("--spec", default="",
+                   metavar="NAME<=VALUE,...",
+                   help="SLO specs (ttft_p99_ms<=MS, "
+                        "latency_p99_ms<=MS, error_rate<=FRAC); "
+                        "empty = the obs/slo.py defaults")
+    o.add_argument("--compact", action="store_true")
+    o.set_defaults(fn=cmd_slo)
+
+    tr = sub.add_parser("trace", help="one request's reconstructed "
+                                      "lifecycle from the span "
+                                      "stream")
+    tr.add_argument("logs_path")
+    tr.add_argument("rid", type=int)
+    tr.add_argument("--compact", action="store_true")
+    tr.set_defaults(fn=cmd_trace)
+
+    h = sub.add_parser("history", help="rolling bench history: trend "
+                                       "table, --import backfill, "
+                                       "--append recording")
+    h.add_argument("history", help="the history.jsonl file")
+    h.add_argument("--import", dest="imports", nargs="+", default=[],
+                   metavar="CAPTURE",
+                   help="backfill from BENCH_*.json captures (or any "
+                        "comparison document); idempotent per label")
+    h.add_argument("--append", default="",
+                   metavar="DOC",
+                   help="record one comparison document (bench "
+                        "summary / run report / capture) as a new "
+                        "entry")
+    h.add_argument("--last", type=int, default=0,
+                   help="show only the newest N entries")
+    h.add_argument("--metrics", default="",
+                   metavar="NAME,...",
+                   help="trend-table columns (default: the headline "
+                        "set present in the file)")
+    h.add_argument("--json", action="store_true",
+                   help="dump the raw entries instead of the table")
+    h.set_defaults(fn=cmd_history)
     return p
 
 

@@ -17,7 +17,10 @@ BENCH row):
 - the ``bench.py`` final summary line (``metric``/``value``);
 - ``BASELINE.json`` (its ``measured`` anchors);
 - a ``BENCH_*.json`` driver capture (``{"tail": "..."}`` — the last
-  JSON line of the captured stdout is the bench final summary).
+  JSON line of the captured stdout is the bench final summary);
+- an ``obs/history.py`` record (``kind: "bench_history"``) or the
+  rolling-median baseline (``kind: "history_baseline"``) that
+  ``bench.py --gate-rolling`` builds over the last N history entries.
 
 Thresholds are RELATIVE and one-sided: wall/step-time may grow, or
 throughput/MFU/accuracy/goodput shrink, by up to the threshold before
@@ -132,6 +135,15 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
             m = extract_metrics(inner)
             if m:
                 return m
+        return out
+    if doc.get("kind") in ("bench_history", "history_baseline"):
+        # obs/history.py shapes: one recorded round, or the rolling-
+        # median baseline --gate-rolling builds — the metrics dict IS
+        # the already-extracted gate mapping (filtered numeric here so
+        # a doctored file cannot smuggle strings into compare())
+        for name, val in (doc.get("metrics") or {}).items():
+            if name in GATE_METRICS:
+                put(name, val)
         return out
     if doc.get("kind") == "run_report":         # aggregate.py report
         put("wall_s", doc.get("wall_s"))

@@ -32,8 +32,11 @@ _NUM = (int, float)
 # History: v1 = PR 1/2 (unstamped metrics rows, flight "version": 1);
 # v2 = the stamp itself + the run_end goodput fields
 # (compile_s/eval_s/sample_s); v3 = the h2d_s window bucket (the
-# batch device-commit wall) + the matching h2d goodput bucket.
-SCHEMA_VERSION = 3
+# batch device-commit wall) + the matching h2d goodput bucket;
+# v4 = the serving request-lifecycle span stream (spans.<proc>.jsonl,
+# SPAN_* contracts below), the bench history records
+# (HISTORY_ENTRY) and the ttft_p99_ms serving-stats field.
+SCHEMA_VERSION = 4
 
 
 # field -> allowed types; a tuple including type(None) marks nullable
@@ -129,6 +132,7 @@ SERVING_STATS = {
     "latency_p50_ms": _NUM + (type(None),),
     "latency_p99_ms": _NUM + (type(None),),
     "ttft_p50_ms": _NUM + (type(None),),
+    "ttft_p99_ms": _NUM + (type(None),),
     "tokens_generated_total": (int,),
     "tokens_per_sec": _NUM + (type(None),),
     "page_occupancy_frac": _NUM,
@@ -142,6 +146,141 @@ def validate_serving_stats(doc: Dict[str, Any],
     """Validate a DecodeEngine.stats() document (no version stamp —
     it is an in-process snapshot, never written to disk by obs/)."""
     return _check(doc, SERVING_STATS, where)
+
+
+# The serving request-lifecycle span stream (obs/spans.py writes
+# spans.<proc>.jsonl; serving/scheduler.py + serving/engine.py emit
+# through an injected SpanRecorder).  SPAN_COMMON is every row's
+# envelope; SPAN_FIELDS types every per-event payload field a span
+# row may carry; SPAN_REQUIRED maps each event (the obs/buckets.py
+# SPAN_EVENTS vocabulary) to the fields it must carry — together the
+# written contract the validator and dtx-obs validate enforce.
+SPAN_COMMON = {
+    "kind": (str,),          # "span"
+    "v": (int,),
+    "t": _NUM,
+    "proc": (int,),
+    "event": (str,),
+}
+
+SPAN_FIELDS = {
+    "rid": (int,),
+    "prompt_len": (int,),
+    "max_new_tokens": (int,),
+    "arrival": _NUM,
+    "reason": (str,),
+    "tick": (int,),
+    "pages_held": (int,),
+    "bucket": (int,),
+    "pages_width": (int,),
+    "ttft_ms": _NUM,
+    "rids": (list,),
+    "batch": (int,),
+    "batch_bucket": (int,),
+    "kv_pages": (int,),
+    "occupancy": _NUM,
+    "generated": (int,),
+    "finish_t": _NUM,
+}
+
+SPAN_REQUIRED = {
+    "submit": ("rid", "prompt_len", "max_new_tokens", "arrival"),
+    "blocked": ("rid", "reason", "tick"),
+    "admit": ("rid", "pages_held", "tick"),
+    "prefill": ("rid", "bucket", "pages_width"),
+    "first_token": ("rid", "ttft_ms"),
+    "tick": ("tick", "rids", "batch", "batch_bucket", "kv_pages",
+             "occupancy"),
+    "retire": ("rid", "generated", "finish_t", "tick"),
+    "error": ("rid", "reason"),
+}
+
+
+def validate_span_row(row: Dict[str, Any], where: str = "row") -> List[str]:
+    """Validate one spans.<proc>.jsonl row: version first, then the
+    envelope, then the event's required payload fields."""
+    if not isinstance(row, dict):
+        return [f"{where}: not an object"]
+    verrs = _version_errs(row, "v", where)
+    if verrs:
+        return verrs
+    errs = _check(row, SPAN_COMMON, where)
+    if row.get("kind") not in (None, "span"):
+        errs.append(f"{where}: kind is {row.get('kind')!r}, expected "
+                    f"'span'")
+    event = row.get("event")
+    if event is not None:
+        required = SPAN_REQUIRED.get(event)
+        if required is None:
+            errs.append(f"{where}: unknown span event {event!r} "
+                        f"(known: {sorted(SPAN_REQUIRED)})")
+        else:
+            errs += _check(row, {f: SPAN_FIELDS[f] for f in required},
+                           where)
+    return errs
+
+
+def validate_span_file(path: str) -> List[str]:
+    """Validate every line of a spans.<proc>.jsonl file."""
+    errs: List[str] = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError as e:
+                errs.append(f"line {i}: not JSON ({e})")
+                continue
+            errs += validate_span_row(row, where=f"line {i}")
+    return errs
+
+
+# One bench-history record (obs/history.py appends these to the
+# rolling history.jsonl: the final bench summary / run-report summary
+# reduced to its gate metrics, so --gate-rolling and the dtx-obs
+# history trend table read a pinned shape).
+HISTORY_ENTRY = {
+    "v": (int,),
+    "kind": (str,),          # "bench_history"
+    "t": _NUM,
+    "label": (str,),
+    "source": (str,),
+    "metrics": (dict,),
+}
+
+
+def validate_history_entry(row: Dict[str, Any],
+                           where: str = "row") -> List[str]:
+    """Validate one history.jsonl record."""
+    if not isinstance(row, dict):
+        return [f"{where}: not an object"]
+    verrs = _version_errs(row, "v", where)
+    if verrs:
+        return verrs
+    errs = _check(row, HISTORY_ENTRY, where)
+    if row.get("kind") != "bench_history":
+        errs.append(f"{where}: kind is {row.get('kind')!r}, expected "
+                    f"'bench_history'")
+    return errs
+
+
+def validate_history_file(path: str) -> List[str]:
+    """Validate every line of a history.jsonl file."""
+    errs: List[str] = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError as e:
+                errs.append(f"line {i}: not JSON ({e})")
+                continue
+            errs += validate_history_entry(row, where=f"line {i}")
+    return errs
 
 
 # The run report obs/aggregate.py produces (dtx-obs report emits it,

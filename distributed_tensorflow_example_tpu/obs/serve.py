@@ -10,8 +10,17 @@ or offline re-serving via ``dtx-obs serve``):
 - ``/metrics`` — the same signals in Prometheus text exposition
   format (``dtx_*`` gauges), scrapeable by any Prometheus/VictoriaM/
   Grafana-agent stack;
-- ``/report``  — the full obs/aggregate.py run report (computed per
-  request — cheap at these log sizes, and always current).
+- ``/report``  — the full obs/aggregate.py run report, cached by the
+  input files' (path, mtime, size) signature so a dashboard poller
+  hammering the endpoint recomputes only when the run actually wrote
+  something new;
+- ``/slo``     — the obs/slo.py multi-window burn-rate verdict over
+  the serving span stream (``spans.<proc>.jsonl`` tails), plus
+  ``dtx_slo_*`` gauges on ``/metrics`` — the machine-actionable
+  "is the service healthy" answer;
+- ``/trace?rid=N`` — one request's reconstructed lifecycle (obs/spans
+  reconstruct) with its raw span events: submit → blocked/admit →
+  prefill → first_token → shared decode ticks → retire.
 
 With a decode engine attached (``StatusServer(logs_path, engine=...)``
 — the ``dtx-serve`` front door, serving/cli.py) the same server also
@@ -23,9 +32,9 @@ exposes:
   scheduler and blocks on ITS request only, so concurrent requests
   share decode steps;
 - request-level latency percentiles as ``dtx_generate_*`` gauges on
-  ``/metrics`` (p50/p99 latency, time-to-first-token, inflight/queue
-  depth, tok/s, KV page occupancy — the obs/schema.SERVING_STATS
-  surface).
+  ``/metrics`` (p50/p99 latency, p50/p99 time-to-first-token,
+  inflight/queue depth, tok/s, KV page occupancy — the
+  obs/schema.SERVING_STATS surface).
 
 The reader side only ever *reads* files the run appends to, so the
 server adds zero overhead to the training loop and the identical code
@@ -48,6 +57,10 @@ from . import heartbeat as hb_lib
 TAIL_BYTES = 256 * 1024
 # a heartbeat older than this marks the process (and the run) stale
 STALE_HEARTBEAT_S = 120.0
+# /report cache lifetime: long enough to shrug off a hammering
+# poller, short enough that wall-clock fields (heartbeat_age_s) keep
+# aging visibly for a HUNG run whose files stopped changing
+REPORT_CACHE_TTL_S = 15.0
 
 
 def tail_rows(path: str, max_bytes: int = TAIL_BYTES) -> List[Dict[str, Any]]:
@@ -137,12 +150,16 @@ def collect_status(logs_path: str,
 
 
 def prometheus_text(status: Dict[str, Any],
-                    serving: Optional[Dict[str, Any]] = None) -> str:
+                    serving: Optional[Dict[str, Any]] = None,
+                    slo: Optional[Dict[str, Any]] = None) -> str:
     """Render a /status document in Prometheus text exposition format
     (version 0.0.4). Gauges only — everything here is a point-in-time
     read of the run's own counters. ``serving``: a
     DecodeEngine.stats() document (schema.SERVING_STATS) appended as
-    the ``dtx_generate_*`` request-latency gauges."""
+    the ``dtx_generate_*`` request-latency gauges.  ``slo``: an
+    obs/slo.evaluate document appended as the ``dtx_slo_*`` burn-rate
+    gauges (per-SLO per-window burn rate, breach flags, observed
+    p99)."""
     out: List[str] = []
 
     def fmt(v) -> str:
@@ -219,6 +236,8 @@ def prometheus_text(status: Dict[str, Any],
               [(None, serving.get("latency_p99_ms"))])
         gauge("dtx_generate_ttft_p50_ms", "median time to first token",
               [(None, serving.get("ttft_p50_ms"))])
+        gauge("dtx_generate_ttft_p99_ms", "p99 time to first token",
+              [(None, serving.get("ttft_p99_ms"))])
         gauge("dtx_generate_tokens_total", "tokens generated",
               [(None, serving.get("tokens_generated_total"))])
         gauge("dtx_generate_tokens_per_sec", "aggregate decode "
@@ -227,6 +246,23 @@ def prometheus_text(status: Dict[str, Any],
               "fraction", [(None, serving.get("page_occupancy_frac"))])
         gauge("dtx_generate_decode_ticks_total", "decode engine ticks "
               "executed", [(None, serving.get("decode_ticks_total"))])
+    if slo:
+        gauge("dtx_slo_requests", "terminal requests the SLO windows "
+              "slide over", [(None, slo.get("requests"))])
+        docs = slo.get("slos") or []
+        gauge("dtx_slo_burn_rate", "error-budget burn rate per SLO "
+              "and window (1.0 = burning exactly at budget)",
+              [({"slo": d.get("name"), "window": label},
+                (d.get("windows") or {}).get(label, {}).get("burn_rate"))
+               for d in docs for label in ("fast", "slow")])
+        gauge("dtx_slo_breach", "1 while the SLO burns past its "
+              "threshold on BOTH windows",
+              [({"slo": d.get("name")}, 1 if d.get("breach") else 0)
+               for d in docs])
+        gauge("dtx_slo_observed_p99_ms", "observed p99 of the SLO's "
+              "metric over its slow window",
+              [({"slo": d.get("name")}, d.get("observed_p99_ms"))
+               for d in docs])
     return "\n".join(out) + "\n"
 
 
@@ -246,18 +282,101 @@ class StatusServer:
 
     ``engine``: a serving/engine.DecodeEngine (or any object with
     ``submit``/``result``/``stats``) — enables ``POST /generate`` and
-    the ``dtx_generate_*`` gauges (the dtx-serve front door)."""
+    the ``dtx_generate_*`` gauges (the dtx-serve front door).
 
-    def __init__(self, logs_path: str, engine=None):
+    ``slos``: obs/slo.SLOSpec list evaluated by ``/slo`` and the
+    ``dtx_slo_*`` gauges (None = obs/slo.DEFAULT_SLOS)."""
+
+    def __init__(self, logs_path: str, engine=None, slos=None):
         self.logs_path = logs_path
         self.engine = engine
+        self.slos = slos
         self.port: Optional[int] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # /report cache keyed by the input files' stat signature: the
+        # aggregate is recomputed only when the run wrote something
+        # new, so a dashboard poller cannot stall the chief.  A short
+        # TTL rides along because the report carries WALL-CLOCK-derived
+        # fields (heartbeat_age_s): a HUNG run stops touching its
+        # files, and a signature-only cache would pin the ages at
+        # their last fresh-looking values forever — the exact stall
+        # signal the field exists to expose.
+        self._report_sig: Optional[tuple] = None
+        self._report_body: Optional[bytes] = None
+        self._report_t = 0.0
+        self._report_lock = threading.Lock()
+
+    def _report_signature(self) -> tuple:
+        """(path, mtime_ns, size) for every file /report reads —
+        metrics streams, heartbeats and flight dumps.  Size rides
+        along so an append inside one mtime granule still misses."""
+        import glob as glob_lib
+
+        sig = []
+        for pattern in ("metrics.*.jsonl", "heartbeat.*",
+                        os.path.join("flight", "*.json")):
+            for path in glob_lib.glob(os.path.join(self.logs_path,
+                                                   pattern)):
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                sig.append((path, st.st_mtime_ns, st.st_size))
+        return tuple(sorted(sig))
+
+    def report_json(self) -> bytes:
+        """The /report payload, recomputed when the signature of the
+        underlying files changed OR the cached copy aged past
+        ``REPORT_CACHE_TTL_S`` (heartbeat ages must keep growing for a
+        hung run)."""
+        from . import aggregate as agg_lib
+
+        sig = self._report_signature()
+        now = time.monotonic()
+        with self._report_lock:
+            if (sig == self._report_sig
+                    and self._report_body is not None
+                    and now - self._report_t < REPORT_CACHE_TTL_S):
+                return self._report_body
+        body = json.dumps(agg_lib.aggregate(self.logs_path)).encode()
+        with self._report_lock:
+            self._report_sig = sig
+            self._report_body = body
+            self._report_t = now
+        return body
+
+    def _span_rows(self):
+        """The /slo and /trace data source.  With a live engine whose
+        recorder is attached (dtx-serve --trace_spans) this is the
+        recorder's in-memory ring — no file re-read per request;
+        offline it is the bounded span-stream tails across processes,
+        time-ordered (same O(tail) discipline as /status)."""
+        rec = getattr(self.engine, "recorder", None) \
+            if self.engine is not None else None
+        if rec is not None:
+            return rec.snapshot()
+        from .spans import span_files
+
+        rows = []
+        for _pid, path in span_files(self.logs_path):
+            rows.extend(r for r in tail_rows(path)
+                        if r.get("kind") == "span")
+        rows.sort(key=lambda r: (r.get("t") or 0.0))
+        return rows
+
+    def slo_doc(self, rows=None) -> Dict[str, Any]:
+        from . import slo as slo_lib
+
+        if rows is None:
+            rows = self._span_rows()
+        return slo_lib.evaluate(slo_lib.records_from_spans(rows),
+                                specs=self.slos)
 
     def start(self, port: int, host: str = "") -> Optional[int]:
         logs_path = self.logs_path
         engine = self.engine
+        server = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # stdout belongs to the run
@@ -272,7 +391,8 @@ class StatusServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                path, _, query = self.path.partition("?")
+                path = path.rstrip("/") or "/"
                 try:
                     if path in ("/", "/status"):
                         doc = collect_status(logs_path)
@@ -280,22 +400,46 @@ class StatusServer:
                             doc["serving"] = engine.stats()
                         self._send(200, json.dumps(doc).encode())
                     elif path == "/metrics":
+                        spans = server._span_rows()
                         text = prometheus_text(
                             collect_status(logs_path),
                             serving=(engine.stats()
-                                     if engine is not None else None))
+                                     if engine is not None else None),
+                            slo=(server.slo_doc(spans) if spans
+                                 else None))
                         self._send(200, text.encode(),
                                    "text/plain; version=0.0.4")
                     elif path == "/report":
-                        from .aggregate import aggregate
-
+                        self._send(200, server.report_json())
+                    elif path == "/slo":
                         self._send(200, json.dumps(
-                            aggregate(logs_path)).encode())
+                            server.slo_doc()).encode())
+                    elif path == "/trace":
+                        from urllib.parse import parse_qs
+
+                        from .spans import trace_record
+
+                        rid = (parse_qs(query).get("rid")
+                               or [None])[0]
+                        try:
+                            rid = int(rid)
+                        except (TypeError, ValueError):
+                            self._send(400, json.dumps(
+                                {"error": "/trace needs ?rid=N (an "
+                                          "integer request id)"}).encode())
+                            return
+                        doc = trace_record(server._span_rows(), rid)
+                        if doc is None:
+                            self._send(404, json.dumps(
+                                {"error": f"rid {rid} not in the span "
+                                          f"stream tails"}).encode())
+                            return
+                        self._send(200, json.dumps(doc).encode())
                     else:
                         self._send(404, json.dumps(
                             {"error": f"unknown path {path!r}",
                              "endpoints": ["/status", "/metrics",
-                                           "/report"]
+                                           "/report", "/slo", "/trace"]
                              + (["/generate"] if engine is not None
                                 else [])}).encode())
                 except Exception as e:  # a bad read must not kill serving

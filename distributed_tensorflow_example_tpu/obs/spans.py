@@ -1,0 +1,289 @@
+"""Request-lifecycle span recorder for the serving stack.
+
+PR 8's serving stack reports only aggregate counters
+(``DecodeEngine.stats()``); a router, an autoscaler or a human
+debugging one slow request needs the *per-request* record: when it
+was submitted, how long admission blocked it (and on what), when its
+prefill ran, when the first token came back, which decode ticks it
+shared and with how full a batch, and when it retired.  This module
+is that record:
+
+- ``SpanRecorder`` appends one strict-JSON row per lifecycle event to
+  ``<logs_path>/spans.<proc>.jsonl`` (the metrics-stream discipline:
+  one file per process, line-buffered, non-finite floats stringified
+  via flight.py's ``_jsonable``, a bad fd degrades the stream instead
+  of killing the engine) and keeps a bounded in-memory ring so the
+  live ``/trace?rid=N`` endpoint never re-reads the file;
+- the event vocabulary is pinned in ``obs/buckets.py SPAN_EVENTS``
+  and the per-event field contract in ``obs/schema.py``
+  (``SPAN_COMMON``/``SPAN_FIELDS``/``SPAN_REQUIRED``), so a drifted
+  name fails at the emit site or in ``dtx-obs validate``, never in a
+  consumer months later;
+- ``reconstruct(rows)`` folds a span stream back into per-request
+  lifecycle records — the exactly-once invariant (each milestone
+  event at most once per rid, every accepted rid retiring) is
+  *checked* during reconstruction and violations surface in each
+  record's ``errors`` list.
+
+The scheduler (serving/scheduler.py) stays jax-free by emitting
+through an *injected* recorder — it never imports this module; the
+engine (serving/engine.py) threads one recorder through both layers.
+Tracing is host-side appends only: greedy decode outputs are
+token-identical with tracing on or off (pinned in
+tests/test_serving.py).
+
+Lifecycle (one accepted request)::
+
+    submit ── blocked(reason)* ── admit ── prefill ── first_token
+           ── [tick]* ── retire
+
+``blocked`` repeats once per tick the request stays unadmitted (with
+``reason`` "pages" or "slots" — the admission-accounting signal);
+``tick`` rows are per decode step, shared across the batch (``rids``
+lists the members, ``occupancy`` the KV-pool fill); ``error`` marks
+requests failed by an engine-loop death (no retire follows).
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import json
+import os
+import re
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from .buckets import SPAN_EVENTS
+from .flight import _jsonable
+from .schema import SCHEMA_VERSION
+
+# in-memory ring default: enough for the /trace view of a busy tail
+# without growing per request forever
+RING_CAPACITY = 8192
+
+# the exactly-once milestones (per rid); blocked/tick/error repeat
+MILESTONES = ("submit", "admit", "prefill", "first_token", "retire")
+
+_SPANS_RE = re.compile(r"spans\.(\d+)\.jsonl$")
+
+
+def span_files(logs_path: str) -> List[Tuple[int, str]]:
+    """[(proc_index, path)] for every span stream in a run dir — the
+    one place the naming/discovery convention lives (the CLI, the
+    status server and the SLO evaluator all reuse it)."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(logs_path,
+                                              "spans.*.jsonl"))):
+        m = _SPANS_RE.search(os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return out
+
+
+class SpanRecorder:
+    """Append-only span stream + bounded in-memory ring.
+
+    ``emit`` validates the event name against the obs/buckets.py
+    registry (the WindowTimer.charge discipline), stamps the schema
+    version and writes one strict-JSON line.  Telemetry must degrade,
+    never kill the engine it observes: a bad fd / full volume closes
+    the stream and emission becomes ring-only."""
+
+    def __init__(self, logs_path: str, process_index: int = 0,
+                 ring: int = RING_CAPACITY):
+        import threading
+
+        os.makedirs(logs_path, exist_ok=True)
+        self.process_index = int(process_index)
+        self.path = os.path.join(
+            logs_path, f"spans.{self.process_index}.jsonl")
+        self._f = open(self.path, "a", buffering=1)  # line-buffered
+        self.ring: collections.deque = collections.deque(maxlen=ring)
+        # the engine emits under ITS lock, but /trace /slo readers are
+        # HTTP handler threads: snapshot() must not race an append
+        self._ring_lock = threading.Lock()
+
+    def emit(self, event: str, **fields) -> None:
+        if event not in SPAN_EVENTS:
+            # one registry (obs/buckets.py) names every span event; an
+            # unknown name would silently vanish from reconstruction
+            raise ValueError(f"unknown span event {event!r}: expected "
+                             f"one of {SPAN_EVENTS}")
+        row = {"kind": "span", "v": SCHEMA_VERSION, "t": time.time(),
+               "proc": self.process_index, "event": event,
+               **_jsonable(fields)}
+        with self._ring_lock:
+            self.ring.append(row)
+        if self._f is None:
+            return
+        try:
+            self._f.write(json.dumps(row, allow_nan=False) + "\n")
+        except (OSError, ValueError):
+            try:
+                self._f.close()
+            except Exception:
+                pass
+            self._f = None
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """A consistent copy of the ring (the live /trace and /slo
+        data source — no file re-read while the engine is attached)."""
+        with self._ring_lock:
+            return list(self.ring)
+
+    def rows_for(self, rid: int) -> List[Dict[str, Any]]:
+        """Every ring row touching ``rid`` — its own events plus the
+        shared decode ticks it was a member of (the /trace view)."""
+        rid = int(rid)
+        return [r for r in self.snapshot()
+                if r.get("rid") == rid or rid in (r.get("rids") or ())]
+
+    def flush(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+
+    def close(self) -> None:
+        if self._f is None:
+            return
+        try:
+            self._f.flush()
+        finally:
+            self._f.close()
+            self._f = None
+
+
+def read_spans(path: str) -> List[Dict[str, Any]]:
+    """Parse a spans.<proc>.jsonl back into rows (whole lines only —
+    a torn trailing append is skipped, not half-parsed)."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                continue
+    return rows
+
+
+def load_spans(logs_path: str) -> List[Dict[str, Any]]:
+    """All span rows under a run dir, time-ordered across processes."""
+    rows: List[Dict[str, Any]] = []
+    for _pid, path in span_files(logs_path):
+        rows.extend(read_spans(path))
+    rows.sort(key=lambda r: (r.get("t") or 0.0))
+    return rows
+
+
+def reconstruct(
+        rows: Iterable[Dict[str, Any]]) -> Dict[tuple, Dict[str, Any]]:
+    """Fold a span stream into per-request lifecycle records.
+
+    Returns ``{(proc, rid): record}`` — keyed by the PAIR because
+    every engine numbers its rids from 0, so streams merged across
+    processes (``load_spans``) would otherwise conflate distinct
+    requests into one corrupted record.  Each record carries the
+    milestone timestamps/payloads, the blocked-reason counts, the
+    decode-tick attribution and a ``complete`` verdict.  The
+    exactly-once invariant is CHECKED here: a duplicate milestone, a
+    milestone for a never-submitted rid, or a retire whose
+    ``generated`` disagrees with ``max_new_tokens`` lands in that
+    record's ``errors`` list — reconstruction never raises on a torn
+    stream."""
+    recs: Dict[tuple, Dict[str, Any]] = {}
+
+    def rec_for(proc: int, rid: int) -> Dict[str, Any]:
+        r = recs.get((proc, rid))
+        if r is None:
+            r = recs[(proc, rid)] = {
+                "proc": proc, "rid": rid, "blocked": {},
+                "decode_ticks": 0, "ticks": [], "errors": [],
+            }
+        return r
+
+    for row in rows:
+        event = row.get("event")
+        proc = int(row.get("proc") or 0)
+        if event == "tick":
+            for rid in (row.get("rids") or ()):
+                r = rec_for(proc, int(rid))
+                r["decode_ticks"] += 1
+                r["ticks"].append(row.get("tick"))
+            continue
+        rid = row.get("rid")
+        if rid is None:
+            continue
+        r = rec_for(proc, int(rid))
+        if event in MILESTONES:
+            key = f"{event}_t"
+            if key in r:
+                r["errors"].append(f"duplicate {event}")
+                continue
+            r[key] = row.get("t")
+        if event == "submit":
+            r["prompt_len"] = row.get("prompt_len")
+            r["max_new_tokens"] = row.get("max_new_tokens")
+            r["arrival"] = row.get("arrival")
+        elif event == "blocked":
+            reason = str(row.get("reason"))
+            r["blocked"][reason] = r["blocked"].get(reason, 0) + 1
+        elif event == "admit":
+            r["pages_held"] = row.get("pages_held")
+            r["admit_tick"] = row.get("tick")
+        elif event == "prefill":
+            r["prefill_bucket"] = row.get("bucket")
+        elif event == "first_token":
+            r["ttft_ms"] = row.get("ttft_ms")
+        elif event == "retire":
+            r["generated"] = row.get("generated")
+            r["finish_t"] = row.get("finish_t")
+            r["retire_tick"] = row.get("tick")
+        elif event == "error":
+            r["error"] = str(row.get("reason"))
+
+    for _key, r in recs.items():
+        if "submit_t" not in r:
+            r["errors"].append("no submit event")
+        for a, b in (("admit", "submit"), ("retire", "admit")):
+            if f"{a}_t" in r and f"{b}_t" not in r:
+                r["errors"].append(f"{a} without {b}")
+        if ("generated" in r and r.get("max_new_tokens") is not None
+                and r["generated"] != r["max_new_tokens"]):
+            r["errors"].append(
+                f"generated {r['generated']} != max_new_tokens "
+                f"{r['max_new_tokens']}")
+        if (r.get("arrival") is not None and r.get("finish_t")
+                is not None):
+            r["latency_ms"] = round(
+                (r["finish_t"] - r["arrival"]) * 1e3, 3)
+        r["complete"] = ("retire_t" in r and "admit_t" in r
+                         and not r["errors"])
+    return recs
+
+
+def trace_record(rows: Iterable[Dict[str, Any]], rid: int,
+                 proc: Optional[int] = None) -> Optional[Dict[str, Any]]:
+    """The /trace?rid=N payload: the reconstructed record plus the
+    raw events touching ``rid`` (its own + shared ticks).  ``proc``
+    disambiguates merged multi-process streams (every engine numbers
+    rids from 0); unset, the lowest matching proc wins and the other
+    candidates are listed in ``ambiguous_procs``."""
+    rid = int(rid)
+    rows = list(rows)
+    recs = reconstruct(rows)
+    procs = sorted(p for p, r in recs if r == rid
+                   and (proc is None or p == proc))
+    if not procs:
+        return None
+    pick = procs[0]
+    events = [r for r in rows
+              if int(r.get("proc") or 0) == pick
+              and (r.get("rid") == rid or rid in (r.get("rids") or ()))]
+    doc = {"rid": rid, "proc": pick,
+           "record": recs[(pick, rid)], "events": events}
+    if len(procs) > 1:
+        doc["ambiguous_procs"] = procs
+    return doc

@@ -16,6 +16,12 @@ Engine knobs: ``--decode_page_size`` (tokens per KV page),
 ``--decode_pages`` (pool size; 0 sizes for ``--decode_max_batch``
 worst-case sequences), ``--decode_max_batch`` (concurrent decode
 slots = the largest batch bucket), ``--serve_port``.
+
+Observability knobs: ``--trace_spans`` records every request's
+lifecycle to ``<logs_path>/spans.<proc>.jsonl`` (obs/spans.py) and
+lights up ``/trace?rid=N``, ``/slo`` and the ``dtx_slo_*`` gauges;
+``--slo`` overrides the SLO specs those evaluate (obs/slo.py DSL,
+e.g. ``ttft_p99_ms<=250,error_rate<=0.01``).
 """
 
 from __future__ import annotations
@@ -114,6 +120,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     except ValueError as e:
         print(f"dtx-serve: {e}", file=sys.stderr)
         return 2
+    from ..obs import slo as slo_lib
+
+    try:
+        slos = slo_lib.parse_specs(cfg.slo)
+    except ValueError as e:
+        print(f"dtx-serve: {e}", file=sys.stderr)
+        return 2
 
     import jax
 
@@ -131,18 +144,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               "random init (demo mode)")
         params = tfm.init(jax.random.PRNGKey(cfg.seed), spec)
 
+    recorder = None
+    if cfg.trace_spans:
+        from ..obs.spans import SpanRecorder
+
+        recorder = SpanRecorder(cfg.logs_path)
+        print(f"dtx-serve: request spans -> {recorder.path}")
     engine = DecodeEngine(
         spec, params, page_size=cfg.decode_page_size,
         num_pages=cfg.decode_pages, max_batch=cfg.decode_max_batch,
-        seed=cfg.seed, kv_quant=cfg.kv_quant)
+        seed=cfg.seed, kv_quant=cfg.kv_quant, recorder=recorder)
     engine.start()
 
     from ..obs.serve import StatusServer
 
-    server = StatusServer(cfg.logs_path, engine=engine)
+    server = StatusServer(cfg.logs_path, engine=engine, slos=slos)
     port = server.start(cfg.serve_port)
     if port is None:
         engine.stop()
+        if recorder is not None:
+            recorder.close()
         return 2
     print(f"dtx-serve: POST /generate on :{port} "
           f"(page_size={engine.page_size} pages={engine.num_pages} "
@@ -160,6 +181,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     finally:
         server.close()
         engine.stop()
+        if recorder is not None:
+            recorder.close()
     return 0
 
 

@@ -90,7 +90,7 @@ class DecodeEngine:
     def __init__(self, spec, params, page_size: int = 16,
                  num_pages: int = 0, max_batch: int = 8,
                  max_len: int = 0, donate: Optional[bool] = None,
-                 seed: int = 0, kv_quant: str = ""):
+                 seed: int = 0, kv_quant: str = "", recorder=None):
         import jax
 
         from . import kv_cache as kvc
@@ -110,8 +110,15 @@ class DecodeEngine:
         pages_per_seq = max(1, math.ceil((self.max_len - 1)
                                          / self.page_size))
         self.num_pages = int(num_pages) or 1 + max_batch * pages_per_seq
+        # ONE span recorder (obs/spans.SpanRecorder or None) threads
+        # both layers: the scheduler narrates admission decisions, the
+        # engine adds the execution milestones (prefill / first_token /
+        # error).  Host-side appends only — greedy outputs are
+        # token-identical with tracing on or off.
+        self.recorder = recorder
         self.sched = sched_lib.ContinuousScheduler(
-            self.num_pages, self.page_size, max_batch)
+            self.num_pages, self.page_size, max_batch,
+            recorder=recorder)
         self.prompt_buckets = sched_lib.shape_buckets(
             max(1, self.max_len - 1))
         self._heads = kvc.local_heads(spec, params)
@@ -249,6 +256,9 @@ class DecodeEngine:
         pb = sched_lib.bucket_for(p, self.prompt_buckets)
         wp = max(1, math.ceil(pb / self.page_size))
         self.shapes_used.add(("prefill", pb, wp))
+        if self.recorder is not None:
+            self.recorder.emit("prefill", rid=rid, bucket=pb,
+                           pages_width=wp)
         bt = np.full((1, wp), SCRATCH_PAGE, np.int32)
         own = seq.pages[:wp]
         bt[0, :len(own)] = own
@@ -268,6 +278,9 @@ class DecodeEngine:
         self._last_tok[rid] = tok
         self._prefills += 1
         self._tokens_out += 1
+        if self.recorder is not None:
+            self.recorder.emit("first_token", rid=rid, ttft_ms=round(
+                (now - res.arrival_t) * 1e3, 3))
         self.sched.record_prefill(rid, now=now)
         if seq.done:
             self._finish(rid, now)
@@ -403,9 +416,14 @@ class DecodeEngine:
                          f"{traceback.format_exc()}")
         with self._lock:
             self._failure = msg
-            for res in self._results.values():
+            for rid, res in self._results.items():
                 if res.finish_t is None and res.error is None:
                     res.error = msg
+                    if self.recorder is not None:
+                        # no retire will follow: mark the lifecycle
+                        # failed so reconstruction doesn't read these
+                        # as silently dropped requests
+                        self.recorder.emit("error", rid=rid, reason=msg)
                     res.event.set()
         with self._work:
             self._running = False
@@ -433,6 +451,7 @@ class DecodeEngine:
                 "latency_p50_ms": _percentile(lats, 0.50),
                 "latency_p99_ms": _percentile(lats, 0.99),
                 "ttft_p50_ms": _percentile(ttfts, 0.50),
+                "ttft_p99_ms": _percentile(ttfts, 0.99),
                 "tokens_generated_total": toks,
                 "tokens_per_sec": (toks / wall if wall > 0 and toks
                                    else None),

@@ -33,6 +33,16 @@ set), which is how the bench proves continuous batching strictly
 beats static batching on ragged lengths: a static batch decodes
 ``max(len)`` ticks per group while continuous backfills retired slots
 the very tick they free.
+
+**Span emission**: when constructed with a ``recorder`` (anything
+with ``.emit(event, **fields)`` — obs/spans.SpanRecorder in the real
+engine), the scheduler narrates every admission decision into the
+request-lifecycle span stream: ``submit`` on accept, ``blocked`` with
+its reason (``pages``/``slots``) once per tick a waiter stays out,
+``admit`` with the pages granted, one ``tick`` row per planned step
+(members, bucket shape, pool occupancy) and ``retire`` when the pages
+free.  The recorder is *injected* so this module stays jax- and
+obs-free; ``recorder=None`` (the default) emits nothing.
 """
 
 from __future__ import annotations
@@ -157,7 +167,8 @@ class ContinuousScheduler:
     retires, then admits, then plans one shared decode step over the
     live ragged batch."""
 
-    def __init__(self, num_pages: int, page_size: int, max_batch: int):
+    def __init__(self, num_pages: int, page_size: int, max_batch: int,
+                 recorder=None):
         if max_batch < 1:
             raise ValueError(f"max_batch={max_batch} must be >= 1")
         self.alloc = BlockAllocator(num_pages, page_size)
@@ -172,6 +183,14 @@ class ContinuousScheduler:
         self.ticks = 0
         self.decode_slots = 0       # slot-ticks executed (live work)
         self.occupancy_samples: List[float] = []
+        # request-lifecycle span emission (obs/spans.SpanRecorder, or
+        # anything with .emit(event, **fields)) — INJECTED so the
+        # scheduler module itself stays jax- and obs-free; None = off
+        self.recorder = recorder
+
+    def _emit(self, event: str, **fields) -> None:
+        if self.recorder is not None:
+            self.recorder.emit(event, **fields)
 
     # ---- request surface ----
     def submit(self, rid: int, prompt_len: int, max_new_tokens: int,
@@ -186,6 +205,11 @@ class ContinuousScheduler:
                 f"{self.alloc.usable} usable")
         self.waiting.append(SeqState(rid, prompt_len, max_new_tokens,
                                      arrival=arrival))
+        # emitted on ACCEPT only (validation above raises first), so
+        # the span stream's submit events mirror requests_total
+        self._emit("submit", rid=rid, prompt_len=int(prompt_len),
+                   max_new_tokens=int(max_new_tokens),
+                   arrival=float(arrival))
 
     def _pages_for(self, prompt_len: int, max_new: int) -> int:
         # rows written run 0 .. prompt+max_new-2: the final token is
@@ -201,27 +225,40 @@ class ContinuousScheduler:
         engine idles).  ``now``: admission considers requests with
         ``arrival <= now`` only (tick-count clock in simulation, wall
         clock live)."""
+        # 0-based boundary index every span event at this boundary
+        # shares (the step-index the SLO windows slide over)
+        tick = self.ticks
         # 1) retire: pages return BEFORE admission looks at the pool
         for s in [s for s in self.live if s.done]:
             self.live.remove(s)
             self.alloc.free(s.pages)
             s.pages = []
             self.finished[s.rid] = s
+            self._emit("retire", rid=s.rid, generated=s.generated,
+                       finish_t=float(s.finish_t or 0.0), tick=tick)
         # 2) admit FIFO among the arrived
         prefills: List[int] = []
         for s in list(self.waiting):
-            if s.arrival > now or len(self.live) >= self.max_batch:
+            if s.arrival > now:
+                continue                  # not arrived ≠ blocked
+            if len(self.live) >= self.max_batch:
+                self._emit("blocked", rid=s.rid, reason="slots",
+                           tick=tick)
                 continue
             pages = self.alloc.alloc(
                 self._pages_for(s.prompt_len, s.max_new_tokens))
             if pages is None:
                 # head-of-line blocks on pages: smaller requests behind
                 # it must not starve it forever — stop admitting
+                self._emit("blocked", rid=s.rid, reason="pages",
+                           tick=tick)
                 break
             s.pages = pages
             self.waiting.remove(s)
             self.live.append(s)
             prefills.append(s.rid)
+            self._emit("admit", rid=s.rid, pages_held=len(pages),
+                       tick=tick)
         if not self.live:
             return None
         decodes = [s.rid for s in self.live if not s.done]
@@ -247,8 +284,11 @@ class ContinuousScheduler:
         )
         self.ticks += 1
         self.decode_slots += len(decodes)
-        self.occupancy_samples.append(
-            self.alloc.in_use / self.alloc.usable)
+        occ = self.alloc.in_use / self.alloc.usable
+        self.occupancy_samples.append(occ)
+        self._emit("tick", tick=tick, rids=list(decodes),
+                   batch=len(decodes), batch_bucket=plan.batch_bucket,
+                   kv_pages=plan.kv_pages, occupancy=round(occ, 6))
         return plan
 
     def record_prefill(self, rid: int, now: float = 0.0) -> None:
@@ -292,6 +332,7 @@ class StaticBatchScheduler(ContinuousScheduler):
     identical request set."""
 
     def plan_tick(self, now: float = float("inf")) -> Optional[TickPlan]:
+        tick = self.ticks
         # retire pages as sequences finish (memory is freed either
         # way; the STATIC restriction is about slots, not pages)
         for s in [s for s in self.live if s.done and s.pages]:
@@ -300,21 +341,32 @@ class StaticBatchScheduler(ContinuousScheduler):
         if self.live and all(s.done for s in self.live):
             for s in self.live:
                 self.finished[s.rid] = s
+                self._emit("retire", rid=s.rid, generated=s.generated,
+                           finish_t=float(s.finish_t or 0.0),
+                           tick=tick)
             self.live = []
         prefills: List[int] = []
         if not self.live:
             # next group: fill up to max_batch from the arrived queue
             for s in list(self.waiting):
-                if s.arrival > now or len(self.live) >= self.max_batch:
+                if s.arrival > now:
+                    continue
+                if len(self.live) >= self.max_batch:
+                    self._emit("blocked", rid=s.rid, reason="slots",
+                               tick=tick)
                     continue
                 pages = self.alloc.alloc(
                     self._pages_for(s.prompt_len, s.max_new_tokens))
                 if pages is None:
+                    self._emit("blocked", rid=s.rid, reason="pages",
+                               tick=tick)
                     break
                 s.pages = pages
                 self.waiting.remove(s)
                 self.live.append(s)
                 prefills.append(s.rid)
+                self._emit("admit", rid=s.rid,
+                           pages_held=len(pages), tick=tick)
         if not self.live:
             return None
         decodes = [s.rid for s in self.live if not s.done]
@@ -336,8 +388,11 @@ class StaticBatchScheduler(ContinuousScheduler):
         )
         self.ticks += 1
         self.decode_slots += len(decodes)
-        self.occupancy_samples.append(
-            self.alloc.in_use / self.alloc.usable)
+        occ = self.alloc.in_use / self.alloc.usable
+        self.occupancy_samples.append(occ)
+        self._emit("tick", tick=tick, rids=list(decodes),
+                   batch=len(decodes), batch_bucket=plan.batch_bucket,
+                   kv_pages=plan.kv_pages, occupancy=round(occ, 6))
         return plan
 
 

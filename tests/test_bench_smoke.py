@@ -9,6 +9,8 @@ config must flow through the genuine capture machinery."""
 
 import json
 
+import pytest
+
 import bench
 
 from conftest import needs_stack  # noqa: E402
@@ -267,6 +269,77 @@ def test_bench_main_tpu_rows_no_guarded_collision(monkeypatch, capsys):
     # (fp8 in the stubs) carries the row mfu the gate reads
     assert final["transformer_wide_mfu"] == 0.66
     assert final["moe_wide_mfu"] == 0.38
+
+
+def test_bench_history_appends_final_summary(monkeypatch, capsys,
+                                             tmp_path):
+    """--history: the run's final summary lands in the rolling
+    history.jsonl reduced to its gate metrics — the trajectory grows
+    by exactly one entry per run."""
+    from distributed_tensorflow_example_tpu.obs import (
+        history as hist_lib,
+    )
+
+    _stub_rows(monkeypatch)
+    hist = tmp_path / "history.jsonl"
+    assert bench.main(["--history", str(hist)]) == 0
+    capsys.readouterr()
+    entries = hist_lib.read_history(str(hist))
+    assert len(entries) == 1
+    assert entries[0]["source"] == "bench"
+    assert entries[0]["metrics"]["wall_s"] == 1.0   # the stub headline
+    assert entries[0]["metrics"]["mfu"] == 0.5
+    assert bench.main(["--history", str(hist)]) == 0
+    capsys.readouterr()
+    assert len(hist_lib.read_history(str(hist))) == 2
+
+
+def test_bench_gate_rolling_exit_codes(monkeypatch, capsys, tmp_path):
+    """--gate-rolling N: 0 against a same-speed history, 3 against a
+    doctored faster one (with the verdict printed strictly AFTER the
+    final summary line), 2 on an empty history — and the regressing
+    run is still recorded."""
+    from distributed_tensorflow_example_tpu.obs import (
+        history as hist_lib,
+    )
+
+    _stub_rows(monkeypatch)
+    hist = tmp_path / "history.jsonl"
+    # empty history: unusable gate (2), but the run IS recorded
+    assert bench.main(["--history", str(hist),
+                       "--gate-rolling", "5"]) == 2
+    out = capsys.readouterr().out.strip().splitlines()
+    assert "gate_error" in json.loads(out[-1])
+    assert len(hist_lib.read_history(str(hist))) == 1
+    # same-speed history: pass
+    assert bench.main(["--history", str(hist),
+                       "--gate-rolling", "5"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    verdict = json.loads(out[-1])
+    assert verdict["ok"] is True and verdict["gate_rolling"] == 5
+    assert verdict["baseline_entries"] == 1     # the prior entry only
+    # doctor a 2x-faster round into the history: rolling median halves
+    # -> wall_s regression, exit 3, evidence order preserved
+    for _ in range(3):
+        hist_lib.append_entry(
+            str(hist), {"metric": "x", "value": 0.5, "mfu": 0.5},
+            label="doctored", source="test")
+    assert bench.main(["--history", str(hist),
+                       "--gate-rolling", "3"]) == 3
+    out = capsys.readouterr().out.strip().splitlines()
+    verdict = json.loads(out[-1])
+    assert "wall_s" in verdict["regressions"]
+    final = json.loads(out[-2])                 # summary precedes it
+    assert final["metric"] == "mnist_20epoch_wall_clock"
+    # the regressing run still landed in the trajectory
+    assert hist_lib.read_history(str(hist))[-1]["source"] == "bench"
+
+
+def test_bench_gate_rolling_requires_history(monkeypatch, capsys):
+    _stub_rows(monkeypatch)
+    with pytest.raises(SystemExit) as ei:
+        bench.main(["--gate-rolling", "5"])
+    assert ei.value.code == 2
 
 
 def test_guarded_isolates_row_failures(monkeypatch, capsys):

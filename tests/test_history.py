@@ -1,0 +1,210 @@
+"""Rolling bench history + the --gate-rolling baseline (ISSUE 12).
+
+Pure file I/O over strict JSON — every test runs in this container.
+The committed BENCH_r0*.json captures double as fixtures: the
+--import backfill is exercised against the real artifacts the
+trajectory is supposed to start from.
+"""
+
+import json
+import os
+
+import pytest
+
+from distributed_tensorflow_example_tpu.obs import cli as cli_lib
+from distributed_tensorflow_example_tpu.obs import compare as cmp_lib
+from distributed_tensorflow_example_tpu.obs import history as hist_lib
+from distributed_tensorflow_example_tpu.obs import schema as schema_lib
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CAPTURES = [os.path.join(_REPO, f"BENCH_r0{i}.json")
+             for i in range(1, 6)]
+
+
+def _summary(wall, mfu=0.5, acc=0.9):
+    return {"metric": "mnist_20epoch_wall_clock", "value": wall,
+            "mfu": mfu, "learning_accuracy": acc}
+
+
+# --- append / read / schema ------------------------------------------------
+
+
+def test_append_read_round_trip(tmp_path):
+    path = str(tmp_path / "history.jsonl")
+    e1 = hist_lib.append_entry(path, _summary(10.0), label="r1",
+                               source="bench")
+    assert e1["metrics"] == {"wall_s": 10.0, "mfu": 0.5,
+                             "test_accuracy": 0.9}
+    hist_lib.append_entry(path, _summary(11.0), label="r2",
+                          source="bench")
+    entries = hist_lib.read_history(path)
+    assert [e["label"] for e in entries] == ["r1", "r2"]
+    assert entries[0]["v"] == schema_lib.SCHEMA_VERSION
+    assert hist_lib.validate_file(path) == []
+    assert schema_lib.validate_history_file(path) == []
+    # every line is strict JSON
+    for line in open(path):
+        json.dumps(json.loads(line), allow_nan=False)
+    # a run report is an accepted input shape too (extract_metrics)
+    rep = {"v": schema_lib.SCHEMA_VERSION, "kind": "run_report",
+           "wall_s": 5.0, "test_accuracy": 0.8,
+           "goodput": {"goodput_frac": 0.7}, "step_time": {},
+           "throughput": {}}
+    e = hist_lib.append_entry(path, rep, label="report")
+    assert e["metrics"]["wall_s"] == 5.0
+    assert e["metrics"]["goodput_frac"] == 0.7
+
+
+def test_read_history_survives_torn_and_foreign_lines(tmp_path):
+    path = str(tmp_path / "history.jsonl")
+    hist_lib.append_entry(path, _summary(1.0), label="ok")
+    with open(path, "a") as f:
+        f.write("{torn\n")
+        f.write(json.dumps({"kind": "window", "v": 4}) + "\n")
+    entries = hist_lib.read_history(path)
+    assert [e["label"] for e in entries] == ["ok"]
+    # the strict validator DOES flag those lines
+    assert hist_lib.validate_file(path) != []
+    assert hist_lib.read_history(str(tmp_path / "missing.jsonl")) == []
+
+
+def test_validate_history_entry_contract(tmp_path):
+    path = str(tmp_path / "history.jsonl")
+    e = hist_lib.append_entry(path, _summary(1.0), label="x")
+    assert schema_lib.validate_history_entry(e) == []
+    errs = schema_lib.validate_history_entry(
+        {k: v for k, v in e.items() if k != "metrics"})
+    assert errs and "metrics" in errs[0]
+    errs = schema_lib.validate_history_entry(
+        {k: v for k, v in e.items() if k != "v"})
+    assert len(errs) == 1 and "schema v1" in errs[0]
+
+
+# --- rolling baseline ------------------------------------------------------
+
+
+def test_rolling_baseline_median_closed_form(tmp_path):
+    path = str(tmp_path / "h.jsonl")
+    for i, wall in enumerate((10.0, 20.0, 30.0, 40.0, 50.0)):
+        hist_lib.append_entry(path, _summary(wall, mfu=0.1 * (i + 1)),
+                              label=f"r{i}")
+    entries = hist_lib.read_history(path)
+    base = hist_lib.rolling_baseline(entries, 3)       # last 3
+    assert base["kind"] == "history_baseline"
+    assert base["entries"] == 3
+    assert base["metrics"]["wall_s"] == 40.0           # median(30,40,50)
+    assert base["metrics"]["mfu"] == pytest.approx(0.4)
+    # a metric present in only SOME entries still contributes
+    hist_lib.append_entry(path, {"metric": "x", "value": 60.0,
+                                 "serving_p99_ms": 100.0}, label="r5")
+    base = hist_lib.rolling_baseline(hist_lib.read_history(path), 2)
+    assert base["metrics"]["serving_p99_ms"] == 100.0
+    assert base["metrics"]["wall_s"] == 55.0           # median(50,60)
+
+
+def test_history_shapes_flow_through_compare():
+    """The bench_history/history_baseline shapes are first-class
+    compare documents — including metrics (prefetch_step_ms) whose
+    names would hijack other extract_metrics branches if the dict
+    were fed in bare."""
+    base = {"kind": "history_baseline", "entries": 3,
+            "metrics": {"wall_s": 10.0, "prefetch_step_ms": 9.0,
+                        "mfu": 0.5, "bogus_metric": 1.0,
+                        "test_accuracy": "doctored"}}
+    m = cmp_lib.extract_metrics(base)
+    # every gate metric survives side by side; non-gate and
+    # non-numeric entries are filtered
+    assert m == {"wall_s": 10.0, "prefetch_step_ms": 9.0, "mfu": 0.5}
+    entry = {"kind": "bench_history", "label": "r1",
+             "metrics": {"wall_s": 12.0}}
+    assert cmp_lib.extract_metrics(entry) == {"wall_s": 12.0}
+    # the rolling gate verdict: a doctored 50% wall regression gates
+    verdict = cmp_lib.compare(base, _summary(15.0))
+    assert not verdict["ok"] and "wall_s" in verdict["regressions"]
+    assert cmp_lib.compare(base, _summary(10.0))["ok"]
+
+
+# --- the --import backfill over the committed captures ---------------------
+
+
+def test_import_committed_captures_idempotent(tmp_path):
+    path = str(tmp_path / "history.jsonl")
+    appended, skipped = hist_lib.import_captures(path, _CAPTURES)
+    assert appended == 5 and skipped == []
+    entries = hist_lib.read_history(path)
+    assert [e["label"] for e in entries] == [
+        f"BENCH_r0{i}" for i in range(1, 6)]
+    assert all(e["source"] == "import" for e in entries)
+    # every committed capture yields at least one gate metric — the
+    # trajectory starts non-empty (the acceptance criterion)
+    assert all(e["metrics"] for e in entries)
+    assert hist_lib.validate_file(path) == []
+    base = hist_lib.rolling_baseline(entries, 5)
+    assert "wall_s" in base["metrics"]
+    # re-import: nothing duplicated
+    appended, skipped = hist_lib.import_captures(path, _CAPTURES)
+    assert appended == 0 and len(skipped) == 5
+    assert len(hist_lib.read_history(path)) == 5
+    # unreadable captures are reported, not fatal
+    appended, skipped = hist_lib.import_captures(
+        path, [str(tmp_path / "ghost.json")])
+    assert appended == 0 and "unreadable" in skipped[0]
+
+
+# --- trend table + CLI -----------------------------------------------------
+
+
+def test_trend_table(tmp_path):
+    path = str(tmp_path / "h.jsonl")
+    hist_lib.append_entry(path, _summary(10.0), label="r1")
+    hist_lib.append_entry(path, _summary(12.0, mfu=0.6), label="r2")
+    table = hist_lib.trend_table(hist_lib.read_history(path))
+    lines = table.splitlines()
+    assert lines[0].startswith("label")
+    assert "wall_s" in lines[0] and "mfu" in lines[0]
+    assert lines[1].startswith("r1") and "10" in lines[1]
+    assert lines[2].startswith("r2") and "0.6" in lines[2]
+    # column selection + last-N
+    table = hist_lib.trend_table(hist_lib.read_history(path),
+                                 metrics=["wall_s"], last=1)
+    assert "mfu" not in table and "r1" not in table
+
+
+def test_cli_history(tmp_path, capsys):
+    path = str(tmp_path / "history.jsonl")
+    assert cli_lib.main(["history", path]) == 2        # empty
+    capsys.readouterr()
+    assert cli_lib.main(["history", path, "--import"] + _CAPTURES) == 0
+    cap = capsys.readouterr()
+    assert "imported 5" in cap.err
+    assert "BENCH_r01" in cap.out                      # trend table
+    assert cli_lib.main(["history", path, "--json"]) == 0
+    entries = json.loads(capsys.readouterr().out)
+    assert len(entries) == 5
+    # --append records any comparison doc (here: a saved summary)
+    doc = tmp_path / "run.json"
+    doc.write_text(json.dumps(_summary(9.0)))
+    assert cli_lib.main(["history", path, "--append", str(doc)]) == 0
+    capsys.readouterr()
+    assert len(hist_lib.read_history(path)) == 6
+    assert cli_lib.main(["history", path, "--append",
+                         str(tmp_path / "nope.json")]) == 2
+    capsys.readouterr()
+    # validate routes history files by kind (arbitrary basename)
+    assert cli_lib.main(["validate", path]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("OK")
+
+
+def test_cli_validate_routes_past_torn_first_line(tmp_path, capsys):
+    """The kind-peek scans to the first WELL-FORMED row: a torn first
+    line (crashed writer) must not misroute a history file to the
+    metrics validator (which would flag every valid record)."""
+    path = str(tmp_path / "h.jsonl")
+    with open(path, "w") as f:
+        f.write("{torn\n")
+    hist_lib.append_entry(path, _summary(1.0), label="ok")
+    assert cli_lib.main(["validate", path]) == 1   # the torn line only
+    out = capsys.readouterr().out
+    assert "not JSON" in out
+    assert "bench_history" not in out   # no kind-mismatch cascade

@@ -560,6 +560,45 @@ def test_prometheus_text_golden(tmp_path):
         float(ln.rsplit(" ", 1)[1])
 
 
+def test_report_endpoint_cached_by_file_signature(tmp_path,
+                                                  monkeypatch):
+    """/report recomputes the aggregate only when the metrics/
+    heartbeat/flight files actually changed (mtime/size signature) —
+    a dashboard poller hammering the endpoint must not stall the
+    chief (it used to recompute per GET)."""
+    d = synth_run(str(tmp_path))
+    calls = []
+    real = agg_lib.aggregate
+    monkeypatch.setattr(agg_lib, "aggregate",
+                        lambda *a, **kw: calls.append(1)
+                        or real(*a, **kw))
+    srv = serve_lib.StatusServer(d)
+    first = srv.report_json()
+    assert json.loads(first)["kind"] == "run_report"
+    assert srv.report_json() == first
+    assert srv.report_json() == first
+    assert len(calls) == 1                      # cached
+    # an append to any input invalidates (size changes even within
+    # one mtime granule)
+    MetricsLogger(d, process_index=0).log_window(**_window(150))
+    assert json.loads(srv.report_json())["kind"] == "run_report"
+    assert len(calls) == 2
+    assert srv.report_json() and len(calls) == 2
+    # a HUNG run stops touching files, but wall-clock fields
+    # (heartbeat_age_s) must keep aging: the cache expires on TTL too
+    srv._report_t -= serve_lib.REPORT_CACHE_TTL_S + 1
+    assert srv.report_json() and len(calls) == 3
+    # and the HTTP route serves the same cached payload
+    port = srv.start(0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/report", timeout=10) as r:
+            assert json.loads(r.read())["kind"] == "run_report"
+        assert len(calls) == 3          # still the TTL recompute only
+    finally:
+        srv.close()
+
+
 def test_status_server_endpoints(tmp_path):
     synth_run(str(tmp_path))
     srv = serve_lib.StatusServer(str(tmp_path))

@@ -474,6 +474,9 @@ def test_engine_stats_contract_and_counters(lm):
     assert st["inflight"] == st["queued"] == 0
     assert st["tokens_generated_total"] == 3 * 4
     assert st["latency_p99_ms"] >= st["latency_p50_ms"] > 0
+    # the r12 satellite: TTFT carries BOTH percentiles (the p99 SLO's
+    # data source — /metrics exported p50 only before)
+    assert st["ttft_p99_ms"] >= st["ttft_p50_ms"] > 0
     assert st["page_occupancy_frac"] == 0.0      # everything freed
     assert st["prefills_total"] == 3
 
@@ -627,6 +630,163 @@ def test_generate_endpoint_requires_engine(tmp_path):
         assert ei.value.code == 503
     finally:
         srv.close()
+
+
+# --- request-lifecycle spans (ISSUE 12 tentpole, engine side) -------------
+
+
+def test_engine_spans_reconstruct_exactly_once(lm, tmp_path):
+    """THE spans acceptance: every accepted request in a REAL engine
+    run (6 ragged requests through 3 slots, admission churn included)
+    is reconstructible exactly-once from spans.<proc>.jsonl — all
+    five milestones, engine-side ttft, scheduler-side page/tick
+    attribution — and the stream validates against the schema."""
+    from distributed_tensorflow_example_tpu.obs import (
+        schema as schema_lib,
+    )
+    from distributed_tensorflow_example_tpu.obs import (
+        spans as spans_lib,
+    )
+
+    spec, params = lm
+    rec = spans_lib.SpanRecorder(str(tmp_path))
+    eng = DecodeEngine(spec, params, page_size=4, max_batch=3,
+                       recorder=rec)
+    rng = np.random.RandomState(11)
+    lens = (3, 7, 5, 11, 2, 8)
+    n_new = 6
+    prompts = [rng.randint(0, 50, size=n).tolist() for n in lens]
+    rids = [eng.submit(p, n_new) for p in prompts]
+    eng.run_until_idle()
+    for rid in rids:
+        assert eng.result(rid, timeout=10.0) is not None
+    rec.close()
+    assert schema_lib.validate_span_file(rec.path) == []
+    rows = spans_lib.read_spans(rec.path)
+    recs = spans_lib.reconstruct(rows)
+    assert set(recs) == {(0, rid) for rid in rids}
+    for rid, p in zip(rids, prompts):
+        r = recs[(0, rid)]
+        assert r["complete"], (rid, r["errors"])
+        assert r["prompt_len"] == len(p)
+        assert r["generated"] == r["max_new_tokens"] == n_new
+        # prefill emits token 1; the rest are shared decode ticks
+        assert r["decode_ticks"] == n_new - 1
+        assert r["ttft_ms"] > 0
+        assert r["latency_ms"] >= r["ttft_ms"]
+        for key in ("submit_t", "admit_t", "prefill_t",
+                    "first_token_t", "retire_t"):
+            assert key in r, (rid, key)
+        assert r["pages_held"] >= 1
+    # engine counters and the span stream agree
+    st = eng.stats()
+    assert st["requests_total"] == len(
+        [r for r in rows if r["event"] == "submit"])
+    assert st["prefills_total"] == len(
+        [r for r in rows if r["event"] == "prefill"])
+    assert st["decode_ticks_total"] == len(
+        [r for r in rows if r["event"] == "tick"])
+    # with only 3 slots, somebody was blocked and narrated why
+    assert any(r["blocked"] for r in recs.values())
+
+
+def test_engine_tracing_token_identical(lm, tmp_path):
+    """Greedy (and seeded-temperature) outputs are token-identical
+    with tracing on vs off — the recorder is host-side appends only,
+    never touching the RNG fold-in or the compiled programs."""
+    from distributed_tensorflow_example_tpu.obs import (
+        spans as spans_lib,
+    )
+
+    spec, params = lm
+    rng = np.random.RandomState(12)
+    prompts = [rng.randint(0, 50, size=n).tolist()
+               for n in (3, 7, 5, 2)]
+    temps = (0.0, 0.0, 0.9, 0.0)
+
+    def run(recorder):
+        eng = DecodeEngine(spec, params, page_size=4, max_batch=2,
+                           seed=7, recorder=recorder)
+        rids = [eng.submit(p, 5, temperature=t)
+                for p, t in zip(prompts, temps)]
+        eng.run_until_idle()
+        return [eng.result(r, timeout=10.0)["tokens"] for r in rids]
+
+    rec = spans_lib.SpanRecorder(str(tmp_path))
+    traced = run(rec)
+    rec.close()
+    assert run(None) == traced
+
+
+def test_engine_loop_failure_emits_error_spans(lm, tmp_path,
+                                               monkeypatch):
+    """An engine-loop death marks every in-flight request's lifecycle
+    with an error span (no retire follows), so reconstruction — and
+    the SLO error-rate metric — sees the failure instead of a
+    silently truncated stream."""
+    from distributed_tensorflow_example_tpu.obs import slo as slo_lib
+    from distributed_tensorflow_example_tpu.obs import (
+        spans as spans_lib,
+    )
+
+    spec, params = lm
+    rec = spans_lib.SpanRecorder(str(tmp_path))
+    eng = DecodeEngine(spec, params, page_size=8, max_batch=2,
+                       recorder=rec)
+    monkeypatch.setattr(
+        eng, "step",
+        lambda: (_ for _ in ()).throw(RuntimeError("boom tick")))
+    eng.start()
+    rid = eng.submit([1, 2, 3], 4)
+    assert "boom tick" in eng.result(rid, timeout=10.0)["error"]
+    eng.stop()
+    rec.close()
+    rows = spans_lib.read_spans(rec.path)
+    recs = spans_lib.reconstruct(rows)
+    assert "boom tick" in recs[(0, rid)]["error"]
+    assert not recs[(0, rid)]["complete"]
+    records = slo_lib.records_from_spans(rows)
+    assert len(records) == 1 and records[0]["error"] is True
+
+
+def test_live_trace_serves_from_recorder_ring(lm, tmp_path):
+    """With a traced engine attached, /trace and /slo read the
+    recorder's in-memory ring — the StatusServer pointed at an EMPTY
+    logs dir (no span files) still serves the live lifecycles."""
+    import urllib.request
+
+    from distributed_tensorflow_example_tpu.obs import (
+        spans as spans_lib,
+    )
+    from distributed_tensorflow_example_tpu.obs.serve import (
+        StatusServer,
+    )
+
+    spec, params = lm
+    rec = spans_lib.SpanRecorder(str(tmp_path / "spans_dir"))
+    eng = DecodeEngine(spec, params, page_size=8, max_batch=2,
+                       recorder=rec)
+    rid = eng.submit([1, 2, 3], 3)
+    eng.run_until_idle()
+    assert eng.result(rid, timeout=10.0) is not None
+    empty = tmp_path / "empty_logs"
+    empty.mkdir()
+    srv = StatusServer(str(empty), engine=eng)
+    port = srv.start(0)
+    assert port
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/trace?rid={rid}",
+                timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["record"]["complete"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/slo", timeout=10) as r:
+            slo = json.loads(r.read())
+        assert slo["requests"] == 1
+    finally:
+        srv.close()
+        rec.close()
 
 
 # --- int8 KV pages (ISSUE 11 leg a) --------------------------------------

@@ -1,0 +1,294 @@
+"""Serving SLO burn-rate evaluation (ISSUE 12 tentpole, verdict side).
+
+Everything here is closed-form: hand-built request records with known
+retire ticks, so the fast/slow-window burn rates are exact fractions
+and the multi-window breach logic is checkable case by case.  The
+span-stream plumbing (records_from_spans over a recorder-fed
+scheduler run) and the CLI/endpoint exit codes ride the same
+deterministic streams.
+"""
+
+import json
+
+import pytest
+
+from distributed_tensorflow_example_tpu.obs import cli as cli_lib
+from distributed_tensorflow_example_tpu.obs import schema as schema_lib
+from distributed_tensorflow_example_tpu.obs import serve as serve_lib
+from distributed_tensorflow_example_tpu.obs import slo as slo_lib
+from distributed_tensorflow_example_tpu.obs import spans as spans_lib
+
+
+def _records(n=100, bad_ticks=(), ttft_bad=900.0, ttft_good=100.0,
+             error_ticks=()):
+    """n requests retiring at ticks 1..n; bad_ticks get a slow ttft,
+    error_ticks an engine error."""
+    out = []
+    for t in range(1, n + 1):
+        out.append({
+            "rid": t, "retire_tick": t,
+            "ttft_ms": ttft_bad if t in bad_ticks else ttft_good,
+            "latency_ms": 50.0,
+            "error": t in error_ticks,
+        })
+    return out
+
+
+def _spec(**kw):
+    base = dict(name="ttft_p99_ms", metric="ttft_ms",
+                threshold_ms=500.0, objective=0.99, fast_window=10,
+                slow_window=100, burn_threshold=2.0)
+    base.update(kw)
+    return slo_lib.SLOSpec(**base)
+
+
+# --- closed-form burn rates ------------------------------------------------
+
+
+def test_burn_rates_exact_and_multi_window_breach():
+    """2 bad requests inside the fast window: fast burn = (2/10)/0.01
+    = 20, slow burn = (2/100)/0.01 = 2 — both >= 2.0 -> breach, with
+    the exact numbers pinned."""
+    doc = slo_lib.evaluate(_records(bad_ticks=(95, 100)),
+                           specs=[_spec()], now_tick=100)
+    s = doc["slos"][0]
+    assert s["windows"]["fast"] == {
+        "window_ticks": 10, "requests": 10, "bad": 2,
+        "bad_frac": 0.2, "burn_rate": 20.0}
+    assert s["windows"]["slow"] == {
+        "window_ticks": 100, "requests": 100, "bad": 2,
+        "bad_frac": 0.02, "burn_rate": 2.0}
+    assert s["breach"] is True
+    assert doc["breaches"] == ["ttft_p99_ms"]
+    assert doc["ok"] is False
+    assert doc["now_tick"] == 100 and doc["requests"] == 100
+
+
+def test_old_badness_does_not_page():
+    """The same 2 bad requests, but old (ticks 1, 2): the slow window
+    still burns at 2.0 but the fast window is clean — multi-window AND
+    means no breach (the 'pages hours after recovery' failure mode)."""
+    doc = slo_lib.evaluate(_records(bad_ticks=(1, 2)),
+                           specs=[_spec()], now_tick=100)
+    s = doc["slos"][0]
+    assert s["windows"]["fast"]["burn_rate"] == 0.0
+    assert s["windows"]["slow"]["burn_rate"] == 2.0
+    assert s["breach"] is False and doc["ok"]
+
+
+def test_single_spike_does_not_page():
+    """One bad tick inside the fast window only: fast burns hot (10.0)
+    but the slow window sits at 1.0 < 2.0 — no breach (the 'one bad
+    tick pages' failure mode)."""
+    doc = slo_lib.evaluate(_records(bad_ticks=(100,)),
+                           specs=[_spec()], now_tick=100)
+    s = doc["slos"][0]
+    assert s["windows"]["fast"]["burn_rate"] == 10.0
+    assert s["windows"]["slow"]["burn_rate"] == 1.0
+    assert s["breach"] is False
+
+
+def test_error_rate_spec_counts_errors_only():
+    spec = _spec(name="error_rate", metric="error", threshold_ms=None,
+                 objective=0.95)
+    # 1 error in the fast 10: (1/10)/0.05 = 2.0; slow: (1/100)/0.05
+    # = 0.2 -> fast-only, no breach
+    doc = slo_lib.evaluate(_records(error_ticks=(100,)), specs=[spec],
+                           now_tick=100)
+    s = doc["slos"][0]
+    assert s["windows"]["fast"]["burn_rate"] == 2.0
+    assert s["windows"]["slow"]["burn_rate"] == pytest.approx(0.2)
+    assert s["breach"] is False
+    # 10 errors spread across the slow window incl. 2 fast: breach
+    doc = slo_lib.evaluate(
+        _records(error_ticks=tuple(range(10, 101, 10))), specs=[spec],
+        now_tick=100)
+    s = doc["slos"][0]
+    assert s["windows"]["slow"]["burn_rate"] == 2.0
+    assert s["windows"]["fast"]["burn_rate"] == 2.0
+    assert s["breach"] is True
+    # an error is bad under LATENCY SLOs too (it delivered nothing)
+    lat = slo_lib.evaluate(_records(error_ticks=(100,)),
+                           specs=[_spec()], now_tick=100)
+    assert lat["slos"][0]["windows"]["fast"]["bad"] == 1
+
+
+def test_missing_measurement_counts_bad():
+    """A retired request with no ttft recorded (torn stream) burns
+    budget — absence of evidence must not look like health."""
+    recs = _records(n=10)
+    recs[-1]["ttft_ms"] = None
+    doc = slo_lib.evaluate(recs, specs=[_spec()], now_tick=10)
+    assert doc["slos"][0]["windows"]["fast"]["bad"] == 1
+
+
+def test_empty_records_and_observed_p99():
+    doc = slo_lib.evaluate([], specs=[_spec()])
+    s = doc["slos"][0]
+    assert doc["ok"] and s["breach"] is False
+    assert s["windows"]["fast"]["requests"] == 0
+    assert s["observed_p99_ms"] is None
+    doc = slo_lib.evaluate(_records(bad_ticks=(95, 100)),
+                           specs=[_spec()], now_tick=100)
+    assert doc["slos"][0]["observed_p99_ms"] == 900.0
+    json.dumps(doc, allow_nan=False)       # strict JSON end to end
+
+
+# --- spec DSL --------------------------------------------------------------
+
+
+def test_parse_specs():
+    specs = slo_lib.parse_specs("")
+    assert specs == list(slo_lib.DEFAULT_SLOS)
+    specs = slo_lib.parse_specs(
+        "ttft_p99_ms<=250, latency_p99_ms<=2000, error_rate<=0.05")
+    assert [s.name for s in specs] == ["ttft_p99_ms",
+                                       "latency_p99_ms", "error_rate"]
+    assert specs[0].threshold_ms == 250.0
+    assert specs[0].metric == "ttft_ms"
+    assert specs[2].objective == pytest.approx(0.95)
+    for bad in ("p99<=1", "ttft_p99_ms", "ttft_p99_ms<=abc",
+                "ttft_p99_ms<=-5", "error_rate<=1.5"):
+        with pytest.raises(ValueError):
+            slo_lib.parse_specs(bad)
+
+
+# --- span-stream plumbing + surfaces ---------------------------------------
+
+
+def _write_spans(path, ttfts, lat_s=0.05, proc=0):
+    """A minimal healthy stream: one request per ttft value, retiring
+    one per tick."""
+    rec = spans_lib.SpanRecorder(str(path), process_index=proc)
+    for i, ttft in enumerate(ttfts):
+        rec.emit("submit", rid=i, prompt_len=2, max_new_tokens=1,
+                 arrival=0.0)
+        rec.emit("admit", rid=i, pages_held=1, tick=i)
+        rec.emit("prefill", rid=i, bucket=2, pages_width=1)
+        rec.emit("first_token", rid=i, ttft_ms=ttft)
+        rec.emit("retire", rid=i, generated=1, finish_t=lat_s,
+                 tick=i + 1)
+    rec.close()
+    return rec.path
+
+
+def test_records_from_spans(tmp_path):
+    path = _write_spans(tmp_path, [10.0, 20.0])
+    assert schema_lib.validate_span_file(path) == []
+    recs = slo_lib.records_from_spans(spans_lib.read_spans(path))
+    assert [r["ttft_ms"] for r in recs] == [10.0, 20.0]
+    assert [r["retire_tick"] for r in recs] == [1, 2]
+    assert all(r["latency_ms"] == 50.0 for r in recs)
+    assert not any(r["error"] for r in recs)
+    # an in-flight request (no terminal event) is excluded
+    rows = spans_lib.read_spans(path)
+    rows.append({"kind": "span", "v": schema_lib.SCHEMA_VERSION,
+                 "t": 9.0, "proc": 0, "event": "submit", "rid": 77,
+                 "prompt_len": 1, "max_new_tokens": 1,
+                 "arrival": 0.0})
+    assert len(slo_lib.records_from_spans(rows)) == 2
+    # an errored request IS terminal
+    rows.append({"kind": "span", "v": schema_lib.SCHEMA_VERSION,
+                 "t": 9.1, "proc": 0, "event": "error", "rid": 77,
+                 "reason": "boom"})
+    recs = slo_lib.records_from_spans(rows)
+    assert len(recs) == 3 and recs[-1]["error"] is True
+
+
+def test_truncated_tail_heads_do_not_read_as_bad(tmp_path):
+    """/slo reads bounded TAILS: a retire whose submit scrolled out of
+    the tail is missing its measurements by truncation, not failure —
+    it must be EXCLUDED, not counted bad (it used to fire false
+    breaches on any long-running server)."""
+    path = _write_spans(tmp_path, [10.0, 20.0])
+    rows = spans_lib.read_spans(path)
+    # simulate the tail window: drop rid 0's submit (the head)
+    truncated = [r for r in rows
+                 if not (r.get("rid") == 0 and r["event"] == "submit")]
+    recs = slo_lib.records_from_spans(truncated)
+    assert [r["rid"] for r in recs] == [1]        # rid 0 excluded
+    doc = slo_lib.evaluate(recs, specs=[_spec(threshold_ms=50.0)])
+    assert doc["ok"]
+
+
+def test_observed_p99_matches_engine_percentile():
+    """dtx_slo_observed_p99_ms and dtx_generate_ttft_p99_ms share ONE
+    percentile definition (np.percentile, linear interpolation) —
+    identical data must yield identical p99s across the two gauge
+    families."""
+    from distributed_tensorflow_example_tpu.serving.engine import (
+        _percentile as engine_percentile,
+    )
+
+    vals = [100.0 * (i + 1) for i in range(10)]
+    recs = [{"rid": i, "retire_tick": i + 1, "ttft_ms": v,
+             "latency_ms": 1.0, "error": False}
+            for i, v in enumerate(vals)]
+    doc = slo_lib.evaluate(recs, specs=[_spec(threshold_ms=1e9)],
+                           now_tick=10)
+    assert doc["slos"][0]["observed_p99_ms"] == pytest.approx(
+        engine_percentile(vals, 0.99))
+
+
+def test_cli_slo_exit_codes(tmp_path, capsys):
+    d = tmp_path / "run"
+    d.mkdir()
+    _write_spans(d, [10.0] * 8)
+    # healthy under a generous spec
+    assert cli_lib.main(["slo", str(d), "--spec",
+                         "ttft_p99_ms<=50,latency_p99_ms<=100,"
+                         "error_rate<=0.5"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] and doc["requests"] == 8
+    # a doctored breach: every request violates the bound -> exit 3
+    assert cli_lib.main(["slo", str(d), "--spec",
+                         "ttft_p99_ms<=5"]) == 3
+    out = capsys.readouterr()
+    doc = json.loads(out.out)
+    assert doc["breaches"] == ["ttft_p99_ms"]
+    assert "BREACH" in out.err
+    # no span stream -> 2; malformed spec -> 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert cli_lib.main(["slo", str(empty)]) == 2
+    assert cli_lib.main(["slo", str(d), "--spec", "bogus"]) == 2
+
+
+def test_slo_endpoint_and_prometheus_gauges(tmp_path):
+    _write_spans(tmp_path, [10.0] * 5)
+    specs = slo_lib.parse_specs(
+        "ttft_p99_ms<=50,latency_p99_ms<=100,error_rate<=0.5")
+    srv = serve_lib.StatusServer(str(tmp_path), slos=specs)
+    port = srv.start(0)
+    assert port
+    try:
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/slo", timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["ok"] and [s["name"] for s in doc["slos"]] == [
+            "ttft_p99_ms", "latency_p99_ms", "error_rate"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        lines = text.splitlines()
+        assert 'dtx_slo_breach{slo="ttft_p99_ms"} 0' in lines
+        assert ('dtx_slo_burn_rate{slo="ttft_p99_ms",window="fast"} 0'
+                in lines)
+        assert 'dtx_slo_observed_p99_ms{slo="ttft_p99_ms"} 10' in lines
+        assert "dtx_slo_requests 5" in lines
+        # every sample line still belongs to a # TYPE'd gauge family
+        for ln in lines:
+            if ln.startswith("#") or not ln:
+                continue
+            name = ln.split("{")[0].split(" ")[0]
+            assert f"# TYPE {name} gauge" in lines
+    finally:
+        srv.close()
+
+
+def test_prometheus_without_spans_has_no_slo_gauges(tmp_path):
+    text = serve_lib.prometheus_text(
+        serve_lib.collect_status(str(tmp_path)))
+    assert "dtx_slo_" not in text

@@ -1,0 +1,329 @@
+"""Request-lifecycle span stream (ISSUE 12 tentpole, write side).
+
+Pure Python throughout — the scheduler emits through an INJECTED
+recorder, so the deterministic simulation half of the serving stack
+narrates full lifecycles with no jax in sight, and reconstruction is
+checkable in closed form: which tick admitted each request, how many
+ticks it was blocked and on what, how many decode ticks it shared,
+and that every milestone happened exactly once.  The engine-side
+(jax) half of the spans acceptance lives in tests/test_serving.py.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributed_tensorflow_example_tpu.obs import cli as cli_lib
+from distributed_tensorflow_example_tpu.obs import schema as schema_lib
+from distributed_tensorflow_example_tpu.obs import serve as serve_lib
+from distributed_tensorflow_example_tpu.obs import spans as spans_lib
+from distributed_tensorflow_example_tpu.obs.buckets import SPAN_EVENTS
+from distributed_tensorflow_example_tpu.serving import scheduler as sl
+
+
+# --- recorder --------------------------------------------------------------
+
+
+def test_recorder_validates_event_names(tmp_path):
+    rec = spans_lib.SpanRecorder(str(tmp_path))
+    with pytest.raises(ValueError, match="unknown span event"):
+        rec.emit("retired")          # not in the registry
+    rec.emit("submit", rid=0, prompt_len=1, max_new_tokens=1,
+             arrival=0.0)
+    rec.close()
+    assert spans_lib.span_files(str(tmp_path)) == [(0, rec.path)]
+    assert schema_lib.validate_span_file(rec.path) == []
+
+
+def test_recorder_strict_json_and_bounded_ring(tmp_path):
+    rec = spans_lib.SpanRecorder(str(tmp_path), process_index=3,
+                                 ring=4)
+    for i in range(10):
+        rec.emit("blocked", rid=i, reason="pages", tick=i)
+    # a non-finite payload field must stringify, not break the stream
+    rec.emit("first_token", rid=0, ttft_ms=float("nan"))
+    rec.close()
+    assert len(rec.ring) == 4                      # bounded
+    rows = spans_lib.read_spans(rec.path)
+    assert len(rows) == 11
+    assert rows[-1]["ttft_ms"] == "nan"            # strict JSON
+    for row in rows:
+        json.dumps(row, allow_nan=False)
+        assert row["v"] == schema_lib.SCHEMA_VERSION
+        assert row["proc"] == 3
+        assert row["event"] in SPAN_EVENTS
+
+
+def test_recorder_rows_for_includes_shared_ticks(tmp_path):
+    rec = spans_lib.SpanRecorder(str(tmp_path))
+    rec.emit("submit", rid=1, prompt_len=2, max_new_tokens=2,
+             arrival=0.0)
+    rec.emit("tick", tick=0, rids=[1, 2], batch=2, batch_bucket=2,
+             kv_pages=1, occupancy=0.5)
+    rec.emit("submit", rid=9, prompt_len=2, max_new_tokens=2,
+             arrival=0.0)
+    rows = rec.rows_for(1)
+    assert [r["event"] for r in rows] == ["submit", "tick"]
+    rec.close()
+
+
+# --- scheduler-sim reconstruction (the closed-form half) -------------------
+
+
+def test_sim_reconstruction_exactly_once_pages_blocked(tmp_path):
+    """THE deterministic acceptance case: 4-usable-page pool, three
+    2-page requests — rids 0/1 admit at tick 0, rid 2 blocks on pages
+    for exactly 3 boundaries and admits the tick the pages free.
+    Every milestone reconstructs exactly once, decode ticks attribute
+    exactly, and the file validates."""
+    rec = spans_lib.SpanRecorder(str(tmp_path))
+    s = sl.ContinuousScheduler(num_pages=5, page_size=4, max_batch=4,
+                               recorder=rec)
+    res = sl.simulate(s, [(0, 4, 4), (1, 4, 4), (2, 4, 4)])
+    rec.close()
+    assert res.decode_ticks == 6
+    assert schema_lib.validate_span_file(rec.path) == []
+    rows = spans_lib.read_spans(rec.path)
+    recs = spans_lib.reconstruct(rows)
+    assert set(recs) == {(0, 0), (0, 1), (0, 2)}
+    for rid, r in recs.items():
+        assert r["complete"], (rid, r["errors"])
+        assert r["errors"] == []
+        assert r["generated"] == r["max_new_tokens"] == 4
+        assert r["pages_held"] == 2
+        # prompt 4 + 3 new rows: prefill emits token 1, then 3 decodes
+        assert r["decode_ticks"] == 3
+    assert recs[(0, 0)]["admit_tick"] == recs[(0, 1)]["admit_tick"] == 0
+    assert recs[(0, 0)]["blocked"] == {}
+    # rid 2: blocked on pages at boundaries 0,1,2; admitted at 3 (the
+    # boundary rid 0/1's pages freed); retired 3 decode ticks later
+    assert recs[(0, 2)]["blocked"] == {"pages": 3}
+    assert recs[(0, 2)]["admit_tick"] == 3
+    assert recs[(0, 0)]["retire_tick"] == 3
+    assert recs[(0, 2)]["retire_tick"] == 6
+    # tick rows carry occupancy: the first tick holds all 4 pages
+    ticks = [r for r in rows if r["event"] == "tick"]
+    assert len(ticks) == 6
+    assert ticks[0]["occupancy"] == 1.0
+    assert ticks[0]["rids"] == [0, 1]
+    # exactly-once at the raw-event level too
+    for rid in (0, 1, 2):
+        for ev in ("submit", "admit", "retire"):
+            n = sum(1 for r in rows
+                    if r["event"] == ev and r.get("rid") == rid)
+            assert n == 1, (rid, ev, n)
+
+
+def test_sim_reconstruction_slots_blocked(tmp_path):
+    """A single-slot engine: the second request is blocked on SLOTS
+    (not pages) for exactly the first request's 2 occupied boundaries
+    (its prefill tick emits a same-tick decode, so 3 tokens take 2
+    ticks) and admits at the boundary the slot frees."""
+    rec = spans_lib.SpanRecorder(str(tmp_path))
+    s = sl.ContinuousScheduler(num_pages=9, page_size=4, max_batch=1,
+                               recorder=rec)
+    sl.simulate(s, [(0, 2, 3), (1, 2, 3)])
+    rec.close()
+    recs = spans_lib.reconstruct(spans_lib.read_spans(rec.path))
+    assert recs[(0, 0)]["blocked"] == {}
+    assert recs[(0, 1)]["blocked"] == {"slots": 2}
+    assert recs[(0, 1)]["admit_tick"] == 2
+    assert all(r["complete"] for r in recs.values())
+
+
+def test_static_scheduler_emits_lifecycle(tmp_path):
+    """The static baseline narrates the same lifecycle shape (its
+    group retirement discipline included), so policy A/Bs can compare
+    span streams too."""
+    rec = spans_lib.SpanRecorder(str(tmp_path))
+    s = sl.StaticBatchScheduler(num_pages=17, page_size=4,
+                                max_batch=2, recorder=rec)
+    sl.simulate(s, [(0, 2, 2), (1, 2, 6), (2, 2, 2)])
+    rec.close()
+    assert schema_lib.validate_span_file(rec.path) == []
+    recs = spans_lib.reconstruct(spans_lib.read_spans(rec.path))
+    assert set(recs) == {(0, 0), (0, 1), (0, 2)}
+    assert all(r["complete"] for r in recs.values())
+    # rid 2 waits out the whole first group (static holds the slots)
+    assert recs[(0, 2)]["admit_tick"] > recs[(0, 0)]["retire_tick"] - 1
+    assert recs[(0, 2)]["blocked"].get("slots", 0) > 0
+
+
+def test_multi_process_streams_do_not_conflate_rids(tmp_path):
+    """Every engine numbers rids from 0: two processes' streams merged
+    by load_spans must reconstruct as DISTINCT (proc, rid) records,
+    and trace_record must disambiguate (lowest proc wins, candidates
+    listed) or accept an explicit proc."""
+    for proc in (0, 1):
+        rec = spans_lib.SpanRecorder(str(tmp_path),
+                                     process_index=proc)
+        s = sl.ContinuousScheduler(num_pages=9, page_size=4,
+                                   max_batch=2, recorder=rec)
+        sl.simulate(s, [(0, 2, 2 + proc)])     # rid 0 in BOTH procs
+        rec.close()
+    rows = spans_lib.load_spans(str(tmp_path))
+    recs = spans_lib.reconstruct(rows)
+    assert set(recs) == {(0, 0), (1, 0)}
+    assert all(r["complete"] for r in recs.values())
+    assert recs[(0, 0)]["generated"] == 2
+    assert recs[(1, 0)]["generated"] == 3
+    doc = spans_lib.trace_record(rows, 0)
+    assert doc["proc"] == 0 and doc["ambiguous_procs"] == [0, 1]
+    assert all(r.get("proc") == 0 for r in doc["events"])
+    doc1 = spans_lib.trace_record(rows, 0, proc=1)
+    assert doc1["record"]["generated"] == 3
+    assert "ambiguous_procs" not in doc1
+    # SLO records keep both requests apart
+    from distributed_tensorflow_example_tpu.obs import slo as slo_lib
+
+    assert len(slo_lib.records_from_spans(rows)) == 2
+
+
+def test_reconstruct_flags_violations():
+    """Doctored streams: duplicate milestones, orphan milestones and
+    token-count mismatches surface in the record's errors — and turn
+    complete off — instead of being silently absorbed."""
+    def row(event, rid, **f):
+        return {"kind": "span", "v": schema_lib.SCHEMA_VERSION,
+                "t": 1.0, "proc": 0, "event": event, "rid": rid, **f}
+
+    dup = [row("submit", 0, prompt_len=2, max_new_tokens=2,
+               arrival=0.0),
+           row("admit", 0, pages_held=1, tick=0),
+           row("admit", 0, pages_held=1, tick=1)]
+    r = spans_lib.reconstruct(dup)[(0, 0)]
+    assert "duplicate admit" in r["errors"] and not r["complete"]
+
+    orphan = [row("retire", 7, generated=2, finish_t=1.0, tick=3)]
+    r = spans_lib.reconstruct(orphan)[(0, 7)]
+    assert "no submit event" in r["errors"]
+    assert "retire without admit" in r["errors"]
+
+    short = [row("submit", 1, prompt_len=2, max_new_tokens=5,
+                 arrival=0.0),
+             row("admit", 1, pages_held=1, tick=0),
+             row("retire", 1, generated=3, finish_t=1.0, tick=2)]
+    r = spans_lib.reconstruct(short)[(0, 1)]
+    assert any("generated 3 != max_new_tokens 5" in e
+               for e in r["errors"])
+
+
+def test_validate_span_row_contract():
+    good = {"kind": "span", "v": schema_lib.SCHEMA_VERSION, "t": 1.0,
+            "proc": 0, "event": "admit", "rid": 3, "pages_held": 2,
+            "tick": 5}
+    assert schema_lib.validate_span_row(good) == []
+    # missing per-event payload field
+    errs = schema_lib.validate_span_row(
+        {k: v for k, v in good.items() if k != "pages_held"})
+    assert errs and "pages_held" in errs[0]
+    # unknown event names are named, not field-cascaded
+    errs = schema_lib.validate_span_row(dict(good, event="finish"))
+    assert any("unknown span event" in e for e in errs)
+    # version-first diagnosis (the obs/schema discipline)
+    errs = schema_lib.validate_span_row(
+        {k: v for k, v in good.items() if k != "v"})
+    assert len(errs) == 1 and "schema v1" in errs[0]
+
+
+def test_read_spans_skips_torn_line(tmp_path):
+    rec = spans_lib.SpanRecorder(str(tmp_path))
+    rec.emit("submit", rid=0, prompt_len=1, max_new_tokens=1,
+             arrival=0.0)
+    rec.close()
+    with open(rec.path, "a") as f:
+        f.write('{"kind": "span", "v": 4, "tor')   # torn mid-append
+    rows = spans_lib.read_spans(rec.path)
+    assert len(rows) == 1 and rows[0]["event"] == "submit"
+
+
+# --- trace: library, endpoint, CLI -----------------------------------------
+
+
+def _spanned_run(path):
+    rec = spans_lib.SpanRecorder(str(path))
+    s = sl.ContinuousScheduler(num_pages=5, page_size=4, max_batch=4,
+                               recorder=rec)
+    sl.simulate(s, [(0, 4, 4), (1, 4, 4), (2, 4, 4)])
+    rec.close()
+    return rec.path
+
+
+def test_trace_record_includes_shared_ticks(tmp_path):
+    _spanned_run(tmp_path)
+    rows = spans_lib.load_spans(str(tmp_path))
+    doc = spans_lib.trace_record(rows, 2)
+    assert doc["rid"] == 2
+    assert doc["record"]["complete"]
+    evs = [r["event"] for r in doc["events"]]
+    assert evs.count("blocked") == 3
+    assert evs.count("tick") == 3          # only ITS shared ticks
+    assert spans_lib.trace_record(rows, 99) is None
+
+
+def test_status_server_slo_and_trace_endpoints(tmp_path):
+    _spanned_run(tmp_path)
+    srv = serve_lib.StatusServer(str(tmp_path))
+    port = srv.start(0)
+    assert port
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/trace?rid=1",
+                timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["record"]["generated"] == 4
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/slo", timeout=10) as r:
+            slo = json.loads(r.read())
+        assert slo["kind"] == "slo_report" and slo["requests"] == 3
+        for path, code in (("/trace", 400), ("/trace?rid=abc", 400),
+                           ("/trace?rid=--5", 400),
+                           ("/trace?rid=99", 404)):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10)
+            assert ei.value.code == code, path
+    finally:
+        srv.close()
+
+
+def test_cli_trace(tmp_path, capsys):
+    _spanned_run(tmp_path)
+    assert cli_lib.main(["trace", str(tmp_path), "2"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["record"]["blocked"] == {"pages": 3}
+    assert cli_lib.main(["trace", str(tmp_path), "99"]) == 2
+    assert cli_lib.main(["trace", str(tmp_path / "empty"), "0"]) == 2
+
+
+# --- the validate/tail hygiene satellite -----------------------------------
+
+
+def test_cli_validate_routes_span_files(tmp_path, capsys):
+    path = _spanned_run(tmp_path)
+    # a whole-dir scan picks the span stream up
+    assert cli_lib.main(["validate", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert f"OK   {path}" in out
+    # doctor a row: FAILs with the span validator's message
+    with open(path, "a") as f:
+        f.write(json.dumps({"kind": "span",
+                            "v": schema_lib.SCHEMA_VERSION, "t": 1.0,
+                            "proc": 0, "event": "warp", "rid": 0})
+                + "\n")
+    assert cli_lib.main(["validate", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "unknown span event" in out
+
+
+def test_cli_tail_formats_span_rows(tmp_path, capsys):
+    _spanned_run(tmp_path)
+    assert cli_lib.main(["tail", str(tmp_path), "-n", "50"]) == 0
+    out = capsys.readouterr().out
+    assert "rid 2 blocked pages" in out
+    assert "rid 0 admit pages=2" in out
+    assert any(ln.startswith("[p0] tick ")
+               for ln in out.splitlines())
