@@ -100,6 +100,10 @@ class SchemaDriftRule:
         "SPAN_COMMON": ("obs/spans.py",),
         "SPAN_FIELDS": ("serving/scheduler.py", "serving/engine.py"),
         "HISTORY_ENTRY": ("obs/history.py",),
+        # restart-timeline rows: the envelope is written by the
+        # narrator (resilience/restart.py); the loop's preempt/
+        # resumed/snapshot narration rides the same emit
+        "RESTART_EVENT": ("resilience/restart.py",),
     }
     GATE_PRODUCERS = ("bench.py", "obs/aggregate.py", "obs/metrics.py",
                       "obs/schema.py", "train/loop.py")

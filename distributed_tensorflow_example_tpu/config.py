@@ -409,7 +409,25 @@ class Config:
                                     # background thread (device->host
                                     # fetches stay synchronous);
                                     # requires --sharded_checkpoints
-    resume: bool = False
+
+    # ---- resilience (resilience/): async incremental checkpoints,
+    # SIGTERM-safe snapshots, exact-step auto-resume ----
+    ckpt_every: int = 0             # steps between write-behind
+                                    # snapshots through the resilience
+                                    # store (0 = off); forces the host
+                                    # loop; installs the SIGTERM/SIGINT
+                                    # final-snapshot handler
+    ckpt_keep: int = 0              # resilience retention: keep the
+                                    # newest K valid snapshots + GC
+                                    # unreferenced objects (0 = all)
+    resume: str = ""                # "" = fresh run; "latest" (bare
+                                    # --resume) = newest classic
+                                    # checkpoint, epoch granularity;
+                                    # "auto" = newest valid resilience
+                                    # manifest, exact-step replay
+                                    # (falls back to the classic
+                                    # formats when no manifest exists).
+                                    # Legacy bool True ≡ "latest".
 
     # ---- misc ----
     eval_batch_size: int = 2000
@@ -444,6 +462,18 @@ def _depth(s: str) -> int:
             f"depth {v} must be >= 1 (omit the flag for the "
             f"backend-aware default)")
     return v
+
+
+def _resume_mode(s: str) -> str:
+    """--resume value: "latest" (the bare-flag const) or "auto" (the
+    resilience exact-step path). Rejected at the CLI, not deep in the
+    loop. "" passes through because argparse runs the type converter
+    over the (string) default too."""
+    if s not in ("", "latest", "auto"):
+        raise argparse.ArgumentTypeError(
+            f"resume mode {s!r}: expected 'latest' (bare --resume) or "
+            f"'auto' (exact-step resilience resume)")
+    return s
 
 
 def _pages(s: str) -> int:
@@ -803,7 +833,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write checkpoint shard files from a "
                         "background thread")
     p.add_argument("--checkpoint_every", type=int, default=d.checkpoint_every)
-    p.add_argument("--resume", action="store_true")
+    p.add_argument("--ckpt_every", type=int, default=d.ckpt_every,
+                   help="resilience store: write-behind incremental "
+                        "snapshot every N steps (0 = off; forces the "
+                        "host loop; installs the SIGTERM final-"
+                        "snapshot handler)")
+    p.add_argument("--ckpt_keep", type=int, default=d.ckpt_keep,
+                   help="resilience retention: keep the newest K "
+                        "valid snapshots and GC unreferenced objects "
+                        "(0 = keep all; requires --ckpt_every)")
+    p.add_argument("--resume", nargs="?", const="latest",
+                   default=d.resume, type=_resume_mode,
+                   help="bare --resume = newest classic checkpoint "
+                        "(epoch granularity); --resume=auto = newest "
+                        "valid resilience manifest, replayed to the "
+                        "exact step")
     p.add_argument("--eval_batch_size", type=int, default=d.eval_batch_size)
     p.add_argument("--pallas", action="store_true")
     p.add_argument("--no_fast_loop", dest="fast_loop", action="store_false")
@@ -1029,6 +1073,59 @@ def validate_quant_config(cfg: Config) -> None:
         raise ValueError(
             "--outer_quant compresses the cross-site outer "
             "pseudo-gradient sync; it needs --sites > 1")
+
+
+def validate_resilience_config(cfg: Config) -> None:
+    """The resilience (--ckpt_every / --ckpt_keep / --resume) matrix —
+    pure config checks, raised before any bootstrap work (the
+    validate_pipeline_config pattern; ``tests/test_cli.py`` pins it
+    without the training stack).
+
+    - ``--ckpt_every`` snapshots through the resilience store
+      (resilience/writer.py) from the HOST loop's per-step safe point
+      — it needs a checkpoint_dir, and it does not compose with
+      ``--fsdp`` (the fsdp state's host layout is the flat-sharded
+      one; the classic --checkpoint_every formats carry the
+      unshard/reshard story);
+    - ``--ckpt_keep`` is the resilience store's retention knob — it
+      means nothing without ``--ckpt_every`` (the classic formats
+      have --keep_checkpoints);
+    - ``--resume`` accepts "" (fresh), "latest"/legacy True (classic
+      formats, epoch granularity) or "auto" (newest valid resilience
+      manifest, exact-step replay); "auto" restores full logical
+      leaves, which the fsdp flat-sharded template cannot receive.
+    """
+    if cfg.resume not in ("", "latest", "auto", True, False):
+        raise ValueError(
+            f"resume={cfg.resume!r}: expected '' (fresh), 'latest' "
+            f"(bare --resume / legacy True) or 'auto' (exact-step "
+            f"resilience resume)")
+    if cfg.ckpt_every < 0:
+        raise ValueError(f"ckpt_every={cfg.ckpt_every} must be >= 0")
+    if cfg.ckpt_keep < 0:
+        raise ValueError(f"ckpt_keep={cfg.ckpt_keep} must be >= 0")
+    if cfg.ckpt_keep and not cfg.ckpt_every:
+        raise ValueError(
+            "--ckpt_keep is the resilience store's retention; it "
+            "needs --ckpt_every > 0 (the classic formats use "
+            "--keep_checkpoints)")
+    if cfg.ckpt_every:
+        if not cfg.checkpoint_dir:
+            raise ValueError(
+                "--ckpt_every needs --checkpoint_dir (the resilience "
+                "store lives there)")
+        if cfg.fsdp:
+            raise ValueError(
+                "--ckpt_every does not compose with --fsdp: the "
+                "resilience snapshot holds full logical leaves, not "
+                "the fsdp flat-sharded host layout (use "
+                "--checkpoint_every with --sharded_checkpoints)")
+    if cfg.resume == "auto" and cfg.fsdp:
+        raise ValueError(
+            "--resume=auto restores full logical leaves from the "
+            "resilience manifest, which the fsdp flat-sharded "
+            "template cannot receive; use bare --resume with the "
+            "classic formats under --fsdp")
 
 
 def parse_config(argv: Sequence[str] | None = None) -> Config:

@@ -118,10 +118,23 @@ def load_run(logs_path: str, max_errors: int = 20) -> Dict[str, Any]:
             n_errors += 1
             if len(errors) < max_errors:
                 errors.append(f"{path}: unreadable flight dump")
+    # restart timeline (resilience/restart.py RestartNarrator):
+    # validated like the metrics rows, folded into the report timeline
+    restarts = []
+    from ..resilience.restart import read_restarts
+
+    for i, row in enumerate(read_restarts(logs_path), 1):
+        errs = schema_lib.validate_restart_row(
+            row, where=f"restarts.jsonl:{i}")
+        if errs:
+            n_errors += len(errs)
+            errors.extend(errs[:max(0, max_errors - len(errors))])
+        restarts.append(row)
     return {
         "procs": procs,
         "heartbeats": hb_lib.read_heartbeats(logs_path),
         "flights": flights,
+        "restarts": restarts,
         "schema_errors": errors,
         "schema_error_count": n_errors,
     }
@@ -138,6 +151,7 @@ def _goodput(windows: List[Dict[str, Any]], run_end: Optional[Dict],
 
     data_wait = wsum("data_wait_s")
     h2d = wsum("h2d_s")
+    ckpt = wsum("ckpt_s")
     train = wsum("dispatch_s") + wsum("device_wait_s")
     host = wsum("host_s")
     steps_obs = int(wsum("steps"))
@@ -154,7 +168,7 @@ def _goodput(windows: List[Dict[str, Any]], run_end: Optional[Dict],
     train -= anomaly_skipped
     straggler_idle = min(train, max(0, lag_steps) * mean_step_s)
     train -= straggler_idle
-    known = (train + compile_s + data_wait + h2d + host + eval_s
+    known = (train + compile_s + data_wait + h2d + ckpt + host + eval_s
              + sample_s + anomaly_skipped + straggler_idle)
     untracked = max(0.0, wall - known)
     buckets = {
@@ -162,6 +176,7 @@ def _goodput(windows: List[Dict[str, Any]], run_end: Optional[Dict],
         "compile": compile_s,
         "data_wait": data_wait,
         "h2d": h2d,
+        "ckpt": ckpt,
         "host": host,
         "eval": eval_s,
         "sample": sample_s,
@@ -170,8 +185,8 @@ def _goodput(windows: List[Dict[str, Any]], run_end: Optional[Dict],
         "untracked": untracked,
     }
     buckets = {k: round(v, 6) for k, v in buckets.items()}
-    badput = (compile_s + data_wait + h2d + host + anomaly_skipped
-              + straggler_idle + untracked)
+    badput = (compile_s + data_wait + h2d + ckpt + host
+              + anomaly_skipped + straggler_idle + untracked)
     out = {
         "wall_s": round(wall, 6),
         "buckets": buckets,
@@ -296,7 +311,29 @@ def aggregate(logs_path: str, max_trajectory: int = 200,
             "proc": d.get("proc"), "reason": d.get("reason"),
             "last_step": d.get("last_step"),
             "exception": (d.get("exception") or {}).get("type")})
+    # the restart timeline (resilience narration): every preemption,
+    # snapshot-on-signal, resume and chief-side retry/reform decision
+    for r in data["restarts"]:
+        entry = {"t": r.get("t"), "kind": "restart",
+                 "proc": r.get("proc"), "event": r.get("event")}
+        for k in ("step", "signal", "reason", "dp", "wait_s",
+                  "attempt", "exit_code", "dead"):
+            if r.get(k) is not None:
+                entry[k] = r.get(k)
+        timeline.append(entry)
     timeline.sort(key=lambda e: (e.get("t") or 0.0))
+
+    rk = [r.get("event") for r in data["restarts"]]
+    restarts_summary = {
+        "events": len(rk),
+        "preemptions": rk.count("preempt"),
+        "snapshots": rk.count("snapshot"),
+        "resumes": rk.count("resumed"),
+        "dead_procs": rk.count("dead_proc"),
+        "retries": rk.count("retry"),
+        "reforms": rk.count("reform"),
+        "gave_up": rk.count("give_up"),
+    }
 
     now = time.time() if now is None else now
     proc_summary = {}
@@ -340,6 +377,7 @@ def aggregate(logs_path: str, max_trajectory: int = 200,
                                  or 0),
             "flight_dumps": len(data["flights"]),
         },
+        "restarts": restarts_summary,
         "timeline": timeline,
         "schema_errors": data["schema_errors"],
         "schema_error_count": data["schema_error_count"],
@@ -371,6 +409,12 @@ def summary_line(report: Dict[str, Any]) -> str:
         bits.append(f"anomalies={an['count']}"
                     + (f" skipped={an['skipped_steps']}"
                        if an.get("skipped_steps") else ""))
+    rs = report.get("restarts") or {}
+    if rs.get("events"):
+        bits.append(
+            f"restarts[preempt={rs.get('preemptions', 0)} "
+            f"resume={rs.get('resumes', 0)} "
+            f"reform={rs.get('reforms', 0)}]")
     if report.get("partial"):
         bits.append("PARTIAL")
     if report.get("schema_error_count"):

@@ -30,8 +30,13 @@ Names are grouped by the surface they name:
 from __future__ import annotations
 
 # host-loop per-window charge buckets (field "<name>_s" in every
-# metrics window row; "host" is the residual field computed from them)
-WINDOW_BUCKETS = ("data_wait", "h2d", "dispatch", "device_wait")
+# metrics window row; "host" is the residual field computed from
+# them). "ckpt" is the async-checkpoint submit stall (the host wall
+# of handing a snapshot to the write-behind thread,
+# resilience/writer.py) — the bucket whose staying-near-zero IS the
+# async-checkpointing claim, gated via bench_checkpoint.
+WINDOW_BUCKETS = ("data_wait", "h2d", "dispatch", "device_wait",
+                  "ckpt")
 
 # the residual bucket name (field "host_s"): wall not charged above
 HOST_BUCKET = "host"
@@ -65,9 +70,11 @@ NAMED_SCOPES = ("ln", "moe_dispatch", "moe_expert", "pp_comm",
 
 # run-level goodput/badput decomposition, in presentation order
 # ("train" is the goodput bucket, "eval"/"sample" auxiliary useful
-# work, the rest badput); aggregate.BUCKETS re-exports this
-GOODPUT_BUCKETS = ("train", "compile", "data_wait", "h2d", "host",
-                   "eval", "sample", "anomaly_skipped",
+# work, the rest badput — "ckpt" is the checkpoint submit stall,
+# kept near zero by the write-behind writer); aggregate.BUCKETS
+# re-exports this
+GOODPUT_BUCKETS = ("train", "compile", "data_wait", "h2d", "ckpt",
+                   "host", "eval", "sample", "anomaly_skipped",
                    "straggler_idle", "untracked")
 
 # serving request-lifecycle span events (obs/spans.py): the ONE
@@ -82,3 +89,17 @@ GOODPUT_BUCKETS = ("train", "compile", "data_wait", "h2d", "host",
 # in a consumer months later.
 SPAN_EVENTS = ("submit", "blocked", "admit", "prefill", "first_token",
                "tick", "retire", "error")
+
+# restart-timeline events (resilience/restart.py RestartNarrator
+# appends them to restarts.jsonl; obs/aggregate.py folds them into
+# the run-report timeline): the preemption/recovery lifecycle
+# ("preempt" = a SIGTERM/SIGINT landed, "snapshot" = the write-behind
+# writer persisted one, "resumed" = --resume=auto picked the run back
+# up) plus the chief-side elastic decisions ("dead_proc" detection,
+# Supervisor "attempt_start"/"attempt_exit", the policy verdicts
+# "retry"/"reform"/"give_up"). RestartNarrator.emit validates against
+# this tuple (the SpanRecorder discipline) and obs/schema.py pins the
+# row envelope.
+RESTART_EVENTS = ("preempt", "snapshot", "resumed", "dead_proc",
+                  "attempt_start", "attempt_exit", "retry", "reform",
+                  "give_up")

@@ -249,6 +249,8 @@ def _validate_one(path: str) -> List[str]:
             return schema_lib.validate_span_file(path)
         if base.startswith("metrics."):
             return schema_lib.validate_metrics_file(path)
+        if base.startswith("restarts"):
+            return schema_lib.validate_restart_file(path)
         # an unnamed JSONL: route by its first WELL-FORMED row's kind
         # (history files travel under arbitrary names; a torn first
         # line — a crashed writer — must not misroute the rest)
@@ -272,6 +274,8 @@ def _validate_one(path: str) -> List[str]:
             return schema_lib.validate_span_file(path)
         if kind == "bench_history":
             return schema_lib.validate_history_file(path)
+        if kind == "restart":
+            return schema_lib.validate_restart_file(path)
         return schema_lib.validate_metrics_file(path)
     try:
         with open(path) as f:
@@ -292,6 +296,9 @@ def cmd_validate(args) -> int:
     for path in args.paths:
         if os.path.isdir(path):
             targets += _stream_files(path)
+            restarts = os.path.join(path, "restarts.jsonl")
+            if os.path.isfile(restarts):
+                targets.append(restarts)
             targets += sorted(glob.glob(os.path.join(path, "flight",
                                                      "*.json")))
         elif os.path.exists(path):

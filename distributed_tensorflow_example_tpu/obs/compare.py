@@ -98,6 +98,15 @@ GATE_METRICS: Dict[str, tuple] = {
     "decode_kv_reduction_int8": ("higher", 0.01),
     "local_sgd_outer_quant_bytes_per_token": ("lower", 0.01),
     "local_sgd_outer_quant_reduction": ("higher", 0.01),
+    # the async-checkpoint keys (ISSUE 13): bench_checkpoint's A/B of
+    # the same numpy loop with the write-behind writer on vs off.
+    # ckpt_stall_ms is the per-snapshot submit wall (a host memcpy +
+    # handoff — short interleaved medians) and the overhead ratio is
+    # with/without step time; both share a crowded host with the
+    # writer thread's hashing, so the wide 25% A/B default applies
+    # (tighten per-deployment via --thresholds when the host is quiet)
+    "ckpt_stall_ms": ("lower", 0.25),
+    "ckpt_overhead_ratio": ("lower", 0.25),
 }
 
 
@@ -204,6 +213,13 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
         put("decode_kv_reduction_int8",
             doc.get("decode_kv_reduction_int8"))
         return out
+    # bench checkpoint row — keyed on ckpt_write_ms, a row-only key
+    # (the final summary carries ckpt_stall_ms/ckpt_overhead_ratio
+    # too and must fall through — the serving lesson)
+    if "ckpt_write_ms" in doc:
+        put("ckpt_stall_ms", doc.get("ckpt_stall_ms"))
+        put("ckpt_overhead_ratio", doc.get("ckpt_overhead_ratio"))
+        return out
     # bench serving row — keyed on continuous_ticks, NOT serving_tok_s:
     # the final summary carries serving_tok_s too, and must fall
     # through to its own branch below to keep wall_s/mfu/...
@@ -248,7 +264,9 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
                   "decode_kv_bytes_per_step_int8",
                   "decode_kv_reduction_int8",
                   "local_sgd_outer_quant_bytes_per_token",
-                  "local_sgd_outer_quant_reduction"):
+                  "local_sgd_outer_quant_reduction",
+                  # the async-checkpoint overhead keys (ISSUE 13)
+                  "ckpt_stall_ms", "ckpt_overhead_ratio"):
             put(k, doc.get(k))
         return out
     # last resort: any directly-named gate metrics

@@ -108,6 +108,28 @@ def env_snapshot(config: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     return snap
 
 
+def install_chained(signum, handler):
+    """Install ``handler`` for ``signum``, returning the previous
+    handler (to chain to and to restore later) — or None when this is
+    not the main thread / the platform lacks the signal. The ONE
+    signal-plumbing helper the forensics hooks here (SIGUSR1) and the
+    resilience preemption handler (SIGTERM/SIGINT,
+    resilience/signals.py) share."""
+    try:
+        return signal.signal(signum, handler)
+    except (ValueError, OSError, AttributeError):
+        return None
+
+
+def restore_handler(signum, prev) -> None:
+    """Undo install_chained (best-effort; SIG_DFL when the previous
+    handler is unknown)."""
+    try:
+        signal.signal(signum, prev or signal.SIG_DFL)
+    except (ValueError, OSError, AttributeError):
+        pass
+
+
 class FlightRecorder:
     """Bounded ring of step records + dump-on-demand."""
 
@@ -241,11 +263,8 @@ class FlightRecorder:
             if callable(self._prev_sigusr1):
                 self._prev_sigusr1(signum, frame)
 
-        try:
-            self._prev_sigusr1 = signal.signal(signal.SIGUSR1, _on_sigusr1)
-        except (ValueError, OSError, AttributeError):
-            # non-main thread, or a platform without SIGUSR1
-            self._prev_sigusr1 = None
+        # non-main thread, or a platform without SIGUSR1 -> None
+        self._prev_sigusr1 = install_chained(signal.SIGUSR1, _on_sigusr1)
         self._installed = True
 
     def uninstall(self) -> None:
@@ -254,11 +273,7 @@ class FlightRecorder:
         if self._prev_excepthook is not None:
             sys.excepthook = self._prev_excepthook
             self._prev_excepthook = None
-        try:
-            signal.signal(signal.SIGUSR1,
-                          self._prev_sigusr1 or signal.SIG_DFL)
-        except (ValueError, OSError, AttributeError):
-            pass
+        restore_handler(signal.SIGUSR1, self._prev_sigusr1)
         self._prev_sigusr1 = None
         self._installed = False
 

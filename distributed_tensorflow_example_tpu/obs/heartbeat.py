@@ -49,26 +49,53 @@ class Heartbeat:
             pass
 
 
-def clear_stale_signals(logs_path: str) -> int:
+# flight-dump reasons a RESUMING run must keep: a preemption dump is
+# the restart's forensic evidence — clearing it at relaunch would
+# erase the very event the restart timeline exists to show
+_PRESERVED_FLIGHT_REASONS = ("sigterm", "preempt")
+
+
+def clear_stale_signals(logs_path: str, resuming: bool = False) -> int:
     """Run-start hygiene, chief-only: remove a previous run's leftover
     per-process signal files from a reused ``logs_path`` — every
     ``heartbeat.*`` (a dead run's peers would otherwise fabricate
     stragglers beyond what ``straggler_report(since=...)`` fences) and
     every ``flight/*.json`` incl. ``report.json`` (a stale dump would
     collate into THIS run's post-mortem and dtx-obs report would mix
-    runs). The metrics jsonl streams are append-only history and stay.
+    runs). The metrics jsonl streams are append-only history and stay,
+    as does the restart timeline (``restarts.jsonl``) — its whole
+    point is spanning restarts.
+
+    ``resuming`` (a ``--resume`` relaunch continuing the SAME run):
+    the cleanup must not assume a fresh run — it spares every
+    ``heartbeat.*`` (the chief's dead-process detection needs the
+    preempted attempt's beats to tell a dead peer from a
+    never-started one; this run's straggler stats still fence them
+    out via ``since``) and every flight dump whose recorded reason is
+    a preemption (``sigterm``/``preempt`` — the restart's evidence;
+    crash/anomaly dumps from older runs still clear).
+
     Best-effort (a locked file must not kill the run); returns the
     number of files removed. A live peer's heartbeat written in the
     start-up race is re-touched at its next window boundary, so a
     spurious removal only delays that beat one window."""
     removed = 0
-    for path in glob.glob(os.path.join(logs_path, "heartbeat.*")):
-        try:
-            os.remove(path)
-            removed += 1
-        except OSError:
-            pass
+    if not resuming:
+        for path in glob.glob(os.path.join(logs_path, "heartbeat.*")):
+            try:
+                os.remove(path)
+                removed += 1
+            except OSError:
+                pass
     for path in glob.glob(os.path.join(logs_path, "flight", "*.json")):
+        if resuming:
+            try:
+                with open(path) as f:
+                    reason = json.load(f).get("reason")
+            except (OSError, ValueError):
+                reason = None  # torn dump: clear it
+            if reason in _PRESERVED_FLIGHT_REASONS:
+                continue
         try:
             os.remove(path)
             removed += 1

@@ -35,8 +35,12 @@ _NUM = (int, float)
 # batch device-commit wall) + the matching h2d goodput bucket;
 # v4 = the serving request-lifecycle span stream (spans.<proc>.jsonl,
 # SPAN_* contracts below), the bench history records
-# (HISTORY_ENTRY) and the ttft_p99_ms serving-stats field.
-SCHEMA_VERSION = 4
+# (HISTORY_ENTRY) and the ttft_p99_ms serving-stats field;
+# v5 = the resilience subsystem: the ckpt_s window/goodput bucket
+# (async-checkpoint submit stall), the restart-timeline stream
+# (restarts.jsonl, RESTART_EVENT below) and the run report's
+# "restarts" section.
+SCHEMA_VERSION = 5
 
 
 # field -> allowed types; a tuple including type(None) marks nullable
@@ -64,6 +68,7 @@ METRICS_WINDOW = {
     "h2d_s": _NUM,
     "dispatch_s": _NUM,
     "device_wait_s": _NUM,
+    "ckpt_s": _NUM,
     "host_s": _NUM,
     "examples_per_sec": _NUM + (type(None),),
     "tokens_per_sec": _NUM + (type(None),),
@@ -237,6 +242,60 @@ def validate_span_file(path: str) -> List[str]:
     return errs
 
 
+# One restart-timeline row (resilience/restart.py RestartNarrator
+# appends these to <logs_path>/restarts.jsonl; the event vocabulary
+# is obs/buckets.py RESTART_EVENTS and the payload beyond this
+# envelope is free-form — decisions carry reason/wait_s/dp/dead,
+# snapshots carry step/objects written, the preempt row its signal).
+RESTART_EVENT = {
+    "kind": (str,),          # "restart"
+    "v": (int,),
+    "t": _NUM,
+    "proc": (int,),
+    "event": (str,),
+}
+
+
+def validate_restart_row(row: Dict[str, Any],
+                         where: str = "row") -> List[str]:
+    """Validate one restarts.jsonl row: version first, then the
+    envelope, then the event vocabulary."""
+    if not isinstance(row, dict):
+        return [f"{where}: not an object"]
+    verrs = _version_errs(row, "v", where)
+    if verrs:
+        return verrs
+    errs = _check(row, RESTART_EVENT, where)
+    if row.get("kind") != "restart":
+        errs.append(f"{where}: kind is {row.get('kind')!r}, expected "
+                    f"'restart'")
+    event = row.get("event")
+    if isinstance(event, str):
+        from .buckets import RESTART_EVENTS
+
+        if event not in RESTART_EVENTS:
+            errs.append(f"{where}: unknown restart event {event!r} "
+                        f"(known: {sorted(RESTART_EVENTS)})")
+    return errs
+
+
+def validate_restart_file(path: str) -> List[str]:
+    """Validate every line of a restarts.jsonl file."""
+    errs: List[str] = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError as e:
+                errs.append(f"line {i}: not JSON ({e})")
+                continue
+            errs += validate_restart_row(row, where=f"line {i}")
+    return errs
+
+
 # One bench-history record (obs/history.py appends these to the
 # rolling history.jsonl: the final bench summary / run-report summary
 # reduced to its gate metrics, so --gate-rolling and the dtx-obs
@@ -302,6 +361,7 @@ RUN_REPORT = {
     "trajectory": (list,),
     "stragglers": (dict,),
     "anomalies": (dict,),
+    "restarts": (dict,),
     "timeline": (list,),
     "schema_errors": (list,),
 }

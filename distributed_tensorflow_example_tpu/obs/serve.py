@@ -309,12 +309,14 @@ class StatusServer:
 
     def _report_signature(self) -> tuple:
         """(path, mtime_ns, size) for every file /report reads —
-        metrics streams, heartbeats and flight dumps.  Size rides
-        along so an append inside one mtime granule still misses."""
+        metrics streams, heartbeats, flight dumps and the restart
+        timeline.  Size rides along so an append inside one mtime
+        granule still misses."""
         import glob as glob_lib
 
         sig = []
         for pattern in ("metrics.*.jsonl", "heartbeat.*",
+                        "restarts.jsonl",
                         os.path.join("flight", "*.json")):
             for path in glob_lib.glob(os.path.join(self.logs_path,
                                                    pattern)):

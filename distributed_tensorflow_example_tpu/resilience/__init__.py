@@ -1,0 +1,68 @@
+"""Resilience package: preemption-surviving training.
+
+Four pieces, composing the self-healing story ROADMAP item 3 asked
+the forensics stack (PRs 1-3, 12) to grow into:
+
+- ``codec``/``manifest`` — the content-addressed incremental snapshot
+  store: every leaf (or leaf shard) persists as an ``objects/``
+  payload named by its content digest, so a leaf unchanged since the
+  previous snapshot is never rewritten; a root manifest written LAST
+  (atomic tmp+rename) makes a snapshot visible only once durable, and
+  a torn newest snapshot falls back to the previous valid manifest;
+- ``writer`` — ``CheckpointWriter``, the write-behind thread: the
+  train thread hands over a host-memory snapshot (near-zero stall,
+  gated by ``bench_checkpoint``) and the thread does the encoding,
+  hashing, file IO and keep-last-K retention;
+- ``signals`` — ``PreemptionHandler``: SIGTERM/SIGINT chain riding
+  the flight recorder's signal plumbing (obs/flight.py); the train
+  loop drains the writer and lands one last consistent snapshot
+  before exit;
+- ``resume`` — exact-step auto-resume (``--resume=auto``): newest
+  valid manifest + the recorded data-pipeline position (epoch +
+  in-epoch batch skip counter), bit-identical to an uninterrupted
+  run;
+- ``restart`` — the chief-side ``RestartPolicy``/``Supervisor``:
+  heartbeat-fed dead-process detection, bounded retry with backoff,
+  mesh reform at a smaller DP width — every decision narrated as
+  restart-timeline events (``restarts.jsonl``) that ``dtx-obs
+  report`` folds into the run timeline.
+
+Re-exports resolve lazily (PEP 562, the serving/ convention). The
+whole package is pure Python + numpy — importing it (or any module in
+it) pulls no jax, so the tier-1 suites run on environments whose jax
+predates the repo's stack.
+"""
+
+_EXPORTS = {
+    "encode_array": "codec",
+    "decode_array": "codec",
+    "bit_container_dtype": "codec",
+    "newest_valid_snapshot": "manifest",
+    "list_snapshots": "manifest",
+    "prune_snapshots": "manifest",
+    "restore_arrays": "manifest",
+    "snapshot_valid": "manifest",
+    "CheckpointWriter": "writer",
+    "PreemptionHandler": "signals",
+    "Preempted": "signals",
+    "ResumePlan": "resume",
+    "auto_resume": "resume",
+    "skip_batches": "resume",
+    "RestartPolicy": "restart",
+    "RestartDecision": "restart",
+    "RestartNarrator": "restart",
+    "Supervisor": "restart",
+    "dead_procs": "restart",
+    "backoff_s": "restart",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(f".{_EXPORTS[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,41 @@
+"""Array <-> on-disk payload codec shared by every checkpoint format.
+
+``np.save``/``np.savez`` cannot round-trip ml_dtypes' bfloat16 /
+float8 families (numpy kind 'V': they come back as raw void arrays
+nothing can cast), so those leaves persist as their same-width
+unsigned-int BIT containers plus the recorded dtype name; readers
+``view`` the bits back. This module is the ONE implementation — the
+classic formats (utils/checkpoint.py) and the resilience snapshot
+store (resilience/manifest.py) both import it, so the two can never
+disagree about what a bf16 leaf looks like on disk.
+
+Pure numpy — no jax import (``np.dtype('bfloat16')`` resolves
+whenever ml_dtypes is importable, which jax guarantees wherever the
+arrays themselves could exist).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def bit_container_dtype(dt) -> np.dtype | None:
+    """The same-width unsigned-int container for dtypes numpy's savers
+    cannot round-trip, or None for native dtypes."""
+    dt = np.dtype(dt)
+    if dt.kind in "biufcSU":
+        return None
+    return np.dtype(f"u{dt.itemsize}")
+
+
+def encode_array(a) -> tuple[np.ndarray, str | None]:
+    """(savable array, original dtype name when bit-encoded)."""
+    a = np.asarray(a)
+    bit = bit_container_dtype(a.dtype)
+    return (a.view(bit), a.dtype.name) if bit else (a, None)
+
+
+def decode_array(a: np.ndarray, dtype_name: str) -> np.ndarray:
+    """Reinterpret a bit-container array back to its recorded dtype
+    (np.dtype resolves 'bfloat16' etc. once ml_dtypes is installed)."""
+    return a.view(np.dtype(dtype_name))

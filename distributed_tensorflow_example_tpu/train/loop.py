@@ -40,7 +40,8 @@ import numpy as np
 
 from .. import cluster
 from ..config import (Config, validate_local_sgd_config,
-                      validate_pipeline_config, validate_quant_config)
+                      validate_pipeline_config, validate_quant_config,
+                      validate_resilience_config)
 from ..data import EpochIterator, load_datasets
 from ..models.mlp import MLPSpec
 from ..parallel import epoch as epoch_lib
@@ -189,6 +190,8 @@ def run(cfg: Config) -> Dict[str, Any]:
     validate_local_sgd_config(cfg)
     # ... and the quantization (--kv_quant/--fp8_ffn/--outer_quant) one
     validate_quant_config(cfg)
+    # ... and the resilience (--ckpt_every/--ckpt_keep/--resume) one
+    validate_resilience_config(cfg)
     if cfg.objective == "lm":
         if cfg.model != "transformer":
             raise ValueError("--objective=lm requires --model=transformer")
@@ -412,7 +415,11 @@ def run(cfg: Config) -> Dict[str, Any]:
                   or cfg.status_port):
         from ..obs.heartbeat import clear_stale_signals
 
-        clear_stale_signals(cfg.logs_path)
+        # a --resume relaunch continues the SAME run: the cleanup
+        # spares the preempted attempt's heartbeats (dead-process
+        # detection) and its sigterm flight dumps (the restart
+        # timeline's evidence) — obs/heartbeat.py has the rationale
+        clear_stale_signals(cfg.logs_path, resuming=bool(cfg.resume))
 
     # --status_port: the live /status + Prometheus endpoint over the
     # logs_path (obs/serve.py) — a pure reader of the files this run
@@ -427,6 +434,18 @@ def run(cfg: Config) -> Dict[str, Any]:
         if port:
             print(f"Status server on port {port} "
                   f"(/status /metrics /report)")
+
+    # restart-timeline narration (resilience/restart.py): preemptions,
+    # snapshots, resumes and dead-process detections append to
+    # <logs_path>/restarts.jsonl, which dtx-obs report folds into the
+    # run timeline. Created whenever the resilience path is on (every
+    # process narrates; rows carry the proc index).
+    restart_narrator = None
+    if cfg.ckpt_every or cfg.resume == "auto":
+        from ..resilience.restart import RestartNarrator
+
+        restart_narrator = RestartNarrator(cfg.logs_path,
+                                           process_index=proc_idx)
 
     # goodput phase accounting: cumulative wall spent OUTSIDE the
     # per-window timing buckets, carried on the run_end event so
@@ -471,12 +490,36 @@ def run(cfg: Config) -> Dict[str, Any]:
             row["mfu"] = round(m, 6) if m is not None else None
             mlogger.log_window(**row)
 
+        narrated_dead: set = set()
+
         def straggler_event(epoch: int) -> None:
             if chief:
                 mlogger.log_event(
                     "stragglers", epoch=int(epoch),
                     **hb_lib.straggler_report(cfg.logs_path,
                                               since=telemetry_start))
+                if restart_narrator is not None and proc_cnt > 1:
+                    # liveness verdict over the same heartbeat files:
+                    # a peer silent past the threshold lands on the
+                    # restart timeline for the supervisor's policy.
+                    # Fenced to THIS attempt's beats (since=): a
+                    # --resume relaunch keeps the preempted attempt's
+                    # stale files on purpose, and a still-compiling
+                    # peer must not read as dead. Narrated ONCE per
+                    # newly-dead proc — a peer staying dead for 40
+                    # epochs is one event, not 40
+                    from ..resilience.restart import dead_procs
+
+                    dead = set(dead_procs(
+                        hb_lib.read_heartbeats(cfg.logs_path),
+                        since=telemetry_start)) - {proc_idx}
+                    fresh = sorted(dead - narrated_dead)
+                    narrated_dead.clear()
+                    narrated_dead.update(dead)
+                    if fresh:
+                        restart_narrator.emit("dead_proc",
+                                              epoch=int(epoch),
+                                              dead=fresh)
 
     # Failure forensics (obs/, the second half of the observability
     # subsystem): windowed profiler capture, the --on_anomaly policy
@@ -504,6 +547,10 @@ def run(cfg: Config) -> Dict[str, Any]:
         policy = anomaly_lib.AnomalyPolicy(
             cfg.on_anomaly, flight=flight, mlogger=mlogger,
             watchdog=anomaly_lib.LossWatchdog(factor=cfg.anomaly_factor))
+    # resilience handles, bound before the guard so its finally can
+    # always reference them (created inside, on the --ckpt_every path)
+    ckpt_writer = None
+    preempt_handler = None
     # --- forensics guard: the body below is try-wrapped ---
     try:
 
@@ -570,6 +617,12 @@ def run(cfg: Config) -> Dict[str, Any]:
             # host-side checkpoints and early stopping need the host loop
             and not (async_mode and (cfg.checkpoint_every or cfg.model_parallel > 1
                                      or cfg.early_stop_patience))
+            # resilience snapshots ride the host loop's per-step safe
+            # point (writer submit + the SIGTERM poll + the exact-step
+            # data_state), and --resume=auto's mid-epoch batch replay
+            # needs the host feed; the scan paths have no per-step
+            # host control
+            and not cfg.ckpt_every and cfg.resume != "auto"
         )
 
         # init_op equivalent (example.py:129, 74): identical seeded init on
@@ -679,11 +732,50 @@ def run(cfg: Config) -> Dict[str, Any]:
         print("Variables initialized ...")  # example.py:130
 
         start_epoch = 0
+        resume_skip = 0      # --resume=auto: in-epoch batches already
+                             # consumed at save time (the exact-step
+                             # replay counter)
+        resume_plan = None
+        resume_flat = None
         resumed_extras: dict = {}
         if cfg.resume and cfg.checkpoint_dir:
-            path = ckpt_lib.latest_checkpoint(cfg.checkpoint_dir)
+            from ..resilience import resume as resume_lib
+
+            path = None
+            if cfg.resume == "auto":
+                # the resilience store: newest RESTORABLE manifest (a
+                # torn newest falls back to the previous one); when no
+                # manifest exists yet, fall through to the classic
+                # formats so a fleet can switch flags mid-history
+                found = resume_lib.auto_resume(cfg.checkpoint_dir)
+                if found is not None:
+                    resume_plan, resume_flat = found
+                    path = resume_plan.root_path
+            if path is None:
+                path = ckpt_lib.latest_checkpoint(cfg.checkpoint_dir)
+            if path is None and cfg.resume != "auto" and not fsdp_mode:
+                # the symmetric fall-FORWARD: a bare --resume against
+                # a dir a --ckpt_every run populated (resilience
+                # manifests only, no classic checkpoint) must not
+                # silently restart from scratch
+                found = resume_lib.auto_resume(cfg.checkpoint_dir)
+                if found is not None:
+                    if found[0].batches_done and fast:
+                        # a MID-epoch plan needs the host loop's batch
+                        # replay, which bare --resume did not opt into
+                        # — refuse to half-resume on the scan path
+                        raise ValueError(
+                            f"checkpoint {found[0].root_path} resumes "
+                            f"mid-epoch (+{found[0].batches_done} "
+                            f"batches): use --resume=auto (the "
+                            f"exact-step path) instead of bare "
+                            f"--resume")
+                    resume_plan, resume_flat = found
+                    path = resume_plan.root_path
             if path:
-                resumed_extras = ckpt_lib.load_extras(path)
+                resumed_extras = (dict(resume_plan.extras)
+                                  if resume_plan is not None
+                                  else ckpt_lib.load_extras(path))
                 saved_zdp = int(resumed_extras.get("zero_dp", 0))
                 if saved_zdp != (dp if cfg.zero_opt else 0):
                     raise ValueError(
@@ -744,7 +836,16 @@ def run(cfg: Config) -> Dict[str, Any]:
                             f"{'yes' if want_m else 'no'}, "
                             f"outer_quant="
                             f"{'int8' if want_q else 'off'})")
-                if fsdp_mode and os.path.isdir(path):
+                if resume_plan is not None:
+                    # exact-step resilience resume: full logical
+                    # leaves, key-matched into this run's template
+                    # (validate_resilience_config already rejected the
+                    # fsdp layout)
+                    state = ckpt_lib.rebuild_tree_validated(
+                        resume_flat, state, ckpt_path=path)
+                    start_epoch = resume_plan.epoch
+                    resume_skip = resume_plan.batches_done
+                elif fsdp_mode and os.path.isdir(path):
                     # sharded-FSDP checkpoint: leaves are the SAVED run's
                     # flat [.., dp_old, chunk] layout — reassemble,
                     # un-flatten at the saved model-parallel degree, and
@@ -770,7 +871,17 @@ def run(cfg: Config) -> Dict[str, Any]:
                 else:
                     state, _, start_epoch = ckpt_lib.restore_checkpoint(path, state)
                 state = mesh_lib.place_state(state, mesh, sspecs)
-                print(f"Resumed from {path} at epoch {start_epoch}")
+                if resume_plan is not None:
+                    print(f"Resumed from {path} at epoch {start_epoch} "
+                          f"step {resume_plan.step} "
+                          f"(+{resume_skip} in-epoch batches)")
+                    if restart_narrator is not None and chief:
+                        restart_narrator.emit(
+                            "resumed", step=int(resume_plan.step),
+                            epoch=int(start_epoch),
+                            batches_done=int(resume_skip))
+                else:
+                    print(f"Resumed from {path} at epoch {start_epoch}")
 
         writer = None
         if cfg.summaries and (chief or cfg.summaries_all_hosts):
@@ -969,6 +1080,72 @@ def run(cfg: Config) -> Dict[str, Any]:
                     save_state(step, resume_epoch)
                 last_ckpt_step = step
 
+        # --- resilience: write-behind snapshots + SIGTERM safety -----
+        if cfg.ckpt_every:
+            from ..resilience import signals as signals_lib
+            from ..resilience.writer import CheckpointWriter
+
+            def _on_snapshot_written(snap_step, wstats):
+                # writer-thread callback: every persisted snapshot
+                # lands on the restart timeline (incremental reuse
+                # counts included — the evidence the store skips
+                # unchanged leaves)
+                if restart_narrator is not None:
+                    restart_narrator.emit(
+                        "snapshot", step=int(snap_step),
+                        objects_written=int(wstats["objects_written"]),
+                        objects_reused=int(wstats["objects_reused"]),
+                        bytes_written=int(wstats["bytes_written"]))
+
+            ckpt_writer = CheckpointWriter(
+                cfg.checkpoint_dir, process_index=proc_idx,
+                process_count=proc_cnt, keep=cfg.ckpt_keep,
+                on_written=_on_snapshot_written if chief else None)
+
+            def _on_preempt_signal(signum):
+                if restart_narrator is not None:
+                    restart_narrator.emit("preempt", signal=int(signum))
+
+            preempt_handler = signals_lib.PreemptionHandler(
+                writer=ckpt_writer, on_signal=_on_preempt_signal)
+            preempt_handler.install()
+
+        def snapshot_state(step: int, epoch: int,
+                           batches_done: int) -> None:
+            """Hand the CURRENT train state to the write-behind
+            writer. The device->host fetch happens HERE (started
+            async via copy_to_host_async, materialized before return:
+            the next dispatch DONATES these buffers, so the copy
+            cannot move to the writer thread); encoding, hashing,
+            file IO and retention all run on the writer thread —
+            the submit wall is the gated ckpt stall."""
+            leaves = ckpt_lib._flatten_with_keys(state)
+            for _k, v in leaves:
+                if hasattr(v, "copy_to_host_async"):
+                    v.copy_to_host_async()
+            meta = None
+            if proc_cnt == 1:
+                snap = {k: np.asarray(v) for k, v in leaves}
+            else:
+                # multi-process: each process hands over only its
+                # addressable replica-0 shards (bounds recorded; the
+                # store reassembles at restore — the sharded-format
+                # discipline); needs the shared-FS contract the
+                # sharded classic format documents
+                snap = {k: ckpt_lib._local_shards(v)
+                        for k, v in leaves}
+                meta = {k: {"shape": [int(d) for d in np.shape(v)],
+                            "dtype": np.dtype(
+                                jnp.result_type(v)).name}
+                        for k, v in leaves}
+            ckpt_writer.submit(
+                int(step), int(epoch), snap,
+                extras=_ckpt_extras() or None,
+                data_state={"epoch": int(epoch),
+                            "batches_done": int(batches_done),
+                            "steps_done": int(step)},
+                leaf_meta=meta)
+
         eval_pending = None  # host scalar: eval count fetched with the metrics
         if fast:
             shuffle_key = jax.random.PRNGKey(cfg.seed + 0x5EED)
@@ -1015,7 +1192,8 @@ def run(cfg: Config) -> Dict[str, Any]:
                          "step_time_p50_ms": ms, "step_time_p95_ms": ms,
                          "step_time_max_ms": ms, "data_wait_s": 0.0,
                          "h2d_s": 0.0, "dispatch_s": 0.0,
-                         "device_wait_s": wall, "host_s": 0.0})
+                         "device_wait_s": wall, "ckpt_s": 0.0,
+                         "host_s": 0.0})
                     heartbeat.touch((epoch + 1) * batch_count)
                     straggler_event(epoch)
                 if flight is not None:
@@ -1310,13 +1488,16 @@ def run(cfg: Config) -> Dict[str, Any]:
                     wtimer.charge("h2d", dt)
                 return out
 
-            def timed_batches(batches):
-                """enumerate(batches), charging the blocking next() into
-                the window's data_wait bucket — minus any h2d commit
-                wall spent inside that next() when the device
-                prefetcher is the feed."""
+            def timed_batches(batches, start=0):
+                """enumerate(batches, start), charging the blocking
+                next() into the window's data_wait bucket — minus any
+                h2d commit wall spent inside that next() when the
+                device prefetcher is the feed. ``start`` offsets the
+                yielded index: a --resume=auto epoch that already
+                skipped its consumed head keeps the uninterrupted
+                run's batch numbering."""
                 it = iter(batches)
-                i = 0
+                i = start
                 while True:
                     t0 = time.perf_counter()
                     h0 = h2d_wall[0]
@@ -1372,7 +1553,8 @@ def run(cfg: Config) -> Dict[str, Any]:
                         step, {"learning_rate": lr_host(steps_done)})
                 wtimer.reset()
 
-            steps_done = start_epoch * iterator.batches_per_epoch
+            steps_done = (start_epoch * iterator.batches_per_epoch
+                          + resume_skip)
             graph_dumped = False
             # ONE persistent host producer spans every epoch (epoch-keyed
             # rewind — the next epoch's gather overlaps the between-epoch
@@ -1391,8 +1573,18 @@ def run(cfg: Config) -> Dict[str, Any]:
             try:
                 for epoch in range(start_epoch, cfg.training_epochs):
                     batch_count = iterator.batches_per_epoch  # example.py:153
-                    count = 0
+                    # exact-step resume: the saved epoch replays its
+                    # already-consumed head (the deterministic
+                    # epoch-keyed order makes the skip land on the
+                    # right batch), and the print cadence counter
+                    # picks up where the uninterrupted run would be
+                    skip = resume_skip if epoch == start_epoch else 0
+                    count = skip % frequency
                     feed = prefetcher.epoch(epoch)
+                    if skip:
+                        from ..resilience.resume import skip_batches
+
+                        feed = skip_batches(feed, skip)
                     if dev_feed is not None:
                         feed = dev_feed.rewind(feed)
                     if wtimer is not None:
@@ -1400,7 +1592,23 @@ def run(cfg: Config) -> Dict[str, Any]:
                         # checkpoint) must not bleed into the next
                         # window's wall and deflate its throughput fields
                         wtimer.reset()
-                    for i, (batch_x, batch_y) in timed_batches(feed):
+                    for i, (batch_x, batch_y) in timed_batches(
+                            feed, start=skip):
+                        if preempt_handler is not None \
+                                and preempt_handler.requested:
+                            # the per-step safe point: land one final
+                            # consistent snapshot at the exact current
+                            # position, drain the writer, exit 128+sig
+                            # (the forensics guard dumps the flight
+                            # record with reason "sigterm")
+                            with tracer.annotate("checkpoint"):
+                                snapshot_state(steps_done, epoch, i)
+                                ckpt_writer.drain()
+                            print(f"Preempted "
+                                  f"({preempt_handler.signal_name()}): "
+                                  f"final snapshot at step "
+                                  f"{steps_done}")
+                            preempt_handler.check()  # raises Preempted
                         if dev_feed is None:
                             # blocking path: the commit runs on the
                             # critical path, at dispatch time (the
@@ -1513,6 +1721,34 @@ def run(cfg: Config) -> Dict[str, Any]:
                             _print_window(step, epoch, i, batch_count, cost,
                                           elapsed_time, frequency)
                             count = 0
+                        if (ckpt_writer is not None
+                                and steps_done % cfg.ckpt_every == 0):
+                            # write-behind snapshot: the submit wall
+                            # (device->host fetch + handoff) is the
+                            # ONLY step cost — encode/hash/IO run on
+                            # the writer thread; charged to the ckpt
+                            # bucket BEFORE the window may close below,
+                            # so the stall lands in the window whose
+                            # step triggered it (a boundary-step
+                            # snapshot must not leak into the next
+                            # window, nor an epoch-final one into the
+                            # reset) — the goodput decomposition is
+                            # how the near-zero claim is proven
+                            t_ck = time.perf_counter()
+                            # epoch-final position normalizes to the
+                            # NEXT epoch's start: resuming from
+                            # (epoch, batch_count) would regenerate a
+                            # whole epoch of batches just to skip them
+                            ck_ep, ck_done = ((epoch, i + 1)
+                                              if i + 1 < batch_count
+                                              else (epoch + 1, 0))
+                            with tracer.annotate("checkpoint"):
+                                snapshot_state(steps_done, ck_ep,
+                                               ck_done)
+                            if wtimer is not None:
+                                wtimer.charge("ckpt",
+                                              time.perf_counter()
+                                              - t_ck)
                         if wtimer is not None:
                             wtimer.step_done()
                             if (wtimer.steps >= cfg.log_every
@@ -1649,8 +1885,24 @@ def run(cfg: Config) -> Dict[str, Any]:
         phase_s["sample"] += time.perf_counter() - t_sample
 
         if cfg.checkpoint_dir:
-            save_state(int(state.step), cfg.training_epochs)
-            # a background checkpoint writer must finish before exit
+            if ckpt_writer is not None:
+                # the resilience store's exit snapshot supersedes the
+                # legacy exit save (ONE durable source of truth for
+                # --resume=auto); incremental reuse makes it nearly
+                # free when a periodic snapshot just landed
+                with tracer.annotate("checkpoint"):
+                    snapshot_state(steps_done, cfg.training_epochs, 0)
+                    ckpt_writer.drain()
+            if ckpt_writer is None or ckpt_enabled:
+                # the legacy final save still runs when the CLASSIC
+                # periodic format is in play (--checkpoint_every
+                # alongside --ckpt_every): a later bare --resume
+                # prefers the classic store, which must then not end
+                # at a stale mid-run epoch boundary
+                save_state(int(state.step), cfg.training_epochs)
+            # any background CLASSIC writer (--async_checkpoints)
+            # must finish before exit — its error surfaces here, not
+            # silently after a 0 exit code
             ckpt_lib.wait_for_pending_saves()
         if writer is not None:
             writer.close()
@@ -1704,8 +1956,15 @@ def run(cfg: Config) -> Dict[str, Any]:
         # whatever the fleet has dumped so far into the post-mortem
         # report
         if flight is not None:
+            from ..resilience.signals import Preempted
+
+            # "sigterm" (a handled preemption, its final snapshot
+            # already durable) is exactly the dump a --resume relaunch
+            # preserves through clear_stale_signals — the restart
+            # timeline's evidence
             reason = ("anomaly_halt"
                       if isinstance(e, anomaly_lib.AnomalyError)
+                      else "sigterm" if isinstance(e, Preempted)
                       else "crash")
             flight.dump(reason, exc=e)
             if chief:
@@ -1717,6 +1976,17 @@ def run(cfg: Config) -> Dict[str, Any]:
         # must not leak past this run, and the status server's socket
         # closes with the run it reports on
         tracer.stop()
+        if preempt_handler is not None:
+            preempt_handler.uninstall()
+        if ckpt_writer is not None:
+            # flush the newest captured snapshot even on the crash
+            # path (crash durability); a writer error here must not
+            # mask the original exception — note it instead
+            try:
+                ckpt_writer.close(drain=True, timeout=60.0)
+            except Exception as ck_err:
+                print(f"NOTE: checkpoint writer close failed: "
+                      f"{ck_err}")
         if flight is not None:
             flight.uninstall()
         if status_server is not None:

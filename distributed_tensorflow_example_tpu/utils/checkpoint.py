@@ -48,36 +48,19 @@ from typing import Any, Tuple
 import jax
 import numpy as np
 
+# ONE bf16-family bit-container codec for every checkpoint format:
+# shared with the resilience snapshot store (resilience/codec.py has
+# the rationale — ml_dtypes leaves register as numpy kind 'V' and
+# np.savez cannot round-trip them)
+from ..resilience.codec import bit_container_dtype as _bit_dtype
+from ..resilience.codec import decode_array as _decode_leaf
+from ..resilience.codec import encode_array as _encode_leaf
+
 
 def _tree_key(path) -> str:
     """The one tree-path -> key-string rule every reader/writer shares."""
     return "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
                     for p in path)
-
-
-def _bit_dtype(dt) -> np.dtype | None:
-    """The same-width unsigned-int container for dtypes ``np.savez``
-    cannot round-trip (ml_dtypes' bfloat16/float8 register as numpy
-    kind 'V' and come back as raw void arrays that nothing can cast),
-    or None for native dtypes. Writers store the BITS in the
-    container; readers ``view`` them back."""
-    dt = np.dtype(dt)
-    if dt.kind in "biufcSU":
-        return None
-    return np.dtype(f"u{dt.itemsize}")
-
-
-def _encode_leaf(a) -> tuple[np.ndarray, str | None]:
-    """(savable array, original dtype name when bit-encoded)."""
-    a = np.asarray(a)
-    bit = _bit_dtype(a.dtype)
-    return (a.view(bit), a.dtype.name) if bit else (a, None)
-
-
-def _decode_leaf(a: np.ndarray, dtype_name: str) -> np.ndarray:
-    """Reinterpret a bit-container array back to its recorded dtype
-    (np.dtype resolves 'bfloat16' etc. because jax imports ml_dtypes)."""
-    return a.view(np.dtype(dtype_name))
 
 
 def _flatten_with_keys(tree: Any):
@@ -420,6 +403,15 @@ def _rebuild(data: dict, template: Any, validate: bool,
 def rebuild_tree(data: dict, template: Any):
     """Key-matched unflatten WITHOUT shape validation (see _rebuild)."""
     return _rebuild(data, template, validate=False)
+
+
+def rebuild_tree_validated(data: dict, template: Any,
+                           ckpt_path: str = "<data>"):
+    """Key-matched unflatten WITH shape validation — the resilience
+    auto-resume path (full logical leaves restored from the snapshot
+    store, resilience/manifest.py) shares the one rebuild
+    implementation with the classic formats."""
+    return _rebuild(data, template, validate=True, ckpt_path=ckpt_path)
 
 
 def restore_checkpoint(path: str, state_template: Any) -> Tuple[Any, int, int]:

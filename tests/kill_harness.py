@@ -1,0 +1,121 @@
+"""Reusable kill-injector harness for the resume acceptance tests.
+
+Drives ``tests/sim_trainer.py`` (and any other subprocess trainer) as
+a victim: launch, wait for an observable condition (a snapshot
+landing, a file appearing), inject a signal — SIGTERM mid-step,
+SIGKILL between snapshots — and relaunch with ``--resume auto``. The
+sim trainer's own ``--die_at_step``/``--die_with`` flags provide the
+deterministic self-injection variant (exact step, no polling race);
+``kill_when`` provides the external mid-step variant.
+
+Import it from tests (``from kill_harness import ...``) — it is not a
+test module itself.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Callable, List, Optional, Sequence
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SIM = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "sim_trainer.py")
+
+
+def sim_cmd(ckpt_dir: str, logs: str, **flags) -> List[str]:
+    """Build a sim_trainer command line; flags map 1:1 to its
+    argparse surface (underscores kept)."""
+    cmd = [sys.executable, SIM, "--ckpt_dir", str(ckpt_dir),
+           "--logs", str(logs)]
+    for k, v in flags.items():
+        cmd += [f"--{k}", str(v)]
+    return cmd
+
+
+def launch(cmd: Sequence[str]) -> subprocess.Popen:
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return subprocess.Popen(list(cmd), env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+def run(cmd: Sequence[str], timeout: float = 120.0):
+    """Run to completion; returns (returncode, stdout)."""
+    proc = launch(cmd)
+    out, _ = proc.communicate(timeout=timeout)
+    return proc.returncode, out
+
+
+def wait_for(predicate: Callable[[], bool], timeout: float = 30.0,
+             interval: float = 0.02) -> bool:
+    """Poll ``predicate`` until true or timeout."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def snapshots_in(ckpt_dir: str) -> List[int]:
+    """Visible snapshot steps (root manifests present)."""
+    from distributed_tensorflow_example_tpu.resilience import manifest
+
+    return [s for s, _n in manifest.list_snapshots(ckpt_dir)]
+
+
+def kill_when(proc: subprocess.Popen, predicate: Callable[[], bool],
+              sig: int = signal.SIGTERM, timeout: float = 30.0,
+              grace: float = 60.0) -> int:
+    """The external injector: wait for ``predicate`` (e.g. the first
+    snapshot landing), send ``sig`` mid-run, then wait for exit.
+    Returns the process's return code (negative = died to an
+    unhandled signal, e.g. -9 for SIGKILL)."""
+    if not wait_for(predicate, timeout=timeout):
+        proc.kill()
+        proc.communicate()
+        raise AssertionError(
+            "kill_when: condition never became true; victim killed")
+    proc.send_signal(sig)
+    try:
+        proc.communicate(timeout=grace)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        raise AssertionError(
+            f"kill_when: victim did not exit within {grace}s of "
+            f"signal {sig}")
+    return proc.returncode
+
+
+def read_losses(logs: str) -> dict:
+    """{step: loss}, last write wins — the union of an interrupted
+    attempt and its resumed continuation IS the full curve."""
+    out = {}
+    path = os.path.join(logs, "losses.jsonl")
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue  # torn mid-append by a kill -9
+            out[int(row["step"])] = float(row["loss"])
+    return out
+
+
+def read_final(logs: str) -> Optional[dict]:
+    path = os.path.join(logs, "final.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)

@@ -1,0 +1,236 @@
+"""Deterministic no-jax trainer driving the resilience subsystem.
+
+The resume acceptance tests (tests/test_resilience_acceptance.py) run
+this script as a subprocess and kill it — SIGTERM mid-step, kill -9
+between snapshots — then relaunch it with ``--resume auto`` and
+require the continuation to be BIT-identical to an uninterrupted run
+(state digest and per-step loss curve). It mirrors the real train
+loop's structure exactly where resilience touches it:
+
+- an epoch-keyed deterministic data stream (epoch ``e``'s batch order
+  is a seeded permutation — the EpochPrefetcher rewind analog), with
+  the in-epoch skip replay on resume;
+- a ``CheckpointWriter`` write-behind snapshot every ``--ckpt_every``
+  steps carrying the exact ``data_state``;
+- a ``PreemptionHandler`` whose safe point lands a final snapshot and
+  exits ``128 + signum``;
+- a ``RestartNarrator`` restart timeline plus a minimal (schema-
+  valid) metrics stream, so ``dtx-obs report`` over the logs dir
+  shows the preempt/resume events.
+
+Pure numpy — the whole point is that the resilience subsystem (and
+this acceptance) runs on environments whose jax predates the repo's
+stack.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_tensorflow_example_tpu.obs.schema import (  # noqa: E402
+    SCHEMA_VERSION,
+)
+from distributed_tensorflow_example_tpu.resilience import (  # noqa: E402
+    resume as resume_lib,
+)
+from distributed_tensorflow_example_tpu.resilience.restart import (  # noqa: E402,E501
+    RestartNarrator,
+)
+from distributed_tensorflow_example_tpu.resilience.signals import (  # noqa: E402,E501
+    PreemptionHandler,
+)
+from distributed_tensorflow_example_tpu.resilience.writer import (  # noqa: E402,E501
+    CheckpointWriter,
+)
+
+
+def make_state(seed: int):
+    r = np.random.default_rng(seed)
+    return {
+        "W": r.standard_normal((16, 16)).astype(np.float32),
+        "b": r.standard_normal((16,)).astype(np.float32),
+        "frozen/emb": r.standard_normal((8, 8)).astype(np.float32),
+        "step": np.asarray(0, np.int64),
+    }
+
+
+def epoch_batches(seed: int, epoch: int, batches: int) -> np.ndarray:
+    """Epoch ``epoch``'s deterministic batch stream (the epoch-keyed
+    shuffle analog): a seeded permutation of per-batch scalars."""
+    r = np.random.default_rng((seed + 1) * 7919 + epoch)
+    return r.permutation(batches).astype(np.float32)
+
+
+def train_step(state, step: int, batch_val: float, seed: int):
+    """One deterministic update: depends on the state, the step index
+    and the CONSUMED batch — a resume that replays the wrong batch
+    diverges, which is what makes the digest comparison an exact-step
+    data-replay proof."""
+    r = np.random.default_rng((seed + 1) * 1000003 + step)
+    g = r.standard_normal(state["W"].shape).astype(np.float32)
+    state = dict(state)
+    state["W"] = (state["W"] * np.float32(0.999)
+                  + np.float32(0.01) * g
+                  + np.float32(1e-3) * np.float32(batch_val))
+    state["b"] = state["b"] + np.float32(1e-4) * np.float32(batch_val)
+    state["step"] = np.asarray(step, np.int64)
+    loss = float(np.mean(state["W"] * state["W"]))
+    return state, loss
+
+
+def state_digest(state) -> str:
+    h = hashlib.sha1()
+    for k in sorted(state):
+        a = np.ascontiguousarray(np.asarray(state[k]))
+        h.update(k.encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def emit_window(f, step: int, epoch: int, cost: float) -> None:
+    """One schema-valid metrics window row (hand-rolled: importing
+    the MetricsLogger would work too, but its device_memory probe
+    imports jax — this script must stay jax-free)."""
+    row = {"kind": "window", "v": SCHEMA_VERSION, "t": time.time(),
+           "proc": 0, "step": step, "epoch": epoch, "cost": cost,
+           "path": "sim", "steps": 1, "window_wall_s": 0.001,
+           "step_time_p50_ms": 1.0, "step_time_p95_ms": 1.0,
+           "step_time_max_ms": 1.0, "data_wait_s": 0.0, "h2d_s": 0.0,
+           "dispatch_s": 0.0, "device_wait_s": 0.001, "ckpt_s": 0.0,
+           "host_s": 0.0, "examples_per_sec": None,
+           "tokens_per_sec": None, "model_flops_per_step": 1,
+           "tflops_per_sec": None, "mfu": None, "rss_bytes": None,
+           "device_memory": None}
+    f.write(json.dumps(row) + "\n")
+    f.flush()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--ckpt_dir", required=True)
+    p.add_argument("--logs", required=True)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batches", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--ckpt_every", type=int, default=4)
+    p.add_argument("--ckpt_keep", type=int, default=0)
+    p.add_argument("--resume", default="")
+    p.add_argument("--step_ms", type=float, default=0.0,
+                   help="sleep per step (gives external killers a "
+                        "window)")
+    p.add_argument("--die_at_step", type=int, default=0,
+                   help="self-inject a failure after this step "
+                        "completes (0 = never)")
+    p.add_argument("--die_with", choices=["kill", "term"],
+                   default="kill",
+                   help="kill = SIGKILL (no cleanup, the between-"
+                        "snapshots torn case); term = SIGTERM to self "
+                        "(the graceful final-snapshot path)")
+    args = p.parse_args(argv)
+
+    os.makedirs(args.logs, exist_ok=True)
+    narrator = RestartNarrator(args.logs, process_index=0)
+    writer = CheckpointWriter(args.ckpt_dir, keep=args.ckpt_keep,
+                              grace_s=0.0,
+                              on_written=lambda s, st: narrator.emit(
+                                  "snapshot", step=int(s),
+                                  objects_written=st["objects_written"],
+                                  objects_reused=st["objects_reused"]))
+    handler = PreemptionHandler(
+        writer=writer,
+        on_signal=lambda sig: narrator.emit("preempt", signal=int(sig)))
+    handler.install()
+
+    total = args.epochs * args.batches
+    state = make_state(args.seed)
+    start_epoch, skip, steps_done = 0, 0, 0
+    if args.resume == "auto":
+        found = resume_lib.auto_resume(args.ckpt_dir)
+        if found is not None:
+            plan, flat = found
+            state = {k: flat[k] for k in state}
+            start_epoch = plan.epoch
+            skip = plan.batches_done
+            steps_done = plan.step
+            narrator.emit("resumed", step=plan.step, epoch=plan.epoch,
+                          batches_done=plan.batches_done)
+            print(f"resumed step={plan.step} epoch={plan.epoch} "
+                  f"skip={skip}")
+
+    losses_path = os.path.join(args.logs, "losses.jsonl")
+    metrics_path = os.path.join(args.logs, "metrics.0.jsonl")
+    with open(losses_path, "a") as lf, open(metrics_path, "a") as mf:
+        loss = float("nan")
+        for epoch in range(start_epoch, args.epochs):
+            data = epoch_batches(args.seed, epoch, args.batches)
+            start_i = skip if epoch == start_epoch else 0
+            # the in-epoch skip replay: resume_lib.skip_batches drops
+            # the consumed head of the epoch-keyed stream
+            feed = resume_lib.skip_batches(list(data), start_i)
+            for i, batch_val in enumerate(feed, start=start_i):
+                if handler.requested:
+                    writer.submit(steps_done, epoch,
+                                  dict(state),
+                                  data_state={"epoch": epoch,
+                                              "batches_done": i,
+                                              "steps_done": steps_done})
+                    writer.drain()
+                    print(f"preempted at step {steps_done}")
+                    handler.check()   # raises Preempted -> 128+sig
+                steps_done += 1
+                state, loss = train_step(state, steps_done,
+                                         float(batch_val), args.seed)
+                lf.write(json.dumps({"step": steps_done,
+                                     "loss": loss}) + "\n")
+                lf.flush()
+                if args.step_ms:
+                    time.sleep(args.step_ms / 1e3)
+                if steps_done % args.ckpt_every == 0:
+                    nxt_epoch = (epoch if i + 1 < args.batches
+                                 else epoch + 1)
+                    nxt_done = i + 1 if i + 1 < args.batches else 0
+                    writer.submit(steps_done, nxt_epoch, dict(state),
+                                  data_state={"epoch": nxt_epoch,
+                                              "batches_done": nxt_done,
+                                              "steps_done": steps_done})
+                if args.die_at_step and steps_done == args.die_at_step:
+                    # let the write-behind thread catch up first: the
+                    # injected kill must land BETWEEN durable
+                    # snapshots (killing a run whose writer never got
+                    # scheduled proves nothing about resume)
+                    writer.drain()
+                    if args.die_with == "kill":
+                        os.kill(os.getpid(), signal.SIGKILL)
+                    os.kill(os.getpid(), signal.SIGTERM)
+            emit_window(mf, steps_done, epoch, loss)
+        # exit snapshot + run_end, then the durable final record
+        writer.submit(steps_done, args.epochs, dict(state),
+                      data_state={"epoch": args.epochs,
+                                  "batches_done": 0,
+                                  "steps_done": steps_done})
+        writer.drain()
+        mf.write(json.dumps({"kind": "event", "v": SCHEMA_VERSION,
+                             "event": "run_end", "t": time.time(),
+                             "proc": 0, "steps": steps_done,
+                             "total_time_s": 0.01}) + "\n")
+    writer.close()
+    handler.uninstall()
+    with open(os.path.join(args.logs, "final.json"), "w") as f:
+        json.dump({"digest": state_digest(state), "steps": steps_done,
+                   "total": total}, f)
+    print(f"done steps={steps_done} digest={state_digest(state)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,6 +82,25 @@ def _stub_rows(monkeypatch):
                           "kv_quant_tok_s_base": 1196.3,
                           "kv_quant_tok_s_int8": 1432.3,
                           "kv_quant_greedy_match": True})
+    # the checkpoint row (r13) runs on EVERY backend: the write-
+    # behind stall + overhead A/B must reach the final line under
+    # their gate names
+    monkeypatch.setattr(
+        bench, "bench_checkpoint",
+        lambda *a, **kw: {"config": "checkpoint",
+                          "nockpt_step_ms": 5.2,
+                          "ckpt_step_ms": 5.6,
+                          "ckpt_overhead_ratio": 1.0769,
+                          "ckpt_stall_ms": 1.05,
+                          "ckpt_write_ms": 42.0,
+                          "ckpt_snapshots": 6,
+                          "ckpt_snapshots_coalesced": 2,
+                          "ckpt_objects_written": 50,
+                          "ckpt_objects_reused": 10,
+                          "ckpt_reuse_frac": 0.1667,
+                          "ckpt_bytes_written": 9999,
+                          "ckpt_state_bytes": 5308416,
+                          "ckpt_snapshots_per_run": 12})
     # the serving row (r9) runs on EVERY backend: analytic
     # continuous-vs-static tick accounting + the measured engine sweep
     monkeypatch.setattr(
@@ -213,6 +232,12 @@ def test_bench_main_cpu_stubbed(monkeypatch, capsys):
     assert final["decode_kv_bytes_per_step_int8"] == 1.34e8
     assert final["decode_kv_reduction_int8"] == 2.0
     assert final["kv_quant_greedy_match"] is True
+    # the r13 async-checkpoint carriage (every backend): submit stall
+    # + the with/without step ratio, gate-named, plus the incremental
+    # store's reuse evidence
+    assert final["ckpt_stall_ms"] == 1.05
+    assert final["ckpt_overhead_ratio"] == 1.0769
+    assert final["ckpt_reuse_frac"] == 0.1667
 
 
 def test_bench_main_all_configs_stubbed(monkeypatch, capsys):

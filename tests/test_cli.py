@@ -312,6 +312,62 @@ def test_quant_validation_matrix():
     bad("sites > 1", outer_quant="int8")
 
 
+def test_resilience_flags():
+    """--ckpt_every / --ckpt_keep / --resume (ISSUE 13) parse onto
+    their Config fields; the bare --resume keeps its legacy meaning
+    ("latest"), --resume=auto selects the exact-step path, and an
+    unknown mode is rejected at the CLI."""
+    import pytest
+
+    cfg = parse_config(["--checkpoint_dir=/tmp/c", "--ckpt_every=25",
+                        "--ckpt_keep=3", "--resume=auto"])
+    assert cfg.ckpt_every == 25 and cfg.ckpt_keep == 3
+    assert cfg.resume == "auto"
+    assert parse_config(["--resume"]).resume == "latest"
+    d = parse_config([])
+    assert d.ckpt_every == 0 and d.ckpt_keep == 0 and d.resume == ""
+    assert not d.resume  # the loop's truthiness contract
+    with pytest.raises(SystemExit):
+        parse_config(["--resume=sometimes"])
+
+
+def test_resilience_validation_matrix():
+    """The resilience validation matrix, pinned against
+    ``config.validate_resilience_config`` directly (pure config — no
+    training stack), the validate_pipeline_config pattern."""
+    import pytest
+
+    from distributed_tensorflow_example_tpu.config import (
+        Config, validate_resilience_config)
+
+    def ok(**kw):
+        validate_resilience_config(Config(**kw))
+
+    def bad(match, **kw):
+        with pytest.raises(ValueError, match=match):
+            validate_resilience_config(Config(**kw))
+
+    # ---- valid combinations ----
+    ok()                                          # defaults: all off
+    ok(checkpoint_dir="/tmp/c", ckpt_every=10)
+    ok(checkpoint_dir="/tmp/c", ckpt_every=10, ckpt_keep=3)
+    ok(checkpoint_dir="/tmp/c", ckpt_every=10, resume="auto")
+    ok(resume="latest")
+    ok(resume=True)                               # legacy bool
+    ok(resume=False)
+    ok(fsdp=True, resume="latest")                # classic formats
+
+    # ---- rejections ----
+    bad("expected", resume="sometimes")
+    bad("must be >= 0", ckpt_every=-1)
+    bad("must be >= 0", ckpt_keep=-1)
+    bad("needs --ckpt_every", ckpt_keep=2)
+    bad("needs --checkpoint_dir", ckpt_every=10)
+    bad("does not compose with --fsdp", checkpoint_dir="/tmp/c",
+        ckpt_every=10, fsdp=True)
+    bad("fsdp", resume="auto", fsdp=True)
+
+
 def test_r3_flag_surface_parses():
     """Every r3 flag parses and lands on its Config field."""
     from distributed_tensorflow_example_tpu.config import parse_config

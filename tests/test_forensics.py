@@ -478,7 +478,7 @@ def _full_window_fields():
                 window_wall_s=0.4, step_time_p50_ms=8.0,
                 step_time_p95_ms=9.5, step_time_max_ms=22.0,
                 data_wait_s=0.01, h2d_s=0.02, dispatch_s=0.1,
-                device_wait_s=0.2, host_s=0.07,
+                device_wait_s=0.2, ckpt_s=0.0, host_s=0.07,
                 examples_per_sec=1950.0, tokens_per_sec=None,
                 model_flops_per_step=4.8e6, tflops_per_sec=0.012,
                 mfu=None)

@@ -32,13 +32,13 @@ from distributed_tensorflow_example_tpu.obs.metrics import MetricsLogger
 
 def _window(step, epoch=0, steps=50, wall=4.0, data_wait=0.5,
             h2d=0.25, dispatch=1.0, device_wait=2.0, host=0.25,
-            cost=1.8, eps=1000.0, mfu=0.011):
+            cost=1.8, eps=1000.0, mfu=0.011, ckpt=0.0):
     return dict(step=step, epoch=epoch, cost=cost, path="host",
                 steps=steps, window_wall_s=wall,
                 step_time_p50_ms=80.0, step_time_p95_ms=95.0,
                 step_time_max_ms=120.0, data_wait_s=data_wait,
                 h2d_s=h2d, dispatch_s=dispatch,
-                device_wait_s=device_wait, host_s=host,
+                device_wait_s=device_wait, ckpt_s=ckpt, host_s=host,
                 examples_per_sec=eps, tokens_per_sec=None,
                 model_flops_per_step=4.8e6, tflops_per_sec=0.012,
                 mfu=mfu)
@@ -443,6 +443,36 @@ def test_compare_understands_quant_keys():
     assert ms["decode_kv_reduction_int8"] == 2.0
     assert ms["local_sgd_outer_quant_bytes_per_token"] == 4.248
     assert ms["local_sgd_outer_quant_reduction"] == 3.99
+
+
+def test_compare_understands_checkpoint_keys():
+    """The async-checkpoint keys (ISSUE 13): the bench_checkpoint row
+    gates on the submit stall and the with/without step ratio (keyed
+    on ckpt_write_ms, a row-only key — the final summary carries the
+    gate names too and must fall through to its own branch)."""
+    row = {"config": "checkpoint", "nockpt_step_ms": 5.2,
+           "ckpt_step_ms": 5.6, "ckpt_overhead_ratio": 1.0769,
+           "ckpt_stall_ms": 1.05, "ckpt_write_ms": 42.0,
+           "ckpt_snapshots": 6, "ckpt_reuse_frac": 0.1667}
+    m = cmp_lib.extract_metrics(row)
+    assert m == {"ckpt_stall_ms": 1.05,
+                 "ckpt_overhead_ratio": 1.0769}
+    # a doctored candidate whose submit stall ballooned gates (wide
+    # 25% A/B threshold)
+    worse = dict(row, ckpt_stall_ms=2.0, ckpt_overhead_ratio=1.6)
+    verdict = cmp_lib.compare(row, worse)
+    assert not verdict["ok"]
+    assert "ckpt_stall_ms" in verdict["regressions"]
+    assert "ckpt_overhead_ratio" in verdict["regressions"]
+    assert cmp_lib.compare(row, row)["ok"]
+    # final-summary shape: the keys ride ALONGSIDE wall_s — the
+    # summary must not be mistaken for a checkpoint row
+    summary = {"metric": "mnist_20epoch_wall_clock", "value": 0.15,
+               "ckpt_stall_ms": 1.05, "ckpt_overhead_ratio": 1.0769}
+    ms = cmp_lib.extract_metrics(summary)
+    assert ms["wall_s"] == 0.15
+    assert ms["ckpt_stall_ms"] == 1.05
+    assert ms["ckpt_overhead_ratio"] == 1.0769
 
 
 def test_compare_zero_baseline_stays_strict_json():

@@ -1,0 +1,669 @@
+"""Resilience subsystem unit suites (pure Python + numpy — these run
+on every environment, stack or not): the content-addressed snapshot
+store, the write-behind writer, the preemption handler, the restart
+policy/supervisor/narrator, the resume helpers, and the obs-side
+integration (run-start hygiene, goodput bucket, report timeline)."""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.obs import aggregate as agg_lib
+from distributed_tensorflow_example_tpu.obs import schema as schema_lib
+from distributed_tensorflow_example_tpu.obs.buckets import (
+    GOODPUT_BUCKETS,
+    RESTART_EVENTS,
+    WINDOW_BUCKETS,
+)
+from distributed_tensorflow_example_tpu.obs.heartbeat import (
+    clear_stale_signals,
+)
+from distributed_tensorflow_example_tpu.resilience import (
+    codec,
+    manifest as M,
+)
+from distributed_tensorflow_example_tpu.resilience import resume as resume_lib
+from distributed_tensorflow_example_tpu.resilience.restart import (
+    RestartNarrator,
+    RestartPolicy,
+    Supervisor,
+    backoff_s,
+    dead_procs,
+    read_restarts,
+)
+from distributed_tensorflow_example_tpu.resilience.signals import (
+    Preempted,
+    PreemptionHandler,
+)
+from distributed_tensorflow_example_tpu.resilience.writer import (
+    CheckpointWriter,
+)
+
+
+# --- codec -----------------------------------------------------------------
+
+
+def test_codec_native_dtypes_pass_through():
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    enc, name = codec.encode_array(a)
+    assert name is None and enc is a or np.array_equal(enc, a)
+    assert codec.bit_container_dtype(np.float32) is None
+    assert codec.bit_container_dtype(np.int64) is None
+
+
+def test_codec_bf16_bit_roundtrip():
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    a = np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    enc, name = codec.encode_array(a)
+    assert name == "bfloat16" and enc.dtype == np.uint16
+    back = codec.decode_array(enc, name)
+    assert back.dtype == a.dtype
+    np.testing.assert_array_equal(back.view(np.uint16),
+                                  a.view(np.uint16))
+
+
+# --- manifest store --------------------------------------------------------
+
+
+def _snap(step, w_val=1.0):
+    return {"W": np.full((4, 3), w_val, np.float32),
+            "frozen": np.ones((2, 2), np.float32),
+            "step": np.asarray(step, np.int64)}
+
+
+def test_persist_restore_roundtrip(tmp_path):
+    d = str(tmp_path)
+    M.persist_snapshot(d, 7, 1, _snap(7, 2.5), extras={"best": 0.9},
+                       data_state={"epoch": 1, "batches_done": 3,
+                                   "steps_done": 7})
+    man, root = M.newest_valid_snapshot(d)
+    data, step, epoch = M.restore_arrays(d, man)
+    assert (step, epoch) == (7, 1)
+    np.testing.assert_array_equal(data["W"], _snap(7, 2.5)["W"])
+    assert int(data["step"]) == 7
+    assert man["extras"] == {"best": 0.9}
+    assert man["data_state"]["batches_done"] == 3
+
+
+def test_incremental_reuse_skips_unchanged_leaves(tmp_path):
+    d = str(tmp_path)
+    s1 = M.persist_snapshot(d, 1, 0, _snap(1, 1.0))
+    s2 = M.persist_snapshot(d, 2, 0, _snap(2, 2.0))
+    # "frozen" is content-identical across snapshots: written once,
+    # reused after — the incremental claim
+    assert s1["objects_reused"] == 0
+    assert s2["objects_reused"] == 1
+    assert s2["objects_written"] == 2  # W changed + the step scalar
+
+
+def test_sharded_leaf_roundtrip_with_bounds(tmp_path):
+    d = str(tmp_path)
+    full = np.arange(24, dtype=np.float32).reshape(6, 4)
+    meta = {"W": {"shape": [6, 4], "dtype": "float32"}}
+    # two disjoint dim-0 shards, same part (single process)
+    snap = {"W": [([[0, 3], [0, 4]], full[:3]),
+                  ([[3, 6], [0, 4]], full[3:])],
+            "step": np.asarray(5, np.int64)}
+    M.persist_snapshot(d, 5, 0, snap, leaf_meta=meta)
+    man, _ = M.newest_valid_snapshot(d)
+    data, _, _ = M.restore_arrays(d, man)
+    np.testing.assert_array_equal(data["W"], full)
+
+
+def test_sharded_leaf_requires_meta_and_coverage(tmp_path):
+    d = str(tmp_path)
+    with pytest.raises(ValueError, match="leaf_meta"):
+        M.persist_snapshot(d, 1, 0,
+                           {"W": [([[0, 2], [0, 2]],
+                                   np.ones((2, 2), np.float32))]})
+    # a gap in coverage is rejected at restore
+    M.persist_snapshot(
+        d, 2, 0,
+        {"W": [([[0, 2], [0, 4]], np.ones((2, 4), np.float32))]},
+        leaf_meta={"W": {"shape": [6, 4], "dtype": "float32"}})
+    man, _ = M.newest_valid_snapshot(d)
+    with pytest.raises(ValueError, match="does not cover"):
+        M.restore_arrays(d, man)
+
+
+def test_torn_newest_falls_back_to_previous_valid(tmp_path):
+    d = str(tmp_path)
+    M.persist_snapshot(d, 1, 0, _snap(1, 1.0))
+    M.persist_snapshot(d, 2, 0, _snap(2, 2.0))
+    man2, _ = M.newest_valid_snapshot(d)
+    assert man2["step"] == 2
+    # tear the newest three ways; each falls back to step 1
+    part = M.load_manifest(os.path.join(d, man2["parts"][0]))
+    obj = part["entries"]["W"][0]["object"]
+    os.remove(os.path.join(d, M.OBJECTS_DIR, obj))
+    assert M.newest_valid_snapshot(d)[0]["step"] == 1
+    M.persist_snapshot(d, 3, 0, _snap(3, 3.0))
+    os.remove(os.path.join(d, M.part_name(3, 0)))
+    assert M.newest_valid_snapshot(d)[0]["step"] == 1
+    M.persist_snapshot(d, 4, 0, _snap(4, 4.0))
+    with open(os.path.join(d, M.root_name(4)), "w") as f:
+        f.write('{"torn')
+    assert M.newest_valid_snapshot(d)[0]["step"] == 1
+
+
+def test_kill9_mid_write_leaves_no_visible_snapshot(tmp_path):
+    # the root-written-last discipline: objects + part present but no
+    # root (the state a SIGKILL mid-save leaves) -> invisible
+    d = str(tmp_path)
+    snap = _snap(1, 1.0)
+    entries = {}
+    for k, v in snap.items():
+        enc, name = codec.encode_array(np.asarray(v))
+        obj, _ = M.write_object(d, enc)
+        entries[k] = [{"object": obj, "bounds": None, "enc": name}]
+    M.write_part(d, 1, 0, entries)
+    assert M.list_snapshots(d) == []
+    assert M.newest_valid_snapshot(d) is None
+
+
+def test_prune_keeps_k_and_gcs_unreferenced_objects(tmp_path):
+    d = str(tmp_path)
+    for s in range(1, 5):
+        M.persist_snapshot(d, s, 0, _snap(s, float(s)))
+    out = M.prune_snapshots(d, keep=2, grace_s=0.0)
+    assert out["roots_deleted"] == 2 and out["parts_deleted"] == 2
+    assert [s for s, _ in M.list_snapshots(d)] == [3, 4]
+    # the shared "frozen" object survives (still referenced); the
+    # pruned snapshots' unique objects (each W + each step scalar)
+    # are collected
+    assert out["objects_deleted"] == 4
+    man, _ = M.newest_valid_snapshot(d)
+    data, _, _ = M.restore_arrays(d, man)  # closure intact after GC
+    np.testing.assert_array_equal(data["frozen"],
+                                  np.ones((2, 2), np.float32))
+    # keep=0 means keep everything
+    assert M.prune_snapshots(d, keep=0)["roots_deleted"] == 0
+
+
+def test_prune_spares_in_flight_newer_snapshot(tmp_path):
+    # multi-process race: the chief's root for step 5 landed but a
+    # peer's part has not — the snapshot reads torn, but it is NEWER
+    # than the kept horizon and may still be landing. Prune must not
+    # destroy it (over-retention is the safe direction, the classic
+    # sharded format's call).
+    d = str(tmp_path)
+    for s in (1, 2, 3):
+        M.persist_snapshot(d, s, 0, _snap(s, float(s)))
+    M.persist_snapshot(d, 5, 0, _snap(5, 5.0), nprocs=2)  # part 1 absent
+    assert not snapshot_or_none_valid(d, 5)
+    out = M.prune_snapshots(d, keep=2, grace_s=0.0)
+    assert out["roots_deleted"] == 1  # only step 1 (older than kept)
+    assert os.path.exists(os.path.join(d, M.root_name(5)))
+    assert os.path.exists(os.path.join(d, M.part_name(5, 0)))
+    # a rootless part newer than the horizon survives too
+    M.write_part(d, 7, 0, {})
+    M.prune_snapshots(d, keep=2, grace_s=0.0)
+    assert os.path.exists(os.path.join(d, M.part_name(7, 0)))
+
+
+def snapshot_or_none_valid(d, step):
+    try:
+        return M.snapshot_valid(
+            d, M.load_manifest(os.path.join(d, M.root_name(step))))
+    except OSError:
+        return False
+
+
+def test_prune_grace_spares_young_objects(tmp_path):
+    d = str(tmp_path)
+    M.persist_snapshot(d, 1, 0, _snap(1, 1.0))
+    M.persist_snapshot(d, 2, 0, _snap(2, 2.0))
+    out = M.prune_snapshots(d, keep=1, grace_s=3600.0)
+    # snapshot 1's manifests go, but its freshly-written objects are
+    # inside the grace window (a concurrent writer's protection)
+    assert out["roots_deleted"] == 1
+    assert out["objects_deleted"] == 0
+
+
+# --- writer ----------------------------------------------------------------
+
+
+def test_writer_basic_and_stats(tmp_path):
+    w = CheckpointWriter(str(tmp_path), keep=0)
+    w.submit(3, 0, _snap(3, 1.5), extras={"a": 1.0},
+             data_state={"epoch": 0, "batches_done": 3,
+                         "steps_done": 3})
+    assert w.drain(timeout=30)
+    s = w.stats()
+    assert s["submitted"] == 1 and s["written"] == 1
+    assert s["last_step"] == 3
+    assert s["ckpt_stall_ms_mean"] >= 0
+    w.close()
+    man, _ = M.newest_valid_snapshot(str(tmp_path))
+    assert man["step"] == 3 and man["extras"] == {"a": 1.0}
+    with pytest.raises(RuntimeError, match="closed"):
+        w.submit(4, 0, _snap(4))
+
+
+def test_writer_coalesces_when_behind(tmp_path):
+    w = CheckpointWriter(str(tmp_path))
+    gate = threading.Event()
+    w._pre_persist = gate.wait  # block the writer thread's persists
+    for s in range(1, 6):
+        w.submit(s, 0, _snap(s, float(s)))
+    gate.set()
+    w.drain(timeout=30)
+    w.close()
+    st = w.stats()
+    # latest wins: intermediate pending snapshots were replaced
+    assert st["coalesced"] >= 3
+    assert st["written"] < 5
+    man, _ = M.newest_valid_snapshot(str(tmp_path))
+    assert man["step"] == 5  # the NEWEST snapshot is the durable one
+
+
+def test_writer_copy_isolates_in_place_mutation(tmp_path):
+    st = {"W": np.ones((3, 3), np.float32)}
+    w = CheckpointWriter(str(tmp_path), copy=True)
+    gate = threading.Event()
+    w._pre_persist = gate.wait
+    w.submit(1, 0, st)
+    st["W"] *= 99.0  # numpy trainer mutates in place after submit
+    gate.set()
+    w.drain(timeout=30)
+    w.close()
+    man, _ = M.newest_valid_snapshot(str(tmp_path))
+    data, _, _ = M.restore_arrays(str(tmp_path), man)
+    np.testing.assert_array_equal(data["W"], np.ones((3, 3), np.float32))
+
+
+def test_writer_error_surfaces_on_drain(tmp_path):
+    w = CheckpointWriter(str(tmp_path))
+
+    def boom():
+        raise OSError("disk full")
+
+    w._pre_persist = boom
+    w.submit(1, 0, _snap(1))
+    with pytest.raises(RuntimeError, match="background checkpoint"):
+        w.drain(timeout=30)
+    # a checkpoint that silently failed must not look durable
+    assert M.newest_valid_snapshot(str(tmp_path)) is None
+    # the consumer is dead: a later submit must RAISE, never enqueue
+    # into a slot nothing will drain (a timeout-less drain at the
+    # preemption safe point would otherwise hang forever)
+    with pytest.raises(RuntimeError):
+        w.submit(2, 0, _snap(2))
+    assert w.drain(timeout=5)  # idle stays set — no hang
+    w.close(drain=False)
+
+
+def test_writer_retention_rides_the_writer_thread(tmp_path):
+    w = CheckpointWriter(str(tmp_path), keep=2, grace_s=0.0)
+    for s in (2, 4, 6, 8):
+        w.submit(s, 0, _snap(s, float(s)))
+        w.drain(timeout=30)
+    w.close()
+    assert [s for s, _ in M.list_snapshots(str(tmp_path))] == [6, 8]
+
+
+# --- signals ---------------------------------------------------------------
+
+
+def test_preemption_handler_sigterm_safe_point(tmp_path):
+    w = CheckpointWriter(str(tmp_path))
+    events = []
+    h = PreemptionHandler(writer=w, on_signal=events.append)
+    prev = signal.getsignal(signal.SIGTERM)
+    h.install()
+    try:
+        assert not h.requested
+        h.check()  # no-op before a signal
+        os.kill(os.getpid(), signal.SIGTERM)  # delivered synchronously
+        assert h.requested and h.signum == signal.SIGTERM
+        assert events == [signal.SIGTERM]
+        assert h.signal_name() == "SIGTERM"
+        with pytest.raises(Preempted) as ei:
+            h.check()
+        assert ei.value.code == 128 + signal.SIGTERM  # 143
+    finally:
+        h.uninstall()
+        w.close()
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_preemption_handler_sigint_graceful_then_escalates():
+    # first Ctrl-C must NOT raise KeyboardInterrupt mid-bytecode (the
+    # safe point would never land the final snapshot); the second one
+    # escalates — the operator asked twice
+    orig = signal.signal(signal.SIGINT, signal.default_int_handler)
+    h = PreemptionHandler()
+    h.install()
+    try:
+        os.kill(os.getpid(), signal.SIGINT)   # no KeyboardInterrupt
+        assert h.requested and h.signum == signal.SIGINT
+        # a same-burst duplicate (supervisors signal the process
+        # group) stays graceful — only a LATER repeat escalates
+        os.kill(os.getpid(), signal.SIGINT)
+        h.signal_t -= 2 * PreemptionHandler.ESCALATE_S
+        with pytest.raises(KeyboardInterrupt):
+            os.kill(os.getpid(), signal.SIGINT)
+    finally:
+        h.uninstall()
+        signal.signal(signal.SIGINT, orig)
+
+
+def test_writer_copy_isolates_sharded_leaves_too(tmp_path):
+    full = np.ones((4, 2), np.float32)
+    st = {"W": [([[0, 4], [0, 2]], full)]}
+    w = CheckpointWriter(str(tmp_path), copy=True)
+    gate = threading.Event()
+    w._pre_persist = gate.wait
+    w.submit(1, 0, st,
+             leaf_meta={"W": {"shape": [4, 2], "dtype": "float32"}})
+    full *= 7.0   # in-place mutation after submit
+    gate.set()
+    w.drain(timeout=30)
+    w.close()
+    man, _ = M.newest_valid_snapshot(str(tmp_path))
+    data, _, _ = M.restore_arrays(str(tmp_path), man)
+    np.testing.assert_array_equal(data["W"], np.ones((4, 2), np.float32))
+
+
+def test_preemption_handler_chains_previous_handler():
+    hits = []
+    orig = signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+    h = PreemptionHandler()
+    h.install()
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert hits == [signal.SIGTERM]  # the previous handler ran too
+    finally:
+        h.uninstall()
+        signal.signal(signal.SIGTERM, orig)
+
+
+# --- restart policy / narrator / supervisor --------------------------------
+
+
+def test_backoff_closed_form():
+    assert backoff_s(0) == 1.0
+    assert backoff_s(3) == 8.0
+    assert backoff_s(10) == 60.0  # capped
+    assert backoff_s(2, base_s=0.5, factor=3.0, cap_s=100.0) == 4.5
+    with pytest.raises(ValueError):
+        backoff_s(-1)
+
+
+def test_policy_decision_matrix():
+    p = RestartPolicy(max_retries=2, backoff_base_s=1.0,
+                      backoff_factor=2.0, backoff_max_s=60.0, min_dp=2)
+    # inside the retry budget: same width, exponential waits
+    d0 = p.decide(0, alive=4, dp=4)
+    d1 = p.decide(1, alive=4, dp=4)
+    assert (d0.action, d0.wait_s, d0.attempt) == ("retry", 1.0, 1)
+    assert (d1.action, d1.wait_s, d1.attempt) == ("retry", 2.0, 2)
+    # budget exhausted + dead peers -> reform at the surviving width
+    d2 = p.decide(2, alive=3, dp=4, dead=(3,))
+    assert (d2.action, d2.dp, d2.attempt) == ("reform", 3, 0)
+    # budget exhausted, nobody dead -> nothing to shed
+    assert p.decide(2, alive=4, dp=4).action == "give_up"
+    # below min_dp -> give up
+    assert p.decide(2, alive=1, dp=4, dead=(1, 2, 3)).action == "give_up"
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError):
+        RestartPolicy(max_retries=-1)
+    with pytest.raises(ValueError):
+        RestartPolicy(min_dp=0)
+    with pytest.raises(ValueError):
+        RestartPolicy(backoff_factor=0.5)
+
+
+def test_dead_procs_threshold():
+    now = 1000.0
+    beats = {0: (50, 995.0), 1: (48, 960.0), 2: (50, 999.0)}
+    assert dead_procs(beats, now=now, dead_after_s=30.0) == [1]
+    assert dead_procs(beats, now=now, dead_after_s=60.0) == []
+    assert dead_procs({}, now=now) == []
+    # the since= fence: a --resume relaunch keeps the preempted
+    # attempt's stale beats on purpose — a peer that has not beaten
+    # THIS attempt yet (still compiling) must not read as dead
+    stale = {0: (50, 995.0), 1: (48, 100.0)}  # proc 1: previous run
+    assert dead_procs(stale, now=now, dead_after_s=30.0,
+                      since=900.0) == []
+    assert dead_procs(stale, now=now, dead_after_s=30.0) == [1]
+
+
+def test_narrator_roundtrip_and_contract(tmp_path):
+    n = RestartNarrator(str(tmp_path), process_index=2)
+    row = n.emit("preempt", signal=15)
+    n.emit("snapshot", step=8, objects_written=3, objects_reused=1)
+    with pytest.raises(ValueError, match="unknown restart event"):
+        n.emit("nonsense")
+    rows = read_restarts(str(tmp_path))
+    assert [r["event"] for r in rows] == ["preempt", "snapshot"]
+    assert rows[0]["proc"] == 2
+    assert schema_lib.validate_restart_row(row) == []
+    assert schema_lib.validate_restart_file(n.path) == []
+    # version-first diagnosis + vocabulary enforcement
+    bad = dict(row, v=1)
+    assert "schema v1" in schema_lib.validate_restart_row(bad)[0]
+    bad2 = dict(row, event="bogus")
+    assert any("unknown restart event" in e
+               for e in schema_lib.validate_restart_row(bad2))
+    # torn line tolerated by the reader, flagged by the validator
+    with open(n.path, "a") as f:
+        f.write('{"torn')
+    assert len(read_restarts(str(tmp_path))) == 2
+    assert schema_lib.validate_restart_file(n.path) != []
+
+
+def test_supervisor_retry_then_success(tmp_path):
+    codes = [1, 1, 0]
+    sleeps = []
+    sup = Supervisor(RestartPolicy(max_retries=3),
+                     narrator=RestartNarrator(str(tmp_path)),
+                     sleep=sleeps.append)
+    res = sup.run(lambda plan: codes.pop(0), dp=4)
+    assert res["completed"] and res["attempts"] == 3 and res["dp"] == 4
+    assert sleeps == [1.0, 2.0]  # the closed-form backoff schedule
+    evs = [r["event"] for r in read_restarts(str(tmp_path))]
+    assert evs == ["attempt_start", "attempt_exit", "retry",
+                   "attempt_start", "attempt_exit", "retry",
+                   "attempt_start", "attempt_exit"]
+
+
+def test_supervisor_reforms_at_surviving_width(tmp_path):
+    launches = []
+
+    def launch(plan):
+        launches.append((plan["attempt"], plan["dp"]))
+        # fails at dp=4 every time; completes once reformed to dp=3
+        return 0 if plan["dp"] == 3 else 1
+
+    sup = Supervisor(
+        RestartPolicy(max_retries=1, backoff_base_s=0.0,
+                      backoff_max_s=0.0),
+        narrator=RestartNarrator(str(tmp_path)), sleep=lambda s: None)
+    res = sup.run(launch, dp=4,
+                  health=lambda: {"alive": 3, "dead": [2]})
+    assert res["completed"] and res["dp"] == 3
+    assert launches == [(0, 4), (1, 4), (0, 3)]
+    evs = [r["event"] for r in read_restarts(str(tmp_path))]
+    assert "reform" in evs and "dead_proc" in evs
+
+
+def test_supervisor_gives_up(tmp_path):
+    sup = Supervisor(RestartPolicy(max_retries=0, min_dp=4),
+                     sleep=lambda s: None)
+    res = sup.run(lambda plan: 9, dp=4,
+                  health=lambda: {"alive": 2, "dead": [2, 3]})
+    assert not res["completed"] and res["exit_code"] == 9
+    assert res["decisions"][-1].action == "give_up"
+
+
+# --- resume helpers --------------------------------------------------------
+
+
+def test_skip_batches_exact_and_short_epoch():
+    assert list(resume_lib.skip_batches(iter(range(5)), 2)) == [2, 3, 4]
+    assert list(resume_lib.skip_batches(range(3), 0)) == [0, 1, 2]
+    with pytest.raises(RuntimeError, match="data pipeline"):
+        resume_lib.skip_batches(iter(range(2)), 5)
+
+
+def test_auto_resume_walks_back_past_unrestorable_payload(tmp_path):
+    # manifest validity covers file EXISTENCE; a power loss can leave
+    # a visible object with a torn payload — the restore failure must
+    # fall back to the previous snapshot, not kill the relaunch
+    d = str(tmp_path)
+    M.persist_snapshot(d, 1, 0, _snap(1, 1.0),
+                       data_state={"epoch": 0, "batches_done": 1,
+                                   "steps_done": 1})
+    M.persist_snapshot(d, 2, 0, _snap(2, 2.0))
+    part = M.load_manifest(os.path.join(d, M.part_name(2, 0)))
+    obj = part["entries"]["W"][0]["object"]
+    with open(os.path.join(d, M.OBJECTS_DIR, obj), "wb") as f:
+        f.write(b"\x93NUMPY")  # truncated payload, file still exists
+    plan, flat = resume_lib.auto_resume(d)
+    assert plan.step == 1
+    np.testing.assert_array_equal(flat["W"], _snap(1, 1.0)["W"])
+
+
+def test_prune_sweeps_orphaned_tmp_files(tmp_path):
+    # a kill -9 between the tmp write and the rename strands
+    # '<name>.tmp<pid>' files; the GC must sweep them past the grace
+    d = str(tmp_path)
+    M.persist_snapshot(d, 1, 0, _snap(1, 1.0))
+    M.persist_snapshot(d, 2, 0, _snap(2, 2.0))
+    orphan_obj = os.path.join(d, M.OBJECTS_DIR, "deadbeef.npy.tmp123")
+    orphan_root = os.path.join(d, "snap-00000009.json.tmp123")
+    for p in (orphan_obj, orphan_root):
+        with open(p, "wb") as f:
+            f.write(b"x" * 64)
+    M.prune_snapshots(d, keep=2, grace_s=3600.0)
+    assert os.path.exists(orphan_obj)      # inside the grace window
+    M.prune_snapshots(d, keep=2, grace_s=0.0)
+    assert not os.path.exists(orphan_obj)
+    assert not os.path.exists(orphan_root)
+
+
+def test_dead_procs_is_fleet_relative():
+    # a fleet whose windows ALL take minutes must not read as
+    # collectively dead: the reference is the front-runner's beat
+    now = 1000.0
+    slow_fleet = {0: (10, 700.0), 1: (10, 702.0), 2: (10, 699.0)}
+    assert dead_procs(slow_fleet, now=now, dead_after_s=30.0) == []
+    # ... but a peer the rest of the fleet beat past IS dead
+    one_dead = {0: (10, 990.0), 1: (10, 991.0), 2: (4, 700.0)}
+    assert dead_procs(one_dead, now=now, dead_after_s=30.0) == [2]
+
+
+def test_auto_resume_empty_dir_and_plan(tmp_path):
+    assert resume_lib.auto_resume(str(tmp_path)) is None
+    M.persist_snapshot(str(tmp_path), 9, 2, _snap(9, 3.0),
+                       extras={"best_val": 0.7},
+                       data_state={"epoch": 2, "batches_done": 1,
+                                   "steps_done": 9})
+    plan, flat = resume_lib.auto_resume(str(tmp_path))
+    assert (plan.step, plan.epoch, plan.batches_done) == (9, 2, 1)
+    assert plan.extras == {"best_val": 0.7}
+    assert int(flat["step"]) == 9
+
+
+# --- obs integration -------------------------------------------------------
+
+
+def test_clear_stale_signals_spares_resume_state(tmp_path):
+    d = str(tmp_path)
+    fdir = os.path.join(d, "flight")
+    os.makedirs(fdir)
+    for p in range(2):
+        with open(os.path.join(d, f"heartbeat.{p}"), "w") as f:
+            json.dump({"proc": p, "step": 5, "t": 1.0}, f)
+    with open(os.path.join(fdir, "0.json"), "w") as f:
+        json.dump({"reason": "sigterm", "proc": 0}, f)
+    with open(os.path.join(fdir, "1.json"), "w") as f:
+        json.dump({"reason": "crash", "proc": 1}, f)
+    RestartNarrator(d).emit("preempt", signal=15)
+    # resuming: heartbeats + the preemption dump + the restart
+    # timeline survive; the crash dump clears
+    removed = clear_stale_signals(d, resuming=True)
+    assert removed == 1
+    assert os.path.exists(os.path.join(d, "heartbeat.0"))
+    assert os.path.exists(os.path.join(fdir, "0.json"))
+    assert not os.path.exists(os.path.join(fdir, "1.json"))
+    assert os.path.exists(os.path.join(d, "restarts.jsonl"))
+    # a fresh run still clears everything (the original contract)
+    removed = clear_stale_signals(d, resuming=False)
+    assert removed == 3
+    assert not os.path.exists(os.path.join(d, "heartbeat.0"))
+    assert not os.path.exists(os.path.join(fdir, "0.json"))
+    assert os.path.exists(os.path.join(d, "restarts.jsonl"))
+
+
+def test_ckpt_bucket_registered_everywhere():
+    assert "ckpt" in WINDOW_BUCKETS and "ckpt" in GOODPUT_BUCKETS
+    assert "ckpt_s" in schema_lib.METRICS_WINDOW
+    assert set(RESTART_EVENTS) >= {"preempt", "snapshot", "resumed",
+                                   "retry", "reform", "give_up"}
+    from distributed_tensorflow_example_tpu.obs.metrics import WindowTimer
+
+    t = WindowTimer()
+    t.charge("ckpt", 0.25)
+    t.step_done()
+    row = t.window_row()
+    assert row["ckpt_s"] == 0.25
+
+
+def _write_metrics_stream(logs, ckpt_s=0.5):
+    row = {"kind": "window", "v": schema_lib.SCHEMA_VERSION, "t": 10.0,
+           "proc": 0, "step": 8, "epoch": 0, "cost": 1.0,
+           "path": "host", "steps": 8, "window_wall_s": 8.0,
+           "step_time_p50_ms": 1000.0, "step_time_p95_ms": 1000.0,
+           "step_time_max_ms": 1000.0, "data_wait_s": 1.0,
+           "h2d_s": 0.5, "dispatch_s": 2.0, "device_wait_s": 3.0,
+           "ckpt_s": ckpt_s, "host_s": 1.0, "examples_per_sec": 10.0,
+           "tokens_per_sec": None, "model_flops_per_step": 100,
+           "tflops_per_sec": None, "mfu": 0.1, "rss_bytes": None,
+           "device_memory": None}
+    end = {"kind": "event", "v": schema_lib.SCHEMA_VERSION,
+           "event": "run_end", "t": 20.0, "proc": 0, "steps": 8,
+           "total_time_s": 10.0, "compile_s": 1.0, "eval_s": 0.5,
+           "sample_s": 0.0}
+    with open(os.path.join(logs, "metrics.0.jsonl"), "w") as f:
+        f.write(json.dumps(row) + "\n")
+        f.write(json.dumps(end) + "\n")
+
+
+def test_aggregate_folds_restart_timeline_and_ckpt_bucket(tmp_path):
+    logs = str(tmp_path)
+    _write_metrics_stream(logs, ckpt_s=0.5)
+    n = RestartNarrator(logs)
+    n.emit("preempt", signal=15, step=6)
+    n.emit("snapshot", step=6)
+    n.emit("resumed", step=6, epoch=0, batches_done=6)
+    report = agg_lib.aggregate(logs, now=30.0)
+    assert report["schema_error_count"] == 0
+    assert report["restarts"]["preemptions"] == 1
+    assert report["restarts"]["resumes"] == 1
+    assert report["restarts"]["snapshots"] == 1
+    kinds = [e for e in report["timeline"] if e["kind"] == "restart"]
+    assert [e["event"] for e in kinds] == ["preempt", "snapshot",
+                                          "resumed"]
+    g = report["goodput"]["buckets"]
+    assert g["ckpt"] == 0.5
+    assert set(g) == set(GOODPUT_BUCKETS)
+    assert schema_lib.validate_run_report(report) == []
+    line = agg_lib.summary_line(report)
+    assert "restarts[preempt=1 resume=1" in line
+
+
+def test_aggregate_without_restarts_is_quiet(tmp_path):
+    logs = str(tmp_path)
+    _write_metrics_stream(logs, ckpt_s=0.0)
+    report = agg_lib.aggregate(logs, now=30.0)
+    assert report["restarts"]["events"] == 0
+    assert "restarts[" not in agg_lib.summary_line(report)

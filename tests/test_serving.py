@@ -516,8 +516,12 @@ def test_engine_loop_failure_fails_pending_fast(lm, monkeypatch):
     monkeypatch.setattr(
         eng, "step",
         lambda: (_ for _ in ()).throw(RuntimeError("boom tick")))
-    eng.start()
+    # queue BEFORE starting the doomed loop: with start() first the
+    # background thread can die before submit runs, and submit then
+    # (correctly) refuses a failed engine — a timing flake, not the
+    # pending-request scenario this test pins
     rid = eng.submit([1, 2, 3], 4)
+    eng.start()
     res = eng.result(rid, timeout=10.0)
     assert res is not None and "boom tick" in res["error"]
     with pytest.raises(RuntimeError, match="boom tick"):
@@ -736,8 +740,11 @@ def test_engine_loop_failure_emits_error_spans(lm, tmp_path,
     monkeypatch.setattr(
         eng, "step",
         lambda: (_ for _ in ()).throw(RuntimeError("boom tick")))
-    eng.start()
+    # queue BEFORE starting the doomed loop (the same race the
+    # pending-fast test documents): the in-flight-death scenario
+    # needs the request accepted first
     rid = eng.submit([1, 2, 3], 4)
+    eng.start()
     assert "boom tick" in eng.result(rid, timeout=10.0)["error"]
     eng.stop()
     rec.close()
