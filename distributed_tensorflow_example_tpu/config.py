@@ -387,6 +387,36 @@ class Config:
                                     # ttft_p99_ms / latency_p99_ms /
                                     # error_rate (obs/slo.py; "" =
                                     # the documented defaults)
+    deadline_ms: float = 0.0        # dtx-serve: default per-request
+                                    # deadline (0 = none); past it the
+                                    # scheduler retires the request
+                                    # with a typed timeout terminal,
+                                    # frees its KV pages and /generate
+                                    # answers 504; a request's own
+                                    # deadline_ms field overrides
+    max_queue: int = 0              # dtx-serve: bound on the pending
+                                    # queue (0 = unbounded); a submit
+                                    # past it is SHED — typed 503 +
+                                    # Retry-After — instead of growing
+                                    # memory without limit
+    brownout: str = ""              # dtx-serve graceful degradation:
+                                    # "" = off, "on" = defaults, or
+                                    # "occ=0.9,occ_lo=0.75,burn=2.0,
+                                    # clamp=8,admit=1" — while page
+                                    # occupancy/SLO burn is over
+                                    # threshold, new admissions'
+                                    # max_new_tokens are clamped and
+                                    # admission width capped
+                                    # (serving/admission.py)
+    engine_retries: int = 0         # dtx-serve: > 0 arms engine
+                                    # SUPERVISION — a crashed decode
+                                    # loop restarts with bounded
+                                    # backoff and re-queues in-flight
+                                    # requests (pages freed, prefill
+                                    # re-run) at most this many times
+                                    # each before a typed failed
+                                    # terminal; 0 = fail-closed
+                                    # (today's behavior)
 
     # ---- validation / early stopping (beyond-reference) ----
     early_stop_patience: int = 0    # > 0: evaluate the validation split
@@ -818,6 +848,36 @@ def build_parser() -> argparse.ArgumentParser:
                         "gauges: comma-separated NAME<=VALUE with "
                         "NAME one of ttft_p99_ms / latency_p99_ms / "
                         "error_rate (obs/slo.py; empty = defaults)")
+    p.add_argument("--deadline_ms", type=float, default=d.deadline_ms,
+                   help="dtx-serve: default per-request deadline in "
+                        "milliseconds (0 = none; a request's own "
+                        "deadline_ms field overrides) — past it the "
+                        "scheduler frees the request's pages and "
+                        "retires it with a typed timeout terminal "
+                        "(POST /generate answers 504)")
+    p.add_argument("--max_queue", type=int, default=d.max_queue,
+                   help="dtx-serve: bound on the pending request "
+                        "queue (0 = unbounded); a submit past the "
+                        "bound is shed with a typed 503 + "
+                        "Retry-After instead of growing the queue "
+                        "without limit")
+    p.add_argument("--brownout", type=str, default=d.brownout,
+                   help="dtx-serve graceful degradation (serving/"
+                        "admission.py): empty = off, 'on' = the "
+                        "documented defaults, or key=value pairs "
+                        "over occ/occ_lo/burn/clamp/admit — while "
+                        "KV page occupancy or the fast-window SLO "
+                        "burn rate is over threshold, new "
+                        "admissions' max_new_tokens are clamped and "
+                        "admission width is capped per tick")
+    p.add_argument("--engine_retries", type=int,
+                   default=d.engine_retries,
+                   help="dtx-serve: > 0 arms engine supervision — a "
+                        "crashed decode loop restarts with bounded "
+                        "backoff, re-queueing in-flight requests "
+                        "(pages freed, prefill re-run) at most this "
+                        "many times each before a typed failed "
+                        "terminal; 0 keeps the fail-closed behavior")
     p.add_argument("--early_stop_patience", type=int,
                    default=d.early_stop_patience,
                    help="stop after P epochs without validation "
@@ -1073,6 +1133,31 @@ def validate_quant_config(cfg: Config) -> None:
         raise ValueError(
             "--outer_quant compresses the cross-site outer "
             "pseudo-gradient sync; it needs --sites > 1")
+
+
+def validate_serving_config(cfg: Config) -> None:
+    """The fail-open serving matrix (--deadline_ms / --max_queue /
+    --brownout / --engine_retries) — pure config checks, raised
+    before any bootstrap work (the validate_pipeline_config pattern;
+    ``tests/test_cli.py`` pins it without the training stack).  Only
+    dtx-serve consults these flags; training ignores them, so the
+    checks are value-shape only plus the brownout DSL parse
+    (serving/admission.py, pure Python — no jax is pulled in)."""
+    if cfg.deadline_ms < 0:
+        raise ValueError(
+            f"deadline_ms={cfg.deadline_ms} must be >= 0 (0 = no "
+            f"default deadline)")
+    if cfg.max_queue < 0:
+        raise ValueError(
+            f"max_queue={cfg.max_queue} must be >= 0 (0 = unbounded)")
+    if cfg.engine_retries < 0:
+        raise ValueError(
+            f"engine_retries={cfg.engine_retries} must be >= 0 (0 = "
+            f"fail-closed, no supervision)")
+    from .serving.admission import parse_brownout
+
+    # raises ValueError with the offending part on a malformed DSL
+    parse_brownout(cfg.brownout)
 
 
 def validate_resilience_config(cfg: Config) -> None:

@@ -333,6 +333,9 @@ def aggregate(logs_path: str, max_trajectory: int = 200,
         "retries": rk.count("retry"),
         "reforms": rk.count("reform"),
         "gave_up": rk.count("give_up"),
+        # the serving supervisor's entries (PR 15): decode-engine
+        # loop deaths restarted in place with in-flight re-queued
+        "engine_restarts": rk.count("engine_restart"),
     }
 
     now = time.time() if now is None else now

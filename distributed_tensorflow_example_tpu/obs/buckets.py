@@ -87,8 +87,21 @@ GOODPUT_BUCKETS = ("train", "compile", "data_wait", "h2d", "ckpt",
 # WindowTimer.charge discipline) and obs/schema.py pins the per-event
 # field contract, so a drifted event name fails at the emit site, not
 # in a consumer months later.
+#
+# The fail-open terminals and supervision records (PR 15): every
+# accepted request ends in EXACTLY ONE of retire ("result") /
+# "timeout" (deadline expiry or client cancel — reason says which) /
+# "shed" (bounded-queue rejection, the only terminal without a
+# submit: the request was never accepted) / "failed" (the supervised
+# engine's per-request retry budget spent, or — via the legacy
+# "error" event — an unsupervised loop death).  "requeue" marks a
+# supervised re-admission (its admit/prefill/first_token milestones
+# reset), "engine_restart" one supervised loop restart (carries the
+# in-flight rids, like a tick row).  obs/spans.reconstruct() is
+# closed over this set and classifies each record's ``terminal``.
 SPAN_EVENTS = ("submit", "blocked", "admit", "prefill", "first_token",
-               "tick", "retire", "error")
+               "tick", "retire", "error", "timeout", "shed",
+               "requeue", "engine_restart", "failed")
 
 # restart-timeline events (resilience/restart.py RestartNarrator
 # appends them to restarts.jsonl; obs/aggregate.py folds them into
@@ -99,7 +112,11 @@ SPAN_EVENTS = ("submit", "blocked", "admit", "prefill", "first_token",
 # Supervisor "attempt_start"/"attempt_exit", the policy verdicts
 # "retry"/"reform"/"give_up"). RestartNarrator.emit validates against
 # this tuple (the SpanRecorder discipline) and obs/schema.py pins the
-# row envelope.
+# row envelope.  "engine_restart" is the SERVING supervisor's entry
+# (serving/engine.py _recover): the decode-engine loop died and was
+# restarted in place with its in-flight requests re-queued — the
+# restarts.jsonl timeline spans training preemptions and serving
+# loop deaths alike, and dtx-obs report folds both.
 RESTART_EVENTS = ("preempt", "snapshot", "resumed", "dead_proc",
                   "attempt_start", "attempt_exit", "retry", "reform",
-                  "give_up")
+                  "give_up", "engine_restart")

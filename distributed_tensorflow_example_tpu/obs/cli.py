@@ -69,11 +69,20 @@ def format_row(row: Dict[str, Any]) -> Optional[str]:
                     f"{_fmt(row.get('batch_bucket'))} "
                     f"kv_pages {_fmt(row.get('kv_pages'))} "
                     f"occ {_fmt(row.get('occupancy'))}")
+        if ev == "engine_restart":
+            # batch-shaped like tick: no single rid
+            return (f"[p{proc}] ENGINE RESTART "
+                    f"{_fmt(row.get('restart'))} "
+                    f"inflight {len(row.get('rids') or ())} "
+                    f"({_fmt(row.get('reason'))})")
         bits = [f"[p{proc}] rid {_fmt(row.get('rid'))} {ev}"]
         for key, label in (("reason", ""), ("pages_held", "pages="),
                            ("bucket", "bucket="),
                            ("ttft_ms", "ttft_ms="),
                            ("generated", "generated="),
+                           ("attempt", "attempt="),
+                           ("attempts", "attempts="),
+                           ("queued", "queued="),
                            ("tick", "tick=")):
             if row.get(key) is not None:
                 bits.append(f"{label}{_fmt(row[key])}")

@@ -107,6 +107,15 @@ GATE_METRICS: Dict[str, tuple] = {
     # (tighten per-deployment via --thresholds when the host is quiet)
     "ckpt_stall_ms": ("lower", 0.25),
     "ckpt_overhead_ratio": ("lower", 0.25),
+    # the fail-open serving keys (ISSUE 15): the completed fraction
+    # of the deterministic degraded workload (deadlines + bounded
+    # queue through the pure scheduler sim — a closed form like the
+    # bubble fractions, tight 1%: any downward move is an
+    # admission/deadline regression) and the supervised engine's p99
+    # under the injected-crash plan (short CPU loops with restarts
+    # baked in — the wide A/B default)
+    "serving_degraded_completed_frac": ("higher", 0.01),
+    "serving_degraded_p99_ms": ("lower", 0.25),
 }
 
 
@@ -228,6 +237,15 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
         put("serving_tok_s", doc.get("serving_tok_s"))
         put("decode_hbm_frac", doc.get("decode_hbm_frac"))
         return out
+    # bench degraded-serving row — keyed on degraded_sim_ticks, a
+    # row-only key (the final summary carries both gate keys too and
+    # must fall through to its own branch — the serving lesson)
+    if "degraded_sim_ticks" in doc:
+        put("serving_degraded_completed_frac",
+            doc.get("serving_degraded_completed_frac"))
+        put("serving_degraded_p99_ms",
+            doc.get("serving_degraded_p99_ms"))
+        return out
     if "wall_clock_20ep_s" in doc:              # bench per-config row
         put("wall_s", doc.get("wall_clock_20ep_s"))
         put("examples_per_sec", doc.get("examples_per_sec"))
@@ -266,7 +284,11 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
                   "local_sgd_outer_quant_bytes_per_token",
                   "local_sgd_outer_quant_reduction",
                   # the async-checkpoint overhead keys (ISSUE 13)
-                  "ckpt_stall_ms", "ckpt_overhead_ratio"):
+                  "ckpt_stall_ms", "ckpt_overhead_ratio",
+                  # the fail-open serving keys (ISSUE 15): degraded
+                  # goodput closed form + supervised crash-plan p99
+                  "serving_degraded_completed_frac",
+                  "serving_degraded_p99_ms"):
             put(k, doc.get(k))
         return out
     # last resort: any directly-named gate metrics

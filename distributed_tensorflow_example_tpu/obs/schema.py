@@ -39,8 +39,13 @@ _NUM = (int, float)
 # v5 = the resilience subsystem: the ckpt_s window/goodput bucket
 # (async-checkpoint submit stall), the restart-timeline stream
 # (restarts.jsonl, RESTART_EVENT below) and the run report's
-# "restarts" section.
-SCHEMA_VERSION = 5
+# "restarts" section;
+# v6 = fail-open serving: the typed-terminal span events
+# (timeout/shed/failed) + the supervision records
+# (requeue/engine_restart) in SPAN_FIELDS/SPAN_REQUIRED, the
+# "engine_restart" restart-timeline event, and the SERVING_STATS
+# shed/timeout/failed/requeue/restart/queue/brownout counters.
+SCHEMA_VERSION = 6
 
 
 # field -> allowed types; a tuple including type(None) marks nullable
@@ -143,6 +148,20 @@ SERVING_STATS = {
     "page_occupancy_frac": _NUM,
     "decode_ticks_total": (int,),
     "prefills_total": (int,),
+    # fail-open serving (PR 15): typed-terminal counters + the
+    # admission-control/supervision surface.  requests_total counts
+    # ACCEPTED requests only; shed requests consume a rid (span-stream
+    # uniqueness) but land here instead.  brownout_active is 0/1 (a
+    # gauge, not a bool — Prometheus has no bool).
+    "shed_total": (int,),
+    "timeout_total": (int,),
+    "failed_total": (int,),
+    "requeued_total": (int,),
+    "engine_restarts_total": (int,),
+    "queue_limit": (int,),
+    "queue_peak": (int,),
+    "brownout_active": (int,),
+    "brownout_clamped_total": (int,),
 }
 
 
@@ -186,6 +205,16 @@ SPAN_FIELDS = {
     "occupancy": _NUM,
     "generated": (int,),
     "finish_t": _NUM,
+    # fail-open payloads (v6): deadline rides submit (optional),
+    # queued the shed/timeout context, attempt(s) the supervision
+    # retry accounting, restart the engine-restart ordinal, clamped
+    # the brownout admit marker
+    "deadline": _NUM,
+    "queued": (bool, int),
+    "attempt": (int,),
+    "attempts": (int,),
+    "restart": (int,),
+    "clamped": (bool,),
 }
 
 SPAN_REQUIRED = {
@@ -198,6 +227,18 @@ SPAN_REQUIRED = {
              "occupancy"),
     "retire": ("rid", "generated", "finish_t", "tick"),
     "error": ("rid", "reason"),
+    # the typed terminals + supervision records (v6): timeout carries
+    # its reason ("deadline"/"cancel") and how much work was lost;
+    # shed is the only terminal without a submit (never accepted);
+    # requeue marks a supervised re-admission (attempt = crashes this
+    # request survived); engine_restart is batch-shaped like tick
+    # (rids = the in-flight set torn down); failed closes the retry
+    # budget.
+    "timeout": ("rid", "reason", "tick", "generated"),
+    "shed": ("rid", "reason", "tick", "queued"),
+    "requeue": ("rid", "attempt", "tick"),
+    "engine_restart": ("restart", "reason", "rids", "tick"),
+    "failed": ("rid", "reason", "attempts"),
 }
 
 

@@ -246,6 +246,32 @@ def prometheus_text(status: Dict[str, Any],
               "fraction", [(None, serving.get("page_occupancy_frac"))])
         gauge("dtx_generate_decode_ticks_total", "decode engine ticks "
               "executed", [(None, serving.get("decode_ticks_total"))])
+        # fail-open serving (PR 15): typed terminals + admission
+        # control + supervision counters
+        gauge("dtx_generate_shed_total", "requests refused by the "
+              "bounded queue (typed 503)",
+              [(None, serving.get("shed_total"))])
+        gauge("dtx_generate_timeout_total", "requests retired by "
+              "deadline expiry or client cancel (typed timeout)",
+              [(None, serving.get("timeout_total"))])
+        gauge("dtx_generate_failed_total", "requests failed after the "
+              "supervised retry budget (typed failed)",
+              [(None, serving.get("failed_total"))])
+        gauge("dtx_generate_requeued_total", "requests re-queued by a "
+              "supervised engine restart",
+              [(None, serving.get("requeued_total"))])
+        gauge("dtx_generate_engine_restarts_total", "supervised "
+              "engine-loop restarts",
+              [(None, serving.get("engine_restarts_total"))])
+        gauge("dtx_generate_queue_peak", "peak pending-queue depth "
+              "observed (bound: queue_limit, 0 = unbounded)",
+              [(None, serving.get("queue_peak"))])
+        gauge("dtx_generate_brownout_active", "1 while the brownout "
+              "admission clamp is active",
+              [(None, serving.get("brownout_active"))])
+        gauge("dtx_generate_brownout_clamped_total", "admissions with "
+              "a brownout-clamped token budget",
+              [(None, serving.get("brownout_clamped_total"))])
     if slo:
         gauge("dtx_slo_requests", "terminal requests the SLO windows "
               "slide over", [(None, slo.get("requests"))])
@@ -263,12 +289,20 @@ def prometheus_text(status: Dict[str, Any],
               "metric over its slow window",
               [({"slo": d.get("name")}, d.get("observed_p99_ms"))
                for d in docs])
+        gauge("dtx_slo_shed_rate", "shed fraction of terminal "
+              "requests over the slow window (load-shedding "
+              "pressure; deliberately not an SLO breach input)",
+              [(None, (slo.get("shed") or {}).get("rate"))])
     return "\n".join(out) + "\n"
 
 
-# a /generate request that cannot finish in this window is reported
-# as a 504 timeout (the engine keeps decoding it; the CLIENT gave up)
+# the /generate handler's ceiling wait; a request carrying its own
+# deadline waits only deadline + grace (the engine retires it with a
+# typed timeout terminal AT the deadline — the 504 is engine-truth,
+# not just the client giving up).  A handler-side expiry with no
+# engine deadline cancels the request so engine-side state frees.
 GENERATE_TIMEOUT_S = 600.0
+GENERATE_DEADLINE_GRACE_S = 5.0
 
 
 class StatusServer:
@@ -459,6 +493,8 @@ class StatusServer:
                         {"error": "no decode engine attached (start "
                                   "via dtx-serve)"}).encode())
                     return
+                from ..serving.admission import ShedError
+
                 try:
                     n = int(self.headers.get("Content-Length") or 0)
                     req = json.loads(self.rfile.read(n) or b"{}")
@@ -466,10 +502,33 @@ class StatusServer:
                     if not isinstance(prompt, list):
                         raise ValueError(
                             "'prompt' must be a list of token ids")
+                    deadline_ms = req.get("deadline_ms")
+                    if deadline_ms is not None:
+                        deadline_ms = float(deadline_ms)
+                        if deadline_ms < 0:
+                            raise ValueError("'deadline_ms' must be "
+                                             ">= 0")
                     rid = engine.submit(
                         prompt,
                         int(req.get("max_new_tokens", 16)),
-                        temperature=float(req.get("temperature", 0.0)))
+                        temperature=float(req.get("temperature", 0.0)),
+                        deadline_ms=deadline_ms)
+                except ShedError as e:
+                    # typed load shedding: the bounded queue is full —
+                    # overloaded, not broken; Retry-After tells the
+                    # client when one queue slot should have drained
+                    self.send_response(503)
+                    body = json.dumps(
+                        {"error": str(e), "status": "shed",
+                         "retry_after_s": e.retry_after_s}).encode()
+                    self.send_header("Retry-After", str(max(
+                        1, int(round(e.retry_after_s)))))
+                    self.send_header("Content-Type",
+                                     "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 except (ValueError, TypeError, KeyError) as e:
                     self._send(400, json.dumps(
                         {"error": f"{type(e).__name__}: {e}"}).encode())
@@ -480,16 +539,42 @@ class StatusServer:
                     self._send(503, json.dumps(
                         {"error": f"{type(e).__name__}: {e}"}).encode())
                     return
+                # the handler wait honors the REQUEST's deadline (its
+                # own field, or the engine default): the engine
+                # retires it at the deadline with a typed timeout
+                # terminal, so the wait only needs a grace window on
+                # top — never the full 600s ceiling against a request
+                # that contracted to finish in two seconds
+                if deadline_ms is None:
+                    deadline_ms = float(getattr(engine, "deadline_ms",
+                                                0.0) or 0.0)
+                wait_s = GENERATE_TIMEOUT_S
+                if deadline_ms and deadline_ms > 0:
+                    wait_s = min(wait_s, deadline_ms / 1e3
+                                 + GENERATE_DEADLINE_GRACE_S)
                 try:
-                    res = engine.result(rid, timeout=GENERATE_TIMEOUT_S)
+                    res = engine.result(rid, timeout=wait_s)
                     if res is None:
+                        # handler-side expiry with no engine-side
+                        # terminal yet: cancel so engine state frees
+                        # (pages, queue slot) instead of decoding for
+                        # a client that already got its 504
+                        cancel = getattr(engine, "cancel", None)
+                        if cancel is not None:
+                            cancel(rid)
                         self._send(504, json.dumps(
                             {"error": "generation timed out",
+                             "status": "timeout",
                              "rid": rid}).encode())
                         return
+                    if res.get("status") == "timeout":
+                        # the engine's typed deadline/cancel terminal
+                        self._send(504, json.dumps(res).encode())
+                        return
                     if "error" in res:
-                        # the engine loop died while THIS request was
-                        # in flight; its event was failed immediately
+                        # typed "failed" (retry budget spent) or the
+                        # engine loop died while THIS request was in
+                        # flight
                         self._send(500, json.dumps(res).encode())
                         return
                     self._send(200, json.dumps(res).encode())

@@ -124,25 +124,37 @@ def parse_specs(text: str) -> List[SLOSpec]:
 
 def records_from_spans(rows: Iterable[Dict[str, Any]]) -> List[Dict[str, Any]]:
     """Per-request SLO records from a span stream: one dict per
-    request that REACHED a terminal state (retire or error), carrying
-    ``retire_tick``, ``ttft_ms``, ``latency_ms`` and ``error``.
-    In-flight requests are excluded — they haven't consumed budget
-    yet.  So are records with no ``submit`` event: the /slo surface
-    reads bounded TAILS, and a long-running server's oldest lifecycle
-    heads scroll out — a retire whose submit was truncated away is
-    missing its measurements by TRUNCATION, not by failure, and must
-    not read as bad (events are time-ordered, so submit-in-tail
-    implies the rest of the lifecycle is too)."""
+    request that REACHED a terminal state, carrying ``retire_tick``,
+    ``ttft_ms``, ``latency_ms``, ``error`` and its typed
+    ``terminal`` (result / timeout / shed / failed).  A ``timeout``
+    or ``failed`` terminal is an errored request — it delivered
+    nothing within its contract — so it burns budget under every SLO;
+    ``shed`` records ride along for ``evaluate``'s separate shed rate
+    but are EXCLUDED from the SLO windows (a typed 503 is the
+    admission policy working, not the service breaking its latency
+    promise).  In-flight requests are excluded — they haven't
+    consumed budget yet.  So are non-shed records with no ``submit``
+    event: the /slo surface reads bounded TAILS, and a long-running
+    server's oldest lifecycle heads scroll out — a retire whose
+    submit was truncated away is missing its measurements by
+    TRUNCATION, not by failure, and must not read as bad (events are
+    time-ordered, so submit-in-tail implies the rest of the lifecycle
+    is too)."""
     from .spans import reconstruct
 
     out = []
     for (proc, rid), rec in sorted(reconstruct(rows).items()):
         err = rec.get("error")
-        if "submit_t" not in rec:
+        terminal = rec.get("terminal")
+        if terminal is None and not err:
             continue
-        if "retire_t" not in rec and not err:
+        if "submit_t" not in rec and terminal != "shed":
             continue
         rt = rec.get("retire_tick")
+        if rt is None:
+            rt = rec.get("timeout_tick")
+        if rt is None:
+            rt = rec.get("shed_tick")
         if rt is None:
             # an errored request may never have retired; pin it to the
             # last tick it touched (or 0) so windows include it
@@ -151,10 +163,13 @@ def records_from_spans(rows: Iterable[Dict[str, Any]]) -> List[Dict[str, Any]]:
         out.append({
             "proc": proc,
             "rid": rid,
+            "terminal": terminal or "failed",
             "retire_tick": int(rt),
             "ttft_ms": rec.get("ttft_ms"),
             "latency_ms": rec.get("latency_ms"),
-            "error": bool(err),
+            # timeout/failed burn budget under every SLO (the typed
+            # non-delivery terminals); shed is handled separately
+            "error": bool(err) or terminal in ("timeout", "failed"),
         })
     return out
 
@@ -178,10 +193,19 @@ def evaluate(records: List[Dict[str, Any]],
 
     Pure and closed-form: given the same records and ``now_tick`` the
     verdict is bit-identical (the tier-1 tests pin exact burn rates).
-    ``now_tick`` defaults to the newest ``retire_tick`` observed."""
+    ``now_tick`` defaults to the newest ``retire_tick`` observed.
+
+    Shed requests (terminal "shed") are carved out before the SLO
+    windows slide: a typed 503 is admission control doing its job,
+    not a latency/error-budget burn — they get their OWN rate in the
+    returned ``shed`` section (count + shed fraction of all terminals
+    per window), surfaced as the ``dtx_slo_shed_rate`` gauge."""
     specs = list(DEFAULT_SLOS if specs is None else specs)
     if now_tick is None:
         now_tick = max((r["retire_tick"] for r in records), default=0)
+    shed_records = [r for r in records
+                    if r.get("terminal") == "shed"]
+    records = [r for r in records if r.get("terminal") != "shed"]
     slos: List[Dict[str, Any]] = []
     breaches: List[str] = []
     for spec in specs:
@@ -222,11 +246,27 @@ def evaluate(records: List[Dict[str, Any]],
         if doc["breach"]:
             breaches.append(spec.name)
         slos.append(doc)
+    # shed's own rate over the slow window: shed / (shed + served)
+    # among terminals inside the window — the load-shedding pressure
+    # signal, deliberately NOT an SLO breach input
+    w = max((s.slow_window for s in specs), default=SLOW_WINDOW)
+    shed_in = sum(1 for r in shed_records
+                  if r["retire_tick"] > now_tick - w)
+    served_in = sum(1 for r in records
+                    if r["retire_tick"] > now_tick - w)
+    shed_doc = {
+        "window_ticks": w,
+        "shed": shed_in,
+        "terminals": shed_in + served_in,
+        "rate": (round(shed_in / (shed_in + served_in), 6)
+                 if shed_in + served_in else 0.0),
+    }
     return {
         "v": SCHEMA_VERSION,
         "kind": "slo_report",
         "now_tick": int(now_tick),
         "requests": len(records),
+        "shed": shed_doc,
         "slos": slos,
         "breaches": breaches,
         "ok": not breaches,

@@ -35,13 +35,27 @@ tests/test_serving.py).
 Lifecycle (one accepted request)::
 
     submit ── blocked(reason)* ── admit ── prefill ── first_token
-           ── [tick]* ── retire
+           ── [tick]* ── retire | timeout | failed
 
 ``blocked`` repeats once per tick the request stays unadmitted (with
-``reason`` "pages" or "slots" — the admission-accounting signal);
-``tick`` rows are per decode step, shared across the batch (``rids``
-lists the members, ``occupancy`` the KV-pool fill); ``error`` marks
-requests failed by an engine-loop death (no retire follows).
+``reason`` "pages", "slots" or "brownout" — the admission-accounting
+signal); ``tick`` rows are per decode step, shared across the batch
+(``rids`` lists the members, ``occupancy`` the KV-pool fill);
+``error`` marks requests failed by an engine-loop death (no retire
+follows).
+
+Fail-open extensions (PR 15): ``timeout`` ends a request whose
+deadline expired or that the client cancelled (pages freed, reason
+says which); ``shed`` is a bounded-queue rejection — the ONE terminal
+without a submit, since the request was never accepted; under engine
+supervision a crash emits ``engine_restart`` (batch-shaped, the torn-
+down in-flight rids) and each surviving request a ``requeue`` (its
+admit/prefill/first_token milestones legitimately repeat — the
+exactly-once fold resets them), with ``failed`` closing a request
+whose retry budget is spent.  ``reconstruct`` classifies every
+record's ``terminal`` ∈ {result, timeout, shed, failed} and flags a
+record carrying more than one — the terminates-exactly-once invariant
+the chaos suite asserts.
 """
 
 from __future__ import annotations
@@ -62,8 +76,18 @@ from .schema import SCHEMA_VERSION
 # without growing per request forever
 RING_CAPACITY = 8192
 
-# the exactly-once milestones (per rid); blocked/tick/error repeat
-MILESTONES = ("submit", "admit", "prefill", "first_token", "retire")
+# the exactly-once milestones (per rid); blocked/tick/error repeat.
+# admit/prefill/first_token RESET on a requeue event (a supervised
+# engine restart re-runs them legitimately); the terminals never do.
+MILESTONES = ("submit", "admit", "prefill", "first_token", "retire",
+              "timeout", "shed", "failed")
+
+# the typed terminal states (PR 15): every accepted request reaches
+# exactly one — "result" (a retire event), "timeout" (deadline or
+# cancel), "shed" (bounded-queue rejection; the one terminal with no
+# submit), "failed" (retry budget spent, or a legacy "error" row).
+# reconstruct() classifies each record's ``terminal`` from these.
+TERMINALS = ("result", "timeout", "shed", "failed")
 
 _SPANS_RE = re.compile(r"spans\.(\d+)\.jsonl$")
 
@@ -207,11 +231,16 @@ def reconstruct(
     for row in rows:
         event = row.get("event")
         proc = int(row.get("proc") or 0)
-        if event == "tick":
+        if event in ("tick", "engine_restart"):
+            # batch-shaped rows: attributed to every member rid
             for rid in (row.get("rids") or ()):
                 r = rec_for(proc, int(rid))
-                r["decode_ticks"] += 1
-                r["ticks"].append(row.get("tick"))
+                if event == "tick":
+                    r["decode_ticks"] += 1
+                    r["ticks"].append(row.get("tick"))
+                else:
+                    r["engine_restarts"] = \
+                        r.get("engine_restarts", 0) + 1
             continue
         rid = row.get("rid")
         if rid is None:
@@ -227,12 +256,16 @@ def reconstruct(
             r["prompt_len"] = row.get("prompt_len")
             r["max_new_tokens"] = row.get("max_new_tokens")
             r["arrival"] = row.get("arrival")
+            if row.get("deadline") is not None:
+                r["deadline"] = row.get("deadline")
         elif event == "blocked":
             reason = str(row.get("reason"))
             r["blocked"][reason] = r["blocked"].get(reason, 0) + 1
         elif event == "admit":
             r["pages_held"] = row.get("pages_held")
             r["admit_tick"] = row.get("tick")
+            if row.get("clamped"):
+                r["brownout_clamped"] = True
         elif event == "prefill":
             r["prefill_bucket"] = row.get("bucket")
         elif event == "first_token":
@@ -243,15 +276,63 @@ def reconstruct(
             r["retire_tick"] = row.get("tick")
         elif event == "error":
             r["error"] = str(row.get("reason"))
+        elif event == "timeout":
+            r["timeout_reason"] = str(row.get("reason"))
+            r["timeout_tick"] = row.get("tick")
+            r["generated"] = row.get("generated")
+        elif event == "shed":
+            r["shed_reason"] = str(row.get("reason"))
+            r["shed_tick"] = row.get("tick")
+        elif event == "failed":
+            r["failed_reason"] = str(row.get("reason"))
+            r["attempts"] = row.get("attempts")
+        elif event == "requeue":
+            # a supervised re-admission legitimately re-runs the
+            # admission/prefill milestones: reset their exactly-once
+            # slate (the terminals stay armed) and count the retry.
+            # The aborted attempt's measurements go too — a stale
+            # ttft from discarded tokens must not feed the SLO fold
+            # if the retry never produces a new first_token
+            # (brownout_clamped stays sticky: the budget mutation
+            # survives the requeue).
+            r["requeues"] = r.get("requeues", 0) + 1
+            r["attempt"] = row.get("attempt")
+            for k in ("admit", "prefill", "first_token"):
+                r.pop(f"{k}_t", None)
+            for k in ("ttft_ms", "prefill_bucket", "pages_held",
+                      "admit_tick"):
+                r.pop(k, None)
 
     for _key, r in recs.items():
-        if "submit_t" not in r:
+        # terminal classification: exactly one of the typed ends.
+        # "error" (unsupervised loop death) types as failed too.
+        ends = [t for t, k in (("result", "retire_t"),
+                               ("timeout", "timeout_t"),
+                               ("shed", "shed_t"),
+                               ("failed", "failed_t"))
+                if k in r]
+        if "error" in r and not ends:
+            ends = ["failed"]
+        r["terminal"] = ends[0] if len(ends) == 1 else None
+        if len(ends) > 1:
+            r["errors"].append(
+                f"multiple terminals: {'+'.join(ends)}")
+        # shed is the one terminal without a submit: the request was
+        # never accepted, so the no-submit check exempts it
+        if "submit_t" not in r and "shed_t" not in r:
             r["errors"].append("no submit event")
+        if "shed_t" in r and "submit_t" in r:
+            r["errors"].append("shed after submit (shed requests are "
+                               "never accepted)")
         for a, b in (("admit", "submit"), ("retire", "admit")):
             if f"{a}_t" in r and f"{b}_t" not in r:
                 r["errors"].append(f"{a} without {b}")
-        if ("generated" in r and r.get("max_new_tokens") is not None
+        if ("retire_t" in r and "generated" in r
+                and r.get("max_new_tokens") is not None
+                and not r.get("brownout_clamped")
                 and r["generated"] != r["max_new_tokens"]):
+            # (a brownout-clamped admit legitimately retires short of
+            # the submitted budget — the clamp IS the degradation)
             r["errors"].append(
                 f"generated {r['generated']} != max_new_tokens "
                 f"{r['max_new_tokens']}")
@@ -259,8 +340,14 @@ def reconstruct(
                 is not None):
             r["latency_ms"] = round(
                 (r["finish_t"] - r["arrival"]) * 1e3, 3)
-        r["complete"] = ("retire_t" in r and "admit_t" in r
-                         and not r["errors"])
+        # complete = reached exactly one TYPED terminal cleanly.  A
+        # legacy "error" row (unsupervised loop death) types the
+        # terminal as failed but stays incomplete: it marks a
+        # truncated lifecycle, not a closed one.
+        r["complete"] = (not r["errors"] and (
+            (r["terminal"] == "result" and "admit_t" in r)
+            or r["terminal"] in ("timeout", "shed")
+            or (r["terminal"] == "failed" and "failed_t" in r)))
     return recs
 
 

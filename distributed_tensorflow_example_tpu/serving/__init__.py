@@ -21,6 +21,13 @@ _EXPORTS = {
     "paged_decode_step": "kv_cache",
     "prefill_into_pages": "kv_cache",
     "sample_tokens": "kv_cache",
+    # fail-open serving (PR 15) — all pure Python like the scheduler
+    "FaultPlan": "faults",
+    "InjectedFault": "faults",
+    "simulate_degraded": "faults",
+    "BrownoutPolicy": "admission",
+    "ShedError": "admission",
+    "parse_brownout": "admission",
 }
 
 __all__ = list(_EXPORTS)

@@ -117,13 +117,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 2
     try:
         config_lib.validate_quant_config(cfg)
+        config_lib.validate_serving_config(cfg)
     except ValueError as e:
         print(f"dtx-serve: {e}", file=sys.stderr)
         return 2
     from ..obs import slo as slo_lib
+    from .admission import parse_brownout
 
     try:
         slos = slo_lib.parse_specs(cfg.slo)
+        brownout = parse_brownout(cfg.brownout)
     except ValueError as e:
         print(f"dtx-serve: {e}", file=sys.stderr)
         return 2
@@ -150,10 +153,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         recorder = SpanRecorder(cfg.logs_path)
         print(f"dtx-serve: request spans -> {recorder.path}")
+    narrator = None
+    if cfg.engine_retries > 0:
+        # supervised restarts land on the SAME restarts.jsonl
+        # timeline the training supervisor writes — dtx-obs report
+        # folds serving loop deaths and training preemptions alike
+        from ..resilience.restart import RestartNarrator
+
+        narrator = RestartNarrator(cfg.logs_path)
+        print(f"dtx-serve: engine supervision armed "
+              f"(engine_retries={cfg.engine_retries}; restarts -> "
+              f"{narrator.path})")
     engine = DecodeEngine(
         spec, params, page_size=cfg.decode_page_size,
         num_pages=cfg.decode_pages, max_batch=cfg.decode_max_batch,
-        seed=cfg.seed, kv_quant=cfg.kv_quant, recorder=recorder)
+        seed=cfg.seed, kv_quant=cfg.kv_quant, recorder=recorder,
+        max_queue=cfg.max_queue, deadline_ms=cfg.deadline_ms,
+        engine_retries=cfg.engine_retries, brownout=brownout,
+        slos=slos, restart_narrator=narrator)
     engine.start()
 
     from ..obs.serve import StatusServer
@@ -170,6 +187,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
           f"max_batch={engine.sched.max_batch} "
           f"max_len={engine.max_len}"
           + (f" kv_quant={engine.kv_quant}" if engine.kv_quant else "")
+          + (f" deadline_ms={engine.deadline_ms:g}"
+             if engine.deadline_ms else "")
+          + (f" max_queue={engine.max_queue}"
+             if engine.max_queue else "")
+          + (" brownout=on" if engine.brownout is not None else "")
           + ")")
     try:
         import time

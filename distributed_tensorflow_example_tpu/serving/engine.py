@@ -42,12 +42,22 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from . import scheduler as sched_lib
+from .admission import BrownoutPolicy, ShedError
+from .faults import InjectedFault
 from .scheduler import SCRATCH_PAGE
 
 # rolling window for the latency percentiles stats() reports (the
 # Prometheus gauges are point-in-time reads; an all-history scan would
 # grow every scrape O(N log N) under the engine lock)
 STATS_WINDOW = 2048
+# brownout burn-rate recompute cadence, in tick boundaries: the SLO
+# fold over the span ring is O(ring), too heavy for every tick
+BURN_EVERY = 32
+# supervised-restart backoff (resilience/restart.backoff_s shape):
+# base doubles per consecutive crash up to the cap, resets on the
+# first healthy tick
+RESTART_BACKOFF_BASE_S = 0.05
+RESTART_BACKOFF_MAX_S = 2.0
 # completed requests retained for result() pickup before the oldest
 # are evicted — bounds a long-running dtx-serve's memory under
 # fire-and-forget clients
@@ -65,7 +75,7 @@ def _percentile(vals: List[float], q: float) -> Optional[float]:
 
 class _Result:
     __slots__ = ("event", "prompt", "tokens", "arrival_t", "first_t",
-                 "finish_t", "error")
+                 "finish_t", "error", "status")
 
     def __init__(self, prompt, arrival_t: float):
         self.event = threading.Event()
@@ -75,6 +85,10 @@ class _Result:
         self.first_t: Optional[float] = None
         self.finish_t: Optional[float] = None
         self.error: Optional[str] = None
+        # terminal type once the event is set: "result" | "timeout" |
+        # "failed" (shed requests never get a _Result — they are
+        # refused at submit with a typed ShedError)
+        self.status: Optional[str] = None
 
 
 class DecodeEngine:
@@ -85,12 +99,41 @@ class DecodeEngine:
     ``max_len`` (prompt + generated) defaults to — and may never
     exceed — ``spec.seq_len`` (the positional table's reach).
     ``donate=None`` resolves by backend (CPU implements no buffer
-    donation and warns per call)."""
+    donation and warns per call).
+
+    Fail-open knobs (all off by default — the default path is
+    bitwise-identical to the unsupervised engine):
+
+    - ``max_queue`` bounds the pending queue; a submit past the bound
+      raises a typed ``ShedError`` (503 + Retry-After at the HTTP
+      door) instead of growing memory without limit;
+    - ``deadline_ms`` is the default per-request deadline (0 = none;
+      a request's own ``deadline_ms`` overrides) — expiry retires it
+      at the next tick boundary with a typed ``timeout`` terminal and
+      frees its pages;
+    - ``brownout`` (admission.BrownoutPolicy) clamps new admissions'
+      token budgets and admission width while page occupancy or the
+      fast-window SLO burn rate is over threshold;
+    - ``engine_retries`` > 0 arms SUPERVISION: a crashed engine loop
+      restarts with bounded backoff, in-flight requests are re-queued
+      (pages freed, prefill re-run) at most ``engine_retries`` times
+      each before a typed ``failed`` terminal — instead of today's
+      fail-closed "every pending request errors, submits refuse";
+    - ``faults`` (faults.FaultPlan) is the deterministic chaos
+      switchboard the above are tested against;
+    - ``slos``/``restart_narrator``: the brownout burn-rate specs
+      (obs/slo.SLOSpec list; None = defaults) and an optional
+      resilience RestartNarrator that lands every supervised restart
+      on the restarts.jsonl timeline."""
 
     def __init__(self, spec, params, page_size: int = 16,
                  num_pages: int = 0, max_batch: int = 8,
                  max_len: int = 0, donate: Optional[bool] = None,
-                 seed: int = 0, kv_quant: str = "", recorder=None):
+                 seed: int = 0, kv_quant: str = "", recorder=None,
+                 max_queue: int = 0, deadline_ms: float = 0.0,
+                 engine_retries: int = 0,
+                 brownout: Optional[BrownoutPolicy] = None,
+                 faults=None, slos=None, restart_narrator=None):
         import jax
 
         from . import kv_cache as kvc
@@ -116,9 +159,21 @@ class DecodeEngine:
         # error).  Host-side appends only — greedy outputs are
         # token-identical with tracing on or off.
         self.recorder = recorder
+        self.faults = faults
+        self.max_queue = int(max_queue)
+        self.deadline_ms = float(deadline_ms)
+        self.engine_retries = int(engine_retries)
+        if self.max_queue < 0 or self.deadline_ms < 0 \
+                or self.engine_retries < 0:
+            raise ValueError("max_queue, deadline_ms and "
+                             "engine_retries must be >= 0")
+        self.brownout = brownout
+        self.slos = slos
+        self.restart_narrator = restart_narrator
+        self.max_batch = int(max_batch)
         self.sched = sched_lib.ContinuousScheduler(
             self.num_pages, self.page_size, max_batch,
-            recorder=recorder)
+            recorder=recorder, faults=faults)
         self.prompt_buckets = sched_lib.shape_buckets(
             max(1, self.max_len - 1))
         self._heads = kvc.local_heads(spec, params)
@@ -145,9 +200,28 @@ class DecodeEngine:
         self._completed = 0
         self._failure: Optional[str] = None
         self._next_rid = 0
+        self._accepted = 0
         self._tick = 0
         self._prefills = 0
         self._tokens_out = 0
+        # fail-open accounting (stats()/dtx_generate_* surface)
+        self._shed = 0
+        self._timeouts = 0
+        self._failed = 0
+        self._requeued = 0
+        self._restarts = 0
+        self._queue_peak = 0
+        self._brownout_active = False
+        self._brownout_clamped = 0
+        self._consec_crashes = 0
+        # monotonic tick-boundary counter — the FaultPlan clock for
+        # crash/stall/delay.  Deliberately NOT the scheduler's tick
+        # counter: a supervised restart rebuilds the scheduler (ticks
+        # reset to 0), and a crash plan must not re-fire at the same
+        # indices forever
+        self._boundaries = 0
+        self._burn_cache: Tuple[int, Optional[float]] = (-BURN_EVERY,
+                                                         None)
         self._started_t: Optional[float] = None
         self._busy_s = 0.0
         self.shapes_used: set = set()
@@ -157,10 +231,17 @@ class DecodeEngine:
 
     # ---- request surface ----
     def submit(self, prompt, max_new_tokens: int,
-               temperature: float = 0.0) -> int:
+               temperature: float = 0.0,
+               deadline_ms: Optional[float] = None) -> int:
         """Queue a request (``prompt``: iterable of int token ids);
         returns its rid.  Thread-safe; the background loop (or the
-        next ``step()``) picks it up."""
+        next ``step()``) picks it up.  ``deadline_ms`` bounds the
+        request's total time in the system (None = the engine's
+        ``deadline_ms`` default; 0 = explicitly none); past it, the
+        scheduler retires the request with a typed ``timeout``
+        terminal and frees its pages.  Raises ``ShedError`` when the
+        bounded pending queue (``max_queue``) is full — the typed
+        503-with-Retry-After rejection."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -175,34 +256,84 @@ class DecodeEngine:
             if self._failure is not None:
                 raise RuntimeError(
                     f"decode engine failed: {self._failure}")
+            if self.max_queue and len(self.sched.waiting) >= self.max_queue:
+                # typed load shedding: the queue bound is the memory
+                # bound.  The shed rid is consumed (span-stream rids
+                # stay unique) but requests_total counts ACCEPTED only
+                rid = self._next_rid
+                self._next_rid += 1
+                self._shed += 1
+                retry_s = self._retry_after_s()
+                if self.recorder is not None:
+                    self.recorder.emit(
+                        "shed", rid=rid, reason="queue",
+                        tick=self.sched.ticks,
+                        queued=len(self.sched.waiting))
+                raise ShedError(
+                    f"queue full ({len(self.sched.waiting)} waiting, "
+                    f"max_queue={self.max_queue})",
+                    retry_after_s=retry_s, rid=rid)
+            dl_ms = self.deadline_ms if deadline_ms is None \
+                else float(deadline_ms)
+            deadline = now + dl_ms / 1e3 if dl_ms > 0 else None
             rid = self._next_rid
             # the scheduler may reject (page need > pool): allocate the
             # rid only on acceptance so requests_total counts accepted
             # requests, not attempts
             self.sched.submit(rid, len(prompt), int(max_new_tokens),
-                              arrival=now)
+                              arrival=now, deadline=deadline)
             self._next_rid += 1
+            self._accepted += 1
+            self._queue_peak = max(self._queue_peak,
+                                   len(self.sched.waiting))
             self._results[rid] = _Result(prompt, now)
             self._temps[rid] = float(temperature)
         with self._work:
             self._work.notify()
         return rid
 
+    def _retry_after_s(self) -> float:
+        """The Retry-After hint on a shed: the p50 request latency
+        when one is known (about one queue slot's drain time), else
+        1s."""
+        p50 = _percentile(list(self._lat_ms), 0.50)
+        return round(max(1.0, (p50 or 0.0) / 1e3), 3)
+
+    def cancel(self, rid: int) -> bool:
+        """Client-side cancellation: mark ``rid`` for retirement at
+        the next tick boundary (pages freed through the same path a
+        deadline expiry uses; the result terminal is ``timeout`` with
+        reason "cancel").  Returns False when the rid is unknown or
+        already terminal."""
+        with self._lock:
+            res = self._results.get(rid)
+            if res is None or res.event.is_set():
+                return False
+            ok = self.sched.cancel(rid)
+        with self._work:
+            self._work.notify()
+        return ok
+
     def result(self, rid: int, timeout: Optional[float] = None):
         """Block until rid completes; returns
-        ``{"rid", "prompt", "tokens", "latency_ms", "ttft_ms"}``,
-        ``{"rid", "error"}`` if the engine loop died mid-request, or
-        None on timeout.  Results stay retrievable until the engine
-        has finished ``RETAIN_FINISHED`` newer requests (KeyError
-        after eviction — bounded memory for fire-and-forget
-        clients)."""
+        ``{"rid", "status": "result", "prompt", "tokens",
+        "latency_ms", "ttft_ms"}`` on success, ``{"rid", "status",
+        "error"}`` for a typed non-result terminal (``status`` is
+        "timeout" — deadline expiry or cancellation — or "failed" —
+        the engine loop died with the retry budget spent), or None
+        when ``timeout`` elapsed first (the request is still in
+        flight).  Results stay retrievable until the engine has
+        finished ``RETAIN_FINISHED`` newer requests (KeyError after
+        eviction — bounded memory for fire-and-forget clients)."""
         res = self._results[rid]
         if not res.event.wait(timeout):
             return None
         if res.error is not None:
-            return {"rid": rid, "error": res.error}
+            return {"rid": rid, "status": res.status or "failed",
+                    "error": res.error}
         return {
             "rid": rid,
+            "status": "result",
             "prompt": list(res.prompt),
             "tokens": list(res.tokens),
             "latency_ms": round((res.finish_t - res.arrival_t) * 1e3, 3),
@@ -213,34 +344,112 @@ class DecodeEngine:
     def step(self) -> bool:
         """Execute one scheduler tick (admissions' prefills + the
         shared decode step).  Returns False when there was nothing to
-        do."""
+        do.  The fail-open order of business at each boundary:
+        brownout verdict -> plan (which expires deadlines/cancels
+        first) -> finalize the expirations' results -> injected
+        crash/stall (FaultPlan) -> execute."""
         with self._lock:
             t0 = time.monotonic()
             if self._started_t is None:
                 self._started_t = t0
+            self._update_brownout()
             plan = self.sched.plan_tick(now=t0)
+            self._finalize_expired(self.sched.take_expired(), t0)
             # the engine keeps its own counters; the scheduler's
             # finished map is the simulate() surface and would grow
             # per request forever in a long-running server
             self.sched.finished.clear()
             if plan is None:
                 return False
+            boundary = self._boundaries
+            self._boundaries += 1
+            if self.faults is not None:
+                if self.faults.crash(boundary):
+                    raise InjectedFault(
+                        f"injected crash at tick boundary {boundary}")
+                stall = (self.faults.stall(boundary)
+                         + self.faults.delay_s)
+                if stall > 0:
+                    # a wedged/slow tick: deadlines keep running while
+                    # the engine holds its lock (submits block too —
+                    # exactly what a stalled worker looks like)
+                    time.sleep(stall)
             for rid in plan.prefills:
                 self._run_prefill(rid)
             decodes = [r for r in plan.decodes
                        if not self.sched._seq(r).done]
             if decodes:
                 self._run_decode(decodes, plan)
+            self._consec_crashes = 0
             self._busy_s += time.monotonic() - t0
             return True
+
+    def _update_brownout(self) -> None:
+        """One hysteresis transition of the brownout policy, applied
+        as this boundary's scheduler verdict (admission.BrownoutPolicy
+        decides; the scheduler clamps)."""
+        if self.brownout is None:
+            return
+        occ = self.sched.alloc.in_use / self.sched.alloc.usable
+        self._brownout_active = self.brownout.update(
+            self._brownout_active, occ, self._fast_burn())
+        self.sched.brownout = (
+            (self.brownout.clamp_new_tokens,
+             self.brownout.admit_per_tick)
+            if self._brownout_active else None)
+        self._brownout_clamped = self.sched.brownout_clamped
+
+    def _fast_burn(self) -> Optional[float]:
+        """Max fast-window SLO burn rate over the recorder ring (None
+        without a recorder), recomputed every ``BURN_EVERY``
+        boundaries — the SLO fold is O(ring) and must not run per
+        tick."""
+        if self.recorder is None:
+            return None
+        at, val = self._burn_cache
+        if self._boundaries - at < BURN_EVERY:
+            return val
+        from ..obs import slo as slo_lib
+
+        doc = slo_lib.evaluate(
+            slo_lib.records_from_spans(self.recorder.snapshot()),
+            specs=self.slos)
+        burns = [(d.get("windows") or {}).get("fast", {}).get("burn_rate")
+                 for d in doc.get("slos") or []]
+        burns = [b for b in burns if isinstance(b, (int, float))]
+        val = max(burns) if burns else None
+        self._burn_cache = (self._boundaries, val)
+        return val
+
+    def _finalize_expired(self, pairs, now: float) -> None:
+        """Set the typed ``timeout`` terminal on every result the
+        scheduler just expired (deadline or cancel)."""
+        for rid, reason in pairs:
+            res = self._results.get(rid)
+            self._timeouts += 1
+            if res is None or res.event.is_set():
+                continue
+            res.status = "timeout"
+            res.error = ("cancelled by client" if reason == "cancel"
+                         else "deadline exceeded")
+            res.finish_t = now
+            self._seal(rid, res)
 
     def run_until_idle(self) -> int:
         """Drive ticks until every submitted request completed;
         returns the number of executed ticks (the bench's measured
-        loop)."""
+        loop).  Supervision applies here exactly as in the background
+        loop: a crashed tick recovers (requeue/restart) when
+        ``engine_retries`` > 0, else propagates."""
         n = 0
         while True:
-            if not self.step():
+            try:
+                did = self.step()
+            except Exception as e:  # noqa: BLE001 — supervised driver
+                if self.engine_retries > 0 and self._recover(e):
+                    continue
+                raise
+            if not did:
                 with self._lock:
                     if self.sched.idle:
                         return n
@@ -323,12 +532,19 @@ class DecodeEngine:
     def _finish(self, rid: int, now: float) -> None:
         res = self._results[rid]
         res.finish_t = now
+        res.status = "result"
         self._completed += 1
         self._lat_ms.append((now - res.arrival_t) * 1e3)
         if res.first_t is not None:
             self._ttft_ms.append((res.first_t - res.arrival_t) * 1e3)
-        # per-rid decode state is dead once the sequence finished;
-        # the result itself stays for pickup under a bounded retention
+        self._seal(rid, res)
+
+    def _seal(self, rid: int, res: "_Result") -> None:
+        """The one terminal-sealing path (caller holds the lock):
+        per-rid decode state dies, the result stays for pickup under
+        the bounded retention, and the waiter wakes — every terminal
+        (result/timeout/failed) funnels through here so the retention
+        discipline cannot drift between them."""
         self._temps.pop(rid, None)
         self._last_tok.pop(rid, None)
         self._finished_order.append(rid)
@@ -399,12 +615,138 @@ class DecodeEngine:
                 did = self.step()
             except Exception as e:   # noqa: BLE001 — the one thread
                 # every request depends on must not die silently
+                if self.engine_retries > 0 and self._recover(e):
+                    continue          # supervised: loop resumes
                 self._fail(e)
                 return
             if not did:
                 with self._work:
                     if self._running:
                         self._work.wait(timeout=0.02)
+
+    # ---- supervision (engine_retries > 0) ----
+    def _recover(self, e: BaseException) -> bool:
+        """A tick crashed under supervision: restart the engine
+        in place instead of failing closed.  Correctness over
+        cleverness — every admitted-but-unfinished request is torn
+        down to its prompt (pages freed with the dead scheduler,
+        generated tokens discarded, prefill re-run on re-admission)
+        and re-queued unless its ``engine_retries`` budget is spent,
+        in which case it gets the typed ``failed`` terminal.  The KV
+        cache is re-initialized (a crash mid-dispatch can leave
+        donated buffers in limbo); compiled programs are kept — they
+        are pure.  Every restart lands on the span stream
+        (``engine_restart``/``requeue``/``failed``) and, when a
+        narrator is attached, on the restarts.jsonl timeline.
+        Returns True (the loop resumes after a bounded backoff)."""
+        from ..resilience.restart import backoff_s
+
+        msg = f"{type(e).__name__}: {e}"
+        now = time.monotonic()
+        with self._lock:
+            self._restarts += 1
+            self._consec_crashes += 1
+            old = self.sched
+            inflight = list(old.live)
+            waiting = list(old.waiting)
+            if self.recorder is not None:
+                self.recorder.emit(
+                    "engine_restart", restart=self._restarts,
+                    reason=msg, rids=[s.rid for s in inflight],
+                    tick=old.ticks)
+            if self.restart_narrator is not None:
+                self.restart_narrator.emit(
+                    "engine_restart", restart=self._restarts,
+                    reason=msg, inflight=len(inflight),
+                    queued=len(waiting))
+            sys.stderr.write(
+                f"dtx-serve: engine loop crashed ({msg}); supervised "
+                f"restart {self._restarts} with {len(inflight)} "
+                f"in-flight re-queued\n")
+            # rebuild the execution state: fresh scheduler/allocator
+            # (the dead one may hold a half-planned boundary) and a
+            # fresh cache (donation can leave the old buffers invalid)
+            self.sched = sched_lib.ContinuousScheduler(
+                self.num_pages, self.page_size, self.max_batch,
+                recorder=self.recorder, faults=self.faults)
+            # the FaultPlan's alloc-call clock survives the restart —
+            # a deterministic plan must not re-fire
+            self.sched.alloc.alloc_calls = old.alloc.alloc_calls
+            self.sched.alloc.injected_fails = old.alloc.injected_fails
+            self.sched.brownout_clamped = old.brownout_clamped
+            # the span stream's tick index stays MONOTONIC across the
+            # restart: the SLO windows and reconstruct slide over it,
+            # and a reset would strand every post-restart terminal
+            # outside windows anchored at the pre-crash maximum
+            self.sched.ticks = old.ticks
+            # pending cancellations and already-expired-but-undrained
+            # rids survive the rebuild: a client that cancelled just
+            # before the crash must still get its typed timeout, not
+            # a silent re-decode (the new scheduler's first boundary
+            # expires the carried markers)
+            self.sched._cancelled = set(old._cancelled)
+            self._finalize_expired(old.take_expired(), now)
+            self.cache = self._kvc.init_paged_cache(
+                self.spec, self.num_pages, self.page_size,
+                heads=self._heads, quant=self.kv_quant)
+            # in-flight requests burned one attempt; waiters did not
+            # (the crash consumed none of their work)
+            survivors = []
+            for s in inflight:
+                s.pages = []          # freed with the dead allocator
+                s.attempts += 1
+                res = self._results.get(s.rid)
+                if res is None or res.event.is_set():
+                    continue
+                if s.attempts > self.engine_retries:
+                    self._finalize_failed(
+                        s.rid, f"engine crashed {s.attempts} times "
+                               f"on this request "
+                               f"(engine_retries={self.engine_retries}"
+                               f"): {msg}",
+                        attempts=s.attempts, now=now)
+                    continue
+                res.tokens.clear()
+                res.first_t = None
+                self._last_tok.pop(s.rid, None)
+                self._requeued += 1
+                if self.recorder is not None:
+                    self.recorder.emit("requeue", rid=s.rid,
+                                       attempt=s.attempts,
+                                       tick=self.sched.ticks)
+                survivors.append(s)
+            # FIFO by arrival across survivors + untouched waiters
+            # (waiters hold no pages and no generated tokens already)
+            for s in sorted(survivors + waiting,
+                            key=lambda st: (st.arrival, st.rid)):
+                self.sched.requeue(s)
+            # markers for rids that did NOT survive (failed terminal)
+            # would never match a waiting/live seq again — prune them
+            self.sched._cancelled &= {s.rid for s in self.sched.waiting}
+            wait_s = backoff_s(self._consec_crashes - 1,
+                               base_s=RESTART_BACKOFF_BASE_S,
+                               cap_s=RESTART_BACKOFF_MAX_S)
+        if wait_s > 0:
+            time.sleep(wait_s)
+        with self._work:
+            self._work.notify()
+        return True
+
+    def _finalize_failed(self, rid: int, msg: str, attempts: int,
+                         now: float) -> None:
+        """The typed ``failed`` terminal: retry budget spent (caller
+        holds the engine lock)."""
+        res = self._results.get(rid)
+        if res is None or res.event.is_set():
+            return
+        self._failed += 1
+        res.status = "failed"
+        res.error = msg
+        res.finish_t = now
+        if self.recorder is not None:
+            self.recorder.emit("failed", rid=rid, reason=msg,
+                               attempts=int(attempts))
+        self._seal(rid, res)
 
     def _fail(self, e: BaseException) -> None:
         """A tick raised: record the failure, refuse new submits, and
@@ -419,6 +761,8 @@ class DecodeEngine:
             for rid, res in self._results.items():
                 if res.finish_t is None and res.error is None:
                     res.error = msg
+                    res.status = "failed"
+                    self._failed += 1
                     if self.recorder is not None:
                         # no retire will follow: mark the lifecycle
                         # failed so reconstruction doesn't read these
@@ -444,7 +788,7 @@ class DecodeEngine:
             toks = self._tokens_out
             occ = self.sched.alloc.in_use / self.sched.alloc.usable
             return {
-                "requests_total": self._next_rid,
+                "requests_total": self._accepted,
                 "completed_total": self._completed,
                 "inflight": len(self.sched.live),
                 "queued": len(self.sched.waiting),
@@ -458,4 +802,15 @@ class DecodeEngine:
                 "page_occupancy_frac": round(occ, 6),
                 "decode_ticks_total": self._tick,
                 "prefills_total": self._prefills,
+                # fail-open accounting (PR 15): typed terminals +
+                # admission-control and supervision counters
+                "shed_total": self._shed,
+                "timeout_total": self._timeouts,
+                "failed_total": self._failed,
+                "requeued_total": self._requeued,
+                "engine_restarts_total": self._restarts,
+                "queue_limit": self.max_queue,
+                "queue_peak": self._queue_peak,
+                "brownout_active": int(self._brownout_active),
+                "brownout_clamped_total": self._brownout_clamped,
             }

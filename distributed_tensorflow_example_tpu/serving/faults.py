@@ -1,0 +1,197 @@
+"""Deterministic fault injection for the serving stack — pure Python.
+
+The failure paths PR 15 adds (deadline expiry, load shedding, engine
+supervision) are worthless untested, and real faults don't show up on
+demand.  ``FaultPlan`` is the chaos switchboard: a frozen, seedable
+description of WHICH faults fire WHEN, injected into the pure
+scheduler (``BlockAllocator`` page-allocation failures) and the
+``DecodeEngine`` loop (crash / stall / delay at chosen ticks).  The
+same plan drives the tick simulation and the real engine, so the
+chaos acceptance suite asserts closed-form counters against the
+scheduler and then replays the identical plan through compiled
+programs.
+
+Clocks (both deterministic):
+
+- **allocation calls** — ``BlockAllocator.alloc`` numbers its calls
+  0, 1, 2, ...; ``alloc_fail_calls`` makes those calls return None
+  (exactly what pool exhaustion looks like to admission — the
+  all-or-nothing contract is preserved, nothing is partially
+  granted);
+- **tick boundaries** — the scheduler's planned-tick index;
+  ``crash_at_ticks`` raises ``InjectedFault`` out of the engine's
+  ``step()`` at that boundary (the loop-death path supervision must
+  survive), ``stall_at_ticks`` sleeps ``stall_s`` before executing it
+  (how a tick outlives a request deadline), ``delay_s`` sleeps before
+  EVERY tick (uniform slowdown).
+
+Disabled is the default and is bitwise-invisible: ``FaultPlan()`` (or
+``faults=None`` anywhere one is accepted) injects nothing, and the
+only added work on the hot path is an attribute check — greedy decode
+through the engine is token-identical with the plumbing present
+(pinned in tests/test_serving_faults.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Optional, Tuple
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed FaultPlan at a crash tick — a distinct type
+    so tests (and the supervision narration) can tell an injected
+    death from an organic one."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """One deterministic chaos schedule.  All fields default to
+    "never": a default-constructed plan is disabled
+    (``active`` False) and injects nothing."""
+
+    # BlockAllocator.alloc call indices (0-based) that fail
+    alloc_fail_calls: Tuple[int, ...] = ()
+    # tick boundaries where the engine's step() raises InjectedFault
+    crash_at_ticks: Tuple[int, ...] = ()
+    # tick boundaries stalled by stall_s before executing
+    stall_at_ticks: Tuple[int, ...] = ()
+    stall_s: float = 0.0
+    # uniform pre-tick delay (every tick), seconds
+    delay_s: float = 0.0
+
+    def __post_init__(self):
+        if self.stall_s < 0 or self.delay_s < 0:
+            raise ValueError("stall_s and delay_s must be >= 0")
+        if self.stall_at_ticks and self.stall_s == 0.0:
+            raise ValueError("stall_at_ticks without stall_s is a "
+                             "no-op; set stall_s > 0")
+
+    @property
+    def active(self) -> bool:
+        return bool(self.alloc_fail_calls or self.crash_at_ticks
+                    or self.stall_at_ticks or self.delay_s)
+
+    # ---- the injection predicates (each clocked as documented) ----
+    def fail_alloc(self, call_index: int) -> bool:
+        return call_index in self.alloc_fail_calls
+
+    def crash(self, tick: int) -> bool:
+        return tick in self.crash_at_ticks
+
+    def stall(self, tick: int) -> float:
+        return self.stall_s if tick in self.stall_at_ticks else 0.0
+
+    def describe(self) -> str:
+        if not self.active:
+            return "disabled"
+        parts = []
+        if self.alloc_fail_calls:
+            parts.append(f"alloc_fail@calls{sorted(self.alloc_fail_calls)}")
+        if self.crash_at_ticks:
+            parts.append(f"crash@ticks{sorted(self.crash_at_ticks)}")
+        if self.stall_at_ticks:
+            parts.append(f"stall{self.stall_s}s@ticks"
+                         f"{sorted(self.stall_at_ticks)}")
+        if self.delay_s:
+            parts.append(f"delay{self.delay_s}s/tick")
+        return " ".join(parts)
+
+    @classmethod
+    def sample(cls, seed: int, horizon: int,
+               alloc_fails: int = 0, crashes: int = 0,
+               stalls: int = 0, stall_s: float = 0.0,
+               delay_s: float = 0.0) -> "FaultPlan":
+        """A seeded random plan over ``horizon`` ticks/calls — the
+        same (seed, shape) always yields the same plan (random.Random,
+        no global state), so a chaos sweep is reproducible from its
+        seed alone."""
+        if horizon < 1:
+            raise ValueError(f"horizon={horizon} must be >= 1")
+        rng = random.Random(seed)
+
+        def pick(n: int) -> Tuple[int, ...]:
+            n = min(n, horizon)
+            return tuple(sorted(rng.sample(range(horizon), n)))
+
+        return cls(alloc_fail_calls=pick(alloc_fails),
+                   crash_at_ticks=pick(crashes),
+                   stall_at_ticks=pick(stalls),
+                   stall_s=float(stall_s), delay_s=float(delay_s))
+
+
+@dataclasses.dataclass(frozen=True)
+class DegradedSimResult:
+    """Closed-form accounting for one degraded replay: every
+    submitted request lands in exactly one terminal bucket (the
+    terminates-typed invariant, counted) — ``completed`` + ``shed`` +
+    ``timed_out`` == requests submitted."""
+
+    completed: int
+    shed: int
+    timed_out: int
+    ticks: int
+    completed_frac: float
+    terminals: dict    # rid -> "result" | "shed" | "timeout"
+
+
+def simulate_degraded(scheduler, requests, max_queue: int = 0) -> DegradedSimResult:
+    """Replay ``requests`` (``(rid, prompt_len, max_new_tokens,
+    arrival[, deadline])`` — deadline in ticks, absolute) through a
+    scheduler under admission control: arrivals are fed at their tick,
+    a full queue (``max_queue`` > 0 waiting slots) sheds on arrival,
+    and the scheduler's own deadline machinery retires expirations.
+    Pure Python — the deterministic half of ``bench_serving_degraded``
+    and the closed-form oracle the chaos tests pin engine counters
+    against."""
+    pending = sorted(
+        ((tuple(r) + (None,) * (5 - len(r))) for r in requests),
+        key=lambda r: (r[3] or 0.0, r[0]))
+    total = len(pending)
+    terminals = {}
+    t = 0.0
+    guard = 0
+    while pending or not scheduler.idle:
+        # feed arrivals due by now; shed on a full waiting queue
+        while pending and (pending[0][3] or 0.0) <= t:
+            rid, p, n, arrival, deadline = pending.pop(0)
+            if max_queue and len(scheduler.waiting) >= max_queue:
+                terminals[rid] = "shed"
+                continue
+            scheduler.submit(rid, p, n, arrival=arrival or 0.0,
+                             deadline=deadline)
+        plan = scheduler.plan_tick(now=t)
+        for rid, _reason in scheduler.take_expired():
+            terminals[rid] = "timeout"
+        t += 1.0
+        if plan is None:
+            if not pending and scheduler.idle:
+                break
+            guard += 1
+            if guard > 10_000_000:
+                raise RuntimeError("degraded simulation did not "
+                                   "converge")
+            continue
+        for rid in plan.prefills:
+            scheduler.record_prefill(rid, now=t)
+        scheduler.record_decode(
+            [r for r in plan.decodes
+             if not scheduler._seq(r).done], now=t)
+        guard += 1
+        if guard > 10_000_000:
+            raise RuntimeError("degraded simulation did not converge")
+    for rid in scheduler.finished:
+        terminals.setdefault(rid, "result")
+    completed = sum(1 for v in terminals.values() if v == "result")
+    shed = sum(1 for v in terminals.values() if v == "shed")
+    timed_out = sum(1 for v in terminals.values() if v == "timeout")
+    if completed + shed + timed_out != total:
+        raise AssertionError(
+            f"terminates-typed invariant violated in simulation: "
+            f"{completed}+{shed}+{timed_out} != {total} requests")
+    return DegradedSimResult(
+        completed=completed, shed=shed, timed_out=timed_out,
+        ticks=scheduler.ticks,
+        completed_frac=round(completed / max(1, total), 6),
+        terminals=terminals)

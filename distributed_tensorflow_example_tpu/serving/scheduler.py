@@ -80,9 +80,15 @@ def bucket_for(n: int, buckets: Tuple[int, ...]) -> int:
 class BlockAllocator:
     """Free-list page allocator over a pool of ``num_pages``. Page 0
     is reserved as the SCRATCH page (dead batch slots write there), so
-    ``usable`` = num_pages - 1.  LIFO reuse keeps the hot pages hot."""
+    ``usable`` = num_pages - 1.  LIFO reuse keeps the hot pages hot.
 
-    def __init__(self, num_pages: int, page_size: int):
+    ``faults``: an optional serving/faults.FaultPlan — allocation
+    calls are numbered 0, 1, 2, ... and a call the plan names fails
+    (returns None, indistinguishable from pool exhaustion to the
+    caller).  None (the default) injects nothing and costs one
+    attribute check."""
+
+    def __init__(self, num_pages: int, page_size: int, faults=None):
         if num_pages < 2:
             raise ValueError(f"num_pages={num_pages} must be >= 2 "
                              f"(page 0 is the reserved scratch page)")
@@ -90,6 +96,9 @@ class BlockAllocator:
             raise ValueError(f"page_size={page_size} must be >= 1")
         self.num_pages = num_pages
         self.page_size = page_size
+        self.faults = faults
+        self.alloc_calls = 0
+        self.injected_fails = 0
         self._free: List[int] = list(range(num_pages - 1, SCRATCH_PAGE,
                                            -1))
 
@@ -108,6 +117,11 @@ class BlockAllocator:
     def alloc(self, n: int) -> Optional[List[int]]:
         """``n`` pages or None (all-or-nothing: a partial grant would
         deadlock admission)."""
+        call = self.alloc_calls
+        self.alloc_calls += 1
+        if self.faults is not None and self.faults.fail_alloc(call):
+            self.injected_fails += 1
+            return None
         if n > len(self._free):
             return None
         got = [self._free.pop() for _ in range(n)]
@@ -136,6 +150,12 @@ class SeqState:
     pages: List[int] = dataclasses.field(default_factory=list)
     generated: int = 0
     finish_t: Optional[float] = None
+    # absolute deadline on the scheduler's ``now`` clock (tick count
+    # in simulation, wall clock live); None = no deadline
+    deadline: Optional[float] = None
+    # engine-supervision retry count (how many crashes this request
+    # already survived via requeue)
+    attempts: int = 0
 
     @property
     def length(self) -> int:
@@ -168,10 +188,10 @@ class ContinuousScheduler:
     live ragged batch."""
 
     def __init__(self, num_pages: int, page_size: int, max_batch: int,
-                 recorder=None):
+                 recorder=None, faults=None):
         if max_batch < 1:
             raise ValueError(f"max_batch={max_batch} must be >= 1")
-        self.alloc = BlockAllocator(num_pages, page_size)
+        self.alloc = BlockAllocator(num_pages, page_size, faults=faults)
         self.page_size = page_size
         self.max_batch = max_batch
         self.batch_buckets = shape_buckets(max_batch)
@@ -187,6 +207,20 @@ class ContinuousScheduler:
         # anything with .emit(event, **fields)) — INJECTED so the
         # scheduler module itself stays jax- and obs-free; None = off
         self.recorder = recorder
+        # deadline/cancel machinery: rids marked for cancellation are
+        # retired at the next tick boundary exactly like an expired
+        # deadline (same page-freeing path, reason "cancel"); the
+        # boundary's typed expirations accumulate in _expired until
+        # the engine drains them via take_expired()
+        self._cancelled: set = set()
+        self._expired: List[Tuple[int, str]] = []
+        self.timeouts = 0
+        # brownout verdict for THIS boundary, set by the engine before
+        # plan_tick: (clamp_new_tokens, admit_per_tick) or None.  The
+        # scheduler only applies it — the policy (thresholds,
+        # hysteresis) lives in serving/admission.py
+        self.brownout: Optional[Tuple[int, int]] = None
+        self.brownout_clamped = 0
 
     def _emit(self, event: str, **fields) -> None:
         if self.recorder is not None:
@@ -194,7 +228,8 @@ class ContinuousScheduler:
 
     # ---- request surface ----
     def submit(self, rid: int, prompt_len: int, max_new_tokens: int,
-               arrival: float = 0.0) -> None:
+               arrival: float = 0.0,
+               deadline: Optional[float] = None) -> None:
         if prompt_len < 1 or max_new_tokens < 1:
             raise ValueError("prompt_len and max_new_tokens must be "
                              ">= 1")
@@ -204,12 +239,86 @@ class ContinuousScheduler:
                 f"request {rid} needs {need} pages; the pool only has "
                 f"{self.alloc.usable} usable")
         self.waiting.append(SeqState(rid, prompt_len, max_new_tokens,
-                                     arrival=arrival))
+                                     arrival=arrival,
+                                     deadline=deadline))
         # emitted on ACCEPT only (validation above raises first), so
         # the span stream's submit events mirror requests_total
+        extra = ({"deadline": float(deadline)}
+                 if deadline is not None else {})
         self._emit("submit", rid=rid, prompt_len=int(prompt_len),
                    max_new_tokens=int(max_new_tokens),
-                   arrival=float(arrival))
+                   arrival=float(arrival), **extra)
+
+    def requeue(self, s: SeqState) -> None:
+        """Put a previously-admitted request back on the waiting
+        queue with its work discarded (pages must already be freed by
+        the caller's teardown; generated tokens are re-earned by a
+        fresh prefill).  Engine supervision's re-admission path — no
+        ``submit`` span is emitted (the rid already has one; the
+        engine narrates the ``requeue`` event itself)."""
+        if s.pages:
+            raise ValueError(f"requeue of rid {s.rid} still holding "
+                             f"pages {s.pages}")
+        s.generated = 0
+        s.finish_t = None
+        self.waiting.append(s)
+
+    def cancel(self, rid: int) -> bool:
+        """Mark ``rid`` for cancellation: the next tick boundary
+        retires it through the deadline path (pages freed, typed
+        ``timeout`` terminal with reason "cancel").  Returns False for
+        a rid that is not waiting or live (already terminal)."""
+        known = any(s.rid == rid for s in self.waiting) \
+            or any(s.rid == rid for s in self.live)
+        if known:
+            self._cancelled.add(rid)
+        return known
+
+    def take_expired(self) -> List[Tuple[int, str]]:
+        """Drain the (rid, reason) pairs retired by deadline expiry or
+        cancellation since the last call — the engine finalizes their
+        results from this list right after each ``plan_tick``."""
+        out, self._expired = self._expired, []
+        return out
+
+    def _expire(self, now: float, tick: int) -> None:
+        """Retire every waiting/live request whose deadline has passed
+        or that was cancelled — pages freed BEFORE retirement and
+        admission look at the pool, one typed ``timeout`` span each."""
+        for s in list(self.waiting):
+            reason = self._expiry_reason(s, now)
+            if reason is None:
+                continue
+            self.waiting.remove(s)
+            self._retire_expired(s, reason, tick, waited=True)
+        for s in list(self.live):
+            if s.done:
+                # finished last boundary, awaiting retirement: its
+                # tokens were delivered IN time — the deadline race
+                # resolves in favor of completed work
+                continue
+            reason = self._expiry_reason(s, now)
+            if reason is None:
+                continue
+            self.live.remove(s)
+            self.alloc.free(s.pages)
+            s.pages = []
+            self._retire_expired(s, reason, tick, waited=False)
+
+    def _expiry_reason(self, s: SeqState, now: float) -> Optional[str]:
+        if s.rid in self._cancelled:
+            return "cancel"
+        if s.deadline is not None and now > s.deadline:
+            return "deadline"
+        return None
+
+    def _retire_expired(self, s: SeqState, reason: str, tick: int,
+                        waited: bool) -> None:
+        self._cancelled.discard(s.rid)
+        self._expired.append((s.rid, reason))
+        self.timeouts += 1
+        self._emit("timeout", rid=s.rid, reason=reason, tick=tick,
+                   generated=int(s.generated), queued=bool(waited))
 
     def _pages_for(self, prompt_len: int, max_new: int) -> int:
         # rows written run 0 .. prompt+max_new-2: the final token is
@@ -228,37 +337,69 @@ class ContinuousScheduler:
         # 0-based boundary index every span event at this boundary
         # shares (the step-index the SLO windows slide over)
         tick = self.ticks
+        # 0) expire: deadlines/cancellations free their pages first —
+        # a request past its deadline must not hold capacity that
+        # could admit a request that can still make its own
+        self._expire(now, tick)
         # 1) retire: pages return BEFORE admission looks at the pool
         for s in [s for s in self.live if s.done]:
             self.live.remove(s)
             self.alloc.free(s.pages)
             s.pages = []
             self.finished[s.rid] = s
+            # a cancel that lost the race to completion must not
+            # leak its marker for the scheduler's lifetime
+            self._cancelled.discard(s.rid)
             self._emit("retire", rid=s.rid, generated=s.generated,
                        finish_t=float(s.finish_t or 0.0), tick=tick)
-        # 2) admit FIFO among the arrived
+        # 2) admit FIFO among the arrived (under the boundary's
+        # brownout verdict, when the engine set one: admission width
+        # capped, new admissions' token budgets clamped)
+        clamp = admit_cap = None
+        if self.brownout is not None:
+            clamp, admit_cap = self.brownout
         prefills: List[int] = []
         for s in list(self.waiting):
             if s.arrival > now:
                 continue                  # not arrived ≠ blocked
+            if admit_cap is not None and len(prefills) >= admit_cap:
+                # brownout admission-width cap: the queue drains at a
+                # bounded rate until the pressure signal clears
+                self._emit("blocked", rid=s.rid, reason="brownout",
+                           tick=tick)
+                break
             if len(self.live) >= self.max_batch:
                 self._emit("blocked", rid=s.rid, reason="slots",
                            tick=tick)
                 continue
+            # degrade, don't refuse: a clamped answer reserves fewer
+            # pages and frees its slot sooner.  The budget mutation,
+            # counter and admit tag land ONLY on a successful
+            # admission — a clamped-then-blocked request must keep
+            # its submitted budget (or its retire would contradict
+            # the submit span with no clamped tag to exempt it)
+            eff_new = s.max_new_tokens
+            if clamp is not None and eff_new > clamp:
+                eff_new = clamp
             pages = self.alloc.alloc(
-                self._pages_for(s.prompt_len, s.max_new_tokens))
+                self._pages_for(s.prompt_len, eff_new))
             if pages is None:
                 # head-of-line blocks on pages: smaller requests behind
                 # it must not starve it forever — stop admitting
                 self._emit("blocked", rid=s.rid, reason="pages",
                            tick=tick)
                 break
+            clamped = eff_new < s.max_new_tokens
+            if clamped:
+                s.max_new_tokens = eff_new
+                self.brownout_clamped += 1
             s.pages = pages
             self.waiting.remove(s)
             self.live.append(s)
             prefills.append(s.rid)
+            extra = {"clamped": True} if clamped else {}
             self._emit("admit", rid=s.rid, pages_held=len(pages),
-                       tick=tick)
+                       tick=tick, **extra)
         if not self.live:
             return None
         decodes = [s.rid for s in self.live if not s.done]
@@ -333,6 +474,9 @@ class StaticBatchScheduler(ContinuousScheduler):
 
     def plan_tick(self, now: float = float("inf")) -> Optional[TickPlan]:
         tick = self.ticks
+        # deadlines/cancellations expire identically under both
+        # policies (the same typed-terminal contract)
+        self._expire(now, tick)
         # retire pages as sequences finish (memory is freed either
         # way; the STATIC restriction is about slots, not pages)
         for s in [s for s in self.live if s.done and s.pages]:
@@ -341,6 +485,7 @@ class StaticBatchScheduler(ContinuousScheduler):
         if self.live and all(s.done for s in self.live):
             for s in self.live:
                 self.finished[s.rid] = s
+                self._cancelled.discard(s.rid)
                 self._emit("retire", rid=s.rid, generated=s.generated,
                            finish_t=float(s.finish_t or 0.0),
                            tick=tick)

@@ -115,6 +115,22 @@ def _stub_rows(monkeypatch):
                           "serving_p99_ms": 214.2,
                           "serving_tok_s": 950.1,
                           "serving_requests": 24})
+    # the degraded-serving row (r15) runs on EVERY backend: the
+    # analytic deadline/shed accounting + the supervision A/B must
+    # reach the final line under the gate names
+    monkeypatch.setattr(
+        bench, "bench_serving_degraded",
+        lambda *a, **kw: {"config": "serving_degraded",
+                          "degraded_sim_ticks": 35,
+                          "degraded_completed_sim": 16,
+                          "degraded_shed_sim": 4,
+                          "degraded_timeout_sim": 4,
+                          "serving_degraded_completed_frac": 0.666667,
+                          "terminates_typed": True,
+                          "supervised_completed": 12,
+                          "unsupervised_completed": 0,
+                          "supervision_recovers": True,
+                          "serving_degraded_p99_ms": 512.5})
     # the multi-site local-SGD row (r10) runs on EVERY backend: the
     # analytic comm-volume keys + the measured A/B must reach the
     # final line under their gate names
@@ -214,6 +230,11 @@ def test_bench_main_cpu_stubbed(monkeypatch, capsys):
     assert final["serving_p99_ms"] == 214.2
     assert final["serving_tok_s"] == 950.1
     assert final["serving_tick_speedup"] == 1.604
+    # the r15 degraded-serving carriage (every backend): analytic
+    # completed fraction + supervised p99 + the A/B verdict
+    assert final["serving_degraded_completed_frac"] == 0.666667
+    assert final["serving_degraded_p99_ms"] == 512.5
+    assert final["supervision_recovers"] is True
     assert final["serving_continuous_beats_static"] is True
     # the r10 multi-site carriage (every backend): the analytic H=8
     # comm bytes/token + reductions + the measured final-cost A/B

@@ -103,6 +103,53 @@ def test_serving_flags():
     assert parse_config(["--decode_pages=2"]).decode_pages == 2
 
 
+def test_failopen_serving_flags():
+    """r15 fail-open knobs parse onto their Config fields and the
+    defaults keep every one OFF (the bitwise-invisible default
+    path)."""
+    cfg = parse_config(["--deadline_ms=2500", "--max_queue=64",
+                        "--brownout=occ=0.8,clamp=4",
+                        "--engine_retries=3"])
+    assert cfg.deadline_ms == 2500.0
+    assert cfg.max_queue == 64
+    assert cfg.brownout == "occ=0.8,clamp=4"
+    assert cfg.engine_retries == 3
+    d = parse_config([])
+    assert d.deadline_ms == 0.0       # no default deadline
+    assert d.max_queue == 0           # unbounded queue
+    assert d.brownout == ""           # brownout off
+    assert d.engine_retries == 0      # fail-closed (no supervision)
+
+
+def test_failopen_serving_validation_matrix():
+    """The fail-open serving validation matrix, pinned against
+    ``config.validate_serving_config`` directly (pure config — no
+    training stack), the validate_pipeline_config pattern; the
+    brownout DSL parse rides it (serving/admission.py, pure
+    Python)."""
+    import pytest
+
+    from distributed_tensorflow_example_tpu.config import (
+        Config, validate_serving_config)
+
+    def ok(**kw):
+        validate_serving_config(Config(**kw))
+
+    def bad(match, **kw):
+        with pytest.raises(ValueError, match=match):
+            validate_serving_config(Config(**kw))
+
+    ok()                                          # defaults: all off
+    ok(deadline_ms=1000.0, max_queue=32, engine_retries=2,
+       brownout="on")
+    ok(brownout="occ=0.5,clamp=2,admit=1,burn=3.0")
+    bad("deadline_ms", deadline_ms=-1.0)
+    bad("max_queue", max_queue=-1)
+    bad("engine_retries", engine_retries=-2)
+    bad("brownout", brownout="bogus=1")
+    bad("brownout", brownout="occ=notafloat")
+
+
 def test_fused_kernel_flags():
     """--fused_ln / --grouped_moe parse onto their Config fields and
     default off (the reference paths stay the default — the kernels

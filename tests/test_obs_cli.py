@@ -339,6 +339,43 @@ def test_compare_understands_serving_keys():
     assert "decode_hbm_frac" in verdict["regressions"]
 
 
+def test_compare_understands_serving_degraded_keys():
+    """The fail-open serving row (ISSUE 15): bench_serving_degraded
+    gates on the deterministic completed fraction (tight 1% — closed
+    form) and the supervised crash-plan p99 (wide), keyed on the
+    row-only degraded_sim_ticks so the final summary — which carries
+    both gate keys too — falls through to its own branch (the
+    serving lesson)."""
+    row = {"config": "serving_degraded", "degraded_sim_ticks": 35,
+           "degraded_completed_sim": 16, "degraded_shed_sim": 4,
+           "degraded_timeout_sim": 4,
+           "serving_degraded_completed_frac": 0.666667,
+           "terminates_typed": True, "supervision_recovers": True,
+           "serving_degraded_p99_ms": 512.5}
+    m = cmp_lib.extract_metrics(row)
+    assert m == {"serving_degraded_completed_frac": 0.666667,
+                 "serving_degraded_p99_ms": 512.5}
+    # a doctored goodput drop (completed fraction down 3% against a
+    # 1% analytic gate) regresses; a p99 blowup past the wide 25%
+    # A/B threshold regresses too
+    worse = dict(row, serving_degraded_completed_frac=0.645833,
+                 serving_degraded_p99_ms=700.0)
+    verdict = cmp_lib.compare(row, worse)
+    assert not verdict["ok"]
+    assert "serving_degraded_completed_frac" in verdict["regressions"]
+    assert "serving_degraded_p99_ms" in verdict["regressions"]
+    # final-summary shape: the degraded keys ride ALONGSIDE wall_s —
+    # the summary must not be mistaken for a degraded row
+    summary = {"metric": "mnist_20epoch_wall_clock", "value": 0.15,
+               "serving_degraded_completed_frac": 0.666667,
+               "serving_degraded_p99_ms": 512.5,
+               "supervision_recovers": True}
+    ms = cmp_lib.extract_metrics(summary)
+    assert ms["wall_s"] == 0.15
+    assert ms["serving_degraded_completed_frac"] == 0.666667
+    assert ms["serving_degraded_p99_ms"] == 512.5
+
+
 def test_compare_understands_local_sgd_keys():
     """The multi-site local-SGD row (ISSUE 10): the bench_local_sgd
     row gates on the analytic H=8 comm bytes/token and the measured

@@ -1,0 +1,858 @@
+"""Fail-open serving (ISSUE 15): the chaos acceptance suite.
+
+Two halves, mirroring the serving stack's own split (the
+tests/test_serving.py discipline):
+
+- **pure Python** (scheduler + faults + admission, no jax anywhere in
+  the process): FaultPlan determinism, allocator fault injection,
+  deadline/cancel page-freeing, brownout transitions, and the
+  closed-form degraded-workload counts ``bench_serving_degraded``
+  gates on;
+- **engine** (CPU jax): the kill/fault matrix through the REAL
+  DecodeEngine — alloc-fail at admission, loop crash mid-decode
+  (supervised and not), stall past a deadline, burst overload — each
+  asserting THE invariant this PR exists to prove: every accepted
+  request terminates in exactly one typed state
+  {result, timeout, shed, failed}, verified per-rid via span
+  ``reconstruct()``; plus the bitwise-invisibility pin (fault
+  plumbing present-but-disabled is token-identical) and the
+  supervision-recovers A/B.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from distributed_tensorflow_example_tpu.serving import (  # noqa: E402
+    admission as adm,
+)
+from distributed_tensorflow_example_tpu.serving import (  # noqa: E402
+    faults as fl,
+)
+from distributed_tensorflow_example_tpu.serving import (  # noqa: E402
+    scheduler as sl,
+)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from distributed_tensorflow_example_tpu.models import (  # noqa: E402
+    transformer as tfm,
+)
+from distributed_tensorflow_example_tpu.serving.engine import (  # noqa: E402
+    DecodeEngine,
+)
+
+FaultPlan = fl.FaultPlan
+
+
+# --- FaultPlan / pure scheduler ------------------------------------------
+
+
+def test_fault_modules_are_pure_python():
+    """faults.py + admission.py (and the package lazy exports
+    resolving them) import with NO jax in the process — what keeps
+    the chaos sim and the bench's analytic half runnable
+    everywhere."""
+    code = (
+        "import sys\n"
+        "from distributed_tensorflow_example_tpu.serving import "
+        "FaultPlan, ShedError, BrownoutPolicy, simulate_degraded\n"
+        "from distributed_tensorflow_example_tpu.serving import "
+        "scheduler as sl\n"
+        "r = simulate_degraded(sl.ContinuousScheduler(9, 4, 2),"
+        " [(0, 3, 2, 0.0, None)])\n"
+        "assert r.completed == 1 and r.terminals[0] == 'result'\n"
+        "assert not FaultPlan().active\n"
+        "assert 'jax' not in sys.modules, 'faults pulled in jax'\n"
+    )
+    subprocess.run([sys.executable, "-c", code], check=True, cwd=_REPO)
+
+
+def test_faultplan_defaults_and_validation():
+    p = FaultPlan()
+    assert not p.active
+    assert p.describe() == "disabled"
+    assert not p.fail_alloc(0) and not p.crash(0)
+    assert p.stall(0) == 0.0
+    with pytest.raises(ValueError):
+        FaultPlan(stall_at_ticks=(1,))          # stall without stall_s
+    with pytest.raises(ValueError):
+        FaultPlan(delay_s=-1.0)
+    p = FaultPlan(crash_at_ticks=(3,), alloc_fail_calls=(0, 2),
+                  stall_at_ticks=(1,), stall_s=0.5, delay_s=0.01)
+    assert p.active and p.crash(3) and not p.crash(2)
+    assert p.fail_alloc(0) and p.fail_alloc(2) and not p.fail_alloc(1)
+    assert p.stall(1) == 0.5 and p.stall(2) == 0.0
+    assert "crash@ticks[3]" in p.describe()
+
+
+def test_faultplan_sample_is_seed_deterministic():
+    a = FaultPlan.sample(7, horizon=50, alloc_fails=3, crashes=2,
+                         stalls=1, stall_s=0.1)
+    b = FaultPlan.sample(7, horizon=50, alloc_fails=3, crashes=2,
+                         stalls=1, stall_s=0.1)
+    assert a == b
+    c = FaultPlan.sample(8, horizon=50, alloc_fails=3, crashes=2,
+                         stalls=1, stall_s=0.1)
+    assert a != c
+    assert len(a.alloc_fail_calls) == 3 and len(a.crash_at_ticks) == 2
+    with pytest.raises(ValueError):
+        FaultPlan.sample(0, horizon=0)
+
+
+def test_allocator_fault_injection_is_alloc_shaped():
+    """An injected allocation failure is indistinguishable from pool
+    exhaustion (None, nothing partially granted) and is counted; the
+    free list is untouched so the next call succeeds."""
+    alloc = sl.BlockAllocator(9, 4, faults=FaultPlan(
+        alloc_fail_calls=(0, 2)))
+    assert alloc.alloc(2) is None                 # call 0 injected
+    assert alloc.free_count == 8
+    got = alloc.alloc(2)                          # call 1 clean
+    assert len(got) == 2 and alloc.in_use == 2
+    assert alloc.alloc(1) is None                 # call 2 injected
+    assert alloc.injected_fails == 2 and alloc.alloc_calls == 3
+    # disabled plan is invisible: same calls, no fails
+    clean = sl.BlockAllocator(9, 4, faults=FaultPlan())
+    assert clean.alloc(2) is not None and clean.injected_fails == 0
+
+
+def test_scheduler_admission_rides_through_alloc_fault():
+    """An alloc-fail at admission blocks the head of line THAT tick
+    (reason "pages" — exactly what exhaustion looks like) and admits
+    on the next; the request still completes (delayed, not lost)."""
+    events = []
+
+    class Rec:
+        def emit(self, e, **f):
+            events.append((e, f))
+
+    s = sl.ContinuousScheduler(9, 4, 2, recorder=Rec(),
+                               faults=FaultPlan(alloc_fail_calls=(0,)))
+    res = fl.simulate_degraded(s, [(0, 3, 2, 0.0, None)])
+    assert res.completed == 1 and res.terminals[0] == "result"
+    blocked = [f for e, f in events if e == "blocked"]
+    assert blocked and blocked[0]["reason"] == "pages"
+    assert s.alloc.injected_fails == 1
+
+
+def test_deadline_expiry_frees_pages_and_types_timeout():
+    """A live request past its deadline is retired at the boundary:
+    pages BACK in the pool before admission looks, a typed timeout
+    span with reason "deadline", and take_expired() reports it
+    exactly once."""
+    events = []
+
+    class Rec:
+        def emit(self, e, **f):
+            events.append((e, f))
+
+    s = sl.ContinuousScheduler(17, 4, 2, recorder=Rec())
+    s.submit(0, 6, 8, arrival=0.0, deadline=2.0)
+    plan = s.plan_tick(now=0.0)
+    assert plan is not None and 0 in plan.prefills
+    held = s.alloc.in_use
+    assert held >= 1
+    s.record_prefill(0, now=1.0)
+    # deadline 2.0 passed: the next boundary expires it
+    assert s.plan_tick(now=3.0) is None
+    assert s.alloc.in_use == 0                    # pages freed
+    assert s.take_expired() == [(0, "deadline")]
+    assert s.take_expired() == []                 # drained exactly once
+    t = [f for e, f in events if e == "timeout"]
+    assert len(t) == 1 and t[0]["reason"] == "deadline"
+    assert t[0]["generated"] == 1 and t[0]["queued"] is False
+    assert s.idle and s.timeouts == 1
+
+
+def test_waiting_deadline_expires_without_pages():
+    s = sl.ContinuousScheduler(9, 4, 1)
+    s.submit(0, 3, 8, arrival=0.0)                # hogs the only slot
+    s.submit(1, 3, 2, arrival=0.0, deadline=1.0)  # will never admit
+    assert s.plan_tick(now=0.0) is not None
+    s.record_prefill(0, now=1.0)
+    assert s.plan_tick(now=2.0) is not None
+    assert (1, "deadline") in s.take_expired()
+    assert all(w.rid != 1 for w in s.waiting)
+
+
+def test_done_request_wins_the_deadline_race():
+    """A request that finished last boundary but awaits retirement
+    must RETIRE (its tokens were delivered in time), not time out,
+    even when the deadline passed in between."""
+    s = sl.ContinuousScheduler(9, 4, 1)
+    s.submit(0, 3, 1, arrival=0.0, deadline=5.0)
+    assert s.plan_tick(now=0.0) is not None
+    s.record_prefill(0, now=1.0)                  # done (1 token)
+    assert s.plan_tick(now=99.0) is None          # way past deadline
+    assert s.take_expired() == []
+    assert 0 in s.finished and s.timeouts == 0
+
+
+def test_cancel_frees_like_a_deadline():
+    events = []
+
+    class Rec:
+        def emit(self, e, **f):
+            events.append((e, f))
+
+    s = sl.ContinuousScheduler(17, 4, 2, recorder=Rec())
+    s.submit(0, 6, 8, arrival=0.0)
+    assert s.plan_tick(now=0.0) is not None
+    s.record_prefill(0, now=1.0)
+    assert s.cancel(0) is True
+    assert s.cancel(99) is False                  # unknown rid
+    assert s.plan_tick(now=1.5) is None
+    assert s.alloc.in_use == 0
+    assert s.take_expired() == [(0, "cancel")]
+    t = [f for e, f in events if e == "timeout"]
+    assert len(t) == 1 and t[0]["reason"] == "cancel"
+    assert s.cancel(0) is False                   # already terminal
+
+
+def test_brownout_policy_transitions_closed_form():
+    p = adm.BrownoutPolicy(occupancy_hi=0.9, occupancy_lo=0.75,
+                           burn_hi=2.0)
+    assert p.update(False, 0.5, None) is False
+    assert p.update(False, 0.9, None) is True       # occ trigger
+    assert p.update(False, 0.5, 2.0) is True        # burn trigger
+    assert p.update(True, 0.8, None) is True        # hysteresis holds
+    assert p.update(True, 0.74, None) is False      # below lo: clears
+    assert p.update(True, 0.74, 2.5) is True        # burn keeps it on
+    with pytest.raises(ValueError):
+        adm.BrownoutPolicy(occupancy_hi=1.5)
+    with pytest.raises(ValueError):
+        adm.BrownoutPolicy(occupancy_lo=0.95, occupancy_hi=0.9)
+    with pytest.raises(ValueError):
+        adm.BrownoutPolicy(clamp_new_tokens=0)
+
+
+def test_parse_brownout_dsl():
+    assert adm.parse_brownout("") is None
+    assert adm.parse_brownout("on") == adm.BrownoutPolicy()
+    p = adm.parse_brownout("occ=0.8,clamp=4,admit=2")
+    assert p.occupancy_hi == 0.8 and p.clamp_new_tokens == 4
+    assert p.admit_per_tick == 2
+    # lo scales down with a lowered hi (lo<=hi must hold)
+    p = adm.parse_brownout("occ=0.5")
+    assert p.occupancy_lo <= p.occupancy_hi == 0.5
+    with pytest.raises(ValueError):
+        adm.parse_brownout("bogus=1")
+    with pytest.raises(ValueError):
+        adm.parse_brownout("occ=x")
+
+
+def test_scheduler_brownout_clamps_and_caps_admission():
+    """With the boundary's brownout verdict set, new admissions clamp
+    their token budget (fewer pages reserved, admit span tagged
+    clamped) and admission width is capped, with the overflow blocked
+    under reason "brownout"."""
+    events = []
+
+    class Rec:
+        def emit(self, e, **f):
+            events.append((e, f))
+
+    s = sl.ContinuousScheduler(33, 4, 4, recorder=Rec())
+    for rid in range(3):
+        s.submit(rid, 3, 16, arrival=0.0)
+    s.brownout = (2, 1)            # clamp to 2 tokens, admit 1/tick
+    plan = s.plan_tick(now=0.0)
+    assert plan.prefills == (0,)   # width capped at 1
+    admitted = s.live[0]
+    assert admitted.max_new_tokens == 2           # clamped
+    assert s.brownout_clamped == 1
+    admits = [f for e, f in events if e == "admit"]
+    assert admits[0].get("clamped") is True
+    blocked = [f for e, f in events if e == "blocked"]
+    assert blocked and blocked[0]["reason"] == "brownout"
+    # verdict cleared: the rest admit unclamped
+    s.brownout = None
+    s.record_prefill(0, now=1.0)
+    plan = s.plan_tick(now=1.0)
+    assert set(plan.prefills) == {1, 2}
+    assert all(x.max_new_tokens == 16 for x in s.live
+               if x.rid in (1, 2))
+
+
+def test_brownout_clamp_lands_only_on_admission():
+    """A clamped-then-BLOCKED request keeps its submitted budget: the
+    mutation/counter/tag land only when admission succeeds —
+    otherwise a later unclamped admit would retire short of the
+    submit span with no clamped tag to exempt it (a false stream
+    violation)."""
+    s = sl.ContinuousScheduler(9, 4, 2,
+                               faults=FaultPlan(alloc_fail_calls=(0,)))
+    s.submit(0, 3, 16)
+    s.brownout = (2, 4)
+    assert s.plan_tick(now=0.0) is None     # injected alloc failure
+    assert s.waiting[0].max_new_tokens == 16  # budget untouched
+    assert s.brownout_clamped == 0
+    plan = s.plan_tick(now=1.0)             # clean alloc this time
+    assert plan is not None and plan.prefills == (0,)
+    assert s.live[0].max_new_tokens == 2
+    assert s.brownout_clamped == 1
+
+
+def test_simulate_degraded_closed_form_counts():
+    """A hand-computable workload: 1 slot, tiny queue — exact
+    completed/shed/timeout counts, the terminates-typed invariant
+    asserted inside the simulator, bit-identical across replays."""
+    def run():
+        s = sl.ContinuousScheduler(33, 4, 1)
+        reqs = [
+            (0, 3, 4, 0.0, None),     # admits at t0, done t4
+            (1, 3, 2, 0.0, 2.0),      # queued behind 0, expires at 2
+            (2, 3, 2, 0.0, None),     # arrives to a FULL queue: shed
+            (3, 3, 2, 0.5, None),     # by t=1, rid 0 admitted -> room
+        ]
+        return fl.simulate_degraded(s, reqs, max_queue=2)
+
+    a, b = run(), run()
+    assert a == b                                   # deterministic
+    assert a.terminals == {0: "result", 1: "timeout", 2: "shed",
+                           3: "result"}
+    assert (a.completed, a.shed, a.timed_out) == (2, 1, 1)
+    assert a.completed_frac == 0.5
+
+
+def test_bench_degraded_sim_counts_pinned():
+    """The bench_serving_degraded analytic half's closed-form
+    expectation (seed 0, the shipped workload): shed/timeout counters
+    the acceptance criterion pins — a drift here IS a scheduler
+    behavior change and must be deliberate."""
+    rng = np.random.RandomState(0)
+    reqs = []
+    t = 0.0
+    for i in range(24):
+        t += float(rng.exponential(1.0))
+        p, n = int(rng.randint(4, 24)), int(rng.randint(2, 18))
+        reqs.append((i, p, n, t, t + 6.0 if i % 3 == 0 else None))
+    sim = fl.simulate_degraded(
+        sl.ContinuousScheduler(33, 8, 4), reqs, max_queue=3)
+    assert sim.completed + sim.shed + sim.timed_out == 24
+    assert (sim.completed, sim.shed, sim.timed_out) == (16, 4, 4)
+    assert sim.completed_frac == round(16 / 24, 6)
+
+
+# --- engine chaos matrix (CPU jax) ---------------------------------------
+
+
+def _spec(**kw):
+    base = dict(input_size=32, num_classes=10, seq_len=32, d_model=32,
+                n_heads=2, num_blocks=2, d_ff=64, objective="lm",
+                vocab_size=50, causal=True)
+    base.update(kw)
+    return tfm.TransformerSpec(**base)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    spec = _spec()
+    return spec, tfm.init(jax.random.PRNGKey(0), spec)
+
+
+def _drain(eng, rids, timeout=60.0):
+    """Collect every rid's terminal result (None = the invariant
+    broke: a request neither completed nor reached a typed end)."""
+    return [eng.result(r, timeout=timeout) for r in rids]
+
+
+def _write_minimal_metrics(logs):
+    """One schema-valid window row + run_end, so aggregate() has a
+    run to anchor the restart timeline to (the test_resilience
+    pattern)."""
+    from distributed_tensorflow_example_tpu.obs import (
+        schema as schema_lib,
+    )
+
+    row = {"kind": "window", "v": schema_lib.SCHEMA_VERSION, "t": 10.0,
+           "proc": 0, "step": 8, "epoch": 0, "cost": 1.0,
+           "path": "host", "steps": 8, "window_wall_s": 8.0,
+           "step_time_p50_ms": 1000.0, "step_time_p95_ms": 1000.0,
+           "step_time_max_ms": 1000.0, "data_wait_s": 1.0,
+           "h2d_s": 0.5, "dispatch_s": 2.0, "device_wait_s": 3.0,
+           "ckpt_s": 0.0, "host_s": 1.0, "examples_per_sec": 10.0,
+           "tokens_per_sec": None, "model_flops_per_step": 100,
+           "tflops_per_sec": None, "mfu": 0.1, "rss_bytes": None,
+           "device_memory": None}
+    end = {"kind": "event", "v": schema_lib.SCHEMA_VERSION,
+           "event": "run_end", "t": 20.0, "proc": 0, "steps": 8,
+           "total_time_s": 10.0, "compile_s": 1.0, "eval_s": 0.5,
+           "sample_s": 0.0}
+    with open(os.path.join(logs, "metrics.0.jsonl"), "w") as f:
+        f.write(json.dumps(row) + "\n")
+        f.write(json.dumps(end) + "\n")
+
+
+def _reconstructed(rec_path):
+    from distributed_tensorflow_example_tpu.obs import spans as spans_lib
+
+    return spans_lib.reconstruct(spans_lib.read_spans(rec_path))
+
+
+def test_fault_plumbing_disabled_is_token_identical(lm):
+    """Bitwise invisibility: supervision armed + a DISABLED FaultPlan
+    produce exactly the tokens of the plain engine (greedy and seeded
+    temperature) — the fail-open layer costs nothing when idle."""
+    spec, params = lm
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, 50, size=n).tolist() for n in (3, 7, 5)]
+    temps = (0.0, 0.9, 0.0)
+
+    def run(**kw):
+        eng = DecodeEngine(spec, params, page_size=4, max_batch=2,
+                           seed=5, **kw)
+        rids = [eng.submit(p, 5, temperature=t)
+                for p, t in zip(prompts, temps)]
+        eng.run_until_idle()
+        return [eng.result(r, timeout=30.0)["tokens"] for r in rids]
+
+    plain = run()
+    armed = run(engine_retries=3, faults=FaultPlan(), max_queue=64,
+                brownout=adm.BrownoutPolicy())
+    assert armed == plain
+
+
+def test_alloc_fail_at_admission_delays_not_loses(lm):
+    """Chaos matrix [alloc-fail]: an injected page-allocation failure
+    at admission delays the request one tick; it completes with the
+    exact baseline tokens (greedy determinism across the fault)."""
+    spec, params = lm
+    base_eng = DecodeEngine(spec, params, page_size=4, max_batch=2)
+    r = base_eng.submit([5, 4, 3], 5)
+    base_eng.run_until_idle()
+    want = base_eng.result(r, timeout=30.0)["tokens"]
+
+    eng = DecodeEngine(spec, params, page_size=4, max_batch=2,
+                       faults=FaultPlan(alloc_fail_calls=(0,)))
+    rid = eng.submit([5, 4, 3], 5)
+    eng.run_until_idle()
+    res = eng.result(rid, timeout=30.0)
+    assert res["status"] == "result" and res["tokens"] == want
+    assert eng.sched.alloc.injected_fails == 1
+
+
+def test_crash_mid_decode_supervised_recovers_exact_tokens(lm, tmp_path):
+    """Chaos matrix [loop crash]: a supervised engine survives
+    crashes mid-decode — requests re-queued (prefill re-run), greedy
+    tokens EXACTLY the no-fault baseline, the span stream closes
+    every rid with one typed terminal, and the restart narration
+    lands on restarts.jsonl for dtx-obs report."""
+    from distributed_tensorflow_example_tpu.obs import (
+        schema as schema_lib,
+    )
+    from distributed_tensorflow_example_tpu.obs import (
+        spans as spans_lib,
+    )
+    from distributed_tensorflow_example_tpu.resilience.restart import (
+        RestartNarrator,
+    )
+
+    spec, params = lm
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(0, 50, size=n).tolist() for n in (3, 6, 4)]
+
+    base_eng = DecodeEngine(spec, params, page_size=4, max_batch=2)
+    base_rids = [base_eng.submit(p, 4) for p in prompts]
+    base_eng.run_until_idle()
+    want = [base_eng.result(r, timeout=30.0)["tokens"]
+            for r in base_rids]
+
+    rec = spans_lib.SpanRecorder(str(tmp_path))
+    eng = DecodeEngine(
+        spec, params, page_size=4, max_batch=2, engine_retries=3,
+        faults=FaultPlan(crash_at_ticks=(1, 3)), recorder=rec,
+        restart_narrator=RestartNarrator(str(tmp_path)))
+    rids = [eng.submit(p, 4) for p in prompts]
+    eng.run_until_idle()
+    results = _drain(eng, rids)
+    rec.close()
+    assert all(r is not None for r in results)
+    assert [r["status"] for r in results] == ["result"] * 3
+    assert [r["tokens"] for r in results] == want
+    st = eng.stats()
+    assert st["engine_restarts_total"] == 2
+    assert st["requeued_total"] >= 1
+    assert st["completed_total"] == 3 and st["failed_total"] == 0
+    # span stream: schema-valid, one typed terminal per rid
+    assert schema_lib.validate_span_file(rec.path) == []
+    rows = spans_lib.read_spans(rec.path)
+    # the span stream's tick index stays MONOTONIC across supervised
+    # restarts (the SLO windows slide over it): a scheduler rebuild
+    # must not reset it to 0
+    ticks = [r["tick"] for r in rows if r["event"] == "tick"]
+    assert ticks == sorted(ticks) and len(set(ticks)) == len(ticks)
+    recs = _reconstructed(rec.path)
+    for rid in rids:
+        r = recs[(0, rid)]
+        assert r["terminal"] == "result" and r["complete"], \
+            (rid, r["errors"])
+    # restarts.jsonl: the engine_restart narration validates and the
+    # run report folds it (aggregate needs a metrics stream to
+    # anchor the run — a minimal window row suffices)
+    from distributed_tensorflow_example_tpu.obs import (
+        aggregate as agg_lib,
+    )
+    from distributed_tensorflow_example_tpu.resilience.restart import (
+        read_restarts,
+    )
+
+    assert schema_lib.validate_restart_file(
+        os.path.join(str(tmp_path), "restarts.jsonl")) == []
+    rows = read_restarts(str(tmp_path))
+    assert [r["event"] for r in rows] == ["engine_restart"] * 2
+    assert all(r["inflight"] >= 0 and r["restart"] >= 1 for r in rows)
+    _write_minimal_metrics(str(tmp_path))
+    report = agg_lib.aggregate(str(tmp_path), now=30.0)
+    assert report["restarts"]["engine_restarts"] == 2
+    assert [e["event"] for e in report["timeline"]
+            if e["kind"] == "restart"] == ["engine_restart"] * 2
+
+
+def test_crash_budget_spent_types_failed(lm, tmp_path):
+    """Chaos matrix [persistent crash]: when every tick crashes, each
+    request burns its retry budget and gets the typed failed terminal
+    — nothing hangs, nothing is silently dropped."""
+    from distributed_tensorflow_example_tpu.obs import (
+        spans as spans_lib,
+    )
+
+    spec, params = lm
+    rec = spans_lib.SpanRecorder(str(tmp_path))
+    eng = DecodeEngine(
+        spec, params, page_size=4, max_batch=2, engine_retries=1,
+        faults=FaultPlan(crash_at_ticks=tuple(range(64))),
+        recorder=rec)
+    rids = [eng.submit([1, 2, 3], 4), eng.submit([4, 5], 3)]
+    eng.run_until_idle()
+    results = _drain(eng, rids)
+    rec.close()
+    assert all(r is not None for r in results)
+    assert all(r["status"] == "failed" for r in results)
+    assert all("engine_retries=1" in r["error"] for r in results)
+    st = eng.stats()
+    assert st["failed_total"] == 2 and st["completed_total"] == 0
+    recs = _reconstructed(rec.path)
+    for rid in rids:
+        r = recs[(0, rid)]
+        assert r["terminal"] == "failed" and r["complete"], \
+            (rid, r["errors"])
+        assert r["attempts"] == 2                 # 1 retry + the first
+
+
+def test_unsupervised_crash_fails_closed(lm):
+    """Supervision off (engine_retries=0): the first crash fails
+    every pending request immediately (the PR-8 fail-closed contract,
+    now typed failed) and refuses new submits."""
+    spec, params = lm
+    eng = DecodeEngine(spec, params, page_size=4, max_batch=2,
+                       faults=FaultPlan(crash_at_ticks=(0,)))
+    rid = eng.submit([1, 2, 3], 4)
+    eng.start()
+    res = eng.result(rid, timeout=30.0)
+    eng.stop()
+    assert res["status"] == "failed"
+    assert "injected crash" in res["error"]
+    with pytest.raises(RuntimeError):
+        eng.submit([1], 1)
+
+
+def test_supervision_completes_strictly_more_under_crash(lm):
+    """The bench_serving_degraded acceptance, in miniature: identical
+    crash plan, supervision on vs off — on completes strictly
+    more."""
+    spec, params = lm
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(0, 50, size=4).tolist() for _ in range(4)]
+    plan = FaultPlan(crash_at_ticks=(1,))
+
+    def completed(retries):
+        eng = DecodeEngine(spec, params, page_size=4, max_batch=2,
+                           engine_retries=retries, faults=plan)
+        rids = [eng.submit(p, 4) for p in prompts]
+        eng.start()
+        res = _drain(eng, rids)
+        eng.stop()
+        assert all(r is not None for r in res)
+        return sum(1 for r in res if r["status"] == "result")
+
+    assert completed(2) == 4
+    assert completed(2) > completed(0)
+
+
+def test_stall_past_deadline_types_timeout_and_frees(lm, tmp_path):
+    """Chaos matrix [stall]: a tick stalled past the request deadline
+    retires it with the typed timeout terminal, frees its pages
+    (occupancy back to zero) and answers the waiter immediately at
+    the next boundary."""
+    from distributed_tensorflow_example_tpu.obs import (
+        spans as spans_lib,
+    )
+
+    spec, params = lm
+    rec = spans_lib.SpanRecorder(str(tmp_path))
+    eng = DecodeEngine(
+        spec, params, page_size=4, max_batch=2, deadline_ms=80.0,
+        faults=FaultPlan(stall_at_ticks=(0,), stall_s=0.25),
+        recorder=rec)
+    rid = eng.submit([1, 2, 3], 8)
+    eng.run_until_idle()
+    res = eng.result(rid, timeout=30.0)
+    rec.close()
+    assert res["status"] == "timeout"
+    assert "deadline" in res["error"]
+    st = eng.stats()
+    assert st["timeout_total"] == 1 and st["page_occupancy_frac"] == 0.0
+    recs = _reconstructed(rec.path)
+    r = recs[(0, rid)]
+    assert r["terminal"] == "timeout" and r["complete"], r["errors"]
+    assert r["timeout_reason"] == "deadline"
+
+
+def test_burst_overload_sheds_typed(lm, tmp_path):
+    """Chaos matrix [burst overload]: past the bounded queue, submits
+    shed with the typed ShedError (rid consumed, Retry-After hint,
+    shed span terminal) while every ACCEPTED request still completes
+    — the invariant covers both populations."""
+    from distributed_tensorflow_example_tpu.obs import (
+        schema as schema_lib,
+    )
+    from distributed_tensorflow_example_tpu.obs import (
+        spans as spans_lib,
+    )
+
+    spec, params = lm
+    rec = spans_lib.SpanRecorder(str(tmp_path))
+    eng = DecodeEngine(spec, params, page_size=4, max_batch=1,
+                       max_queue=2, recorder=rec)
+    accepted, shed_rids = [], []
+    for i in range(6):
+        try:
+            accepted.append(eng.submit([1 + i % 4, 2], 3))
+        except adm.ShedError as e:
+            assert e.retry_after_s >= 1.0
+            shed_rids.append(e.rid)
+    # the loop is not running, so nothing drains: 2 fill the bound,
+    # the remaining 4 shed
+    assert len(shed_rids) == 4
+    eng.run_until_idle()
+    results = _drain(eng, accepted)
+    rec.close()
+    assert all(r is not None and r["status"] == "result"
+               for r in results)
+    st = eng.stats()
+    assert st["shed_total"] == 4
+    assert st["requests_total"] == st["completed_total"] == 2
+    assert st["queue_peak"] == 2 and st["queue_limit"] == 2
+    # rids stay unique across accepted + shed
+    assert len(set(accepted + shed_rids)) == 6
+    assert schema_lib.validate_span_file(rec.path) == []
+    recs = _reconstructed(rec.path)
+    for rid in shed_rids:
+        r = recs[(0, rid)]
+        assert r["terminal"] == "shed" and r["complete"], r["errors"]
+    for rid in accepted:
+        assert recs[(0, rid)]["terminal"] == "result"
+
+
+def test_cancel_survives_supervised_restart(lm):
+    """A cancellation pending when the loop crashes must not be
+    silently dropped by the scheduler rebuild: the carried marker
+    still yields the typed timeout terminal after the restart."""
+    spec, params = lm
+    eng = DecodeEngine(spec, params, page_size=4, max_batch=2,
+                       engine_retries=3)
+    rid = eng.submit([1, 2, 3], 20)
+    assert eng.step()                 # admitted, decoding
+    assert eng.cancel(rid) is True
+    # crash lands BEFORE the next boundary could drain the cancel
+    assert eng._recover(RuntimeError("mid-flight crash")) is True
+    assert rid in eng.sched._cancelled
+    eng.run_until_idle()
+    res = eng.result(rid, timeout=30.0)
+    assert res["status"] == "timeout" and "cancel" in res["error"]
+
+
+def test_client_cancel_types_timeout(lm):
+    spec, params = lm
+    eng = DecodeEngine(spec, params, page_size=4, max_batch=2)
+    rid = eng.submit([1, 2, 3], 20)
+    assert eng.cancel(rid) is True
+    eng.run_until_idle()
+    res = eng.result(rid, timeout=30.0)
+    assert res["status"] == "timeout" and "cancel" in res["error"]
+    assert eng.cancel(rid) is False               # already terminal
+    assert eng.stats()["timeout_total"] == 1
+
+
+def test_brownout_clamps_admissions_under_pressure(lm):
+    """With a hair-trigger occupancy threshold, later admissions are
+    clamped to the brownout budget (shorter answers — degradation,
+    not refusal) and the counters say so."""
+    spec, params = lm
+    pol = adm.BrownoutPolicy(occupancy_hi=0.05, occupancy_lo=0.01,
+                             clamp_new_tokens=2, admit_per_tick=1)
+    eng = DecodeEngine(spec, params, page_size=4, max_batch=4,
+                       brownout=pol)
+    # rid 0 admits at occupancy 0 (policy inactive) and holds pages
+    r0 = eng.submit([1, 2, 3], 8)
+    assert eng.step()
+    assert eng.stats()["brownout_active"] == 0    # decided pre-admit
+    # with the pool now occupied past the hair-trigger threshold,
+    # the next boundary activates the clamp for NEW admissions
+    r1 = eng.submit([4, 5, 6], 8)
+    eng.run_until_idle()
+    results = _drain(eng, [r0, r1])
+    assert all(r is not None and r["status"] == "result"
+               for r in results)
+    assert len(results[0]["tokens"]) == 8         # pre-brownout budget
+    assert len(results[1]["tokens"]) == 2         # clamped admission
+    st = eng.stats()
+    assert st["brownout_clamped_total"] == 1
+
+
+def test_terminates_typed_invariant_under_fault_matrix(lm, tmp_path):
+    """THE acceptance: across the whole chaos matrix (alloc-fail +
+    crash + stall + overload in ONE plan), zero requests are left
+    in-flight at drain and every accepted rid reaches exactly one
+    typed terminal, exactly once, via reconstruct()."""
+    from distributed_tensorflow_example_tpu.obs import (
+        schema as schema_lib,
+    )
+    from distributed_tensorflow_example_tpu.obs import (
+        spans as spans_lib,
+    )
+
+    spec, params = lm
+    rng = np.random.RandomState(13)
+    rec = spans_lib.SpanRecorder(str(tmp_path))
+    plan = FaultPlan(alloc_fail_calls=(1, 4), crash_at_ticks=(2, 6),
+                     stall_at_ticks=(4,), stall_s=0.15)
+    eng = DecodeEngine(spec, params, page_size=4, max_batch=2,
+                       max_queue=4, engine_retries=2, faults=plan,
+                       recorder=rec)
+    accepted, shed = [], 0
+    for i in range(8):
+        prompt = rng.randint(0, 50, size=int(rng.randint(2, 6))).tolist()
+        dl = 250.0 if i % 3 == 0 else None
+        try:
+            accepted.append(eng.submit(prompt, int(rng.randint(2, 7)),
+                                       deadline_ms=dl))
+        except adm.ShedError:
+            shed += 1
+    eng.run_until_idle()
+    results = _drain(eng, accepted)
+    rec.close()
+    # zero in-flight at drain; every accepted request answered
+    assert all(r is not None for r in results)
+    st = eng.stats()
+    assert st["inflight"] == 0 and st["queued"] == 0
+    statuses = [r["status"] for r in results]
+    assert set(statuses) <= {"result", "timeout", "failed"}
+    # engine counters account for every rid, exactly once
+    assert (st["completed_total"] + st["timeout_total"]
+            + st["failed_total"] == len(accepted))
+    assert st["shed_total"] == shed
+    # span-stream proof: schema-valid, one terminal per record
+    assert schema_lib.validate_span_file(rec.path) == []
+    recs = _reconstructed(rec.path)
+    terminal_of = {rid: recs[(0, rid)]["terminal"]
+                   for rid in accepted}
+    assert all(t in ("result", "timeout", "failed")
+               for t in terminal_of.values())
+    for rid, res in zip(accepted, results):
+        assert terminal_of[rid] == res["status"], \
+            (rid, terminal_of[rid], res["status"],
+             recs[(0, rid)]["errors"])
+        assert not recs[(0, rid)]["errors"], recs[(0, rid)]["errors"]
+
+
+def test_generate_endpoint_shed_503_and_deadline_504(lm, tmp_path):
+    """The HTTP front door's typed failure surface: a full queue
+    answers 503 with Retry-After; a request whose deadline expires
+    answers 504 off the engine's typed timeout terminal; the
+    dtx_generate_* gauges carry the new counters."""
+    from distributed_tensorflow_example_tpu.obs.serve import StatusServer
+
+    spec, params = lm
+    # no background loop: requests queue, so the shed path is
+    # deterministic; the deadline test then starts the loop
+    eng = DecodeEngine(spec, params, page_size=4, max_batch=1,
+                       max_queue=1)
+    srv = StatusServer(str(tmp_path), engine=eng)
+    port = srv.start(0)
+    assert port
+    try:
+        def post(doc, timeout=30):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps(doc).encode(),
+                headers={"Content-Type": "application/json"})
+            return urllib.request.urlopen(req, timeout=timeout)
+
+        # fill the queue (engine not started — nothing drains)
+        eng.submit([1, 2], 3)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"prompt": [3, 4], "max_new_tokens": 3})
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        body = json.loads(ei.value.read())
+        assert body["status"] == "shed"
+        assert body["retry_after_s"] >= 1.0
+        # deadline: a 1ms contract expires at the first boundary ->
+        # engine-typed 504 (not the 600s handler ceiling)
+        eng.start()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"prompt": [5, 6], "max_new_tokens": 30,
+                  "deadline_ms": 1})
+        assert ei.value.code == 504
+        body = json.loads(ei.value.read())
+        assert body["status"] == "timeout"
+        # negative deadline is a 400, not a server error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({"prompt": [1], "max_new_tokens": 2,
+                  "deadline_ms": -5})
+        assert ei.value.code == 400
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "dtx_generate_shed_total 1" in text
+        assert "dtx_generate_timeout_total" in text
+        assert "dtx_generate_queue_peak" in text
+    finally:
+        srv.close()
+        eng.stop()
+
+
+def test_engine_restart_narration_is_schema_valid(lm, tmp_path):
+    """The engine_restart vocabulary is registered end to end:
+    SpanRecorder accepts it, the restart narrator row validates, and
+    an unknown event still fails fast."""
+    from distributed_tensorflow_example_tpu.obs import (
+        schema as schema_lib,
+    )
+    from distributed_tensorflow_example_tpu.obs import (
+        spans as spans_lib,
+    )
+
+    rec = spans_lib.SpanRecorder(str(tmp_path))
+    rec.emit("engine_restart", restart=1, reason="x", rids=[0, 1],
+             tick=4)
+    rec.emit("timeout", rid=0, reason="deadline", tick=5, generated=2)
+    rec.emit("shed", rid=9, reason="queue", tick=5, queued=3)
+    rec.emit("requeue", rid=1, attempt=1, tick=0)
+    rec.emit("failed", rid=1, reason="budget", attempts=2)
+    with pytest.raises(ValueError):
+        rec.emit("explode", rid=1)
+    rec.close()
+    assert schema_lib.validate_span_file(rec.path) == []

@@ -292,3 +292,81 @@ def test_prometheus_without_spans_has_no_slo_gauges(tmp_path):
     text = serve_lib.prometheus_text(
         serve_lib.collect_status(str(tmp_path)))
     assert "dtx_slo_" not in text
+
+
+# --- typed terminals in the SLO fold (ISSUE 15) ---------------------------
+
+
+def _vrow(event, rid=None, **f):
+    row = {"kind": "span", "v": schema_lib.SCHEMA_VERSION, "t": 1.0,
+           "proc": 0, "event": event, **f}
+    if rid is not None:
+        row["rid"] = rid
+    return row
+
+
+def _lifecycle(rid, tick, ttft=10.0):
+    return [
+        _vrow("submit", rid=rid, prompt_len=2, max_new_tokens=2,
+              arrival=0.0),
+        _vrow("admit", rid=rid, pages_held=1, tick=tick - 1),
+        _vrow("first_token", rid=rid, ttft_ms=ttft),
+        _vrow("retire", rid=rid, generated=2, finish_t=1.0,
+              tick=tick),
+    ]
+
+
+def test_timeout_and_failed_terminals_burn_error_budget():
+    """SLO error-rate treats timeout/failed as bad (the typed
+    non-delivery terminals), closed-form: 2 bad of 4 terminals on a
+    budget of 0.5 burns at exactly 1.0."""
+    rows = _lifecycle(0, 1) + _lifecycle(1, 2)
+    rows += [_vrow("submit", rid=2, prompt_len=2, max_new_tokens=9,
+                   arrival=0.0),
+             _vrow("timeout", rid=2, reason="deadline", tick=3,
+                   generated=1)]
+    rows += [_vrow("submit", rid=3, prompt_len=2, max_new_tokens=9,
+                   arrival=0.0),
+             _vrow("failed", rid=3, reason="budget", attempts=2)]
+    recs = slo_lib.records_from_spans(rows)
+    assert len(recs) == 4
+    by_rid = {r["rid"]: r for r in recs}
+    assert by_rid[2]["terminal"] == "timeout" and by_rid[2]["error"]
+    assert by_rid[3]["terminal"] == "failed" and by_rid[3]["error"]
+    spec = slo_lib.SLOSpec("error_rate", "error", None, objective=0.5,
+                           fast_window=10, slow_window=10,
+                           burn_threshold=1.0)
+    doc = slo_lib.evaluate(recs, specs=[spec], now_tick=3)
+    w = doc["slos"][0]["windows"]["fast"]
+    assert w["requests"] == 4 and w["bad"] == 2
+    assert w["burn_rate"] == 1.0
+    assert doc["slos"][0]["breach"]
+
+
+def test_shed_gets_its_own_rate_not_the_error_budget():
+    """Shed requests are carved OUT of the SLO windows (a typed 503
+    is policy, not breach) and reported as their own rate over the
+    slow window — closed form: 2 shed of 6 terminals = 1/3."""
+    rows = []
+    for rid, tick in ((0, 1), (1, 2), (2, 3), (3, 4)):
+        rows += _lifecycle(rid, tick)
+    rows += [_vrow("shed", rid=10, reason="queue", tick=2, queued=4),
+             _vrow("shed", rid=11, reason="queue", tick=3, queued=5)]
+    recs = slo_lib.records_from_spans(rows)
+    assert len(recs) == 6
+    spec = slo_lib.SLOSpec("error_rate", "error", None,
+                           objective=0.99, fast_window=10,
+                           slow_window=10, burn_threshold=1.0)
+    doc = slo_lib.evaluate(recs, specs=[spec], now_tick=4)
+    # shed never enters the SLO windows...
+    w = doc["slos"][0]["windows"]["fast"]
+    assert w["requests"] == 4 and w["bad"] == 0
+    assert doc["ok"] and doc["requests"] == 4
+    # ...but gets its own rate section
+    assert doc["shed"]["shed"] == 2
+    assert doc["shed"]["terminals"] == 6
+    assert doc["shed"]["rate"] == round(2 / 6, 6)
+    # and the gauge rides /metrics via prometheus_text
+    status = {"procs": {}, "live": False}
+    text = serve_lib.prometheus_text(status, slo=doc)
+    assert "dtx_slo_shed_rate 0.3333" in text

@@ -327,3 +327,128 @@ def test_cli_tail_formats_span_rows(tmp_path, capsys):
     assert "rid 0 admit pages=2" in out
     assert any(ln.startswith("[p0] tick ")
                for ln in out.splitlines())
+
+
+# --- typed terminals (ISSUE 15: fail-open serving) ------------------------
+
+
+def _vrow(event, rid=None, **f):
+    row = {"kind": "span", "v": schema_lib.SCHEMA_VERSION, "t": 1.0,
+           "proc": 0, "event": event, **f}
+    if rid is not None:
+        row["rid"] = rid
+    return row
+
+
+def test_span_vocabulary_covers_failopen_terminals():
+    """The v6 vocabulary + field contracts are registered end to end:
+    buckets, SPAN_REQUIRED, and the recorder's emit validation."""
+    for ev in ("timeout", "shed", "requeue", "engine_restart",
+               "failed"):
+        assert ev in SPAN_EVENTS
+        assert ev in schema_lib.SPAN_REQUIRED
+    assert schema_lib.validate_span_row(_vrow(
+        "timeout", rid=1, reason="deadline", tick=4, generated=2)) == []
+    assert schema_lib.validate_span_row(_vrow(
+        "shed", rid=9, reason="queue", tick=0, queued=5)) == []
+    errs = schema_lib.validate_span_row(_vrow("timeout", rid=1,
+                                              reason="deadline"))
+    assert errs and any("tick" in e for e in errs)
+
+
+def test_reconstruct_classifies_typed_terminals():
+    """Each typed end yields terminal + complete; the legacy error
+    event types as failed but stays INCOMPLETE (a truncated
+    lifecycle, not a closed one)."""
+    sub = _vrow("submit", rid=0, prompt_len=2, max_new_tokens=8,
+                arrival=0.0)
+    # timeout from the queue (no admit needed)
+    r = spans_lib.reconstruct([sub, _vrow(
+        "timeout", rid=0, reason="deadline", tick=3,
+        generated=0)])[(0, 0)]
+    assert r["terminal"] == "timeout" and r["complete"], r["errors"]
+    assert r["timeout_reason"] == "deadline"
+    # shed: the one terminal WITHOUT a submit
+    r = spans_lib.reconstruct([_vrow(
+        "shed", rid=4, reason="queue", tick=0, queued=7)])[(0, 4)]
+    assert r["terminal"] == "shed" and r["complete"], r["errors"]
+    # a shed AFTER a submit is a stream corruption, flagged
+    r = spans_lib.reconstruct([sub, _vrow(
+        "shed", rid=0, reason="queue", tick=0, queued=1)])[(0, 0)]
+    assert any("shed after submit" in e for e in r["errors"])
+    # typed failed: complete
+    r = spans_lib.reconstruct([sub, _vrow(
+        "failed", rid=0, reason="budget", attempts=3)])[(0, 0)]
+    assert r["terminal"] == "failed" and r["complete"]
+    assert r["attempts"] == 3
+    # legacy error: failed, NOT complete
+    r = spans_lib.reconstruct([sub, _vrow(
+        "error", rid=0, reason="loop died")])[(0, 0)]
+    assert r["terminal"] == "failed" and not r["complete"]
+    # two terminals on one rid: flagged, terminal voided
+    r = spans_lib.reconstruct([
+        sub, _vrow("admit", rid=0, pages_held=1, tick=0),
+        _vrow("retire", rid=0, generated=8, finish_t=1.0, tick=2),
+        _vrow("timeout", rid=0, reason="deadline", tick=2,
+              generated=8)])[(0, 0)]
+    assert r["terminal"] is None and not r["complete"]
+    assert any("multiple terminals" in e for e in r["errors"])
+    # duplicate typed terminal: the milestone slate catches it
+    r = spans_lib.reconstruct([sub, _vrow(
+        "timeout", rid=0, reason="deadline", tick=1, generated=0),
+        _vrow("timeout", rid=0, reason="cancel", tick=2,
+              generated=0)])[(0, 0)]
+    assert "duplicate timeout" in r["errors"] and not r["complete"]
+
+
+def test_reconstruct_requeue_resets_milestone_slate():
+    """A supervised restart legitimately re-runs admit/prefill/
+    first_token: the requeue event resets their exactly-once slate
+    (no false duplicates), counts the retry, and the final retire
+    still closes the record."""
+    rows = [
+        _vrow("submit", rid=2, prompt_len=2, max_new_tokens=3,
+              arrival=0.0),
+        _vrow("admit", rid=2, pages_held=1, tick=0),
+        _vrow("prefill", rid=2, bucket=2, pages_width=1),
+        _vrow("first_token", rid=2, ttft_ms=5.0),
+        _vrow("engine_restart", restart=1, reason="crash",
+              rids=[2], tick=1),
+        _vrow("requeue", rid=2, attempt=1, tick=0),
+        _vrow("admit", rid=2, pages_held=1, tick=1),
+        _vrow("prefill", rid=2, bucket=2, pages_width=1),
+        _vrow("first_token", rid=2, ttft_ms=9.0),
+        _vrow("retire", rid=2, generated=3, finish_t=2.0, tick=4),
+    ]
+    r = spans_lib.reconstruct(rows)[(0, 2)]
+    assert r["complete"] and r["errors"] == [], r["errors"]
+    assert r["terminal"] == "result"
+    assert r["requeues"] == 1 and r["attempt"] == 1
+    assert r["engine_restarts"] == 1
+    assert r["ttft_ms"] == 9.0            # the re-run's measurement
+    # WITHOUT the requeue event the duplicates are still violations
+    no_requeue = [x for x in rows if x["event"] != "requeue"]
+    r = spans_lib.reconstruct(no_requeue)[(0, 2)]
+    assert "duplicate admit" in r["errors"] and not r["complete"]
+    # a retry that TIMES OUT before a new first_token must not carry
+    # the aborted attempt's ttft into the SLO fold (those tokens were
+    # discarded, never delivered)
+    aborted = rows[:6] + [_vrow("timeout", rid=2, reason="deadline",
+                                tick=2, generated=0)]
+    r = spans_lib.reconstruct(aborted)[(0, 2)]
+    assert r["terminal"] == "timeout" and "ttft_ms" not in r
+    assert "prefill_bucket" not in r and "admit_tick" not in r
+
+
+def test_reconstruct_brownout_clamp_exempts_token_check():
+    """A brownout-clamped admit legitimately retires short of the
+    submitted token budget — no generated!=max_new_tokens error."""
+    rows = [
+        _vrow("submit", rid=5, prompt_len=2, max_new_tokens=16,
+              arrival=0.0),
+        _vrow("admit", rid=5, pages_held=1, tick=0, clamped=True),
+        _vrow("retire", rid=5, generated=2, finish_t=1.0, tick=3),
+    ]
+    r = spans_lib.reconstruct(rows)[(0, 5)]
+    assert r["complete"] and r["errors"] == []
+    assert r["brownout_clamped"] is True
