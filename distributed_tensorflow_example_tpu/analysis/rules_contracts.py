@@ -98,7 +98,12 @@ class SchemaDriftRule:
         # payload fields by the two emitting layers (the scheduler's
         # admission narration + the engine's execution milestones)
         "SPAN_COMMON": ("obs/spans.py",),
-        "SPAN_FIELDS": ("serving/scheduler.py", "serving/engine.py"),
+        # v7 widens the writer set: the train loop emits phase spans
+        # (phase/trace_id/dur_ms), the collector stamps source on
+        # merged rows, and the engine threads trace_id/parent_id
+        "SPAN_FIELDS": ("serving/scheduler.py", "serving/engine.py",
+                        "train/loop.py", "obs/collector.py"),
+        "FLEET_REPORT": ("obs/collector.py",),
         "HISTORY_ENTRY": ("obs/history.py",),
         # restart-timeline rows: the envelope is written by the
         # narrator (resilience/restart.py); the loop's preempt/

@@ -381,6 +381,19 @@ class Config:
                                     # only — greedy outputs identical
                                     # on/off); feeds /trace, /slo and
                                     # dtx-obs slo/trace
+    span_rotate_mb: float = 0.0     # > 0: rotate spans.<proc>.jsonl
+                                    # when it would exceed this many
+                                    # MB — the live file is renamed
+                                    # .1 (older segments shift up) so
+                                    # a long-lived server's span disk
+                                    # stays bounded; readers
+                                    # (dtx-obs tail/slo, the fleet
+                                    # collector) stitch the segments
+                                    # back; 0 = never rotate
+    span_keep: int = 3              # rotated span segments retained
+                                    # per process (.1 … .K; older
+                                    # ones are deleted); only
+                                    # meaningful with --span_rotate_mb
     slo: str = ""                   # serving SLO specs evaluated by
                                     # /slo + the dtx_slo_* gauges:
                                     # "NAME<=VALUE,..." with NAME in
@@ -843,6 +856,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "tick/retire), feeding /trace, /slo and the "
                         "dtx-obs slo/trace verbs; host-side appends "
                         "only, greedy outputs token-identical on/off")
+    p.add_argument("--span_rotate_mb", type=float,
+                   default=d.span_rotate_mb,
+                   help="rotate each spans.<proc>.jsonl before it "
+                        "exceeds this many MB (live file renamed .1, "
+                        "older segments shift up; dtx-obs tail/slo "
+                        "and the fleet collector stitch segments "
+                        "transparently); 0 = never rotate")
+    p.add_argument("--span_keep", type=int, default=d.span_keep,
+                   help="rotated span segments retained per process "
+                        "(.1 … .K, older deleted); only meaningful "
+                        "with --span_rotate_mb")
     p.add_argument("--slo", type=str, default=d.slo,
                    help="serving SLO specs for /slo + the dtx_slo_* "
                         "gauges: comma-separated NAME<=VALUE with "
@@ -1154,6 +1178,14 @@ def validate_serving_config(cfg: Config) -> None:
         raise ValueError(
             f"engine_retries={cfg.engine_retries} must be >= 0 (0 = "
             f"fail-closed, no supervision)")
+    if cfg.span_rotate_mb < 0:
+        raise ValueError(
+            f"span_rotate_mb={cfg.span_rotate_mb} must be >= 0 (0 = "
+            f"never rotate)")
+    if cfg.span_keep < 1:
+        raise ValueError(
+            f"span_keep={cfg.span_keep} must be >= 1 (at least one "
+            f"rotated segment is retained while rotation is on)")
     from .serving.admission import parse_brownout
 
     # raises ValueError with the offending part on a malformed DSL

@@ -64,6 +64,18 @@ def metrics_files(logs_path: str) -> List[tuple]:
     return out
 
 
+def has_streams(logs_path: str) -> bool:
+    """True when ``logs_path`` looks like a run dir — it holds at
+    least one metrics/span stream or a restart timeline.  The fleet
+    collector (obs/collector.py) keys source discovery on this, so
+    the definition of "a run dir" stays next to ``metrics_files``."""
+    from .spans import span_files
+
+    return bool(metrics_files(logs_path) or span_files(logs_path)
+                or os.path.exists(os.path.join(logs_path,
+                                               "restarts.jsonl")))
+
+
 def _median(vals: List[float]) -> Optional[float]:
     if not vals:
         return None

@@ -99,9 +99,21 @@ GOODPUT_BUCKETS = ("train", "compile", "data_wait", "h2d", "ckpt",
 # reset), "engine_restart" one supervised loop restart (carries the
 # in-flight rids, like a tick row).  obs/spans.reconstruct() is
 # closed over this set and classifies each record's ``terminal``.
+# "phase" (PR 16) is the TRAINING-side span: one row per completed
+# train-loop phase (a multi-site round, the outer_sync collective, a
+# checkpoint submit) carrying ``phase``/``trace_id``/``dur_ms`` so the
+# fleet collector can interleave training rounds with serving request
+# lifecycles on one timeline.  Valid phase names live in PHASE_SCOPES.
 SPAN_EVENTS = ("submit", "blocked", "admit", "prefill", "first_token",
                "tick", "retire", "error", "timeout", "shed",
-               "requeue", "engine_restart", "failed")
+               "requeue", "engine_restart", "failed", "phase")
+
+# valid "phase" span names (train/loop.py emit sites): "round" is one
+# multi-site dispatch (site_mode), "outer_sync" the cross-site
+# pseudo-gradient exchange, "ckpt" the checkpoint snapshot submit.
+# The scope-registry discipline applies: emit sites pass these
+# literals and obs/schema.py requires the field on every phase row.
+PHASE_SCOPES = ("round", "outer_sync", "ckpt")
 
 # restart-timeline events (resilience/restart.py RestartNarrator
 # appends them to restarts.jsonl; obs/aggregate.py folds them into

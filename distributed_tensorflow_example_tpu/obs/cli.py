@@ -22,13 +22,24 @@ Subcommands (``dtx-obs <cmd> --help`` for flags):
   span stream; exit 3 on breach (the compare regression convention);
 - ``trace LOGS RID`` — one request's reconstructed lifecycle from the
   span stream (submit → blocked/admit → prefill → first_token →
-  decode ticks → retire), with the raw events;
+  decode ticks → retire), with the raw events; ``--export chrome``
+  renders the WHOLE merged timeline as Chrome trace-event JSON
+  (openable in ui.perfetto.dev) instead — RID optional there;
+- ``collect PATH...`` — merge N run dirs' span/metrics/restart
+  streams into one causally-ordered fleet timeline
+  (obs/collector.py): skew-aligned, ``source``-stamped, printed as
+  tail lines (or ``--json`` rows);
+- ``fleet PATH...``  — the fleet report over merged streams:
+  per-source accounting, the fleet-wide exactly-once verdict and the
+  federated SLO evaluation; exit 3 on an SLO breach, a federated-
+  identity violation or an exactly-once violation;
 - ``history FILE``  — the rolling bench history (obs/history.py):
   trend table by default, ``--import`` backfills from committed
   BENCH captures, ``--append`` records any comparison document.
 
 Exit codes: 0 ok; 1 validation failure; 2 bad input (missing files,
-no metrics stream); 3 regression/SLO-breach verdict (compare, slo).
+no metrics stream); 3 regression/SLO-breach/fleet-invariant verdict
+(compare, slo, fleet).
 """
 
 from __future__ import annotations
@@ -75,6 +86,10 @@ def format_row(row: Dict[str, Any]) -> Optional[str]:
                     f"{_fmt(row.get('restart'))} "
                     f"inflight {len(row.get('rids') or ())} "
                     f"({_fmt(row.get('reason'))})")
+        if ev == "phase":
+            # the training-side span: no rid, a registered phase name
+            return (f"[p{proc}] phase {row.get('phase')} "
+                    f"dur {_fmt(row.get('dur_ms'))}ms")
         bits = [f"[p{proc}] rid {_fmt(row.get('rid'))} {ev}"]
         for key, label in (("reason", ""), ("pages_held", "pages="),
                            ("bucket", "bucket="),
@@ -178,6 +193,42 @@ def cmd_compare(args) -> int:
     return 0 if verdict["ok"] else 3
 
 
+def poll_new_lines(path: str, state: Dict[str, tuple]) -> List[str]:
+    """One follow-poll over ``path``: the newly appended WHOLE lines
+    since the recorded position.  ``state`` maps path -> (inode,
+    offset) and is updated in place.
+
+    The rotation/truncation fix (PR 16): a live stream that rotates
+    (the file we were offset into got renamed away and a fresh one
+    took its name — new inode) or truncates (size < our offset) used
+    to make the follow loop silently go quiet forever, because the
+    stale offset never passed the ``size > offset`` check again.  Both
+    regressions now RESET the offset to 0 and re-read the replacement
+    from its start.  Only whole lines are consumed: a poll landing
+    mid-append leaves the torn tail for next time, not split into two
+    unparseable halves."""
+    ino, off = state.get(path, (None, 0))
+    try:
+        st = os.stat(path)
+        if ino is not None and (st.st_ino != ino or st.st_size < off):
+            off = 0
+        if st.st_size <= off:
+            state[path] = (st.st_ino, off)
+            return []
+        with open(path, "rb") as f:
+            f.seek(off)
+            data = f.read()
+    except OSError:
+        return []
+    nl = data.rfind(b"\n")
+    if nl < 0:
+        state[path] = (st.st_ino, off)
+        return []
+    state[path] = (st.st_ino, off + nl + 1)
+    return data[:nl + 1].decode("utf-8",
+                                errors="replace").splitlines()
+
+
 def cmd_tail(args) -> int:
     files = _stream_files(args.logs_path)
     if not files and not args.follow:
@@ -186,11 +237,23 @@ def cmd_tail(args) -> int:
               file=sys.stderr)
         return 2
     # print the last -n formatted lines across streams, then follow
-    offsets: Dict[str, int] = {}
+    from . import spans as spans_lib
+
+    state: Dict[str, tuple] = {}
     backlog: List[tuple] = []
     for path in files:
-        rows = serve_lib.tail_rows(path)
-        offsets[path] = os.path.getsize(path)
+        # a span stream's backlog spans its rotation boundary: the
+        # rotated-away segments (oldest-first) feed the same sorted
+        # backlog the live file does — only the live file is followed
+        rows = []
+        for seg in spans_lib.rotated_files(path)[:-1]:
+            rows.extend(serve_lib.tail_rows(seg))
+        rows.extend(serve_lib.tail_rows(path))
+        try:
+            st = os.stat(path)
+            state[path] = (st.st_ino, st.st_size)
+        except OSError:
+            pass
         for r in rows:
             line = format_row(r)
             if line is not None:
@@ -204,26 +267,7 @@ def cmd_tail(args) -> int:
         while True:
             time.sleep(args.interval)
             for path in _stream_files(args.logs_path):
-                off = offsets.get(path, 0)
-                try:
-                    size = os.path.getsize(path)
-                    if size <= off:
-                        continue
-                    with open(path, "rb") as f:
-                        f.seek(off)
-                        data = f.read()
-                    # consume only whole lines: a poll landing mid-
-                    # append must leave the torn tail for next time,
-                    # not split it into two unparseable halves
-                    nl = data.rfind(b"\n")
-                    if nl < 0:
-                        continue
-                    chunk = data[:nl + 1].decode("utf-8",
-                                                 errors="replace")
-                    offsets[path] = off + nl + 1
-                except OSError:
-                    continue
-                for ln in chunk.splitlines():
+                for ln in poll_new_lines(path, state):
                     try:
                         line = format_row(json.loads(ln))
                     except ValueError:
@@ -362,6 +406,43 @@ def cmd_slo(args) -> int:
 def cmd_trace(args) -> int:
     from . import spans as spans_lib
 
+    if args.export:
+        # whole-timeline export (RID optional): run dirs AND fleet
+        # parents both work, via the collector's discovery/merge
+        from . import collector as col_lib
+
+        try:
+            col = col_lib.collect([args.logs_path])
+        except FileNotFoundError as e:
+            print(f"dtx-obs trace: {e}", file=sys.stderr)
+            return 2
+        rows = col["rows"]
+        if args.rid is not None:
+            rows = [r for r in rows
+                    if r.get("kind") != "span"
+                    or r.get("rid") == args.rid
+                    or args.rid in (r.get("rids") or ())]
+        doc = col_lib.chrome_trace(rows)
+        if not any(e["ph"] != "M" for e in doc["traceEvents"]):
+            print(f"dtx-obs trace: nothing to export under "
+                  f"{args.logs_path!r}"
+                  + (f" for rid {args.rid}" if args.rid is not None
+                     else ""), file=sys.stderr)
+            return 2
+        out = json.dumps(doc, indent=None if args.compact else 1)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(out + "\n")
+            print(f"dtx-obs trace: wrote "
+                  f"{len(doc['traceEvents'])} events to {args.out} "
+                  f"(open in ui.perfetto.dev)", file=sys.stderr)
+        else:
+            print(out)
+        return 0
+    if args.rid is None:
+        print("dtx-obs trace: RID is required without --export",
+              file=sys.stderr)
+        return 2
     rows = spans_lib.load_spans(args.logs_path)
     if not rows:
         print(f"dtx-obs trace: no spans.<proc>.jsonl under "
@@ -373,6 +454,69 @@ def cmd_trace(args) -> int:
               file=sys.stderr)
         return 2
     print(json.dumps(doc, indent=None if args.compact else 1))
+    return 0
+
+
+def cmd_collect(args) -> int:
+    from . import collector as col_lib
+
+    try:
+        col = col_lib.collect(args.paths, align=not args.no_align)
+    except FileNotFoundError as e:
+        print(f"dtx-obs collect: {e}", file=sys.stderr)
+        return 2
+    for s in col["sources"]:
+        print(f"source {s['source']}: {s['rows']} rows, "
+              f"{s['procs']} proc(s), skew {s['skew_s']:+.3f}s",
+              file=sys.stderr)
+    rows = col["rows"]
+    if args.lines > 0:
+        rows = rows[-args.lines:]
+    if args.out:
+        with open(args.out, "w") as f:
+            for r in col["rows"]:
+                f.write(json.dumps(r) + "\n")
+        print(f"dtx-obs collect: wrote {len(col['rows'])} merged "
+              f"rows to {args.out}", file=sys.stderr)
+        return 0
+    for r in rows:
+        if args.json:
+            print(json.dumps(r))
+        else:
+            line = format_row(r)
+            if line is not None:
+                print(f"[{r.get('source')}]{line}")
+    return 0
+
+
+def cmd_fleet(args) -> int:
+    from . import collector as col_lib
+    from . import slo as slo_lib
+
+    try:
+        specs = slo_lib.parse_specs(args.spec)
+    except ValueError as e:
+        print(f"dtx-obs fleet: {e}", file=sys.stderr)
+        return 2
+    try:
+        report = col_lib.fleet_report(args.paths, specs=specs,
+                                      align=not args.no_align)
+    except FileNotFoundError as e:
+        print(f"dtx-obs fleet: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(report, indent=None if args.compact else 1))
+    bad = []
+    if not report["exactly_once"]:
+        bad.append("exactly-once violation")
+    slo_doc = report.get("slo")
+    if slo_doc is not None:
+        if not slo_doc["identity"]["holds"]:
+            bad.append("federated-identity violation")
+        if slo_doc["breaches"]:
+            bad.append(f"SLO breach {','.join(slo_doc['breaches'])}")
+    if bad:
+        print(f"dtx-obs fleet: {'; '.join(bad)}", file=sys.stderr)
+        return 3
     return 0
 
 
@@ -492,11 +636,51 @@ def build_parser() -> argparse.ArgumentParser:
 
     tr = sub.add_parser("trace", help="one request's reconstructed "
                                       "lifecycle from the span "
-                                      "stream")
+                                      "stream; --export chrome for "
+                                      "the Perfetto timeline")
     tr.add_argument("logs_path")
-    tr.add_argument("rid", type=int)
+    tr.add_argument("rid", type=int, nargs="?", default=None,
+                    help="request id (optional with --export: the "
+                         "whole timeline exports by default)")
+    tr.add_argument("--export", choices=("chrome",), default="",
+                    help="render as Chrome trace-event JSON "
+                         "(ui.perfetto.dev) instead of the lifecycle "
+                         "record")
+    tr.add_argument("-o", "--out", default="",
+                    help="write the export to this file instead of "
+                         "stdout")
     tr.add_argument("--compact", action="store_true")
     tr.set_defaults(fn=cmd_trace)
+
+    co = sub.add_parser("collect", help="merge N run dirs into one "
+                                        "causally-ordered fleet "
+                                        "timeline")
+    co.add_argument("paths", nargs="+",
+                    help="run dirs (or parents of run dirs)")
+    co.add_argument("-n", "--lines", type=int, default=0,
+                    help="print only the newest N merged rows")
+    co.add_argument("--json", action="store_true",
+                    help="raw merged rows instead of tail lines")
+    co.add_argument("--no-align", action="store_true",
+                    help="skip per-source clock-skew alignment")
+    co.add_argument("-o", "--out", default="",
+                    help="write the merged rows (JSONL) to this file")
+    co.set_defaults(fn=cmd_collect)
+
+    fl = sub.add_parser("fleet", help="fleet report over merged "
+                                      "streams: exactly-once verdict "
+                                      "+ federated SLO; exit 3 on "
+                                      "breach/violation")
+    fl.add_argument("paths", nargs="+",
+                    help="run dirs (or parents of run dirs)")
+    fl.add_argument("--spec", default="",
+                    metavar="NAME<=VALUE,...",
+                    help="SLO specs (the dtx-obs slo DSL); empty = "
+                         "the obs/slo.py defaults")
+    fl.add_argument("--no-align", action="store_true",
+                    help="skip per-source clock-skew alignment")
+    fl.add_argument("--compact", action="store_true")
+    fl.set_defaults(fn=cmd_fleet)
 
     h = sub.add_parser("history", help="rolling bench history: trend "
                                        "table, --import backfill, "

@@ -116,6 +116,14 @@ GATE_METRICS: Dict[str, tuple] = {
     # baked in — the wide A/B default)
     "serving_degraded_completed_frac": ("higher", 0.01),
     "serving_degraded_p99_ms": ("lower", 0.25),
+    # the span-emission overhead key (ISSUE 16): bench_trace_overhead
+    # replays the SAME saturated request set through the real engine
+    # with the recorder on vs off, interleaved, and the key is the
+    # median of per-round on/off tok/s RATIOS — a ratio of interleaved
+    # same-process arms, so host drift divides out.  Tight 1%: the
+    # fleet-observability claim is that tracing costs <= 1% tok/s,
+    # and the retained fraction sits at ~1.0 by construction
+    "trace_retained_tok_frac": ("higher", 0.01),
 }
 
 
@@ -237,6 +245,13 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
         put("serving_tok_s", doc.get("serving_tok_s"))
         put("decode_hbm_frac", doc.get("decode_hbm_frac"))
         return out
+    # bench trace-overhead row — keyed on trace_on_tok_s, a row-only
+    # key (the final summary carries trace_retained_tok_frac too and
+    # must fall through to its own branch — the serving lesson)
+    if "trace_on_tok_s" in doc:
+        put("trace_retained_tok_frac",
+            doc.get("trace_retained_tok_frac"))
+        return out
     # bench degraded-serving row — keyed on degraded_sim_ticks, a
     # row-only key (the final summary carries both gate keys too and
     # must fall through to its own branch — the serving lesson)
@@ -288,7 +303,9 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
                   # the fail-open serving keys (ISSUE 15): degraded
                   # goodput closed form + supervised crash-plan p99
                   "serving_degraded_completed_frac",
-                  "serving_degraded_p99_ms"):
+                  "serving_degraded_p99_ms",
+                  # the span-emission overhead key (ISSUE 16)
+                  "trace_retained_tok_frac"):
             put(k, doc.get(k))
         return out
     # last resort: any directly-named gate metrics

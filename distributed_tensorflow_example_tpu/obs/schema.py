@@ -44,8 +44,13 @@ _NUM = (int, float)
 # (timeout/shed/failed) + the supervision records
 # (requeue/engine_restart) in SPAN_FIELDS/SPAN_REQUIRED, the
 # "engine_restart" restart-timeline event, and the SERVING_STATS
-# shed/timeout/failed/requeue/restart/queue/brownout counters.
-SCHEMA_VERSION = 6
+# shed/timeout/failed/requeue/restart/queue/brownout counters;
+# v7 = fleet observability: trace-context propagation
+# (trace_id/parent_id on every span, W3C traceparent at the serving
+# edge), the training-side "phase" span event (phase/dur_ms), the
+# collector's "source" stamp on merged rows, and the FLEET_REPORT
+# document (obs/collector.py fleet timeline + federated SLO).
+SCHEMA_VERSION = 7
 
 
 # field -> allowed types; a tuple including type(None) marks nullable
@@ -215,6 +220,18 @@ SPAN_FIELDS = {
     "attempts": (int,),
     "restart": (int,),
     "clamped": (bool,),
+    # fleet observability (v7): trace_id is the 32-hex W3C trace id a
+    # request (or training round) carries through its whole lifecycle
+    # — requeue/engine_restart survivors keep theirs; parent_id is the
+    # 16-hex span id of the caller's traceparent when one arrived at
+    # the serving edge; source is stamped by the fleet collector on
+    # merged rows (never by a writer); phase/dur_ms are the
+    # training-side "phase" span payload (obs/buckets.PHASE_SCOPES).
+    "trace_id": (str,),
+    "parent_id": (str,),
+    "source": (str,),
+    "phase": (str,),
+    "dur_ms": _NUM,
 }
 
 SPAN_REQUIRED = {
@@ -239,6 +256,12 @@ SPAN_REQUIRED = {
     "requeue": ("rid", "attempt", "tick"),
     "engine_restart": ("restart", "reason", "rids", "tick"),
     "failed": ("rid", "reason", "attempts"),
+    # the training-side phase span (v7): one row per completed
+    # train-loop phase, carrying its registered name, the round's
+    # trace id, and the measured wall.  trace_id/parent_id stay
+    # OPTIONAL on every serving event (old fixtures remain valid);
+    # only the phase row requires one.
+    "phase": ("phase", "trace_id", "dur_ms"),
 }
 
 
@@ -263,6 +286,17 @@ def validate_span_row(row: Dict[str, Any], where: str = "row") -> List[str]:
         else:
             errs += _check(row, {f: SPAN_FIELDS[f] for f in required},
                            where)
+        if event == "phase" and isinstance(row.get("phase"), str):
+            from .buckets import PHASE_SCOPES
+
+            if row["phase"] not in PHASE_SCOPES:
+                errs.append(f"{where}: unknown phase "
+                            f"{row['phase']!r} (known: "
+                            f"{sorted(PHASE_SCOPES)})")
+    # the optional trace-context payload (v7) is typed whenever present
+    for f in ("trace_id", "parent_id", "source"):
+        if f in row:
+            errs += _check(row, {f: SPAN_FIELDS[f]}, where)
     return errs
 
 
@@ -406,6 +440,51 @@ RUN_REPORT = {
     "timeline": (list,),
     "schema_errors": (list,),
 }
+
+
+# The fleet report obs/collector.py produces (dtx-obs fleet emits it,
+# the StatusServer /fleet endpoint + dtx_fleet_* gauges read it): N
+# source dirs' span/metrics/restart streams merged into one
+# causally-ordered timeline.  "sources" is one entry per discovered
+# run dir (name, rows, skew_s, procs); "requests" counts reconstructed
+# request lifecycles fleet-wide; "exactly_once" is the PR 15
+# terminates-typed invariant held across sources (every accepted
+# request exactly one typed terminal, no duplicate milestones);
+# "slo" is the federated evaluation (obs/slo.fleet_evaluate): the
+# merged-stream burn plus per-source burns and the closed-form
+# identity section.
+FLEET_REPORT = {
+    "v": (int,),
+    "kind": (str,),          # "fleet_report"
+    "generated_t": _NUM,
+    "sources": (list,),
+    "rows": (int,),
+    "requests": (int,),
+    "exactly_once": (bool,),
+    "errors": (list,),
+    "restarts": (int,),
+    "slo": (dict, type(None)),
+}
+
+
+def validate_fleet_report(doc: Dict[str, Any],
+                          where: str = "fleet") -> List[str]:
+    """Validate a collector fleet report (top-level contract + the
+    per-source entry shape)."""
+    if not isinstance(doc, dict):
+        return [f"{where}: not an object"]
+    verrs = _version_errs(doc, "v", where)
+    if verrs:
+        return verrs
+    errs = _check(doc, FLEET_REPORT, where)
+    if doc.get("kind") != "fleet_report":
+        errs.append(f"{where}: kind is {doc.get('kind')!r}, expected "
+                    f"'fleet_report'")
+    for i, src in enumerate(doc.get("sources") or []):
+        errs += _check(src, {"source": (str,), "rows": (int,),
+                             "skew_s": _NUM, "procs": (int,)},
+                       f"{where}.sources[{i}]")
+    return errs
 
 
 def _check(doc: Dict[str, Any], spec: Dict[str, tuple],

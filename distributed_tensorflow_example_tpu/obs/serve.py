@@ -20,7 +20,19 @@ or offline re-serving via ``dtx-obs serve``):
   "is the service healthy" answer;
 - ``/trace?rid=N`` — one request's reconstructed lifecycle (obs/spans
   reconstruct) with its raw span events: submit → blocked/admit →
-  prefill → first_token → shared decode ticks → retire.
+  prefill → first_token → shared decode ticks → retire;
+- ``/fleet``   — the obs/collector.py fleet report over this server's
+  ``logs_path`` (a run dir is a one-source fleet; a parent of run
+  dirs federates its children): per-source accounting, the fleet-wide
+  exactly-once verdict and the federated SLO evaluation, plus
+  ``dtx_fleet_*`` gauges on ``/metrics`` (TTL-cached — a scrape never
+  re-merges an unchanged fleet).
+
+``POST /generate`` speaks W3C trace context: an incoming
+``traceparent`` header's trace id rides every span the request emits,
+and the response carries a ``traceparent`` (plus ``trace_id`` in the
+body) either way — callers can stitch the serving edge into their own
+traces, and ``dtx-obs trace --export chrome`` shows the full chain.
 
 With a decode engine attached (``StatusServer(logs_path, engine=...)``
 — the ``dtx-serve`` front door, serving/cli.py) the same server also
@@ -151,7 +163,8 @@ def collect_status(logs_path: str,
 
 def prometheus_text(status: Dict[str, Any],
                     serving: Optional[Dict[str, Any]] = None,
-                    slo: Optional[Dict[str, Any]] = None) -> str:
+                    slo: Optional[Dict[str, Any]] = None,
+                    fleet: Optional[Dict[str, Any]] = None) -> str:
     """Render a /status document in Prometheus text exposition format
     (version 0.0.4). Gauges only — everything here is a point-in-time
     read of the run's own counters. ``serving``: a
@@ -159,7 +172,10 @@ def prometheus_text(status: Dict[str, Any],
     the ``dtx_generate_*`` request-latency gauges.  ``slo``: an
     obs/slo.evaluate document appended as the ``dtx_slo_*`` burn-rate
     gauges (per-SLO per-window burn rate, breach flags, observed
-    p99)."""
+    p99).  ``fleet``: an obs/collector.fleet_report document appended
+    as the ``dtx_fleet_*`` gauges (merged-timeline accounting, the
+    exactly-once and federated-identity verdicts, per-source skew and
+    burn)."""
     out: List[str] = []
 
     def fmt(v) -> str:
@@ -293,6 +309,45 @@ def prometheus_text(status: Dict[str, Any],
               "requests over the slow window (load-shedding "
               "pressure; deliberately not an SLO breach input)",
               [(None, (slo.get("shed") or {}).get("rate"))])
+    if fleet:
+        sources = fleet.get("sources") or []
+        gauge("dtx_fleet_sources", "run dirs merged into the fleet "
+              "timeline", [(None, len(sources))])
+        gauge("dtx_fleet_rows", "rows on the merged fleet timeline",
+              [(None, fleet.get("rows"))])
+        gauge("dtx_fleet_requests", "request lifecycles reconstructed "
+              "fleet-wide", [(None, fleet.get("requests"))])
+        gauge("dtx_fleet_exactly_once", "1 while every fleet request "
+              "has exactly one typed terminal",
+              [(None, 1 if fleet.get("exactly_once") else 0)])
+        gauge("dtx_fleet_restarts_total", "engine restarts on the "
+              "merged timeline", [(None, fleet.get("restarts"))])
+        gauge("dtx_fleet_source_skew_seconds", "clock-skew offset the "
+              "collector aligned away per source",
+              [({"source": s.get("source")}, s.get("skew_s"))
+               for s in sources])
+        fslo = fleet.get("slo") or {}
+        if fslo:
+            gauge("dtx_fleet_identity_holds", "1 while the federated "
+                  "burn identity (fleet == request-weighted per-source "
+                  "combination) holds exactly",
+                  [(None, 1 if (fslo.get("identity") or {}).get("holds")
+                    else 0)])
+            fdocs = (fslo.get("fleet") or {}).get("slos") or []
+            gauge("dtx_fleet_burn_rate", "fleet-wide error-budget burn "
+                  "rate per SLO and window",
+                  [({"slo": d.get("name"), "window": label},
+                    (d.get("windows") or {}).get(label, {})
+                    .get("burn_rate"))
+                   for d in fdocs for label in ("fast", "slow")])
+            gauge("dtx_fleet_source_burn_rate", "per-source slow-window "
+                  "burn rate per SLO",
+                  [({"source": src, "slo": d.get("name")},
+                    (d.get("windows") or {}).get("slow", {})
+                    .get("burn_rate"))
+                   for src, ps in sorted(
+                       (fslo.get("per_source") or {}).items())
+                   for d in (ps.get("slos") or [])])
     return "\n".join(out) + "\n"
 
 
@@ -340,6 +395,14 @@ class StatusServer:
         self._report_body: Optional[bytes] = None
         self._report_t = 0.0
         self._report_lock = threading.Lock()
+        # /fleet cache: the collector re-reads every span stream end
+        # to end (rotated segments included), so a scrape must not
+        # re-merge an unchanged fleet.  TTL-only — the merge has no
+        # wall-clock fields, and a stat signature across N run dirs
+        # would cost nearly as much as the merge it guards.
+        self._fleet_doc: Optional[Dict[str, Any]] = None
+        self._fleet_t = -1e18
+        self._fleet_lock = threading.Lock()
 
     def _report_signature(self) -> tuple:
         """(path, mtime_ns, size) for every file /report reads —
@@ -409,6 +472,28 @@ class StatusServer:
         return slo_lib.evaluate(slo_lib.records_from_spans(rows),
                                 specs=self.slos)
 
+    def fleet_doc(self) -> Optional[Dict[str, Any]]:
+        """The /fleet payload: obs/collector.fleet_report over this
+        server's ``logs_path`` (a run dir is a one-source fleet; a
+        parent of run dirs federates its children).  None when no
+        span/metrics streams exist underneath.  TTL-cached."""
+        from . import collector as col_lib
+
+        now = time.monotonic()
+        with self._fleet_lock:
+            if now - self._fleet_t < REPORT_CACHE_TTL_S:
+                return self._fleet_doc
+        doc: Optional[Dict[str, Any]]
+        if col_lib.discover_sources([self.logs_path]):
+            doc = col_lib.fleet_report([self.logs_path],
+                                       specs=self.slos)
+        else:
+            doc = None
+        with self._fleet_lock:
+            self._fleet_doc = doc
+            self._fleet_t = now
+        return doc
+
     def start(self, port: int, host: str = "") -> Optional[int]:
         logs_path = self.logs_path
         engine = self.engine
@@ -419,10 +504,13 @@ class StatusServer:
                 pass
 
             def _send(self, code: int, body: bytes,
-                      ctype: str = "application/json") -> None:
+                      ctype: str = "application/json",
+                      headers: Optional[Dict[str, str]] = None) -> None:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -442,7 +530,8 @@ class StatusServer:
                             serving=(engine.stats()
                                      if engine is not None else None),
                             slo=(server.slo_doc(spans) if spans
-                                 else None))
+                                 else None),
+                            fleet=server.fleet_doc())
                         self._send(200, text.encode(),
                                    "text/plain; version=0.0.4")
                     elif path == "/report":
@@ -471,11 +560,21 @@ class StatusServer:
                                           f"stream tails"}).encode())
                             return
                         self._send(200, json.dumps(doc).encode())
+                    elif path == "/fleet":
+                        doc = server.fleet_doc()
+                        if doc is None:
+                            self._send(404, json.dumps(
+                                {"error": "no span/metrics streams "
+                                          "under this logs_path"}
+                            ).encode())
+                            return
+                        self._send(200, json.dumps(doc).encode())
                     else:
                         self._send(404, json.dumps(
                             {"error": f"unknown path {path!r}",
                              "endpoints": ["/status", "/metrics",
-                                           "/report", "/slo", "/trace"]
+                                           "/report", "/slo", "/trace",
+                                           "/fleet"]
                              + (["/generate"] if engine is not None
                                 else [])}).encode())
                 except Exception as e:  # a bad read must not kill serving
@@ -508,11 +607,15 @@ class StatusServer:
                         if deadline_ms < 0:
                             raise ValueError("'deadline_ms' must be "
                                              ">= 0")
+                    # W3C trace context: a malformed header degrades
+                    # to a fresh trace inside submit, never a 400
+                    traceparent = self.headers.get("traceparent")
                     rid = engine.submit(
                         prompt,
                         int(req.get("max_new_tokens", 16)),
                         temperature=float(req.get("temperature", 0.0)),
-                        deadline_ms=deadline_ms)
+                        deadline_ms=deadline_ms,
+                        traceparent=traceparent)
                 except ShedError as e:
                     # typed load shedding: the bounded queue is full —
                     # overloaded, not broken; Retry-After tells the
@@ -539,6 +642,18 @@ class StatusServer:
                     self._send(503, json.dumps(
                         {"error": f"{type(e).__name__}: {e}"}).encode())
                     return
+                # the response traceparent: the request's trace id
+                # (propagated or freshly minted by submit) with a new
+                # span id naming the serving edge — read BEFORE the
+                # wait, while the engine still holds the rid's context
+                resp_headers: Optional[Dict[str, str]] = None
+                ctx_of = getattr(engine, "trace_context", None)
+                ctx = ctx_of(rid) if ctx_of is not None else None
+                if ctx is not None:
+                    from .spans import format_traceparent, new_span_id
+
+                    resp_headers = {"traceparent": format_traceparent(
+                        ctx[0], new_span_id())}
                 # the handler wait honors the REQUEST's deadline (its
                 # own field, or the engine default): the engine
                 # retires it at the deadline with a typed timeout
@@ -565,19 +680,23 @@ class StatusServer:
                         self._send(504, json.dumps(
                             {"error": "generation timed out",
                              "status": "timeout",
-                             "rid": rid}).encode())
+                             "rid": rid}).encode(),
+                            headers=resp_headers)
                         return
                     if res.get("status") == "timeout":
                         # the engine's typed deadline/cancel terminal
-                        self._send(504, json.dumps(res).encode())
+                        self._send(504, json.dumps(res).encode(),
+                                   headers=resp_headers)
                         return
                     if "error" in res:
                         # typed "failed" (retry budget spent) or the
                         # engine loop died while THIS request was in
                         # flight
-                        self._send(500, json.dumps(res).encode())
+                        self._send(500, json.dumps(res).encode(),
+                                   headers=resp_headers)
                         return
-                    self._send(200, json.dumps(res).encode())
+                    self._send(200, json.dumps(res).encode(),
+                               headers=resp_headers)
                 except Exception as e:
                     self._send(500, json.dumps(
                         {"error": f"{type(e).__name__}: {e}"}).encode())

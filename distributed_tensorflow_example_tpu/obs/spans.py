@@ -56,6 +56,17 @@ whose retry budget is spent.  ``reconstruct`` classifies every
 record's ``terminal`` ∈ {result, timeout, shed, failed} and flags a
 record carrying more than one — the terminates-exactly-once invariant
 the chaos suite asserts.
+
+Fleet extensions (PR 16): every request carries a stable W3C
+``trace_id`` (accepted/minted at the serving edge, threaded through
+``engine.submit`` → scheduler → every span it emits, preserved across
+supervised restarts) so a lifecycle can be followed across processes;
+``reconstruct`` carries it onto the record and flags a mid-lifecycle
+change.  The stream itself is bounded by size-based rotation
+(``spans.<proc>.jsonl.1`` … keep-K, newest rotation = ``.1``);
+``read_spans`` stitches the rotated segments back together so
+``reconstruct``/``load_spans``/the fleet collector see one unbroken
+stream.
 """
 
 from __future__ import annotations
@@ -91,6 +102,43 @@ TERMINALS = ("result", "timeout", "shed", "failed")
 
 _SPANS_RE = re.compile(r"spans\.(\d+)\.jsonl$")
 
+# a W3C trace-context header: version-trace_id-parent_id-flags
+# (https://www.w3.org/TR/trace-context/).  We accept any version byte
+# but reject the all-zero ids the spec marks invalid.
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+def new_trace_id() -> str:
+    """A fresh 32-hex (128-bit) W3C trace id."""
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    """A fresh 16-hex (64-bit) W3C span id (the serving edge's own id,
+    returned to the caller in the response traceparent)."""
+    return os.urandom(8).hex()
+
+
+def parse_traceparent(header: Any) -> Optional[Tuple[str, str]]:
+    """``(trace_id, parent_id)`` from a ``traceparent`` header value,
+    or None when absent/malformed/all-zero — a bad header degrades to
+    a fresh trace, never to a rejected request."""
+    if not isinstance(header, str):
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if not m:
+        return None
+    _ver, trace_id, parent_id, _flags = m.groups()
+    if trace_id == "0" * 32 or parent_id == "0" * 16:
+        return None
+    return trace_id, parent_id
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    """The response-header form: version 00, sampled flag set."""
+    return f"00-{trace_id}-{span_id}-01"
+
 
 def span_files(logs_path: str) -> List[Tuple[int, str]]:
     """[(proc_index, path)] for every span stream in a run dir — the
@@ -112,17 +160,29 @@ class SpanRecorder:
     registry (the WindowTimer.charge discipline), stamps the schema
     version and writes one strict-JSON line.  Telemetry must degrade,
     never kill the engine it observes: a bad fd / full volume closes
-    the stream and emission becomes ring-only."""
+    the stream and emission becomes ring-only.
+
+    ``rotate_bytes`` > 0 bounds the stream on disk (the bounded-queue
+    lesson from PR 15, applied to the file that previously grew
+    without limit on a long-lived engine): when the live file would
+    exceed the limit it cascades to ``spans.<proc>.jsonl.1`` …
+    ``.<keep>`` (newest rotation = ``.1``, oldest dropped) and a fresh
+    live file is opened.  ``read_spans`` stitches the segments back
+    together."""
 
     def __init__(self, logs_path: str, process_index: int = 0,
-                 ring: int = RING_CAPACITY):
+                 ring: int = RING_CAPACITY, rotate_bytes: int = 0,
+                 keep: int = 3):
         import threading
 
         os.makedirs(logs_path, exist_ok=True)
         self.process_index = int(process_index)
+        self.rotate_bytes = int(rotate_bytes)
+        self.keep = max(1, int(keep))
         self.path = os.path.join(
             logs_path, f"spans.{self.process_index}.jsonl")
         self._f = open(self.path, "a", buffering=1)  # line-buffered
+        self._written = os.path.getsize(self.path)
         self.ring: collections.deque = collections.deque(maxlen=ring)
         # the engine emits under ITS lock, but /trace /slo readers are
         # HTTP handler threads: snapshot() must not race an append
@@ -142,12 +202,41 @@ class SpanRecorder:
         if self._f is None:
             return
         try:
-            self._f.write(json.dumps(row, allow_nan=False) + "\n")
+            line = json.dumps(row, allow_nan=False) + "\n"
+            if (self.rotate_bytes > 0 and self._written > 0
+                    and self._written + len(line) > self.rotate_bytes):
+                self._rotate()
+                if self._f is None:
+                    return
+            self._f.write(line)
+            self._written += len(line)
         except (OSError, ValueError):
             try:
                 self._f.close()
             except Exception:
                 pass
+            self._f = None
+
+    def _rotate(self) -> None:
+        """Cascade the live file to ``.1`` (``.keep`` dropped) and
+        reopen.  A rotation failure degrades to ring-only, the same
+        contract as a bad fd."""
+        try:
+            self._f.close()
+        except Exception:
+            pass
+        try:
+            last = f"{self.path}.{self.keep}"
+            if os.path.exists(last):
+                os.remove(last)
+            for i in range(self.keep - 1, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            os.replace(self.path, f"{self.path}.1")
+            self._f = open(self.path, "a", buffering=1)
+            self._written = 0
+        except OSError:
             self._f = None
 
     def snapshot(self) -> List[Dict[str, Any]]:
@@ -177,19 +266,41 @@ class SpanRecorder:
             self._f = None
 
 
-def read_spans(path: str) -> List[Dict[str, Any]]:
+def rotated_files(path: str) -> List[str]:
+    """Every on-disk segment of one span stream, oldest first:
+    ``<path>.<keep>`` … ``<path>.1`` then the live ``<path>`` (the
+    SpanRecorder rotation convention).  A never-rotated stream is just
+    ``[path]``."""
+    segs = []
+    for p in glob.glob(glob.escape(path) + ".*"):
+        suffix = p[len(path) + 1:]
+        if suffix.isdigit():
+            segs.append((int(suffix), p))
+    segs.sort(reverse=True)
+    files = [p for _n, p in segs]
+    if os.path.exists(path) or not files:
+        files.append(path)
+    return files
+
+
+def read_spans(path: str,
+               include_rotated: bool = True) -> List[Dict[str, Any]]:
     """Parse a spans.<proc>.jsonl back into rows (whole lines only —
-    a torn trailing append is skipped, not half-parsed)."""
+    a torn trailing append is skipped, not half-parsed).  Rotated
+    segments (``<path>.K`` … ``.1``) are stitched in front of the live
+    file by default, so a bounded stream reconstructs identically to
+    an unbounded one."""
     rows = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rows.append(json.loads(line))
-            except ValueError:
-                continue
+    for p in (rotated_files(path) if include_rotated else [path]):
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    continue
     return rows
 
 
@@ -246,6 +357,23 @@ def reconstruct(
         if rid is None:
             continue
         r = rec_for(proc, int(rid))
+        # trace-context carry (v7): the id must be STABLE across the
+        # whole lifecycle — a supervised restart requeues the request
+        # under the same trace_id, and a change mid-stream means two
+        # requests were conflated (or propagation broke).
+        tid = row.get("trace_id")
+        if isinstance(tid, str):
+            if "trace_id" not in r:
+                r["trace_id"] = tid
+            elif r["trace_id"] != tid:
+                r["errors"].append(
+                    f"trace_id changed mid-lifecycle: "
+                    f"{r['trace_id']} -> {tid}")
+        if "parent_id" not in r and isinstance(row.get("parent_id"),
+                                               str):
+            r["parent_id"] = row["parent_id"]
+        if "source" not in r and isinstance(row.get("source"), str):
+            r["source"] = row["source"]
         if event in MILESTONES:
             key = f"{event}_t"
             if key in r:

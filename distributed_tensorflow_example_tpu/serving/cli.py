@@ -19,9 +19,13 @@ slots = the largest batch bucket), ``--serve_port``.
 
 Observability knobs: ``--trace_spans`` records every request's
 lifecycle to ``<logs_path>/spans.<proc>.jsonl`` (obs/spans.py) and
-lights up ``/trace?rid=N``, ``/slo`` and the ``dtx_slo_*`` gauges;
-``--slo`` overrides the SLO specs those evaluate (obs/slo.py DSL,
-e.g. ``ttft_p99_ms<=250,error_rate<=0.01``).
+lights up ``/trace?rid=N``, ``/slo``, ``/fleet`` and the
+``dtx_slo_*``/``dtx_fleet_*`` gauges; ``--span_rotate_mb`` /
+``--span_keep`` bound the span stream's disk (size-based rotation,
+readers stitch segments); ``--slo`` overrides the SLO specs those
+evaluate (obs/slo.py DSL, e.g. ``ttft_p99_ms<=250,error_rate<=0.01``).
+``POST /generate`` accepts and returns a W3C ``traceparent`` header —
+the request's spans carry the caller's trace id (obs/serve.py).
 """
 
 from __future__ import annotations
@@ -151,8 +155,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if cfg.trace_spans:
         from ..obs.spans import SpanRecorder
 
-        recorder = SpanRecorder(cfg.logs_path)
-        print(f"dtx-serve: request spans -> {recorder.path}")
+        recorder = SpanRecorder(
+            cfg.logs_path,
+            rotate_bytes=int(cfg.span_rotate_mb * 1024 * 1024),
+            keep=cfg.span_keep)
+        print(f"dtx-serve: request spans -> {recorder.path}"
+              + (f" (rotate at {cfg.span_rotate_mb:g} MB, keep "
+                 f"{cfg.span_keep})" if cfg.span_rotate_mb > 0
+                 else ""))
     narrator = None
     if cfg.engine_retries > 0:
         # supervised restarts land on the SAME restarts.jsonl

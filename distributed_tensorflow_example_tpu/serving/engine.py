@@ -199,6 +199,9 @@ class DecodeEngine:
             maxlen=STATS_WINDOW)
         self._completed = 0
         self._failure: Optional[str] = None
+        # rid -> (trace_id, parent_id): the W3C trace context every
+        # accepted request carries (trimmed with _results retention)
+        self._traces: Dict[int, tuple] = {}
         self._next_rid = 0
         self._accepted = 0
         self._tick = 0
@@ -232,7 +235,8 @@ class DecodeEngine:
     # ---- request surface ----
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0,
-               deadline_ms: Optional[float] = None) -> int:
+               deadline_ms: Optional[float] = None,
+               traceparent: Optional[str] = None) -> int:
         """Queue a request (``prompt``: iterable of int token ids);
         returns its rid.  Thread-safe; the background loop (or the
         next ``step()``) picks it up.  ``deadline_ms`` bounds the
@@ -241,7 +245,21 @@ class DecodeEngine:
         scheduler retires the request with a typed ``timeout``
         terminal and frees its pages.  Raises ``ShedError`` when the
         bounded pending queue (``max_queue``) is full — the typed
-        503-with-Retry-After rejection."""
+        503-with-Retry-After rejection.
+
+        ``traceparent`` is an optional W3C trace-context header value
+        from the caller: its trace_id/parent_id ride every span this
+        request emits (a malformed header degrades to a fresh trace,
+        never to a rejection).  Without one, the engine mints a fresh
+        trace_id — every request is traceable either way; look it up
+        with ``trace_context(rid)``."""
+        from ..obs import spans as spans_lib
+
+        ctx = spans_lib.parse_traceparent(traceparent)
+        if ctx is not None:
+            trace_id, parent_id = ctx
+        else:
+            trace_id, parent_id = spans_lib.new_trace_id(), None
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -265,10 +283,13 @@ class DecodeEngine:
                 self._shed += 1
                 retry_s = self._retry_after_s()
                 if self.recorder is not None:
+                    extra = {"trace_id": trace_id}
+                    if parent_id is not None:
+                        extra["parent_id"] = parent_id
                     self.recorder.emit(
                         "shed", rid=rid, reason="queue",
                         tick=self.sched.ticks,
-                        queued=len(self.sched.waiting))
+                        queued=len(self.sched.waiting), **extra)
                 raise ShedError(
                     f"queue full ({len(self.sched.waiting)} waiting, "
                     f"max_queue={self.max_queue})",
@@ -281,16 +302,25 @@ class DecodeEngine:
             # rid only on acceptance so requests_total counts accepted
             # requests, not attempts
             self.sched.submit(rid, len(prompt), int(max_new_tokens),
-                              arrival=now, deadline=deadline)
+                              arrival=now, deadline=deadline,
+                              trace_id=trace_id, parent_id=parent_id)
             self._next_rid += 1
             self._accepted += 1
             self._queue_peak = max(self._queue_peak,
                                    len(self.sched.waiting))
             self._results[rid] = _Result(prompt, now)
             self._temps[rid] = float(temperature)
+            self._traces[rid] = (trace_id, parent_id)
         with self._work:
             self._work.notify()
         return rid
+
+    def trace_context(self, rid: int) -> Optional[tuple]:
+        """``(trace_id, parent_id)`` for an accepted rid (None for an
+        unknown/shed one) — the serving edge reads this to stamp the
+        response traceparent."""
+        with self._lock:
+            return self._traces.get(int(rid))
 
     def _retry_after_s(self) -> float:
         """The Retry-After hint on a shed: the p50 request latency
@@ -328,9 +358,11 @@ class DecodeEngine:
         res = self._results[rid]
         if not res.event.wait(timeout):
             return None
+        trace = self._traces.get(rid)
+        extra = {"trace_id": trace[0]} if trace else {}
         if res.error is not None:
             return {"rid": rid, "status": res.status or "failed",
-                    "error": res.error}
+                    "error": res.error, **extra}
         return {
             "rid": rid,
             "status": "result",
@@ -338,6 +370,7 @@ class DecodeEngine:
             "tokens": list(res.tokens),
             "latency_ms": round((res.finish_t - res.arrival_t) * 1e3, 3),
             "ttft_ms": round((res.first_t - res.arrival_t) * 1e3, 3),
+            **extra,
         }
 
     # ---- execution ----
@@ -549,7 +582,9 @@ class DecodeEngine:
         self._last_tok.pop(rid, None)
         self._finished_order.append(rid)
         while len(self._finished_order) > RETAIN_FINISHED:
-            self._results.pop(self._finished_order.popleft(), None)
+            evicted = self._finished_order.popleft()
+            self._results.pop(evicted, None)
+            self._traces.pop(evicted, None)
         res.event.set()
 
     # ---- compiled-program caches (one per shape bucket) ----
@@ -711,9 +746,13 @@ class DecodeEngine:
                 self._last_tok.pop(s.rid, None)
                 self._requeued += 1
                 if self.recorder is not None:
+                    # the requeue keeps the request's trace_id — the
+                    # chain across a supervised restart stays unbroken
+                    extra = ({"trace_id": s.trace_id}
+                             if s.trace_id else {})
                     self.recorder.emit("requeue", rid=s.rid,
                                        attempt=s.attempts,
-                                       tick=self.sched.ticks)
+                                       tick=self.sched.ticks, **extra)
                 survivors.append(s)
             # FIFO by arrival across survivors + untouched waiters
             # (waiters hold no pages and no generated tokens already)
@@ -744,8 +783,10 @@ class DecodeEngine:
         res.error = msg
         res.finish_t = now
         if self.recorder is not None:
+            trace = self._traces.get(rid)
+            extra = {"trace_id": trace[0]} if trace else {}
             self.recorder.emit("failed", rid=rid, reason=msg,
-                               attempts=int(attempts))
+                               attempts=int(attempts), **extra)
         self._seal(rid, res)
 
     def _fail(self, e: BaseException) -> None:
@@ -767,7 +808,10 @@ class DecodeEngine:
                         # no retire will follow: mark the lifecycle
                         # failed so reconstruction doesn't read these
                         # as silently dropped requests
-                        self.recorder.emit("error", rid=rid, reason=msg)
+                        trace = self._traces.get(rid)
+                        extra = {"trace_id": trace[0]} if trace else {}
+                        self.recorder.emit("error", rid=rid,
+                                           reason=msg, **extra)
                     res.event.set()
         with self._work:
             self._running = False

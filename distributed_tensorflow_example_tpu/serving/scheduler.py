@@ -156,6 +156,12 @@ class SeqState:
     # engine-supervision retry count (how many crashes this request
     # already survived via requeue)
     attempts: int = 0
+    # W3C trace context (PR 16): the 32-hex trace id this request
+    # carries on every span it emits, stable across requeue (a
+    # supervised restart keeps the chain unbroken); parent_id is the
+    # caller's 16-hex span id when a traceparent arrived at the edge
+    trace_id: Optional[str] = None
+    parent_id: Optional[str] = None
 
     @property
     def length(self) -> int:
@@ -229,7 +235,9 @@ class ContinuousScheduler:
     # ---- request surface ----
     def submit(self, rid: int, prompt_len: int, max_new_tokens: int,
                arrival: float = 0.0,
-               deadline: Optional[float] = None) -> None:
+               deadline: Optional[float] = None,
+               trace_id: Optional[str] = None,
+               parent_id: Optional[str] = None) -> None:
         if prompt_len < 1 or max_new_tokens < 1:
             raise ValueError("prompt_len and max_new_tokens must be "
                              ">= 1")
@@ -240,11 +248,17 @@ class ContinuousScheduler:
                 f"{self.alloc.usable} usable")
         self.waiting.append(SeqState(rid, prompt_len, max_new_tokens,
                                      arrival=arrival,
-                                     deadline=deadline))
+                                     deadline=deadline,
+                                     trace_id=trace_id,
+                                     parent_id=parent_id))
         # emitted on ACCEPT only (validation above raises first), so
         # the span stream's submit events mirror requests_total
         extra = ({"deadline": float(deadline)}
                  if deadline is not None else {})
+        if trace_id is not None:
+            extra["trace_id"] = str(trace_id)
+        if parent_id is not None:
+            extra["parent_id"] = str(parent_id)
         self._emit("submit", rid=rid, prompt_len=int(prompt_len),
                    max_new_tokens=int(max_new_tokens),
                    arrival=float(arrival), **extra)

@@ -447,6 +447,34 @@ def run(cfg: Config) -> Dict[str, Any]:
         restart_narrator = RestartNarrator(cfg.logs_path,
                                            process_index=proc_idx)
 
+    # --trace_spans (fleet observability): training emits PHASE spans
+    # — round / outer_sync / ckpt — onto the same spans.<proc>.jsonl
+    # stream serving writes its request lifecycles to, every row under
+    # ONE run-level trace id, so the fleet collector
+    # (obs/collector.py) can put training rounds and serving requests
+    # on a single causally-ordered timeline and `dtx-obs trace
+    # --export chrome` shows them as nested tracks. Off by default;
+    # host-side appends only, outside the dispatch hot path.
+    span_recorder = None
+    run_trace_id = None
+    if cfg.trace_spans:
+        from ..obs.spans import SpanRecorder, new_trace_id
+
+        span_recorder = SpanRecorder(
+            cfg.logs_path, process_index=proc_idx,
+            rotate_bytes=int(cfg.span_rotate_mb * 1024 * 1024),
+            keep=cfg.span_keep)
+        run_trace_id = new_trace_id()
+
+    def phase_span(name: str, t0: float, **fields) -> None:
+        """One obs/schema phase span: host wall since ``t0`` under the
+        run's trace id. A no-op unless --trace_spans."""
+        if span_recorder is not None:
+            span_recorder.emit(
+                "phase", phase=name, trace_id=run_trace_id,
+                dur_ms=round((time.perf_counter() - t0) * 1e3, 3),
+                **fields)
+
     # goodput phase accounting: cumulative wall spent OUTSIDE the
     # per-window timing buckets, carried on the run_end event so
     # obs/aggregate.py's decomposition sums to the run's wall time
@@ -1076,8 +1104,10 @@ def run(cfg: Config) -> Dict[str, Any]:
                 return
             step = int(state.step)
             if step // cfg.checkpoint_every > last_ckpt_step // cfg.checkpoint_every:
+                t_ck = time.perf_counter()
                 with tracer.annotate("checkpoint"):
                     save_state(step, resume_epoch)
+                phase_span("ckpt", t_ck, step=step)
                 last_ckpt_step = step
 
         # --- resilience: write-behind snapshots + SIGTERM safety -----
@@ -1601,9 +1631,12 @@ def run(cfg: Config) -> Dict[str, Any]:
                             # position, drain the writer, exit 128+sig
                             # (the forensics guard dumps the flight
                             # record with reason "sigterm")
+                            t_ck = time.perf_counter()
                             with tracer.annotate("checkpoint"):
                                 snapshot_state(steps_done, epoch, i)
                                 ckpt_writer.drain()
+                            phase_span("ckpt", t_ck, step=steps_done,
+                                       preempt=True)
                             print(f"Preempted "
                                   f"({preempt_handler.signal_name()}): "
                                   f"final snapshot at step "
@@ -1649,6 +1682,13 @@ def run(cfg: Config) -> Dict[str, Any]:
                             else:
                                 state, cost_dev, acc_dev = train_step(
                                     state, batch_x, batch_y)
+                        if span_recorder is not None and site_mode:
+                            # one dispatch = one local-SGD ROUND (H
+                            # inner steps + the outer sync fused in
+                            # the compiled program): the round phase
+                            # span is its host dispatch wall
+                            phase_span("round", t_disp,
+                                       step=steps_done + 1)
                         if wtimer is not None:
                             t_disp = time.perf_counter() - t_disp
                             wtimer.charge("dispatch", t_disp)
@@ -1671,7 +1711,10 @@ def run(cfg: Config) -> Dict[str, Any]:
                         # deterministically, and fetching it would force a
                         # host-device sync every step
                         if async_mode and steps_done % cfg.sync_period == 0:
+                            t_sync = time.perf_counter()
                             state = param_sync(state)
+                            phase_span("outer_sync", t_sync,
+                                       step=steps_done)
                         examples_seen += global_batch
                         if flight is not None:
                             # one deque append — the ring's step identity;
@@ -1749,6 +1792,7 @@ def run(cfg: Config) -> Dict[str, Any]:
                                 wtimer.charge("ckpt",
                                               time.perf_counter()
                                               - t_ck)
+                            phase_span("ckpt", t_ck, step=steps_done)
                         if wtimer is not None:
                             wtimer.step_done()
                             if (wtimer.steps >= cfg.log_every
@@ -1989,5 +2033,7 @@ def run(cfg: Config) -> Dict[str, Any]:
                       f"{ck_err}")
         if flight is not None:
             flight.uninstall()
+        if span_recorder is not None:
+            span_recorder.close()
         if status_server is not None:
             status_server.close()

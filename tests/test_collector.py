@@ -1,0 +1,362 @@
+"""Fleet span collector acceptance (ISSUE 16 tentpole).
+
+Two halves, the serving-suite discipline:
+
+- **pure Python**: source discovery, the clock-skew alignment golden
+  (two sources ±5s apart, merged order pinned row by row), rotation
+  stitching, the Chrome trace-event export validated against the
+  format's event schema, and fleet-report exactly-once verdicts over
+  doctored streams;
+- **engine** (CPU jax): THE acceptance case — a 3-engine fleet with
+  one engine crashed mid-decode by a FaultPlan, merged into a single
+  timeline, every accepted request reconstructing fleet-wide to
+  exactly one typed terminal with its trace_id chain unbroken across
+  the supervised restart.
+"""
+
+import json
+import os
+
+import pytest
+
+from distributed_tensorflow_example_tpu.obs import collector as col_lib
+from distributed_tensorflow_example_tpu.obs import schema as schema_lib
+from distributed_tensorflow_example_tpu.obs import slo as slo_lib
+from distributed_tensorflow_example_tpu.obs import spans as spans_lib
+from distributed_tensorflow_example_tpu.serving import scheduler as sl
+
+
+def _row(event, t, rid=None, **f):
+    row = {"kind": "span", "v": schema_lib.SCHEMA_VERSION, "t": t,
+           "proc": 0, "event": event, **f}
+    if rid is not None:
+        row["rid"] = rid
+    return row
+
+
+def _write_rows(d, rows, proc=0):
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, f"spans.{proc}.jsonl"), "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return d
+
+
+def _lifecycle(t0, rid, tid=None, dt=0.1):
+    """One complete request starting at t0, milestones dt apart."""
+    extra = {"trace_id": tid} if tid else {}
+    return [
+        _row("submit", t0, rid=rid, prompt_len=2, max_new_tokens=2,
+             arrival=0.0, **extra),
+        _row("admit", t0 + dt, rid=rid, pages_held=1, tick=0, **extra),
+        _row("first_token", t0 + 2 * dt, rid=rid, ttft_ms=10.0,
+             **extra),
+        _row("retire", t0 + 3 * dt, rid=rid, generated=2,
+             finish_t=0.05, tick=2, **extra),
+    ]
+
+
+# --- discovery -------------------------------------------------------------
+
+
+def test_discover_sources_run_dirs_and_parents(tmp_path):
+    a = _write_rows(str(tmp_path / "fleet" / "a"), _lifecycle(1.0, 0))
+    b = _write_rows(str(tmp_path / "fleet" / "b"), _lifecycle(1.0, 0))
+    (tmp_path / "fleet" / "not_a_run").mkdir()    # no streams: skipped
+    # a run dir itself
+    assert col_lib.discover_sources([a]) == [("a", a)]
+    # a parent of run dirs, sorted by name, streamless child skipped
+    assert col_lib.discover_sources([str(tmp_path / "fleet")]) == [
+        ("a", a), ("b", b)]
+    # a duplicate path never yields a duplicate source
+    assert len(col_lib.discover_sources([a, a])) == 1
+    # basename collision across parents disambiguates with #N
+    c = _write_rows(str(tmp_path / "other" / "a"), _lifecycle(1.0, 0))
+    names = [n for n, _ in col_lib.discover_sources([a, c])]
+    assert names == ["a", "a#1"]
+    # a restarts.jsonl alone marks a run dir too
+    r = str(tmp_path / "restart_only")
+    os.makedirs(r)
+    with open(os.path.join(r, "restarts.jsonl"), "w") as f:
+        f.write("{}\n")
+    assert col_lib.discover_sources([r]) == [("restart_only", r)]
+    assert col_lib.discover_sources([str(tmp_path / "ghost")]) == []
+
+
+# --- clock-skew alignment (the golden) -------------------------------------
+
+
+def test_clock_skew_alignment_golden(tmp_path):
+    """Two sources started concurrently, wall clocks 5s apart: the
+    per-source constant offset puts them on one axis and the merged
+    order is pinned row by row — intra-source order untouched, the
+    applied skew reported, never silent."""
+    # a's clock: rows at 1000.0 / 1000.2 / 1000.4
+    a = _write_rows(str(tmp_path / "a"), [
+        _row("submit", 1000.0, rid=0, prompt_len=2, max_new_tokens=1,
+             arrival=0.0),
+        _row("admit", 1000.2, rid=0, pages_held=1, tick=0),
+        _row("retire", 1000.4, rid=0, generated=1, finish_t=0.4,
+             tick=1),
+    ])
+    # b's clock runs 5s AHEAD: same three milestones, emitted at
+    # +0.1/+0.5 of its own start
+    b = _write_rows(str(tmp_path / "b"), [
+        _row("submit", 1005.0, rid=0, prompt_len=2, max_new_tokens=1,
+             arrival=0.0),
+        _row("admit", 1005.1, rid=0, pages_held=1, tick=0),
+        _row("retire", 1005.5, rid=0, generated=1, finish_t=0.5,
+             tick=1),
+    ])
+    col = col_lib.collect([a, b])
+    skews = {s["source"]: s["skew_s"] for s in col["sources"]}
+    assert skews == {"a": 0.0, "b": 5.0}    # reported, never silent
+    # the pinned merged order: both starts align on t=1000.0 (stable
+    # sort keeps source order for the tie), then b's admit at 1000.1,
+    # a's admit at 1000.2, a's retire at 1000.4, b's retire at 1000.5
+    order = [(r["source"], r["event"]) for r in col["rows"]]
+    assert order == [("a", "submit"), ("b", "submit"),
+                     ("b", "admit"), ("a", "admit"),
+                     ("a", "retire"), ("b", "retire")]
+    ts = [r["t"] for r in col["rows"]]
+    assert ts == sorted(ts)
+    assert ts[0] == 1000.0 and ts[-1] == pytest.approx(1000.5)
+    # procs rewritten globally unique (both sources wrote proc 0)
+    assert {(r["source"], r["proc"]) for r in col["rows"]} == {
+        ("a", 0), ("b", 1)}
+    # both requests reconstruct as distinct records from the merge
+    recs = spans_lib.reconstruct(col["rows"])
+    assert len(recs) == 2
+    assert all(r["complete"] for r in recs.values())
+    # --no-align: raw clocks kept, skew reported as 0 (not applied)
+    raw = col_lib.collect([a, b], align=False)
+    assert all(s["skew_s"] == 0.0 for s in raw["sources"])
+    assert [r["t"] for r in raw["rows"]][-1] == 1005.5
+
+
+def test_collect_stitches_rotated_streams(tmp_path):
+    """A source whose span stream rotated mid-run merges whole: the
+    collector sees every row across the .K…
+    .1 segments."""
+    d = str(tmp_path / "rot")
+    rec = spans_lib.SpanRecorder(d, rotate_bytes=600, keep=10)
+    s = sl.ContinuousScheduler(num_pages=5, page_size=4, max_batch=4,
+                               recorder=rec)
+    sl.simulate(s, [(0, 4, 4), (1, 4, 4), (2, 4, 4)])
+    rec.close()
+    assert os.path.exists(rec.path + ".1")
+    col = col_lib.collect([d])
+    assert col["sources"][0]["rows"] == len(
+        spans_lib.read_spans(rec.path))
+    recs = spans_lib.reconstruct(col["rows"])
+    assert set(r for _p, r in recs) == {0, 1, 2}
+    assert all(r["complete"] for r in recs.values())
+
+
+# --- Chrome trace-event export ---------------------------------------------
+
+
+def test_chrome_trace_golden(tmp_path):
+    """The export validates against the Chrome trace-event schema:
+    every event carries ph/pid/tid/name/ts, X events a dur, i events
+    a scope, M events name their source track; request lifecycles
+    nest (same tid, contained intervals); training phases and
+    restarts land on the phase track."""
+    tid = "ab" * 16
+    rows = [dict(r, source="siteA")
+            for r in _lifecycle(1.0, 0, tid=tid)]
+    rows.append(dict(_row("phase", 2.0, phase="round", trace_id=tid,
+                          dur_ms=100.0, step=3), source="siteA"))
+    rows.append(dict(_row("engine_restart", 2.5, restart=1,
+                          reason="crash", rids=[0], tick=1),
+                     source="siteA"))
+    rows.append({"kind": "restart", "t": 2.6, "proc": 0,
+                 "event": "engine_restart", "source": "siteA"})
+    doc = col_lib.chrome_trace(rows)
+    json.dumps(doc, allow_nan=False)                # strict JSON
+    assert doc["displayTimeUnit"] == "ms"
+    assert doc["otherData"]["sources"] == ["siteA"]
+    events = doc["traceEvents"]
+    for e in events:
+        assert e["ph"] in ("M", "X", "i"), e
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert isinstance(e["name"], str)
+        if e["ph"] == "M":
+            assert e["name"] == "process_name"
+            assert e["args"]["name"] == "siteA"
+        else:
+            assert isinstance(e["ts"], (int, float))
+        if e["ph"] == "X":
+            assert e["dur"] >= 1.0
+        if e["ph"] == "i":
+            assert e["s"] == "p"
+    by_name = {e["name"]: e for e in events}
+    # the request span carries its trace context and terminal
+    req = by_name["request 0"]
+    assert req["cat"] == "request" and req["tid"] == 1
+    assert req["args"]["trace_id"] == tid
+    assert req["args"]["terminal"] == "result"
+    assert req["ts"] == 1.0e6 and req["dur"] == pytest.approx(3.0e5)
+    # lifecycle phases nest: same tid, contained in [ts, ts+dur]
+    for name in ("queued", "prefill", "decode"):
+        ph = by_name[name]
+        assert ph["tid"] == req["tid"]
+        assert ph["ts"] >= req["ts"]
+        assert ph["ts"] + ph["dur"] <= req["ts"] + req["dur"] + 1.0
+    # the training phase span sits on the dedicated track (tid 0),
+    # its interval ENDING at the emit time (dur_ms measured wall)
+    tr = by_name["round"]
+    assert tr["tid"] == 0 and tr["cat"] == "train"
+    assert tr["dur"] == pytest.approx(1.0e5)        # 100ms in us
+    assert tr["ts"] + tr["dur"] == pytest.approx(2.0e6)
+    assert tr["args"]["trace_id"] == tid and tr["args"]["step"] == 3
+    # restart/anomaly instants: the span-stream one and the
+    # restarts.jsonl one both land
+    assert by_name["engine_restart"]["ph"] == "i"
+    assert by_name["restart:engine_restart"]["ph"] == "i"
+    # events are time-ordered with metadata first
+    ts = [e.get("ts", -1.0) for e in events if e["ph"] != "M"]
+    assert ts == sorted(ts)
+    assert events[0]["ph"] == "M"
+
+
+# --- fleet report (pure) ---------------------------------------------------
+
+
+def test_fleet_report_exactly_once_and_identity(tmp_path):
+    a = _write_rows(str(tmp_path / "a"),
+                    _lifecycle(1.0, 0) + _lifecycle(1.5, 1))
+    b = _write_rows(str(tmp_path / "b"), _lifecycle(1.2, 0))
+    doc = col_lib.fleet_report([a, b])
+    assert schema_lib.validate_fleet_report(doc) == []
+    assert doc["exactly_once"] and doc["errors"] == []
+    assert doc["requests"] == 3 and doc["restarts"] == 0
+    assert [s["source"] for s in doc["sources"]] == ["a", "b"]
+    assert doc["slo"]["kind"] == "fleet_slo_report"
+    assert doc["slo"]["identity"]["holds"]
+    assert doc["slo"]["sources"] == ["a", "b"]
+    # a doctored duplicate terminal breaks the verdict, named by
+    # SOURCE (the operator's handle), not the rewritten proc
+    rows = _lifecycle(1.0, 0)
+    rows.append(_row("retire", 9.9, rid=0, generated=2, finish_t=9.0,
+                     tick=7))
+    _write_rows(str(tmp_path / "a"),
+                rows + _lifecycle(1.5, 1))
+    doc = col_lib.fleet_report([a, b])
+    assert not doc["exactly_once"]
+    assert any(e.startswith("a rid 0:") and "duplicate retire" in e
+               for e in doc["errors"])
+    # an IN-FLIGHT request (no terminal yet) is not a violation
+    c = _write_rows(str(tmp_path / "c"), [
+        _row("submit", 1.0, rid=5, prompt_len=2, max_new_tokens=2,
+             arrival=0.0)])
+    doc = col_lib.fleet_report([c])
+    assert doc["exactly_once"] and doc["requests"] == 1
+    assert doc["slo"] is None               # no terminal records yet
+
+
+def test_fleet_report_error_cap(tmp_path):
+    """A corrupt fleet diagnoses, not floods: the errors list is
+    capped at MAX_REPORT_ERRORS."""
+    rows = []
+    for rid in range(col_lib.MAX_REPORT_ERRORS + 20):
+        rows += [_row("admit", 1.0 + rid, rid=rid, pages_held=1,
+                      tick=0)]          # admit without submit: error
+    a = _write_rows(str(tmp_path / "a"), rows)
+    doc = col_lib.fleet_report([a])
+    assert not doc["exactly_once"]
+    assert len(doc["errors"]) == col_lib.MAX_REPORT_ERRORS
+
+
+# --- the 3-engine chaos merge (CPU jax) ------------------------------------
+
+
+def test_three_engine_fleet_merges_exactly_once_across_crash(tmp_path):
+    """THE fleet acceptance case: three engines in three run dirs,
+    one crashed mid-decode by a FaultPlan and supervised back up.
+    The merged timeline reconstructs every accepted request
+    fleet-wide to exactly one typed terminal, the crashed engine's
+    requests keep their trace_id chain unbroken across the restart
+    (requeue rides the SAME id the caller sent), and the federated
+    SLO identity holds over the merge."""
+    import jax
+    import numpy as np
+
+    from distributed_tensorflow_example_tpu.models import (
+        transformer as tfm,
+    )
+    from distributed_tensorflow_example_tpu.resilience.restart import (
+        RestartNarrator,
+    )
+    from distributed_tensorflow_example_tpu.serving.engine import (
+        DecodeEngine,
+    )
+    from distributed_tensorflow_example_tpu.serving.faults import (
+        FaultPlan,
+    )
+
+    spec = tfm.TransformerSpec(
+        input_size=32, num_classes=10, seq_len=32, d_model=32,
+        n_heads=2, num_blocks=2, d_ff=64, objective="lm",
+        vocab_size=50, causal=True)
+    params = tfm.init(jax.random.PRNGKey(0), spec)
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, 50, size=n).tolist()
+               for n in (3, 6, 4, 5, 3, 7)]
+
+    # the caller's trace for the crashed engine's first request: its
+    # id must survive the requeue into the merged fleet record
+    want_tid, want_parent = "fe" * 16, "aa" * 8
+    hdr = spans_lib.format_traceparent(want_tid, want_parent)
+
+    dirs, all_rids = [], {}
+    for i in range(3):
+        d = str(tmp_path / f"engine{i}")
+        rec = spans_lib.SpanRecorder(d)
+        kw = {}
+        if i == 1:                      # the crashed member
+            kw = dict(engine_retries=3,
+                      faults=FaultPlan(crash_at_ticks=(1,)),
+                      restart_narrator=RestartNarrator(d))
+        eng = DecodeEngine(spec, params, page_size=4, max_batch=2,
+                           recorder=rec, **kw)
+        rids = [eng.submit(prompts[2 * i + j], 4,
+                           traceparent=hdr if (i, j) == (1, 0)
+                           else None)
+                for j in range(2)]
+        assert eng.trace_context(rids[0]) is not None
+        eng.run_until_idle()
+        results = [eng.result(r, timeout=60.0) for r in rids]
+        assert [r["status"] for r in results] == ["result"] * 2
+        rec.close()
+        dirs.append(d)
+        all_rids[f"engine{i}"] = rids
+
+    doc = col_lib.fleet_report(dirs)
+    assert schema_lib.validate_fleet_report(doc) == []
+    # fleet-wide exactly-once: 6 requests, every one a single typed
+    # terminal, no reconstruction errors — across the crash
+    assert doc["exactly_once"], doc["errors"]
+    assert doc["requests"] == 6
+    assert doc["restarts"] >= 1             # the FaultPlan crash
+    assert [s["source"] for s in doc["sources"]] == [
+        "engine0", "engine1", "engine2"]
+    # the merged reconstruction: typed result terminals everywhere,
+    # and every request carries SOME stable trace_id
+    col = col_lib.collect(dirs)
+    recs = spans_lib.reconstruct(
+        [r for r in col["rows"] if r.get("kind") == "span"])
+    assert len(recs) == 6
+    for key, r in recs.items():
+        assert r["terminal"] == "result" and r["complete"], \
+            (key, r["errors"])
+        assert len(r.get("trace_id") or "") == 32, key
+    # the caller-traced request on the crashed engine: id + parent
+    # exactly as sent, with the restart visibly on its record
+    by_src = {(r["source"], r["rid"]): r for r in recs.values()}
+    traced = by_src[("engine1", all_rids["engine1"][0])]
+    assert traced["trace_id"] == want_tid
+    assert traced["parent_id"] == want_parent
+    # the federated SLO identity holds over the merged stream
+    assert doc["slo"]["identity"]["holds"]
+    assert doc["slo"]["sources"] == ["engine0", "engine1", "engine2"]

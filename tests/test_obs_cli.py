@@ -776,6 +776,182 @@ def test_cli_validate_exit_codes(tmp_path, capsys):
     assert cli_lib.main(["validate", str(tmp_path / "ghost.json")]) == 2
 
 
+# --- tail -f across rotation/truncation (ISSUE 16 satellite) ---------------
+
+
+def test_poll_new_lines_survives_rotation_and_truncation(tmp_path):
+    """The follow-loop regression fix: a stream that rotates under a
+    live ``tail -f`` (renamed away, fresh file took the name — new
+    inode) or truncates (size < recorded offset) used to go silently
+    quiet forever.  Both must reset the offset and re-read the
+    replacement from its start; a torn mid-append tail stays unread
+    until the line completes."""
+    p = str(tmp_path / "spans.0.jsonl")
+    state = {}
+    with open(p, "w") as f:
+        f.write("one\n")
+    assert cli_lib.poll_new_lines(p, state) == ["one"]
+    assert cli_lib.poll_new_lines(p, state) == []      # no growth
+    with open(p, "a") as f:
+        f.write("two\n")
+    assert cli_lib.poll_new_lines(p, state) == ["two"]
+    # rotation mid-tail (the SpanRecorder cascade): live -> .1, a
+    # fresh live file opens under the watched name
+    os.replace(p, p + ".1")
+    with open(p, "w") as f:
+        f.write("three\n")
+    assert cli_lib.poll_new_lines(p, state) == ["three"]
+    # truncation: the new size is SMALLER than our offset
+    with open(p, "w") as f:
+        f.write("x\n")
+    assert cli_lib.poll_new_lines(p, state) == ["x"]
+    # a torn append is left whole for the next poll
+    with open(p, "a") as f:
+        f.write('{"half')
+    assert cli_lib.poll_new_lines(p, state) == []
+    with open(p, "a") as f:
+        f.write('": 1}\n')
+    assert cli_lib.poll_new_lines(p, state) == ['{"half": 1}']
+    # a vanished file is quiet, not a crash
+    os.remove(p)
+    assert cli_lib.poll_new_lines(p, state) == []
+
+
+def test_cli_tail_reads_rotated_span_stream(tmp_path, capsys):
+    """dtx-obs tail's backlog stitches rotated span segments — the
+    lifecycle head that rotated into .1 still prints."""
+    from distributed_tensorflow_example_tpu.obs import spans as spans_lib
+    from distributed_tensorflow_example_tpu.serving import scheduler as sl
+
+    rec = spans_lib.SpanRecorder(str(tmp_path), rotate_bytes=600,
+                                 keep=10)
+    s = sl.ContinuousScheduler(num_pages=5, page_size=4, max_batch=4,
+                               recorder=rec)
+    sl.simulate(s, [(0, 4, 4), (1, 4, 4), (2, 4, 4)])
+    rec.close()
+    assert os.path.exists(rec.path + ".1")
+    assert cli_lib.main(["tail", str(tmp_path), "-n", "200"]) == 0
+    out = capsys.readouterr().out
+    assert "rid 0 submit" in out            # rotated-away head
+    assert "rid 2 blocked pages" in out
+
+
+# --- collect / trace --export / fleet (ISSUE 16) ---------------------------
+
+
+def _fleet_dirs(tmp_path, names=("siteA", "siteB")):
+    """A parent dir holding one deterministic spanned run per name."""
+    from distributed_tensorflow_example_tpu.obs import spans as spans_lib
+    from distributed_tensorflow_example_tpu.serving import scheduler as sl
+
+    parent = tmp_path / "fleet"
+    for name in names:
+        d = parent / name
+        rec = spans_lib.SpanRecorder(str(d))
+        s = sl.ContinuousScheduler(num_pages=5, page_size=4,
+                                   max_batch=4, recorder=rec)
+        sl.simulate(s, [(0, 4, 4), (1, 4, 4)])
+        rec.close()
+    return parent
+
+
+def test_cli_collect(tmp_path, capsys):
+    parent = _fleet_dirs(tmp_path)
+    assert cli_lib.main(["collect", str(parent)]) == 0
+    cap = capsys.readouterr()
+    assert "source siteA:" in cap.err and "source siteB:" in cap.err
+    assert "[siteA]" in cap.out and "[siteB]" in cap.out
+    # --json yields raw merged rows, source-stamped, procs rewritten
+    assert cli_lib.main(["collect", str(parent), "--json"]) == 0
+    rows = [json.loads(ln)
+            for ln in capsys.readouterr().out.splitlines()]
+    assert {r["source"] for r in rows} == {"siteA", "siteB"}
+    assert len({(r["source"], r["proc"]) for r in rows}) == 2
+    # -n bounds the printed tail; -o writes JSONL
+    assert cli_lib.main(["collect", str(parent), "--json",
+                         "-n", "3"]) == 0
+    assert len(capsys.readouterr().out.splitlines()) == 3
+    out_file = tmp_path / "merged.jsonl"
+    assert cli_lib.main(["collect", str(parent),
+                         "-o", str(out_file)]) == 0
+    capsys.readouterr()
+    assert len(out_file.read_text().splitlines()) == len(rows)
+    # no streams anywhere -> exit 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert cli_lib.main(["collect", str(empty)]) == 2
+
+
+def test_cli_trace_export_chrome(tmp_path, capsys):
+    parent = _fleet_dirs(tmp_path)
+    assert cli_lib.main(["trace", str(parent), "--export",
+                         "chrome"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert set(doc) == {"traceEvents", "displayTimeUnit", "otherData"}
+    assert doc["otherData"]["sources"] == ["siteA", "siteB"]
+    # RID narrows the export to one request's events
+    assert cli_lib.main(["trace", str(parent), "1", "--export",
+                         "chrome"]) == 0
+    doc1 = json.loads(capsys.readouterr().out)
+    assert 0 < len(doc1["traceEvents"]) < len(doc["traceEvents"])
+    # -o writes the file (the ui.perfetto.dev handoff)
+    out_file = tmp_path / "trace.json"
+    assert cli_lib.main(["trace", str(parent), "--export", "chrome",
+                         "-o", str(out_file)]) == 0
+    cap = capsys.readouterr()
+    assert "ui.perfetto.dev" in cap.err
+    assert json.load(open(out_file))["traceEvents"]
+    # without --export, RID is still required (exit 2), and an empty
+    # dir has nothing to export (exit 2)
+    assert cli_lib.main(["trace", str(parent)]) == 2
+    empty = tmp_path / "none"
+    empty.mkdir()
+    assert cli_lib.main(["trace", str(empty), "--export",
+                         "chrome"]) == 2
+
+
+def test_cli_fleet_exit_codes(tmp_path, capsys):
+    from distributed_tensorflow_example_tpu.obs import (
+        schema as schema_lib,
+    )
+
+    parent = _fleet_dirs(tmp_path)
+    # healthy fleet under generous specs -> 0, a schema-valid report
+    assert cli_lib.main(["fleet", str(parent), "--spec",
+                         "latency_p99_ms<=100000,error_rate<=0.5"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["kind"] == "fleet_report"
+    assert schema_lib.validate_fleet_report(doc) == []
+    assert doc["exactly_once"] and doc["requests"] == 4
+    assert [s["source"] for s in doc["sources"]] == ["siteA", "siteB"]
+    assert doc["slo"]["identity"]["holds"]
+    # an SLO breach -> exit 3 with the named breach on stderr
+    assert cli_lib.main(["fleet", str(parent), "--spec",
+                         "ttft_p99_ms<=0.001"]) == 3
+    cap = capsys.readouterr()
+    assert "SLO breach ttft_p99_ms" in cap.err
+    # a doctored duplicate milestone -> exactly-once violation -> 3
+    with open(os.path.join(str(parent / "siteA"),
+                           "spans.0.jsonl"), "a") as f:
+        f.write(json.dumps({
+            "kind": "span", "v": schema_lib.SCHEMA_VERSION,
+            "t": 99.0, "proc": 0, "event": "retire", "rid": 0,
+            "generated": 4, "finish_t": 99.0, "tick": 9}) + "\n")
+    assert cli_lib.main(["fleet", str(parent), "--spec",
+                         "latency_p99_ms<=100000,error_rate<=0.5"]) == 3
+    cap = capsys.readouterr()
+    doc = json.loads(cap.out)
+    assert not doc["exactly_once"]
+    assert any("duplicate retire" in e for e in doc["errors"])
+    assert "exactly-once violation" in cap.err
+    # bad spec / no streams -> usage error 2
+    assert cli_lib.main(["fleet", str(parent), "--spec",
+                         "bogus"]) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert cli_lib.main(["fleet", str(empty)]) == 2
+
+
 # --- stale-signal hygiene -------------------------------------------------
 
 

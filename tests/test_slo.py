@@ -370,3 +370,117 @@ def test_shed_gets_its_own_rate_not_the_error_budget():
     status = {"procs": {}, "live": False}
     text = serve_lib.prometheus_text(status, slo=doc)
     assert "dtx_slo_shed_rate 0.3333" in text
+
+
+# --- federated SLO (ISSUE 16: fleet observability) ------------------------
+
+
+def _src_records(source, n, bad, first_tick=1):
+    """n requests for one fleet source retiring at consecutive ticks;
+    the first ``bad`` of them blow the 500ms ttft bound."""
+    return [{"rid": i, "proc": 0, "source": source,
+             "terminal": "result",
+             "retire_tick": first_tick + i,
+             "ttft_ms": 900.0 if i < bad else 100.0,
+             "latency_ms": 50.0, "error": False}
+            for i in range(n)]
+
+
+def test_fleet_identity_closed_form():
+    """THE federated acceptance case: two sources, hand-counted bad
+    fractions.  Because the per-source record sets partition the
+    fleet set inside every shared-now_tick window, the fleet burn MUST
+    equal the request-weighted recombination of the per-source burns —
+    checked exactly (integer counts, one shared rounding), no
+    tolerance."""
+    spec = _spec(objective=0.9, fast_window=5, slow_window=20,
+                 burn_threshold=100.0)      # verdict out of the way
+    # a: 10 requests ticks 1..10, 2 bad; b: 6 requests ticks 5..10,
+    # 1 bad — b's window occupancy differs from a's, so the identity
+    # is not trivially "same counts everywhere"
+    records = (_src_records("a", 10, 2)
+               + _src_records("b", 6, 1, first_tick=5))
+    doc = slo_lib.fleet_evaluate(records, specs=[spec])
+    assert doc["kind"] == "fleet_slo_report"
+    assert doc["sources"] == ["a", "b"]
+    assert doc["now_tick"] == 10            # shared: max fleet-wide
+    # slow window (ticks 1..10): all 16 requests, 3 bad
+    fw = doc["fleet"]["slos"][0]["windows"]["slow"]
+    assert fw["requests"] == 16 and fw["bad"] == 3
+    assert fw["burn_rate"] == round((3 / 16) / 0.1, 6)
+    aw = doc["per_source"]["a"]["slos"][0]["windows"]["slow"]
+    bw = doc["per_source"]["b"]["slos"][0]["windows"]["slow"]
+    assert (aw["requests"], aw["bad"]) == (10, 2)
+    assert (bw["requests"], bw["bad"]) == (6, 1)
+    assert aw["burn_rate"] == 2.0           # (2/10)/0.1
+    # the identity: fleet == request-weighted per-source combination
+    assert doc["identity"]["holds"] and doc["ok"]
+    for chk in doc["identity"]["checks"]:
+        assert chk["holds"], chk
+        assert chk["fleet_bad"] == chk["sum_source_bad"]
+        assert chk["fleet_requests"] == chk["sum_source_requests"]
+        assert chk["fleet_burn"] == chk["recombined_burn"]
+    # fast window (ticks 6..10): a contributes 5 requests 0 bad, b
+    # contributes 5 (ticks 6..10) of which bad rid 0 (tick 5) is OUT
+    fa = doc["per_source"]["a"]["slos"][0]["windows"]["fast"]
+    fb = doc["per_source"]["b"]["slos"][0]["windows"]["fast"]
+    ff = doc["fleet"]["slos"][0]["windows"]["fast"]
+    assert (fa["requests"], fa["bad"]) == (5, 0)
+    assert (fb["requests"], fb["bad"]) == (5, 0)
+    assert (ff["requests"], ff["bad"]) == (10, 0)
+    json.dumps(doc, allow_nan=False)        # strict JSON end to end
+
+
+def test_fleet_shared_now_tick_not_per_source():
+    """Per-source windows slide from the FLEET's newest tick, not each
+    source's own — otherwise the partition property (and with it the
+    identity) would silently break for a source that went quiet."""
+    spec = _spec(objective=0.9, fast_window=3, slow_window=100,
+                 burn_threshold=100.0)
+    # a went quiet at tick 4; b is live through tick 10
+    records = (_src_records("a", 4, 4)       # all bad, ticks 1..4
+               + _src_records("b", 10, 0))
+    doc = slo_lib.fleet_evaluate(records, specs=[spec])
+    fa = doc["per_source"]["a"]["slos"][0]["windows"]["fast"]
+    # fast window = ticks 8..10: a's records are ALL outside it
+    assert fa["requests"] == 0 and fa["bad"] == 0
+    assert doc["identity"]["holds"]
+
+
+def test_fleet_source_falls_back_to_proc():
+    """Records without a collector source stamp (a single-dir
+    multi-proc run) federate per process index."""
+    records = []
+    for proc in (0, 1):
+        for i in range(3):
+            records.append({"rid": i, "proc": proc, "source": None,
+                            "terminal": "result",
+                            "retire_tick": i + 1, "ttft_ms": 100.0,
+                            "latency_ms": 50.0, "error": False})
+    doc = slo_lib.fleet_evaluate(records)
+    assert doc["sources"] == ["proc0", "proc1"]
+    assert doc["identity"]["holds"] and doc["ok"]
+    assert doc["fleet"]["requests"] == 6
+
+
+def test_fleet_records_from_spans_carry_source(tmp_path):
+    """The span->record fold keeps the collector's source stamp, so
+    fleet_evaluate over a merged stream groups correctly end to end
+    — and sheds stay carved out of the identity's windows."""
+    path = _write_spans(tmp_path, [10.0, 20.0])
+    rows = spans_lib.read_spans(path)
+    for r in rows:
+        r["source"] = "siteA"
+    rows.append({"kind": "span", "v": schema_lib.SCHEMA_VERSION,
+                 "t": 9.0, "proc": 0, "event": "shed", "rid": 50,
+                 "reason": "queue", "tick": 1, "queued": 9,
+                 "source": "siteA"})
+    recs = slo_lib.records_from_spans(rows)
+    assert all(r["source"] == "siteA" for r in recs)
+    doc = slo_lib.fleet_evaluate(
+        recs, specs=[_spec(threshold_ms=50.0)])
+    assert doc["sources"] == ["siteA"]
+    assert doc["identity"]["holds"]
+    # the shed record is out of the windows but in the shed section
+    assert doc["fleet"]["requests"] == 2
+    assert doc["fleet"]["shed"]["shed"] == 1

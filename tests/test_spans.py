@@ -452,3 +452,177 @@ def test_reconstruct_brownout_clamp_exempts_token_check():
     r = spans_lib.reconstruct(rows)[(0, 5)]
     assert r["complete"] and r["errors"] == []
     assert r["brownout_clamped"] is True
+
+
+# --- trace-context propagation (ISSUE 16: fleet observability) ------------
+
+
+def test_traceparent_helpers_w3c_round_trip():
+    """The W3C trace-context helpers: id shapes, header round-trip,
+    and the degrade-to-fresh contract on malformed/all-zero input."""
+    tid, sid = spans_lib.new_trace_id(), spans_lib.new_span_id()
+    assert len(tid) == 32 and int(tid, 16) is not None
+    assert len(sid) == 16 and int(sid, 16) is not None
+    assert spans_lib.new_trace_id() != tid         # 128-bit fresh
+    hdr = spans_lib.format_traceparent(tid, sid)
+    assert hdr == f"00-{tid}-{sid}-01"
+    assert spans_lib.parse_traceparent(hdr) == (tid, sid)
+    # case/whitespace tolerant (headers travel through proxies)
+    assert spans_lib.parse_traceparent(f"  {hdr.upper()}  ") \
+        == (tid, sid)
+    # malformed/absent degrades to None (-> a fresh trace), never a
+    # rejection: garbage, wrong field widths, non-string, and the
+    # all-zero ids the spec marks invalid
+    for bad in (None, 7, "", "bogus", f"00-{tid}-{sid}",
+                f"00-{tid[:-1]}-{sid}-01", f"00-{tid}-{sid}ff-01",
+                f"00-{'0' * 32}-{sid}-01", f"00-{tid}-{'0' * 16}-01"):
+        assert spans_lib.parse_traceparent(bad) is None, bad
+
+
+def test_reconstruct_carries_trace_context():
+    """v7: the record carries the FIRST trace_id/parent_id/source it
+    sees; a mid-lifecycle change is flagged (two requests conflated,
+    or propagation broke) and breaks `complete`."""
+    tid = "ab" * 16
+    rows = [
+        _vrow("submit", rid=0, prompt_len=2, max_new_tokens=2,
+              arrival=0.0, trace_id=tid, parent_id="cd" * 8,
+              source="siteA"),
+        _vrow("admit", rid=0, pages_held=1, tick=0, trace_id=tid),
+        _vrow("retire", rid=0, generated=2, finish_t=1.0, tick=2,
+              trace_id=tid),
+    ]
+    r = spans_lib.reconstruct(rows)[(0, 0)]
+    assert r["complete"] and r["errors"] == []
+    assert r["trace_id"] == tid
+    assert r["parent_id"] == "cd" * 8
+    assert r["source"] == "siteA"
+    # a drifted id mid-stream is an exactly-once violation
+    drifted = rows[:2] + [_vrow("retire", rid=0, generated=2,
+                                finish_t=1.0, tick=2,
+                                trace_id="ef" * 16)]
+    r = spans_lib.reconstruct(drifted)[(0, 0)]
+    assert any("trace_id changed mid-lifecycle" in e
+               for e in r["errors"])
+    assert not r["complete"]
+
+
+def test_trace_id_survives_requeue_chain():
+    """The supervision contract fleet tracing rests on: a requeued
+    request re-runs its milestones under the SAME trace_id — the
+    chain across an engine restart is unbroken."""
+    tid = "12" * 16
+    rows = [
+        _vrow("submit", rid=2, prompt_len=2, max_new_tokens=3,
+              arrival=0.0, trace_id=tid),
+        _vrow("admit", rid=2, pages_held=1, tick=0, trace_id=tid),
+        _vrow("engine_restart", restart=1, reason="crash",
+              rids=[2], tick=1),
+        _vrow("requeue", rid=2, attempt=1, tick=0, trace_id=tid),
+        _vrow("admit", rid=2, pages_held=1, tick=1, trace_id=tid),
+        _vrow("prefill", rid=2, bucket=2, pages_width=1,
+              trace_id=tid),
+        _vrow("first_token", rid=2, ttft_ms=9.0, trace_id=tid),
+        _vrow("retire", rid=2, generated=3, finish_t=2.0, tick=4,
+              trace_id=tid),
+    ]
+    r = spans_lib.reconstruct(rows)[(0, 2)]
+    assert r["complete"] and r["errors"] == [], r["errors"]
+    assert r["trace_id"] == tid and r["requeues"] == 1
+    assert r["engine_restarts"] == 1
+
+
+def test_phase_span_contract_v7():
+    """The training-side phase span: registered in SPAN_EVENTS, its
+    scope names pinned in PHASE_SCOPES, and the validator requires
+    phase/trace_id/dur_ms and rejects unregistered scope names."""
+    from distributed_tensorflow_example_tpu.obs.buckets import (
+        PHASE_SCOPES,
+    )
+
+    assert schema_lib.SCHEMA_VERSION == 7
+    assert "phase" in SPAN_EVENTS
+    assert PHASE_SCOPES == ("round", "outer_sync", "ckpt")
+    tid = "ab" * 16
+    good = _vrow("phase", phase="round", trace_id=tid, dur_ms=12.5,
+                 step=3)
+    assert schema_lib.validate_span_row(good) == []
+    for scope in PHASE_SCOPES:
+        assert schema_lib.validate_span_row(
+            _vrow("phase", phase=scope, trace_id=tid,
+                  dur_ms=1.0)) == []
+    errs = schema_lib.validate_span_row(
+        _vrow("phase", phase="warmup", trace_id=tid, dur_ms=1.0))
+    assert any("unknown phase" in e for e in errs)
+    errs = schema_lib.validate_span_row(
+        _vrow("phase", phase="round", dur_ms=1.0))   # no trace_id
+    assert errs and any("trace_id" in e for e in errs)
+    # a mistyped trace_id is caught wherever it appears
+    errs = schema_lib.validate_span_row(
+        _vrow("submit", rid=0, prompt_len=1, max_new_tokens=1,
+              arrival=0.0, trace_id=123))
+    assert errs and any("trace_id" in e for e in errs)
+    # the recorder emits it (phase rows have no rid; reconstruct
+    # skips them rather than minting a phantom record)
+    recs = spans_lib.reconstruct([good])
+    assert recs == {}
+
+
+# --- size-based rotation (ISSUE 16 satellite) ------------------------------
+
+
+def test_rotation_round_trip_preserves_reconstruction(tmp_path):
+    """A rotated stream reconstructs identically to an unbounded one:
+    the cascade lands on .1/.2, rotated_files orders oldest-first and
+    read_spans stitches — the closed-form sim invariants all hold
+    across the boundary."""
+    rec = spans_lib.SpanRecorder(str(tmp_path), rotate_bytes=600,
+                                 keep=10)
+    s = sl.ContinuousScheduler(num_pages=5, page_size=4, max_batch=4,
+                               recorder=rec)
+    sl.simulate(s, [(0, 4, 4), (1, 4, 4), (2, 4, 4)])
+    rec.close()
+    assert os.path.exists(rec.path + ".1")         # it DID rotate
+    files = spans_lib.rotated_files(rec.path)
+    assert files[-1] == rec.path
+    assert files == sorted(
+        files, key=lambda p: -int(p.rsplit(".", 1)[-1])
+        if p != rec.path else 0)
+    # the live file alone is a fragment; stitched, the stream is whole
+    live_only = spans_lib.read_spans(rec.path, include_rotated=False)
+    rows = spans_lib.read_spans(rec.path)
+    assert len(live_only) < len(rows)
+    recs = spans_lib.reconstruct(rows)
+    assert set(recs) == {(0, 0), (0, 1), (0, 2)}
+    for rid, r in recs.items():
+        assert r["complete"], (rid, r["errors"])
+    assert recs[(0, 2)]["blocked"] == {"pages": 3}
+    assert recs[(0, 2)]["admit_tick"] == 3
+    # load_spans (the /slo + trace path) stitches too
+    assert len(spans_lib.load_spans(str(tmp_path))) == len(rows)
+
+
+def test_rotation_keep_cap_drops_oldest(tmp_path):
+    """keep=K bounds the on-disk segment count: the oldest rotation is
+    dropped, never renamed past .K."""
+    rec = spans_lib.SpanRecorder(str(tmp_path), rotate_bytes=200,
+                                 keep=2)
+    for i in range(40):
+        rec.emit("blocked", rid=i, reason="pages", tick=i)
+    rec.close()
+    assert os.path.exists(rec.path + ".1")
+    assert os.path.exists(rec.path + ".2")
+    assert not os.path.exists(rec.path + ".3")
+    assert spans_lib.rotated_files(rec.path) == [
+        rec.path + ".2", rec.path + ".1", rec.path]
+    # newest rotation is .1: its rows are newer than .2's
+    t2 = spans_lib.read_spans(rec.path + ".2",
+                              include_rotated=False)[-1]["tick"]
+    t1 = spans_lib.read_spans(rec.path + ".1",
+                              include_rotated=False)[0]["tick"]
+    assert t1 > t2
+    # a never-rotated stream is just [path]
+    solo = spans_lib.SpanRecorder(str(tmp_path / "solo"))
+    solo.emit("blocked", rid=0, reason="pages", tick=0)
+    solo.close()
+    assert spans_lib.rotated_files(solo.path) == [solo.path]
