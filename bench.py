@@ -2115,6 +2115,157 @@ def bench_trace_overhead(n_requests: int = 16, max_batch: int = 4,
     }
 
 
+def bench_latency_attribution(n_requests: int = 12, max_batch: int = 2,
+                              page_size: int = 8, rounds: int = 5,
+                              seed: int = 0):
+    """Latency-attribution bench (ISSUE 17), two halves:
+
+    1. CHAOS ATTRIBUTION (real DecodeEngine under a FaultPlan): a
+       burst of ragged requests through a SUPERVISED engine with a
+       mid-decode crash, a bounded queue (typed sheds) and a
+       too-tight deadline on every 5th request (typed timeouts), so
+       every terminal type appears.  Every request's waterfall
+       (obs/waterfall.py) must tile its submit->terminal wall with
+       disjoint segments; the gated key is
+       ``waterfall_sum_to_wall_frac`` — the MINIMUM over requests of
+       segment-sum / wall, held to >= 99% in obs/compare.GATE_METRICS
+       (the "buckets sum to wall" honesty discipline, per request:
+       an unexplained gap is exactly what this PR exists to remove).
+       The queueing side (obs/queueing.py) must close too:
+       Little's-law rel_err over the same stream rides along.
+
+    2. OVERHEAD (the bench_trace_overhead discipline): the SAME
+       saturated fault-free replay with attribution OFF vs ON,
+       interleaved per round, where the ON arm pays span emission
+       (incl. the v8 tick_done close) AND the read-side waterfall
+       derivation inside its timed window.
+       ``attribution_retained_tok_frac`` — the median per-round
+       on/off tok/s ratio — is gated to <= 1% loss: where every
+       millisecond went may not cost the milliseconds it explains.
+
+    A missing stack degrades to an error row via the sweep's
+    guarded() (the bench_pp_memory precedent)."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_example_tpu.models import (
+        transformer as tfm)
+    from distributed_tensorflow_example_tpu.obs import (
+        waterfall as wf_lib)
+    from distributed_tensorflow_example_tpu.obs.queueing import (
+        queueing_report)
+    from distributed_tensorflow_example_tpu.obs.spans import (
+        SpanRecorder, read_spans)
+    from distributed_tensorflow_example_tpu.serving.admission import (
+        ShedError)
+    from distributed_tensorflow_example_tpu.serving.engine import (
+        DecodeEngine)
+    from distributed_tensorflow_example_tpu.serving.faults import (
+        FaultPlan)
+
+    rng = np.random.RandomState(seed)
+    seq = 128
+    spec = tfm.TransformerSpec(
+        input_size=seq, num_classes=10, seq_len=seq, d_model=64,
+        n_heads=4, num_blocks=2, d_ff=128, objective="lm",
+        vocab_size=64, causal=True, compute_dtype=jnp.bfloat16)
+    params = tfm.init(jax.random.PRNGKey(0), spec)
+
+    # ---- half 1: chaos attribution --------------------------------
+    tmp = tempfile.mkdtemp(prefix="dtx_latency_attribution_")
+    try:
+        rec = SpanRecorder(tmp)
+        eng = DecodeEngine(
+            spec, params, page_size=page_size, max_batch=max_batch,
+            seed=seed, engine_retries=2, max_queue=max(2, n_requests // 2),
+            faults=FaultPlan(crash_at_ticks=(2,)), recorder=rec)
+        rids = []
+        for i in range(n_requests):
+            p = rng.randint(0, 64,
+                            size=int(rng.randint(4, 16))).tolist()
+            # every 5th request: a deadline far inside the first
+            # prefill compile — the deterministic timeout population
+            dl = 40.0 if i % 5 == 4 else None
+            try:
+                rids.append(eng.submit(p, int(rng.randint(3, 10)),
+                                       deadline_ms=dl))
+            except ShedError:
+                pass  # the typed shed IS part of the chaos mix
+        eng.run_until_idle()
+        for r in rids:
+            eng.result(r, timeout=120.0)
+        rec.close()
+        span_rows = read_spans(rec.path)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    falls = wf_lib.waterfalls(span_rows)
+    summ = wf_lib.summarize(falls)
+    queue = queueing_report(span_rows) or {}
+    ll = queue.get("littles_law") or {}
+
+    # ---- half 2: attribution overhead (interleaved off/on) --------
+    reqs = [(rng.randint(0, 64,
+                         size=int(rng.randint(4, 24))).tolist(),
+             int(rng.randint(2, 18))) for _ in range(16)]
+    tmp = tempfile.mkdtemp(prefix="dtx_latency_attribution_ab_")
+
+    def replay(attribute: bool) -> float:
+        recorder = SpanRecorder(tmp) if attribute else None
+        engine = DecodeEngine(spec, params, page_size=page_size,
+                              max_batch=max_batch, seed=seed,
+                              recorder=recorder)
+        t0 = time.time()
+        ab_rids = [engine.submit(p, n) for p, n in reqs]
+        engine.run_until_idle()
+        toks = sum(len(engine.result(r, timeout=1.0)["tokens"])
+                   for r in ab_rids)
+        if recorder is not None:
+            # the ON arm pays the READ side too: deriving every
+            # waterfall is inside the timed window
+            wf_lib.summarize(wf_lib.waterfalls(recorder.snapshot()))
+            recorder.close()
+        return toks / (time.time() - t0)
+
+    try:
+        replay(False)   # warm-up: every shape bucket compiles here
+        off, on, ratios = [], [], []
+        for _ in range(max(1, rounds)):
+            a = replay(False)
+            b = replay(True)
+            off.append(a)
+            on.append(b)
+            ratios.append(b / a)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    med = float(np.median(ratios))
+    return {
+        "config": "latency_attribution",
+        "workload": f"{n_requests} burst requests (crash tick 2, "
+                    f"supervised, max_queue={max(2, n_requests // 2)}, "
+                    f"deadline 40ms on every 5th), then 16 saturated "
+                    f"requests x {max(1, rounds)} interleaved off/on "
+                    f"rounds, max_batch={max_batch}",
+        "waterfall_requests": summ["requests"],
+        "waterfall_complete": summ["complete"],
+        "waterfall_terminals": summ["terminals"],
+        "waterfall_sum_to_wall_frac": summ["min_sum_to_wall_frac"],
+        "waterfall_max_residual_frac": summ["max_residual_frac"],
+        "waterfall_sum_to_wall_ok": summ["sum_to_wall_ok"],
+        "waterfall_wall_p99_ms": summ["wall_p99_ms"],
+        "littles_law_rel_err": ll.get("rel_err"),
+        "littles_law_holds": ll.get("holds"),
+        "attribution_off_tok_s": round(float(np.median(off)), 1),
+        "attribution_on_tok_s": round(float(np.median(on)), 1),
+        "attribution_retained_tok_frac": round(med, 4),
+        "attribution_overhead_frac": round(1.0 - med, 4),
+        "attribution_rounds": max(1, rounds),
+    }
+
+
 def bench_serving_degraded(n_requests: int = 24, max_batch: int = 4,
                            page_size: int = 8, seed: int = 0):
     """Fail-open serving bench (ISSUE 15): goodput under injected
@@ -2768,6 +2919,12 @@ def main(argv=None) -> int:
     # claim (<= 1%, obs/compare.GATE_METRICS), degrading to an error
     # key where the stack is missing
     guarded("trace_overhead", bench_trace_overhead)
+    # the latency-attribution row (r17, every backend): per-request
+    # waterfalls under a chaos plan must tile submit->terminal
+    # (waterfall_sum_to_wall_frac >= 99%) and the attribution off/on
+    # A/B must retain >= 99% tok/s — both gate via the final summary,
+    # degrading to an error key where the stack is missing
+    guarded("latency_attribution", bench_latency_attribution)
     # the multi-site local-SGD row runs on EVERY backend (r10): the
     # comm-volume half is pure obs/flops closed forms and gates the
     # H-fold reduction claim; the measured sync-vs-H=8 A/B degrades
@@ -3017,6 +3174,21 @@ def main(argv=None) -> int:
         extra["trace_retained_tok_frac"] = \
             tr_row["trace_retained_tok_frac"]
         extra["trace_overhead_frac"] = tr_row["trace_overhead_frac"]
+    la_row = next(
+        (r for r in rows if r.get("config") == "latency_attribution"
+         and "waterfall_requests" in r), None)
+    if la_row:
+        # the latency-attribution gate keys (r17) ride the final
+        # line: every chaos request's segments must sum to its wall
+        # (>= 99%) and attribution must stay effectively free
+        extra["waterfall_sum_to_wall_frac"] = \
+            la_row["waterfall_sum_to_wall_frac"]
+        extra["waterfall_max_residual_frac"] = \
+            la_row["waterfall_max_residual_frac"]
+        extra["attribution_retained_tok_frac"] = \
+            la_row["attribution_retained_tok_frac"]
+        extra["attribution_overhead_frac"] = \
+            la_row["attribution_overhead_frac"]
     lsgd_row = next(
         (r for r in rows if r.get("config") == "local_sgd"
          and "sync_comm_bytes_per_token" in r), None)

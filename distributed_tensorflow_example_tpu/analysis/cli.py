@@ -30,8 +30,8 @@ from typing import List, Optional
 from .findings import (Finding, load_baseline, split_by_baseline,
                        write_baseline)
 from .index import ModuleIndex
-from .rules_contracts import (FlagDriftRule, SchemaDriftRule,
-                              ScopeRegistryRule)
+from .rules_contracts import (FlagDriftRule, GaugeDriftRule,
+                              SchemaDriftRule, ScopeRegistryRule)
 from .rules_loop import HostSyncRule
 from .rules_spmd import (AxisConsistencyRule, CustomVjpRule,
                          NondeterminismRule, RetraceRule)
@@ -47,6 +47,7 @@ ALL_RULES = (
     RetraceRule(),
     NondeterminismRule(),
     FlagDriftRule(),
+    GaugeDriftRule(),
     ScopeRegistryRule(),
 )
 

@@ -109,6 +109,10 @@ class SchemaDriftRule:
         # narrator (resilience/restart.py); the loop's preempt/
         # resumed/snapshot narration rides the same emit
         "RESTART_EVENT": ("resilience/restart.py",),
+        # v8 documents: the per-request latency waterfall and the
+        # history change-point report
+        "WATERFALL": ("obs/waterfall.py",),
+        "DRIFT_REPORT": ("obs/drift.py",),
     }
     GATE_PRODUCERS = ("bench.py", "obs/aggregate.py", "obs/metrics.py",
                       "obs/schema.py", "train/loop.py")
@@ -220,6 +224,52 @@ class FlagDriftRule:
                     hint=("add it to the docs/API.md flag coverage (the "
                           "bare field name anywhere in the file "
                           "counts)")))
+        return findings
+
+
+class GaugeDriftRule:
+    """rule 10: every ``dtx_*`` Prometheus gauge obs/serve.py emits
+    must be mentioned in docs/observability.md — the scrape surface
+    is an API, and an undocumented gauge is a dashboard nobody can
+    build (the flag-drift discipline, applied to /metrics)."""
+
+    id = "gauge-drift"
+    doc = ("obs/serve.py dtx_* gauges must be covered by "
+           "docs/observability.md")
+
+    def check(self, index: ModuleIndex, ctx) -> List[Finding]:
+        serve = index.module_by_suffix("obs/serve.py")
+        api_md = getattr(ctx, "api_md", None)
+        if serve is None or not api_md:
+            return []
+        obs_md = os.path.join(os.path.dirname(api_md),
+                              "observability.md")
+        if not os.path.isfile(obs_md):
+            return []
+        with open(obs_md, encoding="utf-8") as f:
+            words = set(re.findall(r"[A-Za-z0-9_]+", f.read()))
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+        for node in ast.walk(serve.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "gauge"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            name = node.args[0].value
+            if not name.startswith("dtx_") or name in seen:
+                continue
+            seen.add(name)
+            if name not in words:
+                findings.append(Finding(
+                    rule=self.id, file=serve.relpath, line=node.lineno,
+                    msg=(f"gauge {name} is not mentioned anywhere in "
+                         f"{os.path.basename(obs_md)}"),
+                    hint=("document it in docs/observability.md (the "
+                          "bare gauge name anywhere in the file "
+                          "counts) or drop the emission")))
         return findings
 
 

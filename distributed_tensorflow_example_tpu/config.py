@@ -340,6 +340,11 @@ class Config:
                                         # text, /report (obs/serve.py;
                                         # dtx-obs serve re-serves a
                                         # finished run offline)
+    status_cache_s: float = 15.0        # status-server response cache
+                                        # TTL seconds: /report, /fleet
+                                        # and /explain share one
+                                        # obs/serve.TTLCache discipline
+                                        # (0 = recompute every request)
     histograms: bool = False            # grad-norm/param-norm/learning-rate
                                         # summaries every --log_every steps,
                                         # fetched alongside the windowed
@@ -816,6 +821,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "(chief only): /status JSON, /metrics "
                         "Prometheus text, /report goodput report "
                         "(dtx-obs serve re-serves finished runs)")
+    p.add_argument("--status_cache_s", type=float,
+                   default=d.status_cache_s,
+                   help="status-server response cache TTL in seconds "
+                        "— /report, /fleet and /explain share one TTL "
+                        "cache (0 = recompute on every request)")
     p.add_argument("--histograms", action="store_true",
                    help="emit grad-norm/param-norm histogram and "
                         "learning-rate summaries into the event file "
@@ -1182,6 +1192,10 @@ def validate_serving_config(cfg: Config) -> None:
         raise ValueError(
             f"span_rotate_mb={cfg.span_rotate_mb} must be >= 0 (0 = "
             f"never rotate)")
+    if cfg.status_cache_s < 0:
+        raise ValueError(
+            f"status_cache_s={cfg.status_cache_s} must be >= 0 (0 = "
+            f"recompute on every request)")
     if cfg.span_keep < 1:
         raise ValueError(
             f"span_keep={cfg.span_keep} must be >= 1 (at least one "

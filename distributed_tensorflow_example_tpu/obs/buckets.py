@@ -104,9 +104,29 @@ GOODPUT_BUCKETS = ("train", "compile", "data_wait", "h2d", "ckpt",
 # checkpoint submit) carrying ``phase``/``trace_id``/``dur_ms`` so the
 # fleet collector can interleave training rounds with serving request
 # lifecycles on one timeline.  Valid phase names live in PHASE_SCOPES.
+# "tick_done" (PR 17) closes the tick the scheduler's tick row opened:
+# the engine emits it after the boundary's prefill+decode execution
+# with the execution-only ``dur_ms``, so the waterfall can split a
+# decode interval into active compute vs stall (fault-injected sleeps,
+# host scheduling gaps) — the tick-boundary timestamp pair the
+# per-request latency attribution (obs/waterfall.py) segments on.
 SPAN_EVENTS = ("submit", "blocked", "admit", "prefill", "first_token",
-               "tick", "retire", "error", "timeout", "shed",
-               "requeue", "engine_restart", "failed", "phase")
+               "tick", "tick_done", "retire", "error", "timeout",
+               "shed", "requeue", "engine_restart", "failed", "phase")
+
+# per-request latency waterfall segments (obs/waterfall.py), in
+# presentation order — the goodput-buckets discipline applied to ONE
+# request: disjoint intervals that partition submit→terminal wall.
+# "queue_wait" = submitted but not admitted (slot/page waits),
+# "brownout_clamp_delay" = blocked specifically by the brownout
+# governor, "prefill" = admit→first_token, "decode_active" = decode
+# execution, "decode_stall" = tick gaps not covered by execution
+# (injected stalls, host scheduling), "requeue" = engine-restart
+# recovery until re-admission, "finalize" = last tick end→terminal
+# bookkeeping, "untracked" = defensive residual (should be 0).
+WATERFALL_SEGMENTS = ("queue_wait", "brownout_clamp_delay", "prefill",
+                      "decode_active", "decode_stall", "requeue",
+                      "finalize", "untracked")
 
 # valid "phase" span names (train/loop.py emit sites): "round" is one
 # multi-site dispatch (site_mode), "outer_sync" the cross-site

@@ -35,11 +35,20 @@ Subcommands (``dtx-obs <cmd> --help`` for flags):
   identity violation or an exactly-once violation;
 - ``history FILE``  — the rolling bench history (obs/history.py):
   trend table by default, ``--import`` backfills from committed
-  BENCH captures, ``--append`` records any comparison document.
+  BENCH captures, ``--append`` records any comparison document;
+- ``explain LOGS`` — per-request latency waterfalls (obs/
+  waterfall.py): disjoint segments that provably sum to submit ->
+  terminal wall, ``--rid N`` / ``--trace ID`` to focus one request,
+  ``--fleet`` for the queueing analytics (arrival rate, per-bucket
+  service time, Little's-law check) instead;
+- ``drift HISTORY`` — change-point detection over the bench history
+  (obs/drift.py): names the metric, the window and the FIRST
+  offending row; ``--capture`` joins the roofline closed forms; exit
+  3 on confirmed drift (the compare regression convention).
 
 Exit codes: 0 ok; 1 validation failure; 2 bad input (missing files,
-no metrics stream); 3 regression/SLO-breach/fleet-invariant verdict
-(compare, slo, fleet).
+no metrics stream); 3 regression/SLO-breach/fleet-invariant/drift
+verdict (compare, slo, fleet, drift).
 """
 
 from __future__ import annotations
@@ -89,6 +98,10 @@ def format_row(row: Dict[str, Any]) -> Optional[str]:
         if ev == "phase":
             # the training-side span: no rid, a registered phase name
             return (f"[p{proc}] phase {row.get('phase')} "
+                    f"dur {_fmt(row.get('dur_ms'))}ms")
+        if ev == "tick_done":
+            # batch-shaped like tick: no rid, the execute duration
+            return (f"[p{proc}] tick_done {_fmt(row.get('tick'))} "
                     f"dur {_fmt(row.get('dur_ms'))}ms")
         bits = [f"[p{proc}] rid {_fmt(row.get('rid'))} {ev}"]
         for key, label in (("reason", ""), ("pages_held", "pages="),
@@ -229,6 +242,23 @@ def poll_new_lines(path: str, state: Dict[str, tuple]) -> List[str]:
                                 errors="replace").splitlines()
 
 
+def _tail_match(row: Dict[str, Any], rid: Optional[int],
+                trace: Optional[str]) -> bool:
+    """The ``tail --rid/--trace`` filter: span rows about the request
+    (directly, or as a member of a batch row's ``rids``).  With no
+    filter every row passes; with one, non-span rows are noise."""
+    if rid is None and trace is None:
+        return True
+    if row.get("kind") != "span":
+        return False
+    if rid is not None and row.get("rid") != rid \
+            and rid not in (row.get("rids") or ()):
+        return False
+    if trace is not None and row.get("trace_id") != trace:
+        return False
+    return True
+
+
 def cmd_tail(args) -> int:
     files = _stream_files(args.logs_path)
     if not files and not args.follow:
@@ -255,6 +285,8 @@ def cmd_tail(args) -> int:
         except OSError:
             pass
         for r in rows:
+            if not _tail_match(r, args.rid, args.trace or None):
+                continue
             line = format_row(r)
             if line is not None:
                 backlog.append((r.get("t") or 0.0, line))
@@ -269,9 +301,13 @@ def cmd_tail(args) -> int:
             for path in _stream_files(args.logs_path):
                 for ln in poll_new_lines(path, state):
                     try:
-                        line = format_row(json.loads(ln))
+                        row = json.loads(ln)
                     except ValueError:
                         continue
+                    if not isinstance(row, dict) or not _tail_match(
+                            row, args.rid, args.trace or None):
+                        continue
+                    line = format_row(row)
                     if line is not None:
                         print(line, flush=True)
     except KeyboardInterrupt:
@@ -279,7 +315,8 @@ def cmd_tail(args) -> int:
 
 
 def cmd_serve(args) -> int:
-    srv = serve_lib.StatusServer(args.logs_path)
+    srv = serve_lib.StatusServer(args.logs_path,
+                                 cache_ttl_s=args.cache_s)
     port = srv.start(args.port, host=args.host)
     if port is None:
         return 2
@@ -561,6 +598,104 @@ def cmd_history(args) -> int:
     return rc
 
 
+def cmd_explain(args) -> int:
+    from . import collector as col_lib
+    from . import waterfall as wf_lib
+    from .queueing import queueing_report
+
+    try:
+        col = col_lib.collect([args.logs_path])
+    except FileNotFoundError as e:
+        print(f"dtx-obs explain: {e}", file=sys.stderr)
+        return 2
+    span_rows = [r for r in col["rows"] if r.get("kind") == "span"]
+    if args.fleet:
+        q = queueing_report(span_rows)
+        if q is None:
+            print(f"dtx-obs explain: no request submits in the span "
+                  f"stream under {args.logs_path!r}", file=sys.stderr)
+            return 2
+        print(json.dumps(q, indent=None if args.compact else 1))
+        ll = q["littles_law"]
+        if not ll["holds"]:
+            print(f"dtx-obs explain: Little's law gap "
+                  f"{ll['rel_err']:.1%} — {ll['violations']} "
+                  f"in-flight/untracked request(s)", file=sys.stderr)
+        return 0
+    docs = wf_lib.waterfalls(span_rows, rid=args.rid,
+                             trace_id=args.trace or None)
+    if not docs:
+        where = (f" for rid {args.rid}" if args.rid is not None
+                 else f" for trace {args.trace!r}" if args.trace
+                 else "")
+        print(f"dtx-obs explain: no request lifecycles in the span "
+              f"stream under {args.logs_path!r}{where}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps({"summary": wf_lib.summarize(docs),
+                          "waterfalls": docs},
+                         indent=None if args.compact else 1))
+        return 0
+    for d in docs:
+        head = (f"[p{d['proc']}] rid {d['rid']} -> "
+                f"{d['terminal'] or 'IN FLIGHT'}  "
+                f"wall {d['wall_ms']:.1f}ms")
+        if d.get("trace_id"):
+            head += f"  trace {d['trace_id']}"
+        if d["requeues"]:
+            head += f"  requeues {d['requeues']}"
+        print(head)
+        for seg in wf_lib.WATERFALL_SEGMENTS:
+            ms = d["segments"].get(seg, 0.0)
+            if ms <= 0.0:
+                continue
+            frac = ms / d["wall_ms"] if d["wall_ms"] > 0 else 0.0
+            print(f"  {seg:<20} {ms:>10.2f}ms  {frac:>6.1%}")
+        print(f"  {'sum':<20} {d['segment_sum_ms']:>10.2f}ms  "
+              f"(residual {d['residual_ms']:+.3f}ms)")
+    summ = wf_lib.summarize(docs)
+    print(f"{summ['requests']} request(s), {summ['complete']} "
+          f"complete; wall p50 {_fmt(summ['wall_p50_ms'])}ms "
+          f"p99 {_fmt(summ['wall_p99_ms'])}ms; "
+          f"sum-to-wall {'OK' if summ['sum_to_wall_ok'] else 'GAP'} "
+          f"(max residual {summ['max_residual_frac']:.2%})")
+    return 0
+
+
+def cmd_drift(args) -> int:
+    from . import drift as drift_lib
+
+    if not os.path.isfile(args.history):
+        print(f"dtx-obs drift: {args.history}: no such file",
+              file=sys.stderr)
+        return 2
+    metrics = [m.strip() for m in args.metrics.split(",")
+               if m.strip()] or None
+    try:
+        doc = drift_lib.drift_report(
+            args.history, window=args.window,
+            tolerance=args.tolerance, metrics=metrics,
+            capture=args.capture or None)
+    except (OSError, ValueError) as e:
+        print(f"dtx-obs drift: {e}", file=sys.stderr)
+        return 2
+    if doc["entries"] < drift_lib.MIN_ENTRIES:
+        print(f"dtx-obs drift: only {doc['entries']} history "
+              f"entr{'y' if doc['entries'] == 1 else 'ies'} in "
+              f"{args.history!r} — change-point detection needs "
+              f">= {drift_lib.MIN_ENTRIES}", file=sys.stderr)
+        return 2
+    print(json.dumps(doc, indent=None if args.compact else 1))
+    for d in doc["drifts"]:
+        print(f"dtx-obs drift: CONFIRMED {d['metric']} shifted "
+              f"{d['shift_frac']:+.1%} (tolerance "
+              f"{d['tolerance']:.1%}) — first offending row "
+              f"{d['first_offending']!r} (entry "
+              f"{d['first_offending_index']})", file=sys.stderr)
+    return 0 if doc["ok"] else 3
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dtx-obs",
@@ -603,6 +738,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="keep following a live run")
     t.add_argument("--interval", type=float, default=2.0,
                    help="follow poll interval seconds")
+    t.add_argument("--rid", type=int, default=None,
+                   help="only span rows about this request id "
+                        "(directly or as a batch member)")
+    t.add_argument("--trace", default="",
+                   metavar="ID",
+                   help="only span rows stamped with this trace id")
     t.set_defaults(fn=cmd_tail)
 
     s = sub.add_parser("serve", help="serve /status /metrics /report "
@@ -612,6 +753,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--port", type=int, default=8321)
     s.add_argument("--host", default="",
                    help="bind address (default: all interfaces)")
+    s.add_argument("--cache_s", type=float, default=None,
+                   help="response cache TTL in seconds — /report, "
+                        "/fleet and /explain share one TTL cache "
+                        "(default 15; 0 = recompute every request)")
     s.set_defaults(fn=cmd_serve)
 
     v = sub.add_parser("validate", help="schema-validate metrics/"
@@ -704,6 +849,49 @@ def build_parser() -> argparse.ArgumentParser:
     h.add_argument("--json", action="store_true",
                    help="dump the raw entries instead of the table")
     h.set_defaults(fn=cmd_history)
+
+    ex = sub.add_parser("explain",
+                        help="per-request latency waterfalls: where "
+                             "every millisecond between submit and "
+                             "terminal went; --fleet for queueing "
+                             "analytics")
+    ex.add_argument("logs_path",
+                    help="run dir (or parent of run dirs)")
+    ex.add_argument("--rid", type=int, default=None,
+                    help="only this request id")
+    ex.add_argument("--trace", default="",
+                    metavar="ID",
+                    help="only requests stamped with this trace id")
+    ex.add_argument("--fleet", action="store_true",
+                    help="queueing analytics (arrival rate, service "
+                         "time by bucket, Little's-law check) "
+                         "instead of per-request waterfalls")
+    ex.add_argument("--json", action="store_true",
+                    help="raw waterfall documents instead of tables")
+    ex.add_argument("--compact", action="store_true")
+    ex.set_defaults(fn=cmd_explain)
+
+    dr = sub.add_parser("drift",
+                        help="change-point detection over the bench "
+                             "history; exit 3 on confirmed drift")
+    dr.add_argument("history", help="the history.jsonl file")
+    dr.add_argument("--window", type=int, default=0,
+                    help="only the newest N entries (0 = all)")
+    dr.add_argument("--tolerance", type=float, default=None,
+                    help="relative shift tolerance for EVERY metric "
+                         "(default: per-metric, 2x the gate "
+                         "threshold, floor 0.05)")
+    dr.add_argument("--metrics", default="",
+                    metavar="NAME,...",
+                    help="only these metrics (default: every metric "
+                         "present in enough entries)")
+    dr.add_argument("--capture", default="",
+                    metavar="BENCH.json",
+                    help="join this capture's measured decode "
+                         "throughput against the roofline closed "
+                         "forms")
+    dr.add_argument("--compact", action="store_true")
+    dr.set_defaults(fn=cmd_drift)
     return p
 
 

@@ -49,8 +49,10 @@ import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from . import slo as slo_lib
+from . import waterfall as wf_lib
 from .aggregate import has_streams as _has_streams
 from .aggregate import metrics_files
+from .queueing import queueing_report
 from .schema import SCHEMA_VERSION
 from .spans import read_spans, reconstruct, span_files
 
@@ -207,6 +209,10 @@ def fleet_report(paths: Iterable[str],
         "errors": errors[:MAX_REPORT_ERRORS],
         "restarts": restarts,
         "slo": slo_doc,
+        # queueing analytics (v8, obs/queueing.py): arrival rate,
+        # per-bucket service, utilization + the Little's-law identity
+        # over the merged stream — None when nothing was submitted
+        "queueing": queueing_report(span_rows),
     }
 
 
@@ -238,6 +244,10 @@ def chrome_trace(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
 
     span_rows = [r for r in rows if r.get("kind") == "span"]
     recs = reconstruct(span_rows)
+    # per-request waterfall segments (PR 17): the exact attribution
+    # partition nests under the coarse lifecycle slices
+    falls = {(d["proc"], d["rid"]): d
+             for d in wf_lib.waterfalls(span_rows)}
     # stable tid per request within its source track (rid collisions
     # across sources are fine — they live on different pids)
     for (proc, rid), rec in sorted(recs.items()):
@@ -276,6 +286,19 @@ def chrome_trace(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
                     "cat": "lifecycle", "ts": _us(a),
                     "dur": max(1.0, _us(b) - _us(a)),
                 })
+        # the waterfall's exact segment intervals (obs/waterfall.py):
+        # finer than the lifecycle slices — decode splits into
+        # active/stall, restarts show as requeue — skipping the
+        # zero-width and defensive-untracked pieces
+        fall = falls.get((proc, rid))
+        for a, b, seg in (fall or {}).get("intervals", ()):
+            if seg == "untracked" or b <= a:
+                continue
+            events.append({
+                "ph": "X", "pid": pid, "tid": tid, "name": seg,
+                "cat": "waterfall", "ts": _us(a),
+                "dur": max(1.0, _us(b) - _us(a)),
+            })
     for r in rows:
         kind, event = r.get("kind"), r.get("event")
         if kind == "span" and event == "phase":

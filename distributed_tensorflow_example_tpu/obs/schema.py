@@ -49,8 +49,15 @@ _NUM = (int, float)
 # (trace_id/parent_id on every span, W3C traceparent at the serving
 # edge), the training-side "phase" span event (phase/dur_ms), the
 # collector's "source" stamp on merged rows, and the FLEET_REPORT
-# document (obs/collector.py fleet timeline + federated SLO).
-SCHEMA_VERSION = 7
+# document (obs/collector.py fleet timeline + federated SLO);
+# v8 = latency attribution: the "tick_done" span event (the engine
+# closes each tick with its execution-only dur_ms so stall time is
+# separable), the WATERFALL document (obs/waterfall.py per-request
+# segment decomposition), the DRIFT_REPORT document (obs/drift.py
+# model-vs-measured change-point detection), and the FLEET_REPORT's
+# optional "queueing" section (obs/queueing.py Little's-law
+# analytics).
+SCHEMA_VERSION = 8
 
 
 # field -> allowed types; a tuple including type(None) marks nullable
@@ -242,6 +249,12 @@ SPAN_REQUIRED = {
     "first_token": ("rid", "ttft_ms"),
     "tick": ("tick", "rids", "batch", "batch_bucket", "kv_pages",
              "occupancy"),
+    # the tick-closing timestamp (v8): emitted by the engine after a
+    # tick's prefill+decode execution, dur_ms = execution wall only —
+    # (tick_done.t - tick.t) - dur_ms is the tick's stall, the number
+    # obs/waterfall.py splits decode time on.  Batch-shaped like tick
+    # (no rid): reconstruct() skips it, the waterfall consumes it.
+    "tick_done": ("tick", "dur_ms"),
     "retire": ("rid", "generated", "finish_t", "tick"),
     "error": ("rid", "reason"),
     # the typed terminals + supervision records (v6): timeout carries
@@ -464,6 +477,11 @@ FLEET_REPORT = {
     "errors": (list,),
     "restarts": (int,),
     "slo": (dict, type(None)),
+    # queueing analytics (v8, obs/queueing.py): arrival rate,
+    # per-bucket service time, utilization and the Little's-law
+    # consistency check over the merged stream; None when the stream
+    # has no completed requests to measure.
+    "queueing": (dict, type(None)),
 }
 
 
@@ -484,6 +502,101 @@ def validate_fleet_report(doc: Dict[str, Any],
         errs += _check(src, {"source": (str,), "rows": (int,),
                              "skew_s": _NUM, "procs": (int,)},
                        f"{where}.sources[{i}]")
+    return errs
+
+
+# One per-request latency waterfall (obs/waterfall.py derives it from
+# the span stream; dtx-obs explain and the /explain endpoint emit it).
+# "segments" maps obs/buckets.WATERFALL_SEGMENTS names to
+# milliseconds; the segments are computed as an exact partition of
+# [submit_t, terminal_t], so segment_sum_ms matches wall_ms up to
+# float rounding — residual_ms is the honesty field, and "complete"
+# says whether the stream held a typed terminal for this request.
+# "intervals" carries the absolute (t0, t1, segment) triples the
+# Chrome-trace export renders as nested slices.
+WATERFALL = {
+    "v": (int,),
+    "kind": (str,),          # "waterfall"
+    "proc": (int,),
+    "rid": (int,),
+    "terminal": (str, type(None)),
+    "submit_t": _NUM,
+    "terminal_t": _NUM,
+    "wall_ms": _NUM,
+    "segments": (dict,),
+    "segment_sum_ms": _NUM,
+    "residual_ms": _NUM,
+    "decode_ticks": (int,),
+    "requeues": (int,),
+    "complete": (bool,),
+    "intervals": (list,),
+}
+
+
+def validate_waterfall(doc: Dict[str, Any],
+                       where: str = "waterfall") -> List[str]:
+    """Validate one per-request waterfall document (top-level contract
+    + the segment names against the obs/buckets.py registry)."""
+    if not isinstance(doc, dict):
+        return [f"{where}: not an object"]
+    verrs = _version_errs(doc, "v", where)
+    if verrs:
+        return verrs
+    errs = _check(doc, WATERFALL, where)
+    if doc.get("kind") != "waterfall":
+        errs.append(f"{where}: kind is {doc.get('kind')!r}, expected "
+                    f"'waterfall'")
+    segs = doc.get("segments")
+    if isinstance(segs, dict):
+        from .buckets import WATERFALL_SEGMENTS
+
+        unknown = [s for s in segs if s not in WATERFALL_SEGMENTS]
+        if unknown:
+            errs.append(f"{where}: unknown segments {sorted(unknown)} "
+                        f"(known: {list(WATERFALL_SEGMENTS)})")
+        missing = [s for s in WATERFALL_SEGMENTS if s not in segs]
+        if missing:
+            errs.append(f"{where}: segments missing {missing}")
+    return errs
+
+
+# The drift report obs/drift.py produces (dtx-obs drift emits it,
+# exit 3 when "ok" is False): measured bench trajectory vs the
+# analytic closed forms, change-point detection over the history
+# window.  Each "drifts" entry names the metric, the window, the
+# split point and the FIRST offending row label — the three facts a
+# regression hunt needs.  "roofline" is the decode model-vs-measured
+# join (None where the chip peak is unknown, e.g. CPU).
+DRIFT_REPORT = {
+    "v": (int,),
+    "kind": (str,),          # "drift_report"
+    "generated_t": _NUM,
+    "history_path": (str,),
+    "entries": (int,),
+    "window": (int,),
+    "metrics": (list,),
+    "drifts": (list,),
+    "roofline": (dict, type(None)),
+    "ok": (bool,),
+}
+
+
+def validate_drift_report(doc: Dict[str, Any],
+                          where: str = "drift") -> List[str]:
+    """Validate an obs/drift.py report (top-level contract + the
+    per-drift entry shape)."""
+    if not isinstance(doc, dict):
+        return [f"{where}: not an object"]
+    verrs = _version_errs(doc, "v", where)
+    if verrs:
+        return verrs
+    errs = _check(doc, DRIFT_REPORT, where)
+    if doc.get("kind") != "drift_report":
+        errs.append(f"{where}: kind is {doc.get('kind')!r}, expected "
+                    f"'drift_report'")
+    for i, d in enumerate(doc.get("drifts") or []):
+        errs += _check(d, {"metric": (str,), "first_offending": (str,),
+                           "shift_frac": _NUM}, f"{where}.drifts[{i}]")
     return errs
 
 

@@ -75,6 +75,39 @@ STALE_HEARTBEAT_S = 120.0
 REPORT_CACHE_TTL_S = 15.0
 
 
+class TTLCache:
+    """The ONE cache for the recompute-heavy endpoints (/report,
+    /fleet, /explain — each was growing its own lock + timestamp +
+    signature triple).  ``get(compute)`` returns the cached value
+    while it is younger than ``ttl_s``; pass ``sig`` (any comparable
+    snapshot of the inputs, e.g. file stat triples) to ALSO
+    invalidate the moment the inputs change — the /report semantics.
+    ``None`` is a legitimate cached value (a fleet with no streams),
+    so freshness is tracked explicitly, not by value."""
+
+    def __init__(self, ttl_s: float = REPORT_CACHE_TTL_S):
+        self.ttl_s = float(ttl_s)
+        self._lock = threading.Lock()
+        self._sig: Any = None
+        self._value: Any = None
+        self._t = -1e18
+        self._filled = False
+
+    def get(self, compute, sig: Any = None) -> Any:
+        now = time.monotonic()
+        with self._lock:
+            if (self._filled and now - self._t < self.ttl_s
+                    and (sig is None or sig == self._sig)):
+                return self._value
+        value = compute()
+        with self._lock:
+            self._sig = sig
+            self._value = value
+            self._t = now
+            self._filled = True
+        return value
+
+
 def tail_rows(path: str, max_bytes: int = TAIL_BYTES) -> List[Dict[str, Any]]:
     """Parse the last ``max_bytes`` of a JSONL file. When the read
     starts mid-file the first (possibly torn) line is dropped."""
@@ -164,7 +197,8 @@ def collect_status(logs_path: str,
 def prometheus_text(status: Dict[str, Any],
                     serving: Optional[Dict[str, Any]] = None,
                     slo: Optional[Dict[str, Any]] = None,
-                    fleet: Optional[Dict[str, Any]] = None) -> str:
+                    fleet: Optional[Dict[str, Any]] = None,
+                    waterfall: Optional[Dict[str, Any]] = None) -> str:
     """Render a /status document in Prometheus text exposition format
     (version 0.0.4). Gauges only — everything here is a point-in-time
     read of the run's own counters. ``serving``: a
@@ -175,7 +209,9 @@ def prometheus_text(status: Dict[str, Any],
     p99).  ``fleet``: an obs/collector.fleet_report document appended
     as the ``dtx_fleet_*`` gauges (merged-timeline accounting, the
     exactly-once and federated-identity verdicts, per-source skew and
-    burn)."""
+    burn).  ``waterfall``: an obs/waterfall.summarize document
+    appended as the ``dtx_waterfall_*`` latency-attribution gauges
+    (per-segment p50/p99 and the sum-to-wall residual)."""
     out: List[str] = []
 
     def fmt(v) -> str:
@@ -348,6 +384,23 @@ def prometheus_text(status: Dict[str, Any],
                    for src, ps in sorted(
                        (fslo.get("per_source") or {}).items())
                    for d in (ps.get("slos") or [])])
+    if waterfall:
+        segs = waterfall.get("segments") or {}
+        gauge("dtx_waterfall_requests", "requests with a derived "
+              "latency waterfall",
+              [(None, waterfall.get("requests"))])
+        gauge("dtx_waterfall_segment_p50_ms", "median per-request "
+              "time in each waterfall segment",
+              [({"segment": name}, st.get("p50_ms"))
+               for name, st in sorted(segs.items())])
+        gauge("dtx_waterfall_segment_p99_ms", "p99 per-request time "
+              "in each waterfall segment",
+              [({"segment": name}, st.get("p99_ms"))
+               for name, st in sorted(segs.items())])
+        gauge("dtx_waterfall_residual_frac_max", "largest |wall - "
+              "segment sum| fraction across requests (the sum-to-wall "
+              "honesty bound; ~0 by construction)",
+              [(None, waterfall.get("max_residual_frac"))])
     return "\n".join(out) + "\n"
 
 
@@ -376,13 +429,16 @@ class StatusServer:
     ``slos``: obs/slo.SLOSpec list evaluated by ``/slo`` and the
     ``dtx_slo_*`` gauges (None = obs/slo.DEFAULT_SLOS)."""
 
-    def __init__(self, logs_path: str, engine=None, slos=None):
+    def __init__(self, logs_path: str, engine=None, slos=None,
+                 cache_ttl_s: Optional[float] = None):
         self.logs_path = logs_path
         self.engine = engine
         self.slos = slos
         self.port: Optional[int] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        ttl = (REPORT_CACHE_TTL_S if cache_ttl_s is None
+               else float(cache_ttl_s))
         # /report cache keyed by the input files' stat signature: the
         # aggregate is recomputed only when the run wrote something
         # new, so a dashboard poller cannot stall the chief.  A short
@@ -391,18 +447,15 @@ class StatusServer:
         # files, and a signature-only cache would pin the ages at
         # their last fresh-looking values forever — the exact stall
         # signal the field exists to expose.
-        self._report_sig: Optional[tuple] = None
-        self._report_body: Optional[bytes] = None
-        self._report_t = 0.0
-        self._report_lock = threading.Lock()
-        # /fleet cache: the collector re-reads every span stream end
-        # to end (rotated segments included), so a scrape must not
-        # re-merge an unchanged fleet.  TTL-only — the merge has no
-        # wall-clock fields, and a stat signature across N run dirs
-        # would cost nearly as much as the merge it guards.
-        self._fleet_doc: Optional[Dict[str, Any]] = None
-        self._fleet_t = -1e18
-        self._fleet_lock = threading.Lock()
+        self._report_cache = TTLCache(ttl)
+        # /fleet and /explain caches: the collector re-reads every
+        # span stream end to end (rotated segments included) and the
+        # waterfall derivation walks every request's boundaries, so a
+        # scrape must not recompute an unchanged fleet.  TTL-only —
+        # neither has wall-clock fields, and a stat signature across
+        # N run dirs would cost nearly as much as the work it guards.
+        self._fleet_cache = TTLCache(ttl)
+        self._explain_cache = TTLCache(ttl)
 
     def _report_signature(self) -> tuple:
         """(path, mtime_ns, size) for every file /report reads —
@@ -426,24 +479,15 @@ class StatusServer:
 
     def report_json(self) -> bytes:
         """The /report payload, recomputed when the signature of the
-        underlying files changed OR the cached copy aged past
-        ``REPORT_CACHE_TTL_S`` (heartbeat ages must keep growing for a
-        hung run)."""
+        underlying files changed OR the cached copy aged past the
+        cache TTL (heartbeat ages must keep growing for a hung
+        run)."""
         from . import aggregate as agg_lib
 
-        sig = self._report_signature()
-        now = time.monotonic()
-        with self._report_lock:
-            if (sig == self._report_sig
-                    and self._report_body is not None
-                    and now - self._report_t < REPORT_CACHE_TTL_S):
-                return self._report_body
-        body = json.dumps(agg_lib.aggregate(self.logs_path)).encode()
-        with self._report_lock:
-            self._report_sig = sig
-            self._report_body = body
-            self._report_t = now
-        return body
+        return self._report_cache.get(
+            lambda: json.dumps(agg_lib.aggregate(self.logs_path))
+            .encode(),
+            sig=self._report_signature())
 
     def _span_rows(self):
         """The /slo and /trace data source.  With a live engine whose
@@ -479,20 +523,24 @@ class StatusServer:
         span/metrics streams exist underneath.  TTL-cached."""
         from . import collector as col_lib
 
-        now = time.monotonic()
-        with self._fleet_lock:
-            if now - self._fleet_t < REPORT_CACHE_TTL_S:
-                return self._fleet_doc
-        doc: Optional[Dict[str, Any]]
-        if col_lib.discover_sources([self.logs_path]):
-            doc = col_lib.fleet_report([self.logs_path],
-                                       specs=self.slos)
-        else:
-            doc = None
-        with self._fleet_lock:
-            self._fleet_doc = doc
-            self._fleet_t = now
-        return doc
+        def compute() -> Optional[Dict[str, Any]]:
+            if col_lib.discover_sources([self.logs_path]):
+                return col_lib.fleet_report([self.logs_path],
+                                            specs=self.slos)
+            return None
+
+        return self._fleet_cache.get(compute)
+
+    def explain_docs(self) -> List[Dict[str, Any]]:
+        """The /explain data: every reconstructible per-request
+        waterfall over the current span rows (engine ring when live,
+        span tails offline).  TTL-cached unfiltered; the rid/trace
+        query filters are applied per request — filtering is cheap,
+        the derivation is not."""
+        from . import waterfall as wf_lib
+
+        return self._explain_cache.get(
+            lambda: wf_lib.waterfalls(self._span_rows()))
 
     def start(self, port: int, host: str = "") -> Optional[int]:
         logs_path = self.logs_path
@@ -524,14 +572,19 @@ class StatusServer:
                             doc["serving"] = engine.stats()
                         self._send(200, json.dumps(doc).encode())
                     elif path == "/metrics":
+                        from . import waterfall as wf_lib
+
                         spans = server._span_rows()
+                        falls = server.explain_docs()
                         text = prometheus_text(
                             collect_status(logs_path),
                             serving=(engine.stats()
                                      if engine is not None else None),
                             slo=(server.slo_doc(spans) if spans
                                  else None),
-                            fleet=server.fleet_doc())
+                            fleet=server.fleet_doc(),
+                            waterfall=(wf_lib.summarize(falls)
+                                       if falls else None))
                         self._send(200, text.encode(),
                                    "text/plain; version=0.0.4")
                     elif path == "/report":
@@ -569,12 +622,37 @@ class StatusServer:
                             ).encode())
                             return
                         self._send(200, json.dumps(doc).encode())
+                    elif path == "/explain":
+                        from urllib.parse import parse_qs
+
+                        from . import waterfall as wf_lib
+
+                        q = parse_qs(query)
+                        docs = server.explain_docs()
+                        rid_q = (q.get("rid") or [None])[0]
+                        if rid_q is not None:
+                            try:
+                                rid_q = int(rid_q)
+                            except ValueError:
+                                self._send(400, json.dumps(
+                                    {"error": "?rid=N must be an "
+                                              "integer"}).encode())
+                                return
+                            docs = [d for d in docs
+                                    if d["rid"] == rid_q]
+                        trace_q = (q.get("trace") or [None])[0]
+                        if trace_q is not None:
+                            docs = [d for d in docs
+                                    if d.get("trace_id") == trace_q]
+                        self._send(200, json.dumps(
+                            {"summary": wf_lib.summarize(docs),
+                             "waterfalls": docs}).encode())
                     else:
                         self._send(404, json.dumps(
                             {"error": f"unknown path {path!r}",
                              "endpoints": ["/status", "/metrics",
                                            "/report", "/slo", "/trace",
-                                           "/fleet"]
+                                           "/fleet", "/explain"]
                              + (["/generate"] if engine is not None
                                 else [])}).encode())
                 except Exception as e:  # a bad read must not kill serving

@@ -185,7 +185,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     from ..obs.serve import StatusServer
 
-    server = StatusServer(cfg.logs_path, engine=engine, slos=slos)
+    server = StatusServer(cfg.logs_path, engine=engine, slos=slos,
+                          cache_ttl_s=cfg.status_cache_s)
     port = server.start(cfg.serve_port)
     if port is None:
         engine.stop()

@@ -407,12 +407,24 @@ class DecodeEngine:
                     # the engine holds its lock (submits block too —
                     # exactly what a stalled worker looks like)
                     time.sleep(stall)
+            exec_t0 = time.monotonic()
             for rid in plan.prefills:
                 self._run_prefill(rid)
             decodes = [r for r in plan.decodes
                        if not self.sched._seq(r).done]
             if decodes:
                 self._run_decode(decodes, plan)
+            if self.recorder is not None:
+                # close the tick the scheduler's tick row opened:
+                # dur_ms is EXECUTION wall only (prefill + decode),
+                # so (tick_done.t - tick.t) - dur_ms isolates the
+                # boundary's stall — injected sleeps land between the
+                # tick row and exec_t0 and show up as stall, which is
+                # exactly the decode_stall segment the per-request
+                # waterfall (obs/waterfall.py) attributes
+                self.recorder.emit(
+                    "tick_done", tick=self.sched.ticks - 1,
+                    dur_ms=round((time.monotonic() - exec_t0) * 1e3, 3))
             self._consec_crashes = 0
             self._busy_s += time.monotonic() - t0
             return True

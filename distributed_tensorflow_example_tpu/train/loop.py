@@ -429,7 +429,8 @@ def run(cfg: Config) -> Dict[str, Any]:
     if cfg.status_port and chief:
         from ..obs.serve import StatusServer
 
-        status_server = StatusServer(cfg.logs_path)
+        status_server = StatusServer(cfg.logs_path,
+                                     cache_ttl_s=cfg.status_cache_s)
         port = status_server.start(cfg.status_port)
         if port:
             print(f"Status server on port {port} "

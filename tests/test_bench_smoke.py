@@ -143,6 +143,28 @@ def _stub_rows(monkeypatch):
                           "trace_overhead_frac": 0.0057,
                           "trace_spans_emitted": 480,
                           "trace_rounds": 5})
+    # the latency-attribution row (r17) runs on EVERY backend: the
+    # waterfall sum-to-wall residual + the attribution-overhead A/B
+    # are gated and must reach the final line under their gate names
+    monkeypatch.setattr(
+        bench, "bench_latency_attribution",
+        lambda *a, **kw: {"config": "latency_attribution",
+                          "waterfall_requests": 12,
+                          "waterfall_complete": 12,
+                          "waterfall_terminals": {"result": 5,
+                                                  "timeout": 1,
+                                                  "shed": 6},
+                          "waterfall_sum_to_wall_frac": 1.0,
+                          "waterfall_max_residual_frac": 0.0,
+                          "waterfall_sum_to_wall_ok": True,
+                          "waterfall_wall_p99_ms": 152.1,
+                          "littles_law_rel_err": 0.0,
+                          "littles_law_holds": True,
+                          "attribution_off_tok_s": 5012.4,
+                          "attribution_on_tok_s": 4997.1,
+                          "attribution_retained_tok_frac": 0.9969,
+                          "attribution_overhead_frac": 0.0031,
+                          "attribution_rounds": 5})
     # the multi-site local-SGD row (r10) runs on EVERY backend: the
     # analytic comm-volume keys + the measured A/B must reach the
     # final line under their gate names
@@ -276,6 +298,12 @@ def test_bench_main_cpu_stubbed(monkeypatch, capsys):
     # tracing-cost claim over time
     assert final["trace_retained_tok_frac"] == 0.9943
     assert final["trace_overhead_frac"] == 0.0057
+    # the r17 latency-attribution carriage (every backend): the
+    # sum-to-wall residual + the attribution-overhead A/B, gate-named
+    assert final["waterfall_sum_to_wall_frac"] == 1.0
+    assert final["waterfall_max_residual_frac"] == 0.0
+    assert final["attribution_retained_tok_frac"] == 0.9969
+    assert final["attribution_overhead_frac"] == 0.0031
 
 
 def test_bench_main_all_configs_stubbed(monkeypatch, capsys):

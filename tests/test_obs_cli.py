@@ -376,6 +376,43 @@ def test_compare_understands_serving_degraded_keys():
     assert ms["serving_degraded_p99_ms"] == 512.5
 
 
+def test_compare_understands_latency_attribution_keys():
+    """The latency-attribution row (ISSUE 17): bench_latency_attribution
+    gates on the waterfall sum-to-wall fraction (1% — the segments are
+    exact by construction) and the retained-throughput fraction of the
+    attribution A/B, keyed on the row-only waterfall_requests so the
+    final summary falls through to its own branch (the serving
+    lesson)."""
+    row = {"config": "latency_attribution", "waterfall_requests": 12,
+           "waterfall_complete": 12,
+           "waterfall_sum_to_wall_frac": 1.0,
+           "waterfall_max_residual_frac": 0.0,
+           "waterfall_sum_to_wall_ok": True,
+           "littles_law_holds": True,
+           "attribution_retained_tok_frac": 0.9969,
+           "attribution_overhead_frac": 0.0031}
+    m = cmp_lib.extract_metrics(row)
+    assert m == {"waterfall_sum_to_wall_frac": 1.0,
+                 "attribution_retained_tok_frac": 0.9969}
+    # a doctored residual (sum-to-wall down 3% against the 1% gate)
+    # regresses, and so does an attribution A/B past 1% overhead
+    worse = dict(row, waterfall_sum_to_wall_frac=0.97,
+                 attribution_retained_tok_frac=0.97)
+    verdict = cmp_lib.compare(row, worse)
+    assert not verdict["ok"]
+    assert "waterfall_sum_to_wall_frac" in verdict["regressions"]
+    assert "attribution_retained_tok_frac" in verdict["regressions"]
+    # final-summary shape: the attribution keys ride ALONGSIDE wall_s
+    # — the summary must not be mistaken for an attribution row
+    summary = {"metric": "mnist_20epoch_wall_clock", "value": 0.15,
+               "waterfall_sum_to_wall_frac": 1.0,
+               "attribution_retained_tok_frac": 0.9969}
+    ms = cmp_lib.extract_metrics(summary)
+    assert ms["wall_s"] == 0.15
+    assert ms["waterfall_sum_to_wall_frac"] == 1.0
+    assert ms["attribution_retained_tok_frac"] == 0.9969
+
+
 def test_compare_understands_local_sgd_keys():
     """The multi-site local-SGD row (ISSUE 10): the bench_local_sgd
     row gates on the analytic H=8 comm bytes/token and the measured
@@ -653,7 +690,7 @@ def test_report_endpoint_cached_by_file_signature(tmp_path,
     assert srv.report_json() and len(calls) == 2
     # a HUNG run stops touching files, but wall-clock fields
     # (heartbeat_age_s) must keep aging: the cache expires on TTL too
-    srv._report_t -= serve_lib.REPORT_CACHE_TTL_S + 1
+    srv._report_cache._t -= serve_lib.REPORT_CACHE_TTL_S + 1
     assert srv.report_json() and len(calls) == 3
     # and the HTTP route serves the same cached payload
     port = srv.start(0)

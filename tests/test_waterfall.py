@@ -1,0 +1,540 @@
+"""Latency attribution (ISSUE 17): waterfalls, queueing, drift.
+
+Three halves, mirroring the layer's own split:
+
+- **pure decomposition** (no jax): the waterfall state machine on
+  synthetic span rows — disjoint segments tiling submit→terminal
+  EXACTLY (residual 0 by construction), brownout/requeue labeling,
+  the closed-form Little's-law identity (exact when every arrival
+  terminates in-window, violations counting the in-flight gap), and
+  the change-point golden (a doctored history names the metric and
+  the FIRST offending row; a clean one stays quiet);
+- **CLI + server surfaces**: ``dtx-obs explain``/``drift`` exit
+  codes, the ``/explain`` endpoint + ``dtx_waterfall_*`` gauges, the
+  shared TTLCache discipline, and the ``--status_cache_s`` flag's
+  validation;
+- **engine chaos property suite** (CPU jax): the REAL DecodeEngine
+  under a FaultPlan crash + requeue + shed + timeout workload — for
+  EVERY request the derived segments are non-negative, the intervals
+  are disjoint and tile the wall, and the residual is ≤ 1% of wall
+  (the ``bench_latency_attribution`` gate, proven per-rid here).
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.obs import buckets as bk
+from distributed_tensorflow_example_tpu.obs import cli as cli_lib
+from distributed_tensorflow_example_tpu.obs import drift as drift_lib
+from distributed_tensorflow_example_tpu.obs import (
+    queueing as queueing_lib,
+)
+from distributed_tensorflow_example_tpu.obs import schema as schema_lib
+from distributed_tensorflow_example_tpu.obs import serve as serve_lib
+from distributed_tensorflow_example_tpu.obs import spans as spans_lib
+from distributed_tensorflow_example_tpu.obs import (
+    waterfall as wf_lib,
+)
+
+V = schema_lib.SCHEMA_VERSION
+
+
+def _row(event, t, rid=None, proc=0, **kw):
+    r = {"kind": "span", "v": V, "event": event, "t": t, "proc": proc}
+    if rid is not None:
+        r["rid"] = rid
+    r.update(kw)
+    return r
+
+
+def _assert_tiles(doc):
+    """THE invariant: the intervals are sorted, disjoint, and tile
+    [submit_t, terminal_t] exactly — so the segments must sum to the
+    wall with zero residual."""
+    iv = doc["intervals"]
+    if not iv:
+        assert doc["wall_ms"] == 0.0
+        return
+    assert iv[0][0] == doc["submit_t"]
+    assert abs(iv[-1][1] - doc["terminal_t"]) < 1e-9
+    for (a0, a1, _s), (b0, _b1, _s2) in zip(iv, iv[1:]):
+        assert a1 <= b0 + 1e-12 and a0 < a1
+        assert abs(a1 - b0) < 1e-9          # no gap either
+    assert abs(doc["residual_ms"]) <= max(doc["wall_ms"] * 0.01, 1e-3)
+
+
+# --- the state machine on synthetic rows ---------------------------------
+
+
+def test_waterfall_simple_lifecycle_partitions_exactly():
+    rows = [
+        _row("submit", 0.0, rid=0),
+        _row("blocked", 0.2, rid=0, reason="slots"),
+        _row("admit", 1.0, rid=0),
+        _row("tick", 1.0, tick=0, rids=[0]),
+        _row("first_token", 2.0, rid=0),
+        _row("tick", 2.0, tick=1, rids=[0]),
+        _row("tick_done", 2.5, tick=1, dur_ms=300.0),
+        _row("retire", 3.0, rid=0),
+    ]
+    docs = wf_lib.waterfalls(rows)
+    assert len(docs) == 1
+    d = docs[0]
+    assert d["terminal"] == "result" and d["complete"]
+    assert d["wall_ms"] == pytest.approx(3000.0)
+    segs = d["segments"]
+    # slot-blocked waiting IS queue_wait; admit→first_token is
+    # prefill; the tick_done pair splits decode into the execution
+    # window [2.2, 2.5] and the trailing gap, re-labeled finalize
+    # because the retire narration lands at the next boundary
+    assert segs["queue_wait"] == pytest.approx(1000.0)
+    assert segs["prefill"] == pytest.approx(1000.0)
+    assert segs["decode_active"] == pytest.approx(500.0)
+    assert segs["finalize"] == pytest.approx(500.0)
+    assert segs["decode_stall"] == 0.0 and segs["requeue"] == 0.0
+    assert d["residual_ms"] == pytest.approx(0.0, abs=1e-6)
+    _assert_tiles(d)
+    assert schema_lib.validate_waterfall(d) == []
+
+
+def test_waterfall_brownout_and_requeue_are_attributed():
+    rows = [
+        _row("submit", 0.0, rid=7),
+        _row("blocked", 0.5, rid=7, reason="brownout"),
+        _row("admit", 1.5, rid=7),
+        _row("first_token", 2.0, rid=7),
+        _row("requeue", 2.5, rid=7),         # supervised restart
+        # post-restart blocked waiting is restart overhead, NOT
+        # ordinary queueing — the state must stay "requeue"
+        _row("blocked", 2.7, rid=7, reason="slots"),
+        _row("admit", 3.0, rid=7),
+        _row("first_token", 3.5, rid=7),
+        _row("retire", 4.0, rid=7),
+    ]
+    d = wf_lib.waterfalls(rows)[0]
+    segs = d["segments"]
+    assert segs["queue_wait"] == pytest.approx(500.0)
+    assert segs["brownout_clamp_delay"] == pytest.approx(1000.0)
+    assert segs["requeue"] == pytest.approx(500.0)
+    assert d["requeues"] == 1
+    assert d["residual_ms"] == pytest.approx(0.0, abs=1e-6)
+    _assert_tiles(d)
+
+
+def test_waterfall_without_tick_done_degrades_to_decode_active():
+    """Older streams (schema < v8, the pure tick simulator) carry no
+    tick_done close: decode time must stay decode_active, never be
+    invented as stall."""
+    rows = [
+        _row("submit", 0.0, rid=0),
+        _row("admit", 0.1, rid=0),
+        _row("first_token", 0.2, rid=0),
+        _row("tick", 0.2, tick=0, rids=[0]),
+        _row("tick", 0.4, tick=1, rids=[0]),
+        _row("retire", 0.6, rid=0),
+    ]
+    d = wf_lib.waterfalls(rows)[0]
+    assert d["segments"]["decode_active"] == pytest.approx(400.0)
+    assert d["segments"]["decode_stall"] == 0.0
+    assert d["residual_ms"] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_waterfall_filters_and_incomplete():
+    rows = [
+        _row("submit", 0.0, rid=0, trace_id="a" * 32),
+        _row("retire", 1.0, rid=0),
+        _row("submit", 0.5, rid=1),          # no terminal: in flight
+    ]
+    assert len(wf_lib.waterfalls(rows)) == 2
+    assert [d["rid"] for d in wf_lib.waterfalls(rows, rid=1)] == [1]
+    by_trace = wf_lib.waterfalls(rows, trace_id="a" * 32)
+    assert [d["rid"] for d in by_trace] == [0]
+    d1 = wf_lib.waterfalls(rows, rid=1)[0]
+    assert not d1["complete"] and d1["terminal"] is None
+    summ = wf_lib.summarize(wf_lib.waterfalls(rows))
+    assert summ["requests"] == 2 and summ["complete"] == 1
+    assert summ["terminals"] == {"result": 1}
+    assert summ["sum_to_wall_ok"]
+
+
+def test_waterfall_segment_registry_is_closed():
+    """Every label the state machine can produce is registered (the
+    scope-registry discipline), and the schema validator rejects an
+    unknown segment."""
+    assert set(bk.WATERFALL_SEGMENTS) >= {
+        "queue_wait", "brownout_clamp_delay", "prefill",
+        "decode_active", "decode_stall", "requeue", "finalize"}
+    rows = [_row("submit", 0.0, rid=0), _row("retire", 1.0, rid=0)]
+    d = wf_lib.waterfalls(rows)[0]
+    d["segments"]["made_up"] = 1.0
+    assert any("made_up" in e for e in schema_lib.validate_waterfall(d))
+
+
+def test_tick_done_emission_validates():
+    """The recorder accepts the v8 tick_done row and the span-file
+    validator passes the pair."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        rec = spans_lib.SpanRecorder(tmp)
+        rec.emit("tick", tick=0, rids=[0], batch=1,
+                 batch_bucket=1, kv_pages=2, occupancy=0.5)
+        rec.emit("tick_done", tick=0, dur_ms=1.25)
+        rec.close()
+        assert schema_lib.validate_span_file(rec.path) == []
+        rows = spans_lib.read_spans(rec.path)
+        done = [r for r in rows if r["event"] == "tick_done"]
+        assert len(done) == 1 and done[0]["dur_ms"] == 1.25
+
+
+# --- queueing analytics: the Little's-law identity -----------------------
+
+
+def _lifecycle(rid, submit, admit, retire, bucket=4, proc=0):
+    return [
+        _row("submit", submit, rid=rid, proc=proc),
+        _row("admit", admit, rid=rid, proc=proc),
+        _row("prefill", admit, rid=rid, proc=proc, bucket=bucket),
+        _row("retire", retire, rid=rid, proc=proc),
+    ]
+
+
+def test_littles_law_identity_exact_closed_form():
+    """3 requests, sojourn 2 s each over a 4 s window:
+    L = (2+2+2)/4 = 1.5 and λ·W = (3/4)·2 = 1.5 — the identity is
+    EXACT when every arrival terminates in-window."""
+    rows = (_lifecycle(0, 0.0, 0.5, 2.0)
+            + _lifecycle(1, 1.0, 1.5, 3.0)
+            + _lifecycle(2, 2.0, 2.5, 4.0))
+    rep = queueing_lib.queueing_report(rows)
+    ll = rep["littles_law"]
+    assert rep["arrivals"] == 3 and rep["completed"] == 3
+    assert ll["L"] == pytest.approx(1.5)
+    assert ll["lambda_W"] == pytest.approx(1.5)
+    assert ll["rel_err"] == pytest.approx(0.0, abs=1e-9)
+    assert ll["holds"] and ll["violations"] == 0
+    assert rep["arrival_rate_per_s"] == pytest.approx(0.75)
+    # per-bucket service time: admit → terminal
+    assert rep["service_ms_by_bucket"]["4"]["n"] == 3
+    assert rep["service_ms_by_bucket"]["4"]["mean_ms"] == (
+        pytest.approx(1500.0))
+
+
+def test_littles_law_flags_untracked_time():
+    """A request with no terminal (torn tail, crashed writer) is the
+    violation that explains the identity gap."""
+    rows = (_lifecycle(0, 0.0, 0.5, 2.0)
+            + _lifecycle(1, 1.0, 1.5, 3.0)[:3])   # no retire
+    rep = queueing_lib.queueing_report(rows)
+    ll = rep["littles_law"]
+    assert rep["in_flight"] == 1 and ll["violations"] == 1
+    assert ll["rel_err"] > 0.05 and not ll["holds"]
+
+
+def test_queueing_report_empty_is_none():
+    assert queueing_lib.queueing_report([]) is None
+    assert queueing_lib.queueing_report(
+        [_row("tick", 0.0, tick=0, rids=[])]) is None
+
+
+# --- drift detection: the change-point golden ----------------------------
+
+
+def _hist(path, values, metric="decode_step_ms"):
+    with open(path, "w") as f:
+        for i, v in enumerate(values):
+            f.write(json.dumps({
+                "v": V, "kind": "bench_history", "t": 1000.0 + i,
+                "label": f"r{i}", "source": f"BENCH_r{i}.json",
+                "metrics": {metric: v, "wall_s": 10.0},
+            }) + "\n")
+    return str(path)
+
+
+def test_detect_names_first_offending_row():
+    vals = [10.0, 10.2, 9.9, 10.1, 10.0, 13.0, 13.1, 12.9, 13.2, 13.0]
+    # a gated "lower"-is-better metric drifts UP
+    d = drift_lib.detect([f"r{i}" for i in range(10)], vals,
+                         "step_time_p50_ms")
+    assert d is not None
+    assert d["metric"] == "step_time_p50_ms"
+    assert d["direction"] == "lower"
+    assert d["first_offending"] == "r5"
+    assert d["first_offending_index"] == 5
+    assert d["shift_frac"] > 0.25
+    # an IMPROVEMENT (downward shift) is not a drift for it
+    assert drift_lib.detect(
+        [f"r{i}" for i in range(10)], vals[::-1],
+        "step_time_p50_ms") is None
+    # an ungated metric drifts either way (direction "any")
+    d = drift_lib.detect([f"r{i}" for i in range(10)], vals[::-1],
+                         "decode_step_ms")
+    assert d is not None and d["direction"] == "any"
+    # one noisy spike is NOT a level shift — medians absorb it
+    spike = [10.0, 10.2, 9.9, 13.0, 10.1, 10.0, 9.8, 10.2]
+    assert drift_lib.detect([f"r{i}" for i in range(8)], spike,
+                            "step_time_p50_ms") is None
+
+
+def test_drift_cli_exit_codes(tmp_path, capsys):
+    flat = [10.0, 10.2, 9.9, 10.1, 10.0, 9.8]
+    doctored = flat[:4] + [13.0, 13.1, 12.9, 13.2]
+    clean = _hist(tmp_path / "clean.jsonl", flat)
+    bad = _hist(tmp_path / "bad.jsonl", doctored)
+
+    assert cli_lib.main(["drift", clean]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] and doc["drifts"] == []
+    assert schema_lib.validate_drift_report(doc) == []
+
+    assert cli_lib.main(["drift", bad]) == 3
+    out = capsys.readouterr()
+    doc = json.loads(out.out)
+    assert not doc["ok"]
+    names = [d["metric"] for d in doc["drifts"]]
+    assert "decode_step_ms" in names
+    d = next(x for x in doc["drifts"] if x["metric"] == "decode_step_ms")
+    assert d["first_offending"] == "r4"
+    assert "decode_step_ms" in out.err and "r4" in out.err
+
+    # too-short history and a missing file are usage errors, never a
+    # fabricated verdict
+    short = _hist(tmp_path / "short.jsonl", [10.0, 10.1])
+    assert cli_lib.main(["drift", short]) == 2
+    capsys.readouterr()
+    assert cli_lib.main(["drift", str(tmp_path / "ghost.jsonl")]) == 2
+    capsys.readouterr()
+    # --metrics restricts the scan; wall_s alone stays clean
+    assert cli_lib.main(["drift", bad, "--metrics", "wall_s"]) == 0
+    capsys.readouterr()
+
+
+# --- CLI explain + tail filters ------------------------------------------
+
+
+def _span_file(tmp_path, rows):
+    p = tmp_path / "spans.0.jsonl"
+    with open(p, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return str(tmp_path)
+
+
+def _two_request_rows():
+    return (
+        [_row("submit", 0.0, rid=0, trace_id="a" * 32),
+         _row("admit", 0.4, rid=0),
+         _row("first_token", 0.8, rid=0),
+         _row("retire", 1.0, rid=0)]
+        + [_row("submit", 0.2, rid=1),
+           _row("admit", 0.6, rid=1),
+           _row("first_token", 0.9, rid=1),
+           _row("retire", 1.4, rid=1)]
+    )
+
+
+def test_cli_explain(tmp_path, capsys):
+    d = _span_file(tmp_path, _two_request_rows())
+    assert cli_lib.main(["explain", d]) == 0
+    out = capsys.readouterr().out
+    assert "rid 0" in out and "rid 1" in out
+    assert "sum-to-wall OK" in out
+    assert cli_lib.main(["explain", d, "--rid", "1", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert [w["rid"] for w in doc["waterfalls"]] == [1]
+    assert doc["summary"]["sum_to_wall_ok"]
+    assert cli_lib.main(["explain", d, "--trace", "a" * 32,
+                         "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert [w["rid"] for w in doc["waterfalls"]] == [0]
+    assert cli_lib.main(["explain", d, "--fleet"]) == 0
+    fleet = json.loads(capsys.readouterr().out)
+    assert fleet["littles_law"]["holds"]
+    # no such rid / no stream at all: exit 2, not an empty success
+    assert cli_lib.main(["explain", d, "--rid", "99"]) == 2
+    capsys.readouterr()
+    assert cli_lib.main(["explain", str(tmp_path / "nope")]) == 2
+    capsys.readouterr()
+
+
+def test_cli_tail_rid_and_trace_filters(tmp_path, capsys):
+    rows = _two_request_rows() + [
+        _row("tick", 0.85, tick=0, rids=[0, 1], occupancy=1.0),
+        _row("tick_done", 0.95, tick=0, dur_ms=100.0),
+    ]
+    d = _span_file(tmp_path, rows)
+    assert cli_lib.main(["tail", d, "--rid", "0"]) == 0
+    out = capsys.readouterr().out
+    assert "rid 0" in out and "rid 1" not in out
+    # member tick rows (rids carries the rid) ride along
+    assert "tick" in out
+    assert cli_lib.main(["tail", d, "--trace", "a" * 32]) == 0
+    out = capsys.readouterr().out
+    assert "rid 0" in out and "rid 1" not in out
+    # unfiltered: the tick_done row formats with its duration
+    assert cli_lib.main(["tail", d]) == 0
+    out = capsys.readouterr().out
+    assert "tick_done" in out and "100" in out
+
+
+# --- the status server: /explain + the shared TTL cache ------------------
+
+
+def test_ttl_cache_semantics():
+    calls = []
+
+    def compute():
+        calls.append(1)
+        return len(calls)
+
+    c = serve_lib.TTLCache(ttl_s=3600.0)
+    assert c.get(compute) == 1
+    assert c.get(compute) == 1          # cached within TTL
+    assert len(calls) == 1
+    # a signature change invalidates even inside the TTL
+    assert c.get(compute, sig="a") == 2
+    assert c.get(compute, sig="a") == 2
+    assert c.get(compute, sig="b") == 3
+    # ttl 0 recomputes every time (--status_cache_s 0)
+    z = serve_lib.TTLCache(ttl_s=0.0)
+    assert z.get(compute) == 4 and z.get(compute) == 5
+    # None is a legitimate cached value, not a miss
+    n = serve_lib.TTLCache(ttl_s=3600.0)
+    assert n.get(lambda: calls.append(1) or None) is None
+    before = len(calls)
+    assert n.get(lambda: calls.append(1) or None) is None
+    assert len(calls) == before
+
+
+def test_explain_endpoint_and_waterfall_gauges(tmp_path):
+    _span_file(tmp_path, _two_request_rows())
+    srv = serve_lib.StatusServer(str(tmp_path), cache_ttl_s=0.0)
+    port = srv.start(0)
+    assert port
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                return r.status, r.read().decode()
+
+        code, body = get("/explain")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["summary"]["requests"] == 2
+        assert doc["summary"]["sum_to_wall_ok"]
+        code, body = get("/explain?rid=1")
+        assert [w["rid"] for w in json.loads(body)["waterfalls"]] == [1]
+        # a malformed rid is a 400, not a traceback
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get("/explain?rid=zzz")
+        assert ei.value.code == 400
+        code, body = get("/metrics")
+        assert "dtx_waterfall_requests 2" in body
+        assert "dtx_waterfall_residual_frac_max" in body
+        assert 'dtx_waterfall_segment_p99_ms{segment="queue_wait"}' \
+            in body
+    finally:
+        srv.close()
+
+
+def test_status_cache_s_flag_validation():
+    from distributed_tensorflow_example_tpu.config import (
+        Config, parse_config, validate_serving_config,
+    )
+
+    assert parse_config([]).status_cache_s == 15.0
+    assert parse_config(
+        ["--status_cache_s", "0"]).status_cache_s == 0.0
+    validate_serving_config(Config(status_cache_s=0.0))
+    with pytest.raises(ValueError, match="status_cache_s"):
+        validate_serving_config(Config(status_cache_s=-1.0))
+
+
+# --- engine chaos property suite (CPU jax) -------------------------------
+
+
+jax = pytest.importorskip("jax")
+
+
+from distributed_tensorflow_example_tpu.models import (  # noqa: E402
+    transformer as tfm,
+)
+from distributed_tensorflow_example_tpu.serving import (  # noqa: E402
+    admission as adm,
+)
+from distributed_tensorflow_example_tpu.serving import (  # noqa: E402
+    faults as fl,
+)
+from distributed_tensorflow_example_tpu.serving.engine import (  # noqa: E402
+    DecodeEngine,
+)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    spec = tfm.TransformerSpec(
+        input_size=32, num_classes=10, seq_len=32, d_model=32,
+        n_heads=2, num_blocks=2, d_ff=64, objective="lm",
+        vocab_size=50, causal=True)
+    return spec, tfm.init(jax.random.PRNGKey(0), spec)
+
+
+def test_chaos_waterfalls_sum_to_wall_per_request(lm, tmp_path):
+    """The property the attribution gate holds in aggregate, proven
+    per-rid under chaos: crash (→ requeue), shed (typed, span-only)
+    and deadline timeout in ONE workload, and EVERY request's derived
+    segments tile its submit→terminal wall within 1%."""
+    spec, params = lm
+    rng = np.random.RandomState(11)
+    rec = spans_lib.SpanRecorder(str(tmp_path))
+    eng = DecodeEngine(
+        spec, params, page_size=4, max_batch=2, seed=0,
+        engine_retries=2, max_queue=4,
+        faults=fl.FaultPlan(crash_at_ticks=(2,)), recorder=rec)
+    rids, shed = [], 0
+    for i in range(8):
+        prompt = rng.randint(0, 50, size=3 + (i % 4)).tolist()
+        # the first prefill compile takes seconds on CPU, so a 40 ms
+        # deadline deterministically times out
+        dl = 40.0 if i == 3 else None
+        try:
+            rids.append(eng.submit(prompt, 4, deadline_ms=dl))
+        except adm.ShedError:
+            shed += 1
+    eng.run_until_idle()
+    results = [eng.result(r, timeout=60.0) for r in rids]
+    rec.close()
+    assert all(r is not None for r in results)
+
+    rows = spans_lib.read_spans(rec.path)
+    assert schema_lib.validate_span_file(rec.path) == []
+    docs = wf_lib.waterfalls(rows)
+    # every consumed rid reconstructs: accepted requests from their
+    # submit row, shed ones from their span-only shed row (zero wall)
+    assert len(docs) == len(rids) + shed
+    assert set(rids) <= {d["rid"] for d in docs}
+    for d in docs:
+        assert d["complete"], (d["rid"], d)
+        assert all(v >= 0.0 for v in d["segments"].values())
+        _assert_tiles(d)
+    summ = wf_lib.summarize(docs)
+    assert summ["sum_to_wall_ok"]
+    assert summ["max_residual_frac"] <= 0.01
+    # the chaos actually happened: a crash re-queued someone, the
+    # deadline timed out, and the bounded queue shed
+    terms = summ["terminals"]
+    assert terms.get("result", 0) >= 1
+    assert terms.get("timeout", 0) >= 1
+    assert shed >= 1
+    assert any(d["requeues"] > 0 for d in docs)
+    assert any(d["segments"]["requeue"] > 0 for d in docs)
+
+    # the queue explains itself too: every submit terminated, so the
+    # identity holds with zero violations
+    ll = queueing_lib.queueing_report(rows)["littles_law"]
+    assert ll["holds"] and ll["violations"] == 0
