@@ -2408,6 +2408,245 @@ def _bench_serving_degraded_measured(rng, page_size: int,
     return out
 
 
+class _ScriptedReplica:
+    """Engine-shaped scripted replica for bench_fleet_failover's
+    analytic half (pure Python — the fake drives serving/router.py
+    without jax): ``fail_first`` dispatches end in the typed
+    ``failed`` terminal (an engine whose retry budget is spent),
+    everything after completes immediately."""
+
+    def __init__(self, fail_first: int = 0):
+        self.fail_first = fail_first
+        self.submitted = 0
+        self.next_rid = 0
+        self.results = {}
+        self.completed_total = 0
+        self.failed_total = 0
+
+    def submit(self, prompt, max_new_tokens, temperature=0.0,
+               deadline_ms=None, traceparent=None, attempts=0):
+        rid = self.next_rid
+        self.next_rid += 1
+        if self.submitted < self.fail_first:
+            self.failed_total += 1
+            self.results[rid] = {
+                "rid": rid, "status": "failed",
+                "error": "injected crash (retry budget spent)",
+                "attempts": int(attempts) + 1}
+        else:
+            self.completed_total += 1
+            self.results[rid] = {
+                "rid": rid, "status": "result",
+                "tokens": [int(t) for t in prompt][:1],
+                "latency_ms": 1.0, "ttft_ms": 1.0}
+        self.submitted += 1
+        return rid
+
+    def result(self, rid, timeout=None):
+        return self.results[rid]
+
+    def cancel(self, rid):
+        return False
+
+    def stats(self):
+        return {"queued": 0, "inflight": 0, "queue_limit": 0,
+                "completed_total": self.completed_total,
+                "shed_total": 0, "timeout_total": 0,
+                "failed_total": self.failed_total,
+                "engine_restarts_total": 0}
+
+
+def bench_fleet_failover(n_requests: int = 12, max_batch: int = 4,
+                         page_size: int = 8, seed: int = 0):
+    """Fault-tolerant fleet bench (ISSUE 18): the router's failover
+    claim, two halves like bench_serving_degraded:
+
+    1. ANALYTIC (pure Python, every backend — the gateable evidence):
+       the real serving/router.Router over scripted replicas, one of
+       which fails every dispatch with the typed ``failed`` terminal
+       (an engine past its retry budget).  Every accepted request
+       must fail over and complete — the completed fraction is a
+       closed form at 1.0 and gated tight (``fleet_completed_frac``,
+       1%: any dip means the failover path dropped or
+       double-delivered a request); the breaker must have opened on
+       the sick replica by the end.
+
+    2. MEASURED (3 tiny lm engines through the real DecodeEngine):
+       the same fleet behind the router with a crash FaultPlan on
+       replica 0 (``engine_retries=1``, crashes past the budget),
+       span streams per replica + the router narration dir, then
+       ``obs/collector.fleet_report`` over the run dirs must hold
+       fleet-wide exactly-once with clean failover chains.  The
+       failed-over completed requests' p99 is gated wide
+       (``fleet_failover_p99_ms`` — crash/restart/re-prefill loops
+       are noisy by construction), and the routered fleet must beat
+       the SAME workload round-robined without failover
+       (``fleet_beats_routerless``)."""
+    from distributed_tensorflow_example_tpu.serving.health import (
+        BreakerPolicy)
+    from distributed_tensorflow_example_tpu.serving.router import (
+        Router)
+
+    sick = _ScriptedReplica(fail_first=10 ** 9)   # always failing
+    replicas = [sick, _ScriptedReplica(), _ScriptedReplica()]
+    router = Router(replicas, fleet_retries=2,
+                    breaker=BreakerPolicy(seed=seed))
+    completed = 0
+    failovers = 0
+    for i in range(n_requests):
+        rid = router.submit([1 + i % 7] * 4, 4)
+        res = router.result(rid, timeout=5.0)
+        assert res is not None, "scripted replicas answer immediately"
+        if res.get("status") == "result":
+            completed += 1
+            failovers += int(res.get("failovers") or 0)
+    st = router.stats()
+    row = {
+        "config": "fleet_failover",
+        "workload": f"{n_requests} requests over 3 replicas, "
+                    f"replica0 fails every dispatch (typed failed), "
+                    f"fleet_retries=2",
+        "fleet_failover_requests": n_requests,
+        "fleet_completed_frac": round(completed / n_requests, 6),
+        "fleet_analytic_failovers": failovers,
+        "fleet_breaker_opened": any(
+            p["breaker"]["state"] != "closed"
+            for p in st["per_replica"]),
+        "terminates_typed": st["requests_total"]
+        == st["completed_total"] + st["fleet_failed_total"],
+    }
+    # ---- measured half: the real 3-engine fleet under an injected
+    # crash plan; degrades to an error key where the stack is
+    # unavailable (the bench_pp_memory precedent)
+    try:
+        row.update(_bench_fleet_failover_measured(
+            page_size, max_batch, seed))
+    except Exception as e:   # noqa: BLE001 — degrade, don't void
+        row["fleet_failover_measured_error"] = str(e)[:200]
+    return row
+
+
+def _bench_fleet_failover_measured(page_size: int, max_batch: int,
+                                   seed: int) -> dict:
+    """The measured half of bench_fleet_failover: a 3-replica router
+    fleet with a crash FaultPlan on replica 0, verified through
+    obs/collector.fleet_report, A/B'd against the router-less
+    round-robin of the same workload (see bench_fleet_failover)."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_example_tpu.models import (
+        transformer as tfm)
+    from distributed_tensorflow_example_tpu.obs import (
+        collector as collector_lib)
+    from distributed_tensorflow_example_tpu.obs.spans import (
+        SpanRecorder)
+    from distributed_tensorflow_example_tpu.serving.engine import (
+        DecodeEngine)
+    from distributed_tensorflow_example_tpu.serving.faults import (
+        FaultPlan)
+    from distributed_tensorflow_example_tpu.serving.router import (
+        Router)
+
+    seq = 128
+    spec = tfm.TransformerSpec(
+        input_size=seq, num_classes=10, seq_len=seq, d_model=64,
+        n_heads=4, num_blocks=2, d_ff=128, objective="lm",
+        vocab_size=64, causal=True, compute_dtype=jnp.bfloat16)
+    params = tfm.init(jax.random.PRNGKey(0), spec)
+    rng = np.random.RandomState(seed)
+    n_req = 12
+    prompts = [rng.randint(0, 64, size=int(rng.randint(4, 16))).tolist()
+               for _ in range(n_req)]
+    news = [int(rng.randint(3, 10)) for _ in range(n_req)]
+
+    def engines(recorders):
+        out = []
+        for i in range(3):
+            # replica 0 is the chaos target: crashes past its
+            # engine_retries=1 budget so its requests type "failed"
+            # and the router must move them
+            plan = FaultPlan(crash_at_ticks=(1, 2, 3, 4)) \
+                if i == 0 else FaultPlan()
+            out.append(DecodeEngine(
+                spec, params, page_size=page_size,
+                max_batch=max_batch, seed=seed, engine_retries=1,
+                faults=plan,
+                recorder=recorders[i] if recorders else None))
+            out[-1].start()
+        return out
+
+    tmp = tempfile.mkdtemp(prefix="bench_fleet_")
+    try:
+        import os
+
+        recs = [SpanRecorder(os.path.join(tmp, f"replica{i}"))
+                for i in range(3)]
+        router_rec = SpanRecorder(os.path.join(tmp, "router"))
+        fleet = engines(recs)
+        router = Router(fleet, fleet_retries=2, recorder=router_rec)
+        rids = [router.submit(p, n) for p, n in zip(prompts, news)]
+        results = [router.result(r, timeout=120.0) for r in rids]
+        # let each engine hit its final tick boundary: the 'retire'
+        # span lands one plan_tick AFTER the seal that unblocked
+        # result(), so an immediate stop() can clip the last terminal
+        import time as time_lib
+
+        t0 = time_lib.monotonic()
+        while time_lib.monotonic() - t0 < 10.0:
+            if all(not e.sched.live and not e.sched.waiting
+                   for e in fleet):
+                time_lib.sleep(0.05)
+                break
+            time_lib.sleep(0.02)
+        for e in fleet:
+            e.stop()
+        for rec in recs + [router_rec]:
+            rec.close()
+        assert all(r is not None for r in results), \
+            "a request neither completed nor reached a typed terminal"
+        done = [r for r in results if r.get("status") == "result"]
+        moved = [r for r in done if r.get("failovers")]
+        rep = collector_lib.fleet_report(
+            [os.path.join(tmp, d) for d in sorted(os.listdir(tmp))])
+        assert rep["exactly_once"], \
+            f"fleet exactly-once broken: {rep['errors'][:3]}"
+        fo = rep.get("failover") or {}
+        # ---- router-less A/B: same workload, same chaos plan,
+        # round-robin placement, nobody re-places a failed request
+        base = engines(None)
+        brids = [(base[i % 3], base[i % 3].submit(p, n))
+                 for i, (p, n) in enumerate(zip(prompts, news))]
+        bres = [e.result(r, timeout=120.0) for e, r in brids]
+        for e in base:
+            e.stop()
+        base_done = sum(1 for r in bres
+                        if r is not None
+                        and r.get("status") == "result")
+        out = {
+            "fleet_requests_measured": n_req,
+            "fleet_measured_completed": len(done),
+            "fleet_measured_failovers": sum(
+                int(r.get("failovers") or 0) for r in done),
+            "fleet_failover_chains": int(fo.get("chains") or 0),
+            "fleet_chains_clean": bool(fo.get("clean", True)),
+            "fleet_routerless_completed": base_done,
+            "fleet_beats_routerless": (len(done) / n_req
+                                       > base_done / n_req),
+        }
+        lats = [r["latency_ms"] for r in moved]
+        if lats:
+            out["fleet_failover_p99_ms"] = round(
+                float(np.percentile(lats, 99)), 2)
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_local_sgd(rounds: int = 6, batch: int = 64, seq: int = 64,
                     seed: int = 0):
     """Multi-site local-SGD (DiLoCo) bench (ISSUE 10), two halves:
@@ -2913,6 +3152,12 @@ def main(argv=None) -> int:
     # unsupervised crash A/B is CPU-viable at the tiny engine size,
     # degrading to an error key where the stack is missing
     guarded("serving_degraded", bench_serving_degraded)
+    # the fleet-failover row runs on EVERY backend (r18): the router-
+    # over-scripted-replicas completed fraction is a pure closed form
+    # (gated tight at 1.0) and the 3-engine crash-plan fleet behind
+    # the real router is CPU-viable at the tiny engine size,
+    # degrading to an error key where the stack is missing
+    guarded("fleet_failover", bench_fleet_failover)
     # the span-emission overhead row (r16, every backend): the same
     # engine replay with the recorder on vs off, interleaved — its
     # retained-tok/s ratio gates the "tracing is effectively free"
@@ -3165,6 +3410,22 @@ def main(argv=None) -> int:
         if sd_row.get("supervision_recovers") is not None:
             extra["supervision_recovers"] = \
                 sd_row["supervision_recovers"]
+    ff_row = next(
+        (r for r in rows if r.get("config") == "fleet_failover"
+         and "fleet_failover_requests" in r), None)
+    if ff_row:
+        # fleet-failover gate keys (r18): the analytic routered
+        # completed fraction (tight, a closed form at 1.0) and the
+        # measured failed-over p99 under the crash plan (wide);
+        # fleet_beats_routerless rides along as the A/B verdict
+        extra["fleet_completed_frac"] = \
+            ff_row["fleet_completed_frac"]
+        if ff_row.get("fleet_failover_p99_ms") is not None:
+            extra["fleet_failover_p99_ms"] = \
+                ff_row["fleet_failover_p99_ms"]
+        if ff_row.get("fleet_beats_routerless") is not None:
+            extra["fleet_beats_routerless"] = \
+                ff_row["fleet_beats_routerless"]
     tr_row = next(
         (r for r in rows if r.get("config") == "trace_overhead"
          and "trace_retained_tok_frac" in r), None)

@@ -100,9 +100,12 @@ class SchemaDriftRule:
         "SPAN_COMMON": ("obs/spans.py",),
         # v7 widens the writer set: the train loop emits phase spans
         # (phase/trace_id/dur_ms), the collector stamps source on
-        # merged rows, and the engine threads trace_id/parent_id
+        # merged rows, and the engine threads trace_id/parent_id;
+        # v9 adds the fleet router's route/failover narration
+        # (replica/attempt)
         "SPAN_FIELDS": ("serving/scheduler.py", "serving/engine.py",
-                        "train/loop.py", "obs/collector.py"),
+                        "train/loop.py", "obs/collector.py",
+                        "serving/router.py"),
         "FLEET_REPORT": ("obs/collector.py",),
         "HISTORY_ENTRY": ("obs/history.py",),
         # restart-timeline rows: the envelope is written by the

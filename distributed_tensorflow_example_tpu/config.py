@@ -435,6 +435,33 @@ class Config:
                                     # each before a typed failed
                                     # terminal; 0 = fail-closed
                                     # (today's behavior)
+    replicas: int = 1               # dtx-serve: > 1 runs a FLEET — N
+                                    # decode engines behind the
+                                    # serving/router least-loaded
+                                    # health-scored front door
+                                    # (per-replica span streams in
+                                    # <logs>/replica<i>, router
+                                    # narration in <logs>/router);
+                                    # 1 = single-engine front door
+                                    # (today's behavior)
+    fleet_retries: int = 2          # dtx-serve fleet: bound on the
+                                    # ADDITIONAL replicas a request may
+                                    # fail over to after its current
+                                    # replica spends its
+                                    # --engine_retries budget or trips
+                                    # its breaker; past it the request
+                                    # ends with exactly one typed
+                                    # failed terminal fleet-wide
+    breaker: str = ""               # dtx-serve fleet: per-replica
+                                    # circuit breaker — "" = defaults,
+                                    # "on" = defaults, or "failures=3,
+                                    # base=0.2,cap=5.0,jitter=0.1,
+                                    # floor=0.2,seed=0": open after N
+                                    # consecutive typed failures (or
+                                    # health below floor), half-open
+                                    # single probe after seeded-jitter
+                                    # exponential backoff
+                                    # (serving/health.py)
 
     # ---- validation / early stopping (beyond-reference) ----
     early_stop_patience: int = 0    # > 0: evaluate the validation split
@@ -912,6 +939,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "(pages freed, prefill re-run) at most this "
                         "many times each before a typed failed "
                         "terminal; 0 keeps the fail-closed behavior")
+    p.add_argument("--replicas", type=int, default=d.replicas,
+                   help="dtx-serve: > 1 runs a fleet — N decode "
+                        "engines behind the serving/router "
+                        "least-loaded health-scored front door "
+                        "(per-replica spans in <logs>/replica<i>, "
+                        "router narration in <logs>/router); 1 = "
+                        "single-engine front door")
+    p.add_argument("--fleet_retries", type=int,
+                   default=d.fleet_retries,
+                   help="dtx-serve fleet: bound on the additional "
+                        "replicas a request may fail over to after "
+                        "its current replica spends its "
+                        "--engine_retries budget or trips its "
+                        "breaker; past it the request ends with "
+                        "exactly one typed failed terminal "
+                        "fleet-wide")
+    p.add_argument("--breaker", type=str, default=d.breaker,
+                   help="dtx-serve fleet: per-replica circuit "
+                        "breaker (serving/health.py) — empty or "
+                        "'on' = defaults, or key=value pairs over "
+                        "failures/base/cap/jitter/floor/seed: open "
+                        "after N consecutive typed failures (or "
+                        "health below floor), half-open single "
+                        "probe after seeded-jitter exponential "
+                        "backoff")
     p.add_argument("--early_stop_patience", type=int,
                    default=d.early_stop_patience,
                    help="stop after P epochs without validation "
@@ -1200,10 +1252,20 @@ def validate_serving_config(cfg: Config) -> None:
         raise ValueError(
             f"span_keep={cfg.span_keep} must be >= 1 (at least one "
             f"rotated segment is retained while rotation is on)")
+    if cfg.replicas < 1:
+        raise ValueError(
+            f"replicas={cfg.replicas} must be >= 1 (1 = single-"
+            f"engine front door, > 1 = fleet behind the router)")
+    if cfg.fleet_retries < 0:
+        raise ValueError(
+            f"fleet_retries={cfg.fleet_retries} must be >= 0 (0 = "
+            f"no cross-replica failover)")
     from .serving.admission import parse_brownout
+    from .serving.health import parse_breaker
 
-    # raises ValueError with the offending part on a malformed DSL
+    # raise ValueError with the offending part on a malformed DSL
     parse_brownout(cfg.brownout)
+    parse_breaker(cfg.breaker)
 
 
 def validate_resilience_config(cfg: Config) -> None:

@@ -110,9 +110,16 @@ GOODPUT_BUCKETS = ("train", "compile", "data_wait", "h2d", "ckpt",
 # decode interval into active compute vs stall (fault-injected sleeps,
 # host scheduling gaps) — the tick-boundary timestamp pair the
 # per-request latency attribution (obs/waterfall.py) segments on.
+# "route"/"failover" (PR 18) are the fleet ROUTER's narration: one
+# "route" per placement (fleet rid, replica name, carried attempt
+# count) and one "failover" per cross-engine re-submit (plus the
+# reason) — they describe WHERE a request went, while the lifecycle
+# truth stays in the replica streams; obs/spans.reconstruct() treats
+# a record holding only these rows as narration, not a lifecycle.
 SPAN_EVENTS = ("submit", "blocked", "admit", "prefill", "first_token",
                "tick", "tick_done", "retire", "error", "timeout",
-               "shed", "requeue", "engine_restart", "failed", "phase")
+               "shed", "requeue", "engine_restart", "failed", "phase",
+               "route", "failover")
 
 # per-request latency waterfall segments (obs/waterfall.py), in
 # presentation order — the goodput-buckets discipline applied to ONE

@@ -182,6 +182,11 @@ def fleet_report(paths: Iterable[str],
     recs = reconstruct(span_rows)
     errors: List[str] = []
     exactly_once = True
+    # router narration records (route/failover rows only) describe
+    # placements, not lifecycles: they neither count as requests nor
+    # enter the SLO fold
+    lifecycles = {k: rec for k, rec in recs.items()
+                  if not rec.get("narration")}
     for (proc, rid), rec in sorted(recs.items()):
         # a terminal-free record with a clean errors list is simply
         # still in flight — not a violation; anything in errors
@@ -192,9 +197,58 @@ def fleet_report(paths: Iterable[str],
             src = rec.get("source") or f"proc{proc}"
             for e in rec["errors"]:
                 errors.append(f"{src} rid {rid}: {e}")
+    # cross-engine failover join (v9): a request the router moved
+    # spans one lifecycle PER HOP, tied together by its stable
+    # trace_id.  Fleet-wide exactly-once then means: every
+    # intermediate hop closed with a typed "failed" (the replica's
+    # budget verdict) or "shed" (refused at the door, placed
+    # elsewhere), and exactly the LAST hop carries the
+    # client-delivered terminal.  An intermediate "result"/"timeout"
+    # would be a double answer — flagged.
+    by_trace: Dict[str, List[tuple]] = {}
+    for key, rec in lifecycles.items():
+        tid = rec.get("trace_id")
+        if isinstance(tid, str):
+            by_trace.setdefault(tid, []).append((key, rec))
+    chains = 0
+    hops = 0
+    chain_terminals: Dict[str, int] = {}
+    intermediate: set = set()
+    clean = True
+    for tid, members in sorted(by_trace.items()):
+        if len(members) < 2:
+            continue
+        members.sort(key=lambda kr: (
+            kr[1].get("submit_t") or kr[1].get("shed_t") or 0.0))
+        chains += 1
+        hops += len(members) - 1
+        for key, rec in members[:-1]:
+            intermediate.add(key)
+            term = rec.get("terminal")
+            if term in ("result", "timeout"):
+                clean = False
+                exactly_once = False
+                src = rec.get("source") or f"proc{key[0]}"
+                errors.append(
+                    f"{src} rid {key[1]}: intermediate failover hop "
+                    f"ended {term!r} (trace {tid}) — double-delivered")
+        last = members[-1][1].get("terminal")
+        if last is not None:
+            chain_terminals[last] = chain_terminals.get(last, 0) + 1
+    failover_doc = ({"chains": chains, "hops": hops, "clean": clean,
+                     "terminals": chain_terminals}
+                    if chains else None)
     restarts = sum(1 for r in col["rows"]
                    if r.get("event") == "engine_restart")
-    slo_records = slo_lib.records_from_spans(span_rows)
+    # the federated SLO counts a failed-over request ONCE, with its
+    # final terminal: intermediate hops (and router narration) are
+    # carved out of the record stream before the fold
+    excluded = intermediate | {k for k in recs if k not in lifecycles}
+    slo_rows = [r for r in span_rows
+                if r.get("rid") is None
+                or (int(r.get("proc") or 0),
+                    int(r["rid"])) not in excluded]
+    slo_records = slo_lib.records_from_spans(slo_rows)
     slo_doc = (slo_lib.fleet_evaluate(slo_records, specs)
                if slo_records else None)
     return {
@@ -204,7 +258,7 @@ def fleet_report(paths: Iterable[str],
         "sources": [{k: v for k, v in s.items() if k != "dir"}
                     for s in col["sources"]],
         "rows": len(col["rows"]),
-        "requests": len(recs),
+        "requests": len(lifecycles),
         "exactly_once": exactly_once,
         "errors": errors[:MAX_REPORT_ERRORS],
         "restarts": restarts,
@@ -213,6 +267,10 @@ def fleet_report(paths: Iterable[str],
         # per-bucket service, utilization + the Little's-law identity
         # over the merged stream — None when nothing was submitted
         "queueing": queueing_report(span_rows),
+        # cross-engine failover accounting (v9): the per-trace hop
+        # chains the router produced — None when no request spanned
+        # more than one lifecycle
+        "failover": failover_doc,
     }
 
 

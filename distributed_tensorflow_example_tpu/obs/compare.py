@@ -135,6 +135,17 @@ GATE_METRICS: Dict[str, tuple] = {
     # pattern: interleaved same-process arms, host drift divides out)
     "waterfall_sum_to_wall_frac": ("higher", 0.01),
     "attribution_retained_tok_frac": ("higher", 0.01),
+    # the fleet-failover keys (ISSUE 18): bench_fleet_failover drives
+    # a 3-replica router fleet with one engine crashed past its retry
+    # budget.  fleet_completed_frac is the completed fraction of the
+    # deterministic analytic fleet (pure router over scripted
+    # replicas — a closed form at 1.0, tight 1%: any dip means the
+    # failover path dropped or double-delivered a request);
+    # fleet_failover_p99_ms is the measured failed-over request p99
+    # under the injected-crash plan (short CPU loops with restarts
+    # and re-prefill baked in — the wide 25% A/B default)
+    "fleet_completed_frac": ("higher", 0.01),
+    "fleet_failover_p99_ms": ("lower", 0.25),
 }
 
 
@@ -281,6 +292,14 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
         put("serving_degraded_p99_ms",
             doc.get("serving_degraded_p99_ms"))
         return out
+    # bench fleet-failover row — keyed on fleet_failover_requests, a
+    # row-only key (the final summary carries both gate keys too and
+    # must fall through to its own branch — the serving lesson)
+    if "fleet_failover_requests" in doc:
+        put("fleet_completed_frac", doc.get("fleet_completed_frac"))
+        put("fleet_failover_p99_ms",
+            doc.get("fleet_failover_p99_ms"))
+        return out
     if "wall_clock_20ep_s" in doc:              # bench per-config row
         put("wall_s", doc.get("wall_clock_20ep_s"))
         put("examples_per_sec", doc.get("examples_per_sec"))
@@ -330,7 +349,11 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
                   # chaos run's sum-to-wall minimum + the waterfall-
                   # derivation overhead ratio
                   "waterfall_sum_to_wall_frac",
-                  "attribution_retained_tok_frac"):
+                  "attribution_retained_tok_frac",
+                  # the fleet-failover keys (ISSUE 18): analytic
+                  # fleet completed fraction + measured failover p99
+                  "fleet_completed_frac",
+                  "fleet_failover_p99_ms"):
             put(k, doc.get(k))
         return out
     # last resort: any directly-named gate metrics

@@ -56,8 +56,16 @@ _NUM = (int, float)
 # segment decomposition), the DRIFT_REPORT document (obs/drift.py
 # model-vs-measured change-point detection), and the FLEET_REPORT's
 # optional "queueing" section (obs/queueing.py Little's-law
-# analytics).
-SCHEMA_VERSION = 8
+# analytics);
+# v9 = fleet serving: the router narration span events
+# ("route"/"failover" with the per-replica "replica" field in
+# SPAN_FIELDS/SPAN_REQUIRED — placement and cross-engine failover
+# records that join replica-stream lifecycles by trace_id), and the
+# FLEET_REPORT's "failover" section (per-trace hop chains: every
+# intermediate hop a typed "failed", the last hop the fleet
+# terminal, intermediates excluded from the federated SLO so a
+# failed-over request counts once).
+SCHEMA_VERSION = 9
 
 
 # field -> allowed types; a tuple including type(None) marks nullable
@@ -239,6 +247,9 @@ SPAN_FIELDS = {
     "source": (str,),
     "phase": (str,),
     "dur_ms": _NUM,
+    # fleet serving (v9): the router's route/failover narration names
+    # the replica a request was placed on
+    "replica": (str,),
 }
 
 SPAN_REQUIRED = {
@@ -275,6 +286,14 @@ SPAN_REQUIRED = {
     # OPTIONAL on every serving event (old fixtures remain valid);
     # only the phase row requires one.
     "phase": ("phase", "trace_id", "dur_ms"),
+    # the fleet router's narration rows (v9): rid is the FLEET rid
+    # (the router's own namespace), replica the placement target,
+    # attempt the cumulative PR 15 retry count carried across
+    # engines; failover adds why the request moved.  Lifecycle events
+    # for the request live in the REPLICA's stream — reconstruct()
+    # treats narration-only records as non-lifecycles.
+    "route": ("rid", "replica", "attempt"),
+    "failover": ("rid", "replica", "attempt", "reason"),
 }
 
 
@@ -482,6 +501,12 @@ FLEET_REPORT = {
     # consistency check over the merged stream; None when the stream
     # has no completed requests to measure.
     "queueing": (dict, type(None)),
+    # cross-engine failover accounting (v9, the router join): hop
+    # chains grouped by trace_id across sources — chains/hops counts,
+    # the chain-shape verdict (every intermediate hop a typed
+    # "failed", exactly one fleet terminal at the end) and the
+    # per-chain terminals; None when no request spans >1 lifecycle.
+    "failover": (dict, type(None)),
 }
 
 

@@ -198,7 +198,8 @@ def prometheus_text(status: Dict[str, Any],
                     serving: Optional[Dict[str, Any]] = None,
                     slo: Optional[Dict[str, Any]] = None,
                     fleet: Optional[Dict[str, Any]] = None,
-                    waterfall: Optional[Dict[str, Any]] = None) -> str:
+                    waterfall: Optional[Dict[str, Any]] = None,
+                    router: Optional[Dict[str, Any]] = None) -> str:
     """Render a /status document in Prometheus text exposition format
     (version 0.0.4). Gauges only — everything here is a point-in-time
     read of the run's own counters. ``serving``: a
@@ -211,7 +212,10 @@ def prometheus_text(status: Dict[str, Any],
     exactly-once and federated-identity verdicts, per-source skew and
     burn).  ``waterfall``: an obs/waterfall.summarize document
     appended as the ``dtx_waterfall_*`` latency-attribution gauges
-    (per-segment p50/p99 and the sum-to-wall residual)."""
+    (per-segment p50/p99 and the sum-to-wall residual).  ``router``:
+    a serving/router.Router.stats() document appended as the
+    ``dtx_router_*`` fleet gauges (fleet counters plus per-replica
+    health / breaker / load, labelled ``replica``)."""
     out: List[str] = []
 
     def fmt(v) -> str:
@@ -401,6 +405,53 @@ def prometheus_text(status: Dict[str, Any],
               "segment sum| fraction across requests (the sum-to-wall "
               "honesty bound; ~0 by construction)",
               [(None, waterfall.get("max_residual_frac"))])
+    if router:
+        # fleet router (PR 18, serving/router.Router.stats())
+        per_replica = router.get("per_replica") or []
+        gauge("dtx_router_replicas", "replicas behind the fleet "
+              "router", [(None, router.get("replicas"))])
+        gauge("dtx_router_replicas_healthy", "replicas whose circuit "
+              "breaker is closed",
+              [(None, router.get("replicas_healthy"))])
+        gauge("dtx_router_draining", "1 while the router is draining "
+              "(SIGTERM: no new admissions)",
+              [(None, router.get("draining"))])
+        gauge("dtx_router_requests_total", "requests the router "
+              "accepted and placed",
+              [(None, router.get("requests_total"))])
+        gauge("dtx_router_completed_total", "requests that reached a "
+              "clean result through the router",
+              [(None, router.get("completed_total"))])
+        gauge("dtx_router_failovers_total", "cross-engine failover "
+              "hops (a request re-submitted to another replica)",
+              [(None, router.get("failovers_total"))])
+        gauge("dtx_router_fleet_failed_total", "requests failed after "
+              "the fleet-level retry budget (typed failed fleet-wide)",
+              [(None, router.get("fleet_failed_total"))])
+        gauge("dtx_router_shed_total", "requests the router refused "
+              "(draining, every replica shed, or breakers open)",
+              [(None, router.get("shed_total"))])
+        gauge("dtx_router_drain_cancelled_total", "queued requests "
+              "typed-cancelled by a drain",
+              [(None, router.get("drain_cancelled_total"))])
+        gauge("dtx_router_replica_health", "per-replica health score "
+              "in [0, 1] (serving/health.health_score)",
+              [({"replica": r.get("name")}, r.get("health"))
+               for r in per_replica])
+        gauge("dtx_router_replica_load", "per-replica queued + "
+              "in-flight load at the last probe",
+              [({"replica": r.get("name")}, r.get("load"))
+               for r in per_replica])
+        gauge("dtx_router_breaker_open", "1 while the replica's "
+              "circuit breaker is not closed (open or half-open)",
+              [({"replica": r.get("name")},
+                0 if (r.get("breaker") or {}).get("state") == "closed"
+                else 1) for r in per_replica])
+        gauge("dtx_router_breaker_trips_total", "lifetime circuit-"
+              "breaker trips per replica",
+              [({"replica": r.get("name")},
+                (r.get("breaker") or {}).get("trips"))
+               for r in per_replica])
     return "\n".join(out) + "\n"
 
 
@@ -698,12 +749,17 @@ class StatusServer:
                     # typed load shedding: the bounded queue is full —
                     # overloaded, not broken; Retry-After tells the
                     # client when one queue slot should have drained
+                    # (integer-seconds CEIL via the one shared helper
+                    # — rounding DOWN invited the retry back early)
+                    from ..serving.admission import retry_after_header
+
                     self.send_response(503)
                     body = json.dumps(
                         {"error": str(e), "status": "shed",
                          "retry_after_s": e.retry_after_s}).encode()
-                    self.send_header("Retry-After", str(max(
-                        1, int(round(e.retry_after_s)))))
+                    self.send_header(
+                        "Retry-After",
+                        str(retry_after_header(e.retry_after_s)))
                     self.send_header("Content-Type",
                                      "application/json")
                     self.send_header("Content-Length", str(len(body)))

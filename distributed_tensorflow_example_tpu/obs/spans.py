@@ -414,6 +414,17 @@ def reconstruct(
         elif event == "failed":
             r["failed_reason"] = str(row.get("reason"))
             r["attempts"] = row.get("attempts")
+        elif event in ("route", "failover"):
+            # fleet-router narration (v9): WHERE the request went.
+            # The lifecycle itself lives in a REPLICA's stream (under
+            # that stream's own rid), so these rows create no
+            # milestone expectations — a record holding only them is
+            # narration, not a truncated lifecycle.
+            key = "routes" if event == "route" else "failovers"
+            r[key] = r.get(key, 0) + 1
+            r["replica"] = row.get("replica")
+            if row.get("attempt") is not None:
+                r["attempt"] = row.get("attempt")
         elif event == "requeue":
             # a supervised re-admission legitimately re-runs the
             # admission/prefill milestones: reset their exactly-once
@@ -445,9 +456,19 @@ def reconstruct(
         if len(ends) > 1:
             r["errors"].append(
                 f"multiple terminals: {'+'.join(ends)}")
+        # router narration streams hold route/failover rows (and
+        # nothing else) per fleet rid: mark them so consumers can
+        # separate narration from lifecycles, and exempt them from
+        # the lifecycle checks below
+        r["narration"] = bool(
+            (r.get("routes") or r.get("failovers"))
+            and "submit_t" not in r and "shed_t" not in r
+            and r.get("error") is None)
         # shed is the one terminal without a submit: the request was
-        # never accepted, so the no-submit check exempts it
-        if "submit_t" not in r and "shed_t" not in r:
+        # never accepted, so the no-submit check exempts it (router
+        # narration describes a lifecycle that lives elsewhere)
+        if "submit_t" not in r and "shed_t" not in r \
+                and not r["narration"]:
             r["errors"].append("no submit event")
         if "shed_t" in r and "submit_t" in r:
             r["errors"].append("shed after submit (shed requests are "

@@ -28,6 +28,17 @@ _EXPORTS = {
     "BrownoutPolicy": "admission",
     "ShedError": "admission",
     "parse_brownout": "admission",
+    "retry_after_hint": "admission",
+    "retry_after_header": "admission",
+    # fleet serving (PR 18) — the routing decision layer stays pure
+    # Python like the scheduler; RouterServer is stdlib http.server
+    "Router": "router",
+    "RouterServer": "router",
+    "BreakerPolicy": "health",
+    "CircuitBreaker": "health",
+    "HealthMonitor": "health",
+    "health_score": "health",
+    "parse_breaker": "health",
 }
 
 __all__ = list(_EXPORTS)

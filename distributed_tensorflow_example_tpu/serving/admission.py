@@ -25,7 +25,27 @@ without jax.  ``parse_brownout`` is the ``--brownout`` flag DSL.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Optional
+
+
+def retry_after_hint(p50_ms: Optional[float]) -> float:
+    """The Retry-After hint a shed carries: the p50 request latency in
+    SECONDS when one is known (about one queue slot's drain time),
+    floored at 1s.  The ONE place the heuristic lives — the engine's
+    shed path, the /generate 503 header and the fleet router all
+    consume it (drifting copies were how PR 15 and PR 16 ended up
+    disagreeing on the hint by a rounding mode)."""
+    return round(max(1.0, (p50_ms or 0.0) / 1e3), 3)
+
+
+def retry_after_header(retry_after_s: float) -> int:
+    """The HTTP ``Retry-After`` header value for a hint in seconds:
+    integer-seconds CEILING, floored at 1.  Ceil, not round — a 1.4s
+    hint rounded down to 1 invites the client back 0.4s before the
+    queue slot it is waiting on has drained, which re-sheds the retry
+    under steady load."""
+    return max(1, int(math.ceil(float(retry_after_s))))
 
 
 class ShedError(RuntimeError):

@@ -26,6 +26,15 @@ readers stitch segments); ``--slo`` overrides the SLO specs those
 evaluate (obs/slo.py DSL, e.g. ``ttft_p99_ms<=250,error_rate<=0.01``).
 ``POST /generate`` accepts and returns a W3C ``traceparent`` header —
 the request's spans carry the caller's trace id (obs/serve.py).
+
+Fleet knobs: ``--replicas N`` (N > 1) runs N in-process engines
+behind the serving/router front door (least-loaded placement over
+health scores, per-replica circuit breakers via ``--breaker``,
+cross-engine failover bounded by ``--fleet_retries``); per-replica
+span streams land under ``<logs>/replica<i>`` and the router's
+route/failover narration under ``<logs>/router`` so ``dtx-obs
+fleet`` joins the whole story.  SIGTERM drains: stop admitting,
+finish in-flight, typed-shed the queue.
 """
 
 from __future__ import annotations
@@ -107,6 +116,92 @@ def _spec_from_cfg(cfg):
     )
 
 
+def _main_fleet(cfg, spec, params, slos, brownout) -> int:
+    """``--replicas N`` (N > 1): the fleet mode.  N in-process
+    ``DecodeEngine`` replicas — each with its own span stream under
+    ``<logs>/replica<i>`` so ``dtx-obs fleet`` federates them as
+    sources — behind the serving/router least-loaded health-scored
+    front door.  The router's route/failover narration lands in
+    ``<logs>/router``; SIGTERM drains (stop admitting, finish
+    in-flight, typed-shed the queue)."""
+    import os
+
+    from .engine import DecodeEngine
+    from .health import parse_breaker
+    from .router import Router, RouterServer
+
+    breaker = parse_breaker(cfg.breaker or "on")
+    recorders = []
+
+    def _recorder(sub):
+        if not cfg.trace_spans:
+            return None
+        from ..obs.spans import SpanRecorder
+
+        rec = SpanRecorder(
+            os.path.join(cfg.logs_path, sub),
+            rotate_bytes=int(cfg.span_rotate_mb * 1024 * 1024),
+            keep=cfg.span_keep)
+        recorders.append(rec)
+        return rec
+
+    narrator = None
+    if cfg.engine_retries > 0:
+        from ..resilience.restart import RestartNarrator
+
+        narrator = RestartNarrator(cfg.logs_path)
+    engines = []
+    for i in range(cfg.replicas):
+        engines.append(DecodeEngine(
+            spec, params, page_size=cfg.decode_page_size,
+            num_pages=cfg.decode_pages,
+            max_batch=cfg.decode_max_batch,
+            seed=cfg.seed, kv_quant=cfg.kv_quant,
+            recorder=_recorder(f"replica{i}"),
+            max_queue=cfg.max_queue, deadline_ms=cfg.deadline_ms,
+            engine_retries=cfg.engine_retries, brownout=brownout,
+            slos=slos, restart_narrator=narrator))
+        engines[-1].start()
+    router = Router(engines, fleet_retries=cfg.fleet_retries,
+                    breaker=breaker, recorder=_recorder("router"))
+    server = RouterServer(router)
+    server.install_sigterm()
+    port = server.start(cfg.serve_port)
+    if port is None:
+        for e in engines:
+            e.stop()
+        for rec in recorders:
+            rec.close()
+        return 2
+    print(f"dtx-serve: fleet of {cfg.replicas} replicas behind "
+          f"POST /generate on :{port} "
+          f"(fleet_retries={cfg.fleet_retries} "
+          f"breaker=failures:{breaker.failures}"
+          + (f" engine_retries={cfg.engine_retries}"
+             if cfg.engine_retries else "")
+          + (f" spans -> {cfg.logs_path}/replica<i>"
+             if cfg.trace_spans else "") + ")")
+    try:
+        import time
+
+        while not router.draining:
+            time.sleep(0.5)
+        # SIGTERM drained the router (queue typed-shed); let the
+        # in-flight decodes retire before tearing the engines down
+        while any(e.stats().get("inflight", 0) for e in engines):
+            time.sleep(0.1)
+        print("dtx-serve: fleet drained, exiting")
+    except KeyboardInterrupt:
+        router.drain()
+    finally:
+        server.close()
+        for e in engines:
+            e.stop()
+        for rec in recorders:
+            rec.close()
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     from .. import config as config_lib
 
@@ -150,6 +245,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print("dtx-serve: no --checkpoint_dir — serving a seeded "
               "random init (demo mode)")
         params = tfm.init(jax.random.PRNGKey(cfg.seed), spec)
+
+    if cfg.replicas > 1:
+        return _main_fleet(cfg, spec, params, slos, brownout)
 
     recorder = None
     if cfg.trace_spans:

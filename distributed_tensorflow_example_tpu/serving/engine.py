@@ -42,7 +42,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from . import scheduler as sched_lib
-from .admission import BrownoutPolicy, ShedError
+from .admission import BrownoutPolicy, ShedError, retry_after_hint
 from .faults import InjectedFault
 from .scheduler import SCRATCH_PAGE
 
@@ -75,7 +75,7 @@ def _percentile(vals: List[float], q: float) -> Optional[float]:
 
 class _Result:
     __slots__ = ("event", "prompt", "tokens", "arrival_t", "first_t",
-                 "finish_t", "error", "status")
+                 "finish_t", "error", "status", "attempts")
 
     def __init__(self, prompt, arrival_t: float):
         self.event = threading.Event()
@@ -85,6 +85,9 @@ class _Result:
         self.first_t: Optional[float] = None
         self.finish_t: Optional[float] = None
         self.error: Optional[str] = None
+        # retry-budget accounting on a typed "failed" terminal — the
+        # fleet router carries it onto the next replica
+        self.attempts: Optional[int] = None
         # terminal type once the event is set: "result" | "timeout" |
         # "failed" (shed requests never get a _Result — they are
         # refused at submit with a typed ShedError)
@@ -202,6 +205,11 @@ class DecodeEngine:
         # rid -> (trace_id, parent_id): the W3C trace context every
         # accepted request carries (trimmed with _results retention)
         self._traces: Dict[int, tuple] = {}
+        # rid -> the attempts count seeded by submit(attempts=): the
+        # local retry budget bounds crashes THIS engine absorbs, so
+        # the budget check offsets by the carried-in base while spans
+        # keep the cumulative fleet-wide count
+        self._attempt_base: Dict[int, int] = {}
         self._next_rid = 0
         self._accepted = 0
         self._tick = 0
@@ -236,7 +244,8 @@ class DecodeEngine:
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0,
                deadline_ms: Optional[float] = None,
-               traceparent: Optional[str] = None) -> int:
+               traceparent: Optional[str] = None,
+               attempts: int = 0) -> int:
         """Queue a request (``prompt``: iterable of int token ids);
         returns its rid.  Thread-safe; the background loop (or the
         next ``step()``) picks it up.  ``deadline_ms`` bounds the
@@ -252,7 +261,14 @@ class DecodeEngine:
         request emits (a malformed header degrades to a fresh trace,
         never to a rejection).  Without one, the engine mints a fresh
         trace_id — every request is traceable either way; look it up
-        with ``trace_context(rid)``."""
+        with ``trace_context(rid)``.
+
+        ``attempts`` seeds the supervision retry ledger (0 = a fresh
+        request): a fleet router failing a request over from another
+        engine passes the count the old engine burned, so the PR 15
+        ``attempts`` accounting stays cumulative ACROSS engines —
+        ``engine_retries`` then bounds the *additional* crashes this
+        engine will absorb before the typed ``failed`` terminal."""
         from ..obs import spans as spans_lib
 
         ctx = spans_lib.parse_traceparent(traceparent)
@@ -304,6 +320,13 @@ class DecodeEngine:
             self.sched.submit(rid, len(prompt), int(max_new_tokens),
                               arrival=now, deadline=deadline,
                               trace_id=trace_id, parent_id=parent_id)
+            if attempts:
+                # a failed-over request arrives mid-ledger: the seq
+                # carries the cumulative count (requeue/failed spans
+                # stay fleet-truthful), the base offsets the local
+                # budget check in _recover
+                self.sched.waiting[-1].attempts = int(attempts)
+                self._attempt_base[rid] = int(attempts)
             self._next_rid += 1
             self._accepted += 1
             self._queue_peak = max(self._queue_peak,
@@ -323,11 +346,25 @@ class DecodeEngine:
             return self._traces.get(int(rid))
 
     def _retry_after_s(self) -> float:
-        """The Retry-After hint on a shed: the p50 request latency
-        when one is known (about one queue slot's drain time), else
-        1s."""
-        p50 = _percentile(list(self._lat_ms), 0.50)
-        return round(max(1.0, (p50 or 0.0) / 1e3), 3)
+        """The Retry-After hint on a shed: admission.retry_after_hint
+        over the rolling p50 (the ONE home of the heuristic — the
+        /generate 503 header and the fleet router consume the same
+        number)."""
+        return retry_after_hint(_percentile(list(self._lat_ms), 0.50))
+
+    def waiting_rids(self) -> List[int]:
+        """Rids still WAITING for admission (no pages held, no tokens
+        earned) — the fleet router's drain path typed-cancels exactly
+        these; in-flight requests finish."""
+        with self._lock:
+            return [s.rid for s in self.sched.waiting]
+
+    def fast_burn(self) -> Optional[float]:
+        """The cached fast-window SLO burn rate (None without a
+        recorder or before the first fold) — the router's health
+        probe reads this from any thread."""
+        with self._lock:
+            return self._fast_burn()
 
     def cancel(self, rid: int) -> bool:
         """Client-side cancellation: mark ``rid`` for retirement at
@@ -361,8 +398,14 @@ class DecodeEngine:
         trace = self._traces.get(rid)
         extra = {"trace_id": trace[0]} if trace else {}
         if res.error is not None:
-            return {"rid": rid, "status": res.status or "failed",
-                    "error": res.error, **extra}
+            out = {"rid": rid, "status": res.status or "failed",
+                   "error": res.error, **extra}
+            if res.attempts is not None:
+                # the spent retry ledger rides the typed failed
+                # terminal — a fleet router seeds the next engine's
+                # submit(attempts=) with it
+                out["attempts"] = res.attempts
+            return out
         return {
             "rid": rid,
             "status": "result",
@@ -597,6 +640,7 @@ class DecodeEngine:
             evicted = self._finished_order.popleft()
             self._results.pop(evicted, None)
             self._traces.pop(evicted, None)
+            self._attempt_base.pop(evicted, None)
         res.event.set()
 
     # ---- compiled-program caches (one per shape bucket) ----
@@ -745,7 +789,8 @@ class DecodeEngine:
                 res = self._results.get(s.rid)
                 if res is None or res.event.is_set():
                     continue
-                if s.attempts > self.engine_retries:
+                if s.attempts > self.engine_retries \
+                        + self._attempt_base.get(s.rid, 0):
                     self._finalize_failed(
                         s.rid, f"engine crashed {s.attempts} times "
                                f"on this request "
@@ -793,6 +838,7 @@ class DecodeEngine:
         self._failed += 1
         res.status = "failed"
         res.error = msg
+        res.attempts = int(attempts)
         res.finish_t = now
         if self.recorder is not None:
             trace = self._traces.get(rid)

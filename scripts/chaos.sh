@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Chaos acceptance — the ISSUE 18 gate, runnable standalone. Runs the
+# fault-injection suites (engine-level FaultPlans in
+# test_serving_faults.py plus the 3-replica fleet chaos tests in
+# test_router.py) with DTX_CHAOS_RUNS pointed at a kept directory, then
+# replays every produced fleet_chaos_* run dir through `dtx-obs fleet`
+# and asserts the offline verdict is clean (exit 0: fleet-wide
+# exactly-once, failover chains consistent). Latency SLOs are widened —
+# chaos runs crash engines on purpose; this gate is about terminal
+# accounting, not speed.
+cd "$(dirname "$0")/.." || exit 1
+set -o pipefail
+
+RUNS="${DTX_CHAOS_RUNS:-$(mktemp -d /tmp/dtx_chaos.XXXXXX)}"
+mkdir -p "$RUNS" || exit 1
+export DTX_CHAOS_RUNS="$RUNS"
+echo "chaos: run dirs under $RUNS"
+
+env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+    tests/test_serving_faults.py tests/test_router.py || exit $?
+
+found=0
+for d in "$RUNS"/fleet_chaos_*/; do
+  [ -d "$d" ] || continue
+  found=1
+  echo "chaos: dtx-obs fleet ${d}"
+  env JAX_PLATFORMS=cpu python -m distributed_tensorflow_example_tpu.obs.cli \
+      fleet "$d"*/ --compact \
+      --spec 'ttft_p99_ms<=60000,latency_p99_ms<=120000,error_rate<=0.99' \
+      || exit $?
+done
+if [ "$found" -eq 0 ]; then
+  echo "chaos: no fleet_chaos_* run dirs produced" >&2
+  exit 1
+fi
+echo "chaos: OK"

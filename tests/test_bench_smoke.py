@@ -131,6 +131,19 @@ def _stub_rows(monkeypatch):
                           "unsupervised_completed": 0,
                           "supervision_recovers": True,
                           "serving_degraded_p99_ms": 512.5})
+    # the fleet-failover row (r18) runs on EVERY backend: the analytic
+    # router completed fraction + failover p99 are the gated evidence
+    # and must reach the final line under their gate names
+    monkeypatch.setattr(
+        bench, "bench_fleet_failover",
+        lambda *a, **kw: {"config": "fleet_failover",
+                          "fleet_failover_requests": 12,
+                          "fleet_completed_frac": 0.916667,
+                          "fleet_analytic_failovers": 3,
+                          "fleet_breaker_opened": True,
+                          "terminates_typed": True,
+                          "fleet_failover_p99_ms": 3264.91,
+                          "fleet_beats_routerless": True})
     # the span-overhead row (r16) runs on EVERY backend: the
     # interleaved spans-on/off ratio is the gated evidence that
     # tracing is effectively free and must reach the final line
@@ -269,6 +282,11 @@ def test_bench_main_cpu_stubbed(monkeypatch, capsys):
     assert final["serving_degraded_completed_frac"] == 0.666667
     assert final["serving_degraded_p99_ms"] == 512.5
     assert final["supervision_recovers"] is True
+    # the r18 fleet-failover carriage (every backend): the gated
+    # completed fraction + failover p99 + the router-less A/B verdict
+    assert final["fleet_completed_frac"] == 0.916667
+    assert final["fleet_failover_p99_ms"] == 3264.91
+    assert final["fleet_beats_routerless"] is True
     assert final["serving_continuous_beats_static"] is True
     # the r10 multi-site carriage (every backend): the analytic H=8
     # comm bytes/token + reductions + the measured final-cost A/B

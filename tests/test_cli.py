@@ -148,6 +148,28 @@ def test_failopen_serving_validation_matrix():
     bad("engine_retries", engine_retries=-2)
     bad("brownout", brownout="bogus=1")
     bad("brownout", brownout="occ=notafloat")
+    # r18 fleet knobs ride the same validator (breaker DSL parse from
+    # serving/health.py, pure Python)
+    ok(replicas=3, fleet_retries=0, breaker="on")
+    ok(breaker="failures=2,base=0.1,cap=2.0,jitter=0,seed=7")
+    bad("replicas", replicas=0)
+    bad("fleet_retries", fleet_retries=-1)
+    bad("breaker", breaker="bogus=1")
+    bad("breaker", breaker="failures=x")
+
+
+def test_fleet_serving_flags():
+    """r18 fleet knobs parse onto their Config fields and default to
+    the single-engine path (replicas=1: no router in the loop)."""
+    cfg = parse_config(["--replicas=3", "--fleet_retries=1",
+                        "--breaker=failures=2,floor=0.1"])
+    assert cfg.replicas == 3
+    assert cfg.fleet_retries == 1
+    assert cfg.breaker == "failures=2,floor=0.1"
+    d = parse_config([])
+    assert d.replicas == 1            # single engine, no router
+    assert d.fleet_retries == 2
+    assert d.breaker == ""            # breaker defaults (fleet only)
 
 
 def test_fused_kernel_flags():

@@ -376,6 +376,43 @@ def test_compare_understands_serving_degraded_keys():
     assert ms["serving_degraded_p99_ms"] == 512.5
 
 
+def test_compare_understands_fleet_failover_keys():
+    """The fleet-failover row (ISSUE 18): bench_fleet_failover gates
+    on the analytic routered completed fraction (tight 1% — scripted
+    replicas, a closed form) and the measured failover p99 (wide),
+    keyed on the row-only fleet_failover_requests so the final
+    summary — which carries both gate keys too — falls through to
+    its own branch (the serving lesson)."""
+    row = {"config": "fleet_failover", "fleet_failover_requests": 12,
+           "fleet_completed_frac": 1.0,
+           "fleet_analytic_failovers": 3,
+           "fleet_breaker_opened": True, "terminates_typed": True,
+           "fleet_beats_routerless": True,
+           "fleet_failover_p99_ms": 3264.91}
+    m = cmp_lib.extract_metrics(row)
+    assert m == {"fleet_completed_frac": 1.0,
+                 "fleet_failover_p99_ms": 3264.91}
+    # a doctored completed-fraction drop (3% against the 1% analytic
+    # gate) regresses; a failover-p99 blowup past the wide 25% A/B
+    # threshold regresses too
+    worse = dict(row, fleet_completed_frac=0.916667,
+                 fleet_failover_p99_ms=4500.0)
+    verdict = cmp_lib.compare(row, worse)
+    assert not verdict["ok"]
+    assert "fleet_completed_frac" in verdict["regressions"]
+    assert "fleet_failover_p99_ms" in verdict["regressions"]
+    # final-summary shape: the fleet keys ride ALONGSIDE wall_s — the
+    # summary must not be mistaken for a fleet row
+    summary = {"metric": "mnist_20epoch_wall_clock", "value": 0.15,
+               "fleet_completed_frac": 1.0,
+               "fleet_failover_p99_ms": 3264.91,
+               "fleet_beats_routerless": True}
+    ms = cmp_lib.extract_metrics(summary)
+    assert ms["wall_s"] == 0.15
+    assert ms["fleet_completed_frac"] == 1.0
+    assert ms["fleet_failover_p99_ms"] == 3264.91
+
+
 def test_compare_understands_latency_attribution_keys():
     """The latency-attribution row (ISSUE 17): bench_latency_attribution
     gates on the waterfall sum-to-wall fraction (1% — the segments are

@@ -1,0 +1,789 @@
+"""Fault-tolerant serving fleet (ISSUE 18): router + health + breaker.
+
+Two halves, the tests/test_serving_faults.py discipline:
+
+- **pure Python** (router + health over scripted replicas, no jax in
+  the process): the health-score closed form, the circuit breaker's
+  full state machine with its EXACT seeded backoff sequence, the
+  --breaker DSL, Retry-After unification, least-loaded placement,
+  failover bookkeeping (attempts carried, trace stable, budget
+  bounded) and drain semantics;
+- **engine** (CPU jax): the fleet chaos acceptance — 3 real
+  DecodeEngines behind the router with a crash FaultPlan on one,
+  verified fleet-wide through obs/collector.fleet_report (exactly one
+  typed terminal per request, clean failover chains, unbroken
+  trace_id, completed fraction strictly beating the router-less
+  round-robin) — plus the bitwise-invisibility pin (router over one
+  healthy replica == the bare engine, token for token) and the
+  RouterServer HTTP front door.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import tempfile
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from distributed_tensorflow_example_tpu.serving import (  # noqa: E402
+    admission as adm,
+)
+from distributed_tensorflow_example_tpu.serving import (  # noqa: E402
+    health as hl,
+)
+from distributed_tensorflow_example_tpu.serving import (  # noqa: E402
+    router as rt,
+)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from distributed_tensorflow_example_tpu.models import (  # noqa: E402
+    transformer as tfm,
+)
+from distributed_tensorflow_example_tpu.serving.engine import (  # noqa: E402
+    DecodeEngine,
+)
+from distributed_tensorflow_example_tpu.serving.faults import (  # noqa: E402
+    FaultPlan,
+)
+
+
+# --- purity ---------------------------------------------------------------
+
+
+def test_router_modules_are_pure_python():
+    """router.py + health.py (and the package lazy exports resolving
+    them) import and route a failover with NO jax in the process —
+    the whole fleet decision layer is subprocess-provable, like the
+    scheduler and the fault plumbing before it."""
+    code = (
+        "import sys\n"
+        "from distributed_tensorflow_example_tpu.serving import (\n"
+        "    Router, RouterServer, BreakerPolicy, CircuitBreaker,\n"
+        "    HealthMonitor, health_score, parse_breaker,\n"
+        "    retry_after_header)\n"
+        "class R:\n"
+        "    def __init__(self, fail):\n"
+        "        self.fail, self.n, self.res = fail, 0, {}\n"
+        "    def submit(self, p, m, **kw):\n"
+        "        rid = self.n; self.n += 1\n"
+        "        self.res[rid] = ({'rid': rid, 'status': 'failed',\n"
+        "                          'error': 'x',\n"
+        "                          'attempts': kw.get('attempts', 0) + 1}\n"
+        "                         if self.fail else\n"
+        "                         {'rid': rid, 'status': 'result',\n"
+        "                          'tokens': [1], 'latency_ms': 1.0})\n"
+        "        return rid\n"
+        "    def result(self, rid, timeout=None):\n"
+        "        return self.res[rid]\n"
+        "    def cancel(self, rid):\n"
+        "        return False\n"
+        "    def stats(self):\n"
+        "        return {'queued': 0, 'inflight': 0, 'queue_limit': 0,\n"
+        "                'completed_total': 0, 'shed_total': 0,\n"
+        "                'timeout_total': 0, 'failed_total': 0,\n"
+        "                'engine_restarts_total': 0}\n"
+        "r = Router([R(True), R(False)], fleet_retries=2)\n"
+        "res = r.result(r.submit([1, 2], 4), timeout=5.0)\n"
+        "assert res['status'] == 'result' and res['failovers'] == 1\n"
+        "assert health_score() == 1.0\n"
+        "assert retry_after_header(0.3) == 1\n"
+        "assert parse_breaker('failures=5').failures == 5\n"
+        "assert 'jax' not in sys.modules, 'router pulled in jax'\n"
+    )
+    subprocess.run([sys.executable, "-c", code], check=True, cwd=_REPO)
+
+
+# --- Retry-After unification (satellite) ----------------------------------
+
+
+def test_retry_after_helpers_are_the_one_place():
+    """ONE serving helper computes Retry-After: the float hint from
+    the p50 (retry_after_hint) and the integer-seconds ceil the HTTP
+    header carries (retry_after_header) — both surfaces (obs/serve
+    and the router) consume these."""
+    assert adm.retry_after_hint(None) == 1.0
+    assert adm.retry_after_hint(0.0) == 1.0
+    assert adm.retry_after_hint(2500.0) == 2.5
+    assert adm.retry_after_hint(437.0) == 1.0      # floored at 1s
+    # integer ceil: sub-second hints round UP to 1, fractional
+    # seconds to the next integer (an HTTP Retry-After is integral)
+    assert adm.retry_after_header(0.3) == 1
+    assert adm.retry_after_header(1.0) == 1
+    assert adm.retry_after_header(1.2) == 2
+    assert adm.retry_after_header(2.0) == 2
+
+
+# --- health score ---------------------------------------------------------
+
+
+def test_health_score_closed_form():
+    assert hl.health_score() == 1.0
+    # queue fullness spends up to W_QUEUE
+    assert hl.health_score(queued=4, queue_limit=8) == 1.0 - 0.125
+    assert hl.health_score(queued=9, queue_limit=8) == 0.75
+    assert hl.health_score(queued=9, queue_limit=0) == 1.0  # unbounded
+    # burn saturates at BURN_SCALE
+    assert hl.health_score(burn_rate=1.0) == 0.875
+    assert hl.health_score(burn_rate=4.0) == 0.75
+    assert hl.health_score(burn_rate=None) == 1.0
+    # failure fraction of the probe window's terminals
+    assert hl.health_score(failure_delta=1, ok_delta=3) == 0.925
+    assert hl.health_score(failure_delta=3, ok_delta=0) == 0.7
+    # staleness saturates at STALE_SCALE_S
+    assert hl.health_score(staleness_s=5.0) == 0.9
+    assert hl.health_score(staleness_s=60.0) == 0.8
+    # every signal saturated: exactly 0 (the weights sum to 1)
+    assert hl.health_score(queued=9, queue_limit=1, failure_delta=5,
+                           burn_rate=99.0, staleness_s=99.0) == 0.0
+
+
+def test_health_monitor_tracks_deltas_not_totals():
+    t = [100.0]
+    mon = hl.HealthMonitor(clock=lambda: t[0])
+    base = {"queued": 0, "queue_limit": 0, "completed_total": 10,
+            "shed_total": 0, "timeout_total": 0, "failed_total": 0,
+            "engine_restarts_total": 0}
+    assert mon.update(dict(base)) == 1.0    # clean totals, no window
+    # 3 more completions, no new failures: clean
+    t[0] += 1.0
+    assert mon.update({**base, "completed_total": 13}) \
+        == hl.health_score(failure_delta=0, ok_delta=3,
+                           staleness_s=1.0)
+    # 2 new faileds vs 1 completion: the failure fraction bites
+    t[0] += 1.0
+    s = mon.update({**base, "completed_total": 14, "failed_total": 2})
+    assert s == hl.health_score(failure_delta=2, ok_delta=1,
+                                staleness_s=1.0)
+    assert mon.score == s
+
+
+# --- breaker policy / DSL -------------------------------------------------
+
+
+def test_parse_breaker_dsl():
+    assert hl.parse_breaker("") == hl.BreakerPolicy()
+    assert hl.parse_breaker("on") == hl.BreakerPolicy()
+    p = hl.parse_breaker("failures=5,base=0.5,cap=10,jitter=0.2,"
+                         "floor=0.1,seed=7")
+    assert p == hl.BreakerPolicy(failures=5, base_s=0.5, cap_s=10.0,
+                                 jitter=0.2, health_floor=0.1, seed=7)
+    with pytest.raises(ValueError, match="bad --breaker part"):
+        hl.parse_breaker("nope=1")
+    with pytest.raises(ValueError, match="bad --breaker value"):
+        hl.parse_breaker("failures=lots")
+    with pytest.raises(ValueError):
+        hl.parse_breaker("failures=0")            # policy validation
+
+
+def test_breaker_policy_validation():
+    with pytest.raises(ValueError):
+        hl.BreakerPolicy(base_s=0.0)
+    with pytest.raises(ValueError):
+        hl.BreakerPolicy(cap_s=0.1, base_s=0.2)
+    with pytest.raises(ValueError):
+        hl.BreakerPolicy(jitter=1.5)
+    with pytest.raises(ValueError):
+        hl.BreakerPolicy(health_floor=1.0)
+
+
+# --- breaker state machine ------------------------------------------------
+
+
+def _breaker(t, **kw):
+    return hl.CircuitBreaker(hl.BreakerPolicy(**kw),
+                             clock=lambda: t[0])
+
+
+def test_breaker_consecutive_threshold_and_close():
+    t = [0.0]
+    b = _breaker(t, failures=3, jitter=0.0)
+    assert b.state == "closed" and b.allow()
+    b.record_failure("one")
+    b.record_failure("two")
+    assert b.state == "closed"                    # 2 < 3
+    b.record_success()                            # success RESETS
+    b.record_failure("one")
+    b.record_failure("two")
+    b.record_failure("three")
+    assert b.state == "open" and b.trips == 1
+    assert b.last_reason == "three"
+    assert not b.allow()                          # backoff not elapsed
+    # backoff (jitter 0): exactly base_s
+    t[0] += 0.2
+    assert b.allow()                              # -> half-open probe
+    assert b.state == "half_open"
+    assert not b.allow()                          # single probe only
+    b.record_success()
+    assert b.state == "closed" and b.consecutive_failures == 0
+    assert b.allow()
+
+
+def test_breaker_half_open_failure_reopens_with_next_step():
+    t = [0.0]
+    b = _breaker(t, failures=1, jitter=0.0, base_s=0.2, cap_s=5.0)
+    b.record_failure("boom", now=t[0])
+    assert b.state == "open"
+    t[0] += 0.2
+    assert b.allow()                              # probe
+    b.record_failure("still broken", now=t[0])    # re-open, trip 2
+    assert b.state == "open" and b.trips == 2
+    t[0] += 0.2
+    assert not b.allow()                          # 2nd step = 0.4s
+    t[0] += 0.2
+    assert b.allow() and b.state == "half_open"
+
+
+def test_breaker_backoff_sequence_exact():
+    """The seeded-jitter exponential ladder in closed form: trip n
+    (1-based, ordinal resets on close) backs off
+    ``min(cap, base * 2**(n-1)) * (1 + jitter * u_n)`` with u_n the
+    n-th draw of random.Random(seed) — byte-exact, no tolerance."""
+    seed, base, cap, jitter = 7, 0.2, 5.0, 0.1
+    u = random.Random(seed)
+    expect = [round(min(cap, base * 2 ** n)
+                    * (1.0 + jitter * u.random()), 6)
+              for n in range(6)]
+    t = [0.0]
+    b = _breaker(t, failures=1, base_s=base, cap_s=cap,
+                 jitter=jitter, seed=seed)
+    got = []
+    b.record_failure("first", now=t[0])
+    got.append(b._retry_at - t[0])
+    for _ in range(5):
+        t[0] = b._retry_at
+        assert b.allow()                          # half-open probe
+        b.record_failure("again", now=t[0])       # re-open, next step
+        got.append(b._retry_at - t[0])
+    assert [round(g, 6) for g in got] == expect
+    # cap reached: steps 5 and 6 use cap * (1 + jitter * u_n)
+    assert expect[5] <= cap * (1.0 + jitter)
+
+
+def test_breaker_would_allow_is_non_consuming():
+    t = [0.0]
+    b = _breaker(t, failures=1, jitter=0.0)
+    assert b.would_allow()
+    b.record_failure("x", now=t[0])
+    assert not b.would_allow()
+    t[0] += 0.2
+    # the peek reads True but must NOT move the state machine
+    assert b.would_allow() and b.state == "open"
+    assert b.would_allow() and b.state == "open"
+    assert b.allow() and b.state == "half_open"   # dispatch consumes
+    assert not b.would_allow()                    # probe outstanding
+    b.abort_probe()                               # shed at the door
+    assert b.would_allow() and b.allow()          # slot handed back
+    b.abort_probe()
+    b.record_success()
+    b.abort_probe()                               # no-op when closed
+    assert b.state == "closed"
+
+
+def test_breaker_health_collapse_trips_closed_only():
+    t = [0.0]
+    b = _breaker(t, failures=3, health_floor=0.2, jitter=0.0)
+    b.note_health(0.5, now=t[0])
+    assert b.state == "closed"
+    b.note_health(0.1, now=t[0])
+    assert b.state == "open" and "health collapse" in b.last_reason
+    retry = b._retry_at
+    b.note_health(0.0, now=t[0])                  # open: no re-trip
+    assert b._retry_at == retry and b.trips == 1
+
+
+# --- scripted replica + pure router ---------------------------------------
+
+
+class FakeReplica:
+    """Engine-shaped scripted replica: ``script`` outcomes are
+    consumed per submit ("ok" | "failed" | "shed" | "dead" | "wait");
+    extra submits default to "ok".  "wait" parks the request until
+    cancel() types it timeout (the drain path)."""
+
+    def __init__(self, script=(), queued=0, queue_limit=0,
+                 shed_hint=2.5):
+        self.script = list(script)
+        self.queued = queued
+        self.queue_limit = queue_limit
+        self.shed_hint = shed_hint
+        self.next_rid = 0
+        self.results = {}
+        self.submits = []
+        self.waiting = []
+        self.completed_total = 0
+        self.failed_total = 0
+        self.shed_total = 0
+
+    def submit(self, prompt, max_new_tokens, temperature=0.0,
+               deadline_ms=None, traceparent=None, attempts=0):
+        outcome = self.script.pop(0) if self.script else "ok"
+        if outcome == "shed":
+            self.shed_total += 1
+            raise adm.ShedError("queue full",
+                                retry_after_s=self.shed_hint)
+        if outcome == "dead":
+            raise RuntimeError("engine stopped")
+        rid = self.next_rid
+        self.next_rid += 1
+        self.submits.append({
+            "rid": rid, "prompt": [int(x) for x in prompt],
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": temperature, "deadline_ms": deadline_ms,
+            "traceparent": traceparent, "attempts": attempts})
+        if outcome == "failed":
+            self.failed_total += 1
+            self.results[rid] = {
+                "rid": rid, "status": "failed", "error": "injected",
+                "attempts": int(attempts) + 1}
+        elif outcome == "wait":
+            self.waiting.append(rid)
+            self.results[rid] = None
+        else:
+            self.completed_total += 1
+            self.results[rid] = {
+                "rid": rid, "status": "result", "tokens": [1, 2],
+                "latency_ms": 1.0, "ttft_ms": 1.0}
+        return rid
+
+    def result(self, rid, timeout=None):
+        return self.results.get(rid)
+
+    def cancel(self, rid):
+        if rid in self.waiting:
+            self.waiting.remove(rid)
+            self.results[rid] = {
+                "rid": rid, "status": "timeout",
+                "error": "cancelled before completion (cancel)"}
+            return True
+        return False
+
+    def waiting_rids(self):
+        return list(self.waiting)
+
+    def stats(self):
+        return {"queued": self.queued + len(self.waiting),
+                "inflight": 0, "queue_limit": self.queue_limit,
+                "completed_total": self.completed_total,
+                "shed_total": self.shed_total, "timeout_total": 0,
+                "failed_total": self.failed_total,
+                "engine_restarts_total": 0}
+
+
+def test_placement_is_least_loaded_per_health():
+    busy = FakeReplica(queued=5)
+    idle = FakeReplica(queued=0)
+    r = rt.Router([busy, idle])
+    res = r.result(r.submit([1, 2, 3], 4), timeout=5.0)
+    assert res["status"] == "result"
+    assert idle.submits and not busy.submits      # least-loaded won
+    # equal load: the lowest index is the deterministic tie-break
+    a, b = FakeReplica(), FakeReplica()
+    r2 = rt.Router([a, b])
+    r2.result(r2.submit([1], 2), timeout=5.0)
+    assert a.submits and not b.submits
+
+
+def test_failover_carries_attempts_and_trace():
+    """The acceptance kernel in miniature: a typed failed terminal
+    re-submits elsewhere with the SAME trace_id and the accumulated
+    attempts count; the result reports the fleet rid + hop count."""
+    sick = FakeReplica(script=["failed"])
+    well = FakeReplica()
+    r = rt.Router([sick, well], fleet_retries=2)
+    rid = r.submit([5, 6], 4, deadline_ms=5000.0)
+    res = r.result(rid, timeout=5.0)
+    assert res["status"] == "result" and res["rid"] == rid
+    assert res["failovers"] == 1
+    assert sick.submits[0]["attempts"] == 0
+    assert well.submits[0]["attempts"] == 1       # carried over
+    t0 = sick.submits[0]["traceparent"].split("-")[1]
+    t1 = well.submits[0]["traceparent"].split("-")[1]
+    assert t0 == t1                               # unbroken trace
+    assert r.trace_context(rid)[0] == t0
+    # the re-submit re-expresses the ORIGINAL deadline (remaining
+    # ms, not a fresh 5000)
+    assert 0 < well.submits[0]["deadline_ms"] <= 5000.0
+    st = r.stats()
+    assert st["requests_total"] == 1 and st["completed_total"] == 1
+    assert st["failovers_total"] == 1 and st["fleet_failed_total"] == 0
+
+
+def test_fleet_retry_budget_types_exactly_one_failed():
+    """Both replicas fail every hop: the request must end in ONE
+    typed failed terminal naming the spent budget — never an
+    unbounded ping-pong."""
+    a = FakeReplica(script=["failed"] * 5)
+    b = FakeReplica(script=["failed"] * 5)
+    r = rt.Router([a, b], fleet_retries=1)
+    res = r.result(r.submit([1], 2), timeout=5.0)
+    assert res["status"] == "failed"
+    assert "fleet retry budget spent" in res["error"]
+    assert res["failovers"] == 1 and res["attempts"] == 2
+    assert len(a.submits) + len(b.submits) == 2   # 1 route + 1 hop
+    st = r.stats()
+    assert st["fleet_failed_total"] == 1 and st["completed_total"] == 0
+
+
+def test_every_replica_shedding_propagates_min_hint():
+    a = FakeReplica(script=["shed"], shed_hint=3.0)
+    b = FakeReplica(script=["shed"], shed_hint=2.0)
+    r = rt.Router([a, b])
+    with pytest.raises(adm.ShedError) as ei:
+        r.submit([1], 2)
+    assert ei.value.retry_after_s == 2.0          # the SMALLEST hint
+    assert r.stats()["shed_total"] == 1
+    # one replica shedding is routed around, not surfaced
+    c = FakeReplica(script=["shed"], shed_hint=3.0)
+    d = FakeReplica()
+    r2 = rt.Router([c, d])
+    assert r2.result(r2.submit([1], 2), timeout=5.0)["status"] \
+        == "result"
+
+
+def test_open_breakers_shed_with_earliest_reprobe_wait():
+    t = [0.0]
+    sick = FakeReplica(script=["failed"] * 9)
+    r = rt.Router([sick], fleet_retries=0,
+                  breaker=hl.BreakerPolicy(failures=1, jitter=0.0,
+                                           base_s=4.0),
+                  clock=lambda: t[0])
+    res = r.result(r.submit([1], 2), timeout=5.0)
+    assert res["status"] == "failed"              # budget 0: no hops
+    with pytest.raises(adm.ShedError) as ei:
+        r.submit([1], 2)                          # breaker now open
+    assert "no admittable replica" in str(ei.value)
+    assert ei.value.retry_after_s == 4.0          # the re-probe wait
+    t[0] += 4.0
+    assert r.result(r.submit([1], 2), timeout=5.0) is not None
+
+
+def test_dead_replica_submit_is_routed_around():
+    dead = FakeReplica(script=["dead"])
+    well = FakeReplica()
+    r = rt.Router([dead, well])
+    res = r.result(r.submit([1], 2), timeout=5.0)
+    assert res["status"] == "result"
+    assert well.submits and not dead.submits
+    assert dead.failed_total == 0                 # refused at the door
+
+
+def test_drain_sheds_new_and_remaps_waiting_to_shed():
+    parked = FakeReplica(script=["wait"])
+    r = rt.Router([parked])
+    rid = r.submit([1, 2], 4)
+    assert r.drain() == 1                         # cancelled 1 waiting
+    assert r.drain() == 0                         # idempotent
+    assert r.draining
+    with pytest.raises(adm.ShedError, match="router draining"):
+        r.submit([3], 2)
+    res = r.result(rid, timeout=5.0)
+    # the replica stream holds its typed timeout terminal; the CLIENT
+    # contract is "shed, try again elsewhere"
+    assert res["status"] == "shed"
+    assert res["retry_after_s"] == rt.ROUTER_RETRY_AFTER_S
+    assert "draining" in res["error"]
+    st = r.stats()
+    assert st["draining"] == 1
+    assert st["drain_cancelled_total"] == 1 and st["shed_total"] == 1
+
+
+def test_router_narration_spans_and_reconstruct(tmp_path):
+    """With a recorder attached the router writes route/failover
+    narration: fleet rid, replica name, attempt, trace_id — and
+    reconstruct() treats the stream as narration (no 'no submit
+    event' complaints), counting routes/failovers per rid."""
+    from distributed_tensorflow_example_tpu.obs import (
+        spans as spans_lib,
+    )
+
+    rec = spans_lib.SpanRecorder(str(tmp_path))
+    r = rt.Router([FakeReplica(script=["failed"]), FakeReplica()],
+                  fleet_retries=2, recorder=rec)
+    rid = r.submit([1, 2], 4)
+    res = r.result(rid, timeout=5.0)
+    assert res["status"] == "result"
+    rec.close()
+    rows = spans_lib.read_spans(rec.path)
+    events = [row["event"] for row in rows]
+    assert events == ["route", "failover"]
+    assert all(row["rid"] == rid for row in rows)
+    assert rows[0]["replica"] == "replica0"
+    assert rows[1]["replica"] == "replica1"
+    assert rows[1]["reason"] == "replica failed"
+    assert rows[0]["trace_id"] == rows[1]["trace_id"]
+    recs = spans_lib.reconstruct(rows)
+    rec0 = recs[(0, rid)]
+    assert rec0["narration"] is True
+    assert rec0["routes"] == 1 and rec0["failovers"] == 1
+    assert rec0["errors"] == []                   # NOT "no submit"
+
+
+def test_router_validation():
+    with pytest.raises(ValueError):
+        rt.Router([])
+    with pytest.raises(ValueError):
+        rt.Router([FakeReplica()], fleet_retries=-1)
+
+
+# --- RouterServer HTTP front door -----------------------------------------
+
+
+def _post(port, doc, path="/generate", headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def test_router_server_http_surface():
+    r = rt.Router([FakeReplica(script=["failed"]), FakeReplica()],
+                  fleet_retries=2)
+    srv = rt.RouterServer(r)
+    port = srv.start(0)
+    assert port
+    try:
+        tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        code, hdrs, doc = _post(
+            port, {"prompt": [1, 2, 3], "max_new_tokens": 4},
+            headers={"traceparent": tp})
+        assert code == 200 and doc["status"] == "result"
+        assert doc["failovers"] == 1
+        assert hdrs["traceparent"].split("-")[1] == "ab" * 16
+        # /status: per-replica health/breaker section
+        code, _, body = _get(port, "/status")
+        st = json.loads(body)
+        assert code == 200 and st["live"] is True
+        names = [p["name"] for p in st["router"]["per_replica"]]
+        assert names == ["replica0", "replica1"]
+        assert all("breaker" in p for p in st["router"]["per_replica"])
+        # /metrics: the dtx_router_* gauges
+        code, _, body = _get(port, "/metrics")
+        text = body.decode()
+        assert code == 200
+        for g in ("dtx_router_replicas", "dtx_router_replicas_healthy",
+                  "dtx_router_requests_total",
+                  "dtx_router_failovers_total",
+                  "dtx_router_replica_health{replica=\"replica0\"}",
+                  "dtx_router_breaker_open{replica=\"replica1\"}"):
+            assert g in text, f"{g} missing from /metrics"
+        # malformed body: 400, not 500
+        code, _, doc = _post(port, {"prompt": "nope"})
+        assert code == 400
+    finally:
+        srv.close()
+
+
+def test_router_server_shed_503_retry_after_integer_ceil():
+    """Replica 503 hints are HONORED: the fleet's Retry-After header
+    is the integer ceil of the smallest replica hint (satellite:
+    admission.retry_after_header is the one place)."""
+    r = rt.Router([FakeReplica(script=["shed"], shed_hint=1.2)])
+    srv = rt.RouterServer(r)
+    port = srv.start(0)
+    try:
+        code, hdrs, doc = _post(
+            port, {"prompt": [1], "max_new_tokens": 2})
+        assert code == 503 and doc["status"] == "shed"
+        assert doc["retry_after_s"] == 1.2
+        assert hdrs["Retry-After"] == "2"         # ceil(1.2)
+    finally:
+        srv.close()
+
+
+def test_router_server_sigterm_drains():
+    import signal as signal_lib
+
+    prev = signal_lib.getsignal(signal_lib.SIGTERM)
+    r = rt.Router([FakeReplica()])
+    srv = rt.RouterServer(r)
+    srv.install_sigterm()
+    port = srv.start(0)
+    try:
+        os.kill(os.getpid(), signal_lib.SIGTERM)
+        # the handler ran in THIS process: draining, new submits shed
+        assert r.draining
+        code, hdrs, doc = _post(
+            port, {"prompt": [1], "max_new_tokens": 2})
+        assert code == 503 and "draining" in doc["error"]
+        assert hdrs["Retry-After"] == "1"
+        code, _, body = _get(port, "/status")
+        assert json.loads(body)["live"] is False
+    finally:
+        srv.close()
+    # close() restored the previous handler
+    assert signal_lib.getsignal(signal_lib.SIGTERM) == prev
+
+
+# --- the engine-backed fleet ----------------------------------------------
+
+
+def _spec(**kw):
+    base = dict(input_size=32, num_classes=10, seq_len=32, d_model=32,
+                n_heads=2, num_blocks=2, d_ff=64, objective="lm",
+                vocab_size=50, causal=True)
+    base.update(kw)
+    return tfm.TransformerSpec(**base)
+
+
+def _settle(engines, timeout=10.0):
+    """Let every engine reach its final tick boundary before stop():
+    the 'retire' span lands one plan_tick AFTER the seal that
+    unblocked result(), so an immediate stop() can clip the last
+    request's terminal off the stream."""
+    import time
+
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if all(not e.sched.live and not e.sched.waiting
+               for e in engines):
+            time.sleep(0.05)      # the boundary's emit follows remove
+            return
+        time.sleep(0.02)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    spec = _spec()
+    return spec, tfm.init(jax.random.PRNGKey(0), spec)
+
+
+def test_router_over_one_healthy_replica_is_bitwise_invisible(lm):
+    """The router over a single healthy replica produces exactly the
+    bare engine's tokens — the fleet layer costs nothing when there
+    is nothing to route around."""
+    spec, params = lm
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, 50, size=n).tolist() for n in (3, 7, 5)]
+    temps = (0.0, 0.9, 0.0)
+
+    def bare():
+        eng = DecodeEngine(spec, params, page_size=4, max_batch=2,
+                           seed=5)
+        # submit BEFORE the loop starts: tick composition (and so the
+        # seeded sampling stream) is deterministic in both arms
+        rids = [eng.submit(p, 5, temperature=t)
+                for p, t in zip(prompts, temps)]
+        eng.start()
+        out = [eng.result(r, timeout=60.0)["tokens"] for r in rids]
+        eng.stop()
+        return out
+
+    def routed():
+        eng = DecodeEngine(spec, params, page_size=4, max_batch=2,
+                           seed=5)
+        r = rt.Router([eng])
+        rids = [r.submit(p, 5, temperature=t)
+                for p, t in zip(prompts, temps)]
+        eng.start()
+        out = [r.result(x, timeout=60.0)["tokens"] for x in rids]
+        eng.stop()
+        return out
+
+    assert bare() == routed()
+
+
+def test_fleet_chaos_acceptance(lm, tmp_path):
+    """THE acceptance criterion: a 3-replica fleet behind the router
+    with a FaultPlan crashing one engine past its retry budget —
+    every accepted request ends in exactly ONE typed terminal
+    fleet-wide (obs/collector.fleet_report over the per-replica run
+    dirs + the router narration dir), failed-over requests keep an
+    unbroken trace_id, and the routered completed fraction strictly
+    beats the router-less round-robin of the SAME workload."""
+    from distributed_tensorflow_example_tpu.obs import (
+        collector as collector_lib,
+    )
+    from distributed_tensorflow_example_tpu.obs import (
+        spans as spans_lib,
+    )
+
+    spec, params = lm
+    rng = np.random.RandomState(0)
+    n_req = 10
+    prompts = [rng.randint(0, 50, size=int(rng.randint(3, 9))).tolist()
+               for _ in range(n_req)]
+    news = [int(rng.randint(3, 7)) for _ in range(n_req)]
+    base_dir = os.environ.get("DTX_CHAOS_RUNS") or str(tmp_path)
+    run_dir = tempfile.mkdtemp(prefix="fleet_chaos_", dir=base_dir)
+
+    def engines(recorders):
+        out = []
+        for i in range(3):
+            plan = FaultPlan(crash_at_ticks=(1, 2, 3, 4)) \
+                if i == 0 else FaultPlan()
+            out.append(DecodeEngine(
+                spec, params, page_size=4, max_batch=2, seed=5,
+                engine_retries=1, faults=plan,
+                recorder=recorders[i] if recorders else None))
+            out[-1].start()
+        return out
+
+    recs = [spans_lib.SpanRecorder(os.path.join(run_dir, f"replica{i}"))
+            for i in range(3)]
+    router_rec = spans_lib.SpanRecorder(os.path.join(run_dir, "router"))
+    fleet = engines(recs)
+    router = rt.Router(fleet, fleet_retries=2, recorder=router_rec)
+    rids = [router.submit(p, n) for p, n in zip(prompts, news)]
+    results = [router.result(r, timeout=120.0) for r in rids]
+    _settle(fleet)
+    for e in fleet:
+        e.stop()
+    for rec in recs + [router_rec]:
+        rec.close()
+
+    # 1) every accepted request reached a typed terminal at the
+    # router surface
+    assert all(r is not None for r in results)
+    assert all(r.get("status") in ("result", "timeout", "shed",
+                                   "failed") for r in results)
+    done = [r for r in results if r["status"] == "result"]
+    moved = [r for r in done if r.get("failovers")]
+    assert moved, "the crash plan must force at least one failover"
+    # 2) failed-over requests keep their trace: the result's trace_id
+    # is the submit-time trace the router minted
+    for r in moved:
+        i = rids.index(r["rid"])
+        assert r["trace_id"] == router.trace_context(rids[i])[0]
+    # 3) fleet-wide exactly-once through the collector join
+    rep = collector_lib.fleet_report(
+        [os.path.join(run_dir, d) for d in sorted(os.listdir(run_dir))])
+    assert rep["exactly_once"], rep["errors"][:5]
+    fo = rep["failover"]
+    assert fo is not None and fo["clean"]
+    assert fo["chains"] >= len(moved)
+    assert fo["terminals"].get("result", 0) >= len(moved)
+    # the fleet saw every request exactly once: narration rows and
+    # intermediate hops are excluded from the request count
+    assert rep["requests"] >= n_req
+    # 4) completed fraction with failover strictly beats router-less
+    # round-robin of the same workload under the same chaos plan
+    base = engines(None)
+    brids = [(base[i % 3], base[i % 3].submit(p, n))
+             for i, (p, n) in enumerate(zip(prompts, news))]
+    bres = [e.result(x, timeout=120.0) for e, x in brids]
+    for e in base:
+        e.stop()
+    base_done = sum(1 for r in bres
+                    if r is not None and r.get("status") == "result")
+    assert len(done) / n_req > base_done / n_req, \
+        (len(done), base_done)

@@ -219,6 +219,50 @@ def test_cancel_frees_like_a_deadline():
     assert s.cancel(0) is False                   # already terminal
 
 
+def test_static_batch_deadline_cancel_parity():
+    """PR 15's typed-terminal contract holds under BOTH batching
+    policies: an identical expiring workload driven through
+    ContinuousScheduler and StaticBatchScheduler yields the same
+    typed terminals (deadline/cancel), the same timeout-span shapes,
+    and fully-freed pages — static batching changes WHEN work admits,
+    never HOW it expires."""
+
+    def drive(cls):
+        events = []
+
+        class Rec:
+            def emit(self, e, **f):
+                events.append((e, f))
+
+        s = cls(17, 4, 2, recorder=Rec())
+        s.submit(0, 6, 8, arrival=0.0, deadline=2.0)
+        s.submit(1, 6, 8, arrival=0.0)
+        s.submit(2, 3, 2, arrival=0.0, deadline=0.5)  # never admits
+        assert s.plan_tick(now=0.0) is not None
+        s.record_prefill(0, now=1.0)
+        s.record_prefill(1, now=1.0)
+        assert s.cancel(1) is True
+        s.plan_tick(now=3.0)                          # everything expires
+        expired = sorted(s.take_expired())
+        assert s.take_expired() == []                 # drained exactly once
+        spans = sorted(
+            ((f["rid"], f["reason"], f["queued"], f["generated"])
+             for e, f in events if e == "timeout"))
+        shapes = sorted(
+            (f["rid"], tuple(sorted(f)))
+            for e, f in events if e == "timeout")
+        assert s.alloc.in_use == 0 and s.idle
+        return expired, spans, shapes, s.timeouts
+
+    cont = drive(sl.ContinuousScheduler)
+    stat = drive(sl.StaticBatchScheduler)
+    assert cont[0] == stat[0] == [(0, "deadline"), (1, "cancel"),
+                                  (2, "deadline")]
+    assert cont[1] == stat[1]                         # identical typed spans
+    assert cont[2] == stat[2]                         # identical field shapes
+    assert cont[3] == stat[3] == 3
+
+
 def test_brownout_policy_transitions_closed_form():
     p = adm.BrownoutPolicy(occupancy_hi=0.9, occupancy_lo=0.75,
                            burn_hi=2.0)

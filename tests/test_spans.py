@@ -540,7 +540,7 @@ def test_phase_span_contract_v7():
         PHASE_SCOPES,
     )
 
-    assert schema_lib.SCHEMA_VERSION == 8  # v8: waterfall/drift/tick_done
+    assert schema_lib.SCHEMA_VERSION == 9  # v9: route/failover/fleet join
     assert "phase" in SPAN_EVENTS
     assert PHASE_SCOPES == ("round", "outer_sync", "ckpt")
     tid = "ab" * 16
