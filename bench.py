@@ -2647,6 +2647,224 @@ def _bench_fleet_failover_measured(page_size: int, max_batch: int,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_workload_replay(n_requests: int = 16, max_batch: int = 4,
+                          page_size: int = 8, seed: int = 0):
+    """Workload time-machine bench (ISSUE 19), two halves like
+    bench_fleet_failover:
+
+    1. ANALYTIC (pure Python, every backend — the gateable evidence):
+       a seeded synthetic WORKLOAD (obs/workload.py) through the
+       scheduler-only replay fast path twice
+       (serving/replay.replay_sim — the REAL ContinuousScheduler,
+       reused not forked).  Deterministic by construction, so
+       ``replay_determinism_frac`` is a closed form at 1.0 and gated
+       tight (1%: any dip means replay lost its determinism).  The
+       capacity loop closes in the same sim frame (ticks as seconds):
+       the service rate measured off the fastest sustained replay of
+       a speed sweep feeds ``obs/capacity.forecast`` and the forecast
+       must land on the measured saturation knee
+       (``capacity_forecast_rel_err``, exact algebra modulo rounding
+       — gated at the wide 25%).
+
+    2. MEASURED (a tiny lm engine through the real DecodeEngine):
+       capture a seeded source run's span stream into a WORKLOAD,
+       replay it TWICE through fresh seeded engines
+       (serving/replay.replay_engine), and require identical typed
+       terminals + token content (overwrites
+       ``replay_determinism_frac`` when it succeeds) with the
+       collector's exactly-once join holding over each replay's span
+       dir.  Degrades to an error key where the stack is missing
+       (the bench_pp_memory precedent)."""
+    from distributed_tensorflow_example_tpu.obs import (
+        capacity as capacity_lib)
+    from distributed_tensorflow_example_tpu.obs import (
+        workload as workload_lib)
+    from distributed_tensorflow_example_tpu.serving import (
+        replay as replay_lib)
+
+    # tick-scale arrivals (the sim clock reads seconds as ticks):
+    # ~2-tick inter-arrival gaps at speed 1, so the speed sweep
+    # actually moves the workload from arrival-limited to
+    # service-limited and the capacity knee is a real saturation
+    # point, not a degenerate tie
+    doc = workload_lib.synthetic_workload(
+        n_requests, seed=seed, qps=0.5, mean_prompt=16, mean_new=8,
+        vocab_size=64)
+
+    def sim(speed=1.0):
+        return replay_lib.replay_sim(
+            doc, num_pages=33, page_size=page_size,
+            max_batch=max_batch, speed=speed)
+
+    ident = replay_lib.identity(sim(), sim())
+    # ---- the capacity loop in the sim frame: sweep the SAME
+    # workload at increasing speed; a point's offered rate is the
+    # compressed arrival window, its completed throughput the full
+    # makespan in tick-seconds
+    points = []
+    for sp in (1.0, 2.0, 4.0, 8.0, 16.0):
+        r = sim(sp)
+        dur = max(doc["duration_s"] / sp, 1e-9)
+        points.append({
+            "speed": sp,
+            "n_requests": r["n_requests"],
+            "completed": r["completed"],
+            "qps_offered": round(r["n_requests"] / dur, 6),
+            "qps_completed": round(
+                r["completed"] / max(r["total_ticks"], 1), 6),
+            "tok_s": (sum(p["tokens"] or 0 for p in r["per_request"])
+                      / max(r["total_ticks"], 1)),
+        })
+    knee = capacity_lib.measured_knee(points)
+    # the service budget is the knee point's own token rate — the
+    # forecast at 100% utilization must then reproduce the knee's
+    # completed throughput exactly (sustainable = service/mean_new =
+    # n*mean/makespan/mean = n/makespan), so rel_err is rounding noise
+    service_tok_s = next(p["tok_s"] for p in points
+                         if p["speed"] == knee["knee_speed"])
+    fc = capacity_lib.forecast(doc, service_tok_s,
+                               utilization_target=1.0)
+    vd = capacity_lib.verdict(fc["sustainable_qps"],
+                              knee["measured_qps"])
+    # the planning shape (the dtx-obs capacity default surface)
+    plan = capacity_lib.forecast(doc, service_tok_s)
+    row = {
+        "config": "workload_replay",
+        "workload": f"{n_requests} synthetic requests (seed={seed}) "
+                    f"through replay_sim x2 + a 5-speed capacity "
+                    f"sweep; then a captured engine run replayed x2",
+        "workload_replay_requests": n_requests,
+        "workload_id": doc["workload_id"],
+        "replay_identical": ident["identical"],
+        "replay_determinism_frac": ident["determinism_frac"],
+        "capacity_forecast_qps": vd["forecast_qps"],
+        "capacity_measured_qps": vd["measured_qps"],
+        "capacity_forecast_rel_err": vd["rel_err"],
+        "capacity_knee_speed": knee["knee_speed"],
+        "capacity_required_replicas": plan["required_replicas"],
+        "terminates_typed": ident["identical"]
+        and not ident["mismatches"],
+    }
+    # ---- measured half: capture a real seeded engine run, replay it
+    # twice; degrades to an error key where the stack is unavailable
+    try:
+        row.update(_bench_workload_replay_measured(
+            page_size, max_batch, seed))
+    except Exception as e:   # noqa: BLE001 — degrade, don't void
+        row["workload_replay_measured_error"] = str(e)[:200]
+    return row
+
+
+def _bench_workload_replay_measured(page_size: int, max_batch: int,
+                                    seed: int) -> dict:
+    """The measured half of bench_workload_replay: capture a seeded
+    source run off its span stream, replay the WORKLOAD twice through
+    fresh seeded engines, and require identical typed terminals +
+    token content with the collector's exactly-once join holding."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_tensorflow_example_tpu.models import (
+        transformer as tfm)
+    from distributed_tensorflow_example_tpu.obs import (
+        collector as collector_lib)
+    from distributed_tensorflow_example_tpu.obs import (
+        workload as workload_lib)
+    from distributed_tensorflow_example_tpu.obs.spans import (
+        SpanRecorder)
+    from distributed_tensorflow_example_tpu.serving import (
+        replay as replay_lib)
+    from distributed_tensorflow_example_tpu.serving.engine import (
+        DecodeEngine)
+
+    seq = 128
+    spec = tfm.TransformerSpec(
+        input_size=seq, num_classes=10, seq_len=seq, d_model=64,
+        n_heads=4, num_blocks=2, d_ff=128, objective="lm",
+        vocab_size=64, causal=True, compute_dtype=jnp.bfloat16)
+    params = tfm.init(jax.random.PRNGKey(0), spec)
+
+    def settle(eng):
+        # let the engine hit its final tick boundary before stop():
+        # the 'retire' span lands one plan_tick after the seal that
+        # unblocked result() (the bench_fleet_failover lesson)
+        import time as time_lib
+
+        t0 = time_lib.monotonic()
+        while time_lib.monotonic() - t0 < 10.0:
+            if not eng.sched.live and not eng.sched.waiting:
+                time_lib.sleep(0.05)
+                break
+            time_lib.sleep(0.02)
+
+    import os
+
+    tmp = tempfile.mkdtemp(prefix="bench_replay_")
+    try:
+        # ---- the seeded SOURCE run the workload is captured from
+        src = os.path.join(tmp, "src")
+        rec = SpanRecorder(src)
+        eng = DecodeEngine(spec, params, page_size=page_size,
+                           max_batch=max_batch, seed=seed,
+                           recorder=rec)
+        eng.start()
+        rng = np.random.RandomState(seed)
+        n_req = 8
+        rids = []
+        for _ in range(n_req):
+            prompt = rng.randint(
+                1, 64, size=int(rng.randint(4, 12))).tolist()
+            rids.append(eng.submit(prompt, int(rng.randint(3, 8))))
+        results = [eng.result(r, timeout=120.0) for r in rids]
+        settle(eng)
+        eng.stop()
+        rec.close()
+        assert all(r is not None for r in results), \
+            "a source request neither completed nor typed a terminal"
+        doc = workload_lib.capture(src)
+        assert doc["n_requests"] == n_req
+
+        # ---- two seeded replays through FRESH engines, each with its
+        # own replay_of-stamped span dir
+        reports = []
+        for i in range(2):
+            d = os.path.join(tmp, f"replay{i}")
+            rrec = replay_lib.replay_recorder(d, doc["workload_id"])
+            e2 = DecodeEngine(spec, params, page_size=page_size,
+                              max_batch=max_batch, seed=seed,
+                              recorder=rrec)
+            e2.start()
+            try:
+                reports.append(replay_lib.replay_engine(
+                    e2, doc, vocab_size=64, speed=25.0))
+            finally:
+                settle(e2)
+                e2.stop()
+                rrec.close()
+            rep = collector_lib.fleet_report([d])
+            assert rep["exactly_once"], \
+                f"replay {i} exactly-once broken: {rep['errors'][:3]}"
+        ident = replay_lib.identity(*reports)
+        tok_s = (reports[0]["tokens_total"]
+                 / max(reports[0]["wall_s"], 1e-9))
+        return {
+            "workload_replay_measured_requests": n_req,
+            "replay_measured_identical": ident["identical"],
+            # overwrites the analytic closed form with the real-engine
+            # evidence when the stack is available
+            "replay_determinism_frac": ident["determinism_frac"],
+            "replay_exactly_once": True,
+            "replay_measured_tok_s": round(tok_s, 3),
+            "replay_measured_qps": reports[0]["qps_completed"],
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_local_sgd(rounds: int = 6, batch: int = 64, seq: int = 64,
                     seed: int = 0):
     """Multi-site local-SGD (DiLoCo) bench (ISSUE 10), two halves:
@@ -3158,6 +3376,13 @@ def main(argv=None) -> int:
     # the real router is CPU-viable at the tiny engine size,
     # degrading to an error key where the stack is missing
     guarded("fleet_failover", bench_fleet_failover)
+    # the workload-replay row runs on EVERY backend (r19): the
+    # scheduler-only two-replay identity + the sim-frame capacity
+    # sweep are pure closed forms (gated tight/wide respectively),
+    # and the captured-run double replay through the real engine is
+    # CPU-viable at the tiny model size, degrading to an error key
+    # where the stack is missing
+    guarded("workload_replay", bench_workload_replay)
     # the span-emission overhead row (r16, every backend): the same
     # engine replay with the recorder on vs off, interleaved — its
     # retained-tok/s ratio gates the "tracing is effectively free"
@@ -3426,6 +3651,21 @@ def main(argv=None) -> int:
         if ff_row.get("fleet_beats_routerless") is not None:
             extra["fleet_beats_routerless"] = \
                 ff_row["fleet_beats_routerless"]
+    wr_row = next(
+        (r for r in rows if r.get("config") == "workload_replay"
+         and "workload_replay_requests" in r), None)
+    if wr_row:
+        # workload-replay gate keys (r19): two-replay determinism
+        # (tight — real-engine evidence when the measured half ran,
+        # the scheduler-only closed form otherwise) and the capacity
+        # forecast-vs-knee gap (wide); replay_identical rides along
+        # as the verdict bit
+        extra["replay_determinism_frac"] = \
+            wr_row["replay_determinism_frac"]
+        extra["capacity_forecast_rel_err"] = \
+            wr_row["capacity_forecast_rel_err"]
+        if wr_row.get("replay_identical") is not None:
+            extra["replay_identical"] = wr_row["replay_identical"]
     tr_row = next(
         (r for r in rows if r.get("config") == "trace_overhead"
          and "trace_retained_tok_frac" in r), None)

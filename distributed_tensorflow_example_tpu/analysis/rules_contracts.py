@@ -102,10 +102,12 @@ class SchemaDriftRule:
         # (phase/trace_id/dur_ms), the collector stamps source on
         # merged rows, and the engine threads trace_id/parent_id;
         # v9 adds the fleet router's route/failover narration
-        # (replica/attempt)
+        # (replica/attempt); v10 adds the replay driver's replay_of
+        # stamp (serving/replay.py builds the recorder extra) and the
+        # scheduler's fingerprint payload
         "SPAN_FIELDS": ("serving/scheduler.py", "serving/engine.py",
                         "train/loop.py", "obs/collector.py",
-                        "serving/router.py"),
+                        "serving/router.py", "serving/replay.py"),
         "FLEET_REPORT": ("obs/collector.py",),
         "HISTORY_ENTRY": ("obs/history.py",),
         # restart-timeline rows: the envelope is written by the
@@ -116,6 +118,11 @@ class SchemaDriftRule:
         # history change-point report
         "WATERFALL": ("obs/waterfall.py",),
         "DRIFT_REPORT": ("obs/drift.py",),
+        # v10 documents: the captured workload (obs/workload.py
+        # distills a span dir into the portable request schedule
+        # dtx-serve --replay and dtx-obs capacity consume)
+        "WORKLOAD": ("obs/workload.py",),
+        "WORKLOAD_REQUEST": ("obs/workload.py",),
     }
     GATE_PRODUCERS = ("bench.py", "obs/aggregate.py", "obs/metrics.py",
                       "obs/schema.py", "train/loop.py")
@@ -125,10 +132,80 @@ class SchemaDriftRule:
         schema_mod = index.module_by_suffix("obs/schema.py")
         if schema_mod is not None:
             out.extend(self._check_contracts(index, schema_mod))
+            out.extend(self._check_version_bump(index, schema_mod,
+                                               ctx))
         compare_mod = index.module_by_suffix("obs/compare.py")
         if compare_mod is not None:
             out.extend(self._check_gate(index, compare_mod))
         return out
+
+    def _check_version_bump(self, index: ModuleIndex,
+                            schema_mod: Module, ctx) -> List[Finding]:
+        """A SCHEMA_VERSION bump is a three-sided contract change:
+        the history comment in obs/schema.py must narrate the new
+        version, docs/observability.md must document it, and the
+        CONTRACT_WRITERS registry here must be revisited (its comment
+        names the version whose documents it last absorbed).  A bump
+        that touches only the integer drifts all three — this check
+        makes the co-touch mechanical (v10 is the first fixture)."""
+        node = schema_mod.const_nodes.get("SCHEMA_VERSION")
+        if not (isinstance(node, ast.Constant)
+                and isinstance(node.value, int)):
+            return []
+        tag = f"v{node.value}"
+        findings: List[Finding] = []
+        # (a) the schema's own history comment: the tag must appear
+        # on some line other than the assignment itself
+        assign_line = node.lineno
+        if not any(tag in text for i, text in
+                   enumerate(schema_mod.lines, 1) if i != assign_line):
+            findings.append(Finding(
+                rule=self.id, file=schema_mod.relpath,
+                line=assign_line,
+                msg=(f"SCHEMA_VERSION = {node.value} but the version-"
+                     f"history comment never mentions {tag}"),
+                hint=(f"append a '# {tag} = ...' entry describing "
+                      f"what the bump changed — the history comment "
+                      f"is the migration narrative")))
+        # (b) docs/observability.md documents the new version
+        api_md = getattr(ctx, "api_md", None)
+        obs_md = (os.path.join(os.path.dirname(api_md),
+                               "observability.md") if api_md else "")
+        if obs_md and os.path.isfile(obs_md):
+            with open(obs_md, encoding="utf-8") as f:
+                words = set(re.findall(r"[A-Za-z0-9_]+", f.read()))
+            if tag not in words:
+                findings.append(Finding(
+                    rule=self.id, file=schema_mod.relpath,
+                    line=assign_line,
+                    msg=(f"SCHEMA_VERSION = {node.value} but "
+                         f"docs/observability.md never mentions "
+                         f"{tag}"),
+                    hint=("document the new schema version's "
+                          "documents/fields in docs/observability.md "
+                          "in the same tree as the bump")))
+        # (c) the CONTRACT_WRITERS registry here was revisited: a
+        # comment in this module names the bumped version (absorbing
+        # the new documents into the writer map is part of the bump)
+        me = index.module_by_suffix("analysis/rules_contracts.py")
+        lines = me.lines if me is not None else []
+        if not lines:
+            try:
+                with open(__file__, encoding="utf-8") as f:
+                    lines = f.read().splitlines()
+            except OSError:
+                lines = []
+        if lines and not any(tag in text for text in lines):
+            findings.append(Finding(
+                rule=self.id, file=schema_mod.relpath,
+                line=assign_line,
+                msg=(f"SCHEMA_VERSION = {node.value} but "
+                     f"analysis/rules_contracts.py CONTRACT_WRITERS "
+                     f"was never revisited for {tag}"),
+                hint=("absorb the bump's new/changed documents into "
+                      "CONTRACT_WRITERS (a comment naming the "
+                      "version records the revisit)")))
+        return findings
 
     def _writer_keys(self, index: ModuleIndex,
                      suffixes) -> Optional[Set[str]]:

@@ -462,6 +462,21 @@ class Config:
                                     # single probe after seeded-jitter
                                     # exponential backoff
                                     # (serving/health.py)
+    replay: str = ""                # dtx-serve: path to a captured
+                                    # WORKLOAD json (dtx-obs capture)
+                                    # — instead of serving HTTP, replay
+                                    # the recorded request schedule
+                                    # through the engine/fleet at the
+                                    # recorded arrival offsets and
+                                    # print the replay report
+                                    # (serving/replay.py); spans carry
+                                    # replay_of: <workload_id>
+    replay_speed: float = 1.0       # dtx-serve --replay: time
+                                    # compression — arrivals fire at
+                                    # arrival_s / speed and relative
+                                    # deadlines scale by 1/speed
+                                    # (2.0 = twice as fast; the
+                                    # capacity-knee sweep's knob)
 
     # ---- validation / early stopping (beyond-reference) ----
     early_stop_patience: int = 0    # > 0: evaluate the validation split
@@ -964,6 +979,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "health below floor), half-open single "
                         "probe after seeded-jitter exponential "
                         "backoff")
+    p.add_argument("--replay", type=str, default=d.replay,
+                   help="dtx-serve: path to a captured WORKLOAD json "
+                        "(dtx-obs capture) — replay the recorded "
+                        "request schedule through the engine/fleet "
+                        "at the recorded arrival offsets and print "
+                        "the replay report instead of serving HTTP; "
+                        "every span carries replay_of")
+    p.add_argument("--replay_speed", type=float,
+                   default=d.replay_speed,
+                   help="dtx-serve --replay: time compression — "
+                        "arrivals fire at arrival_s / speed and "
+                        "relative deadlines scale by 1/speed (the "
+                        "capacity-knee sweep's knob)")
     p.add_argument("--early_stop_patience", type=int,
                    default=d.early_stop_patience,
                    help="stop after P epochs without validation "
@@ -1260,6 +1288,10 @@ def validate_serving_config(cfg: Config) -> None:
         raise ValueError(
             f"fleet_retries={cfg.fleet_retries} must be >= 0 (0 = "
             f"no cross-replica failover)")
+    if cfg.replay_speed <= 0:
+        raise ValueError(
+            f"replay_speed={cfg.replay_speed} must be > 0 (1.0 = "
+            f"recorded pace, 2.0 = twice as fast)")
     from .serving.admission import parse_brownout
     from .serving.health import parse_breaker
 

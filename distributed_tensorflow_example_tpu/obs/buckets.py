@@ -116,6 +116,10 @@ GOODPUT_BUCKETS = ("train", "compile", "data_wait", "h2d", "ckpt",
 # reason) — they describe WHERE a request went, while the lifecycle
 # truth stays in the replica streams; obs/spans.reconstruct() treats
 # a record holding only these rows as narration, not a lifecycle.
+# v10 (ISSUE 19) adds no NEW events — workload capture/replay rides
+# the existing vocabulary: "submit" rows gain the optional
+# ``fingerprint`` chain (schema.SPAN_FIELDS) and replayed runs stamp
+# every row with ``replay_of`` via serving/replay.replay_recorder.
 SPAN_EVENTS = ("submit", "blocked", "admit", "prefill", "first_token",
                "tick", "tick_done", "retire", "error", "timeout",
                "shed", "requeue", "engine_restart", "failed", "phase",

@@ -1,0 +1,174 @@
+"""Capacity forecasting: workload shape + service model -> QPS.
+
+The forward-looking half of the queueing analytics (ISSUE 19): where
+``obs/queueing.py`` explains a PAST stream (utilization, Little's
+law), this module answers the planning question — given a captured
+WORKLOAD's traffic shape, how many requests per second can one
+replica sustain, and how many replicas does the offered load need?
+
+The model is utilization-first and deliberately closed-form
+(auditable, drift-gateable):
+
+- a replica's decode budget is ``service_tok_s`` generated tokens per
+  second — either MEASURED (an unloaded ``serving/replay.py`` run's
+  ``tokens_total / wall_s``, the only honest base off-TPU where
+  ``chip_peak_hbm_bytes`` is None) or the ROOFLINE bound
+  (``roofline_decode_tok_s``: peak HBM bytes/s over
+  ``obs/flops.decode_bytes_per_step``, the bench's gated decode
+  ceiling);
+- one request costs its mean decode tokens (prefill is amortized into
+  the measured rate; the forecast is decode-bound by the same
+  argument the roofline makes), so
+  ``sustainable_qps = service_tok_s * utilization_target /
+  mean_new_tokens`` — Little's law rearranged from time-per-request
+  to requests-per-time at the target utilization;
+- ``required_replicas = ceil(offered_qps / sustainable_qps)``.
+
+Validation closes the loop: ``measured_knee`` finds the saturation
+knee by replaying the SAME workload at increasing ``--speed`` (the
+highest completed-throughput the system sustained without dropping
+requests), and ``verdict`` compares it to the forecast —
+``capacity_forecast_rel_err`` is gated at 25% and ``dtx-obs
+capacity`` exits 3 when measurement falls short of forecast beyond
+tolerance (the drift-detection exit-code idiom).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Dict, List, Optional
+
+from . import flops as flops_lib
+from .schema import SCHEMA_VERSION
+
+# default fraction of the service budget a forecast plans to (run a
+# queue at 100% and Little's law says the backlog diverges)
+UTILIZATION_TARGET = 0.8
+
+# a speed point "sustains" when at least this fraction of requests
+# reached the result terminal
+SUSTAINED_COMPLETED_FRAC = 0.99
+
+DEFAULT_TOLERANCE = 0.25
+
+
+def workload_shape(doc: Dict[str, Any]) -> Dict[str, float]:
+    """The forecast's inputs off a WORKLOAD document: offered rate and
+    mean request shape."""
+    reqs = doc["requests"]
+    n = max(len(reqs), 1)
+    dur = max(float(doc.get("duration_s") or 0.0), 1e-9)
+    return {
+        "offered_qps": round(len(reqs) / dur, 6),
+        "mean_prompt_len": round(
+            sum(int(r["prompt_len"]) for r in reqs) / n, 3),
+        "mean_new_tokens": round(
+            sum(int(r["max_new_tokens"]) for r in reqs) / n, 3),
+    }
+
+
+def roofline_decode_tok_s(spec, batch: int, kv_len: float,
+                          device=None,
+                          kv_dtype_bytes: Optional[float] = None
+                          ) -> Optional[float]:
+    """The decode-token ceiling one replica's HBM allows: peak bytes/s
+    over the analytic bytes/step, times the batch one step serves.
+    None off-TPU (the peak is unknown — callers fall back to a
+    measured rate, never a fabricated one)."""
+    peak = flops_lib.chip_peak_hbm_bytes(device)
+    if peak is None:
+        return None
+    bytes_per_step = flops_lib.decode_bytes_per_step(
+        spec, batch, kv_len, kv_dtype_bytes=kv_dtype_bytes)
+    if bytes_per_step <= 0:
+        return None
+    return peak / bytes_per_step * max(int(batch), 1)
+
+
+def forecast(doc: Dict[str, Any], service_tok_s: float,
+             utilization_target: float = UTILIZATION_TARGET
+             ) -> Dict[str, Any]:
+    """The closed-form capacity document for one workload against one
+    replica's service rate.  Exact by construction: a synthetic
+    fixture whose service rate and token counts are chosen by hand
+    reproduces ``sustainable_qps`` to float precision (the test's
+    exactness hook)."""
+    if service_tok_s <= 0:
+        raise ValueError(
+            f"service_tok_s={service_tok_s} must be > 0")
+    if not 0 < utilization_target <= 1:
+        raise ValueError(f"utilization_target={utilization_target} "
+                         f"must be in (0, 1]")
+    shape = workload_shape(doc)
+    sustainable = (service_tok_s * utilization_target
+                   / max(shape["mean_new_tokens"], 1e-9))
+    rho = shape["offered_qps"] / max(sustainable, 1e-9)
+    return {
+        "v": SCHEMA_VERSION,
+        "kind": "capacity",
+        "generated_t": time.time(),
+        "workload_id": doc["workload_id"],
+        "n_requests": int(doc["n_requests"]),
+        "offered_qps": shape["offered_qps"],
+        "mean_prompt_len": shape["mean_prompt_len"],
+        "mean_new_tokens": shape["mean_new_tokens"],
+        "service_tok_s": round(float(service_tok_s), 6),
+        "utilization_target": float(utilization_target),
+        "sustainable_qps": round(sustainable, 6),
+        "utilization": round(rho * utilization_target, 6),
+        "required_replicas": int(math.ceil(
+            shape["offered_qps"] / max(sustainable, 1e-9))),
+    }
+
+
+def measured_knee(points: List[Dict[str, Any]],
+                  min_completed_frac: float = SUSTAINED_COMPLETED_FRAC
+                  ) -> Dict[str, Any]:
+    """The saturation knee over replay reports of ONE workload at
+    increasing speeds: each point offers ``qps_offered`` and completes
+    ``qps_completed``; the measured capacity is the highest completed
+    throughput among points that still completed (essentially) every
+    request — past the knee, sheds/timeouts appear and completed
+    throughput plateaus.  ``points`` entries need ``speed``,
+    ``qps_offered``, ``qps_completed``, ``n_requests`` and
+    ``completed`` (the ``replay_engine`` report surface)."""
+    if not points:
+        raise ValueError("measured_knee needs at least one point")
+    rows = []
+    for p in sorted(points, key=lambda p: float(p.get("speed") or 0)):
+        frac = p["completed"] / max(int(p["n_requests"]), 1)
+        rows.append({"speed": float(p["speed"]),
+                     "qps_offered": float(p["qps_offered"]),
+                     "qps_completed": float(p["qps_completed"]),
+                     "completed_frac": round(frac, 6),
+                     "sustained": frac >= min_completed_frac})
+    sustained = [r for r in rows if r["sustained"]]
+    base = sustained if sustained else rows
+    best = max(base, key=lambda r: r["qps_completed"])
+    return {
+        "points": rows,
+        "measured_qps": round(best["qps_completed"], 6),
+        "knee_speed": best["speed"],
+        "saturated": any(not r["sustained"] for r in rows),
+    }
+
+
+def verdict(forecast_qps: float, measured_qps: float,
+            tolerance: float = DEFAULT_TOLERANCE) -> Dict[str, Any]:
+    """Forecast vs measurement: ``rel_err`` is the gated
+    ``capacity_forecast_rel_err``; ``ok`` is False exactly when the
+    measured capacity falls SHORT of the forecast beyond tolerance
+    (beating the forecast is headroom, not a failure — but it still
+    counts toward rel_err, so a wildly conservative model drifts the
+    gate)."""
+    if forecast_qps <= 0:
+        raise ValueError(f"forecast_qps={forecast_qps} must be > 0")
+    rel_err = abs(measured_qps - forecast_qps) / forecast_qps
+    return {
+        "forecast_qps": round(float(forecast_qps), 6),
+        "measured_qps": round(float(measured_qps), 6),
+        "rel_err": round(rel_err, 6),
+        "tolerance": float(tolerance),
+        "ok": measured_qps >= forecast_qps * (1.0 - tolerance),
+    }

@@ -44,11 +44,24 @@ Subcommands (``dtx-obs <cmd> --help`` for flags):
 - ``drift HISTORY`` — change-point detection over the bench history
   (obs/drift.py): names the metric, the window and the FIRST
   offending row; ``--capture`` joins the roofline closed forms; exit
-  3 on confirmed drift (the compare regression convention).
+  3 on confirmed drift (the compare regression convention);
+- ``capture RUN`` — distill a run's span stream (single engine or a
+  fleet parent dir) into a portable WORKLOAD document
+  (obs/workload.py, schema v10): per-request arrival offsets, token
+  counts, deadlines and prompt fingerprints — the input to ``dtx-serve
+  --replay`` and ``capacity``;
+- ``capacity WORKLOAD`` — closed-form capacity forecast
+  (obs/capacity.py): sustainable QPS per replica and required
+  replicas off the workload shape and a service rate;
+  ``--measured-qps`` joins a replayed saturation knee and exits 3
+  when measurement falls short of forecast beyond tolerance.
+
+``tail``/``explain`` take ``--workload WID`` to isolate rows a replay
+stamped with ``replay_of: WID``.
 
 Exit codes: 0 ok; 1 validation failure; 2 bad input (missing files,
-no metrics stream); 3 regression/SLO-breach/fleet-invariant/drift
-verdict (compare, slo, fleet, drift).
+no metrics stream); 3 regression/SLO-breach/fleet-invariant/drift/
+capacity verdict (compare, slo, fleet, drift, capacity).
 """
 
 from __future__ import annotations
@@ -105,6 +118,7 @@ def format_row(row: Dict[str, Any]) -> Optional[str]:
                     f"dur {_fmt(row.get('dur_ms'))}ms")
         bits = [f"[p{proc}] rid {_fmt(row.get('rid'))} {ev}"]
         for key, label in (("reason", ""), ("pages_held", "pages="),
+                           ("replay_of", "replay_of="),
                            ("bucket", "bucket="),
                            ("ttft_ms", "ttft_ms="),
                            ("generated", "generated="),
@@ -243,11 +257,14 @@ def poll_new_lines(path: str, state: Dict[str, tuple]) -> List[str]:
 
 
 def _tail_match(row: Dict[str, Any], rid: Optional[int],
-                trace: Optional[str]) -> bool:
-    """The ``tail --rid/--trace`` filter: span rows about the request
-    (directly, or as a member of a batch row's ``rids``).  With no
-    filter every row passes; with one, non-span rows are noise."""
-    if rid is None and trace is None:
+                trace: Optional[str],
+                workload: Optional[str] = None) -> bool:
+    """The ``tail --rid/--trace/--workload`` filter: span rows about
+    the request (directly, or as a member of a batch row's ``rids``),
+    or — for ``--workload`` — rows a replay stamped with
+    ``replay_of``.  With no filter every row passes; with one,
+    non-span rows are noise."""
+    if rid is None and trace is None and workload is None:
         return True
     if row.get("kind") != "span":
         return False
@@ -255,6 +272,8 @@ def _tail_match(row: Dict[str, Any], rid: Optional[int],
             and rid not in (row.get("rids") or ()):
         return False
     if trace is not None and row.get("trace_id") != trace:
+        return False
+    if workload is not None and row.get("replay_of") != workload:
         return False
     return True
 
@@ -285,7 +304,8 @@ def cmd_tail(args) -> int:
         except OSError:
             pass
         for r in rows:
-            if not _tail_match(r, args.rid, args.trace or None):
+            if not _tail_match(r, args.rid, args.trace or None,
+                               args.workload or None):
                 continue
             line = format_row(r)
             if line is not None:
@@ -305,7 +325,8 @@ def cmd_tail(args) -> int:
                     except ValueError:
                         continue
                     if not isinstance(row, dict) or not _tail_match(
-                            row, args.rid, args.trace or None):
+                            row, args.rid, args.trace or None,
+                            args.workload or None):
                         continue
                     line = format_row(row)
                     if line is not None:
@@ -372,6 +393,9 @@ def _validate_one(path: str) -> List[str]:
             doc = json.load(f)
     except (OSError, ValueError) as e:
         return [f"{path}: unreadable ({e})"]
+    if isinstance(doc, dict) and doc.get("kind") == "workload":
+        # a dtx-obs capture document (schema v10) under any name
+        return schema_lib.validate_workload(doc, where=path)
     if isinstance(doc, dict) and doc.get("kind") == "run_report":
         return schema_lib.validate_run_report(doc, where=path)
     if base == "report.json":
@@ -609,6 +633,11 @@ def cmd_explain(args) -> int:
         print(f"dtx-obs explain: {e}", file=sys.stderr)
         return 2
     span_rows = [r for r in col["rows"] if r.get("kind") == "span"]
+    if args.workload:
+        # only rows a replay stamped with this source workload id —
+        # the A/B surface across replays of one capture
+        span_rows = [r for r in span_rows
+                     if r.get("replay_of") == args.workload]
     if args.fleet:
         q = queueing_report(span_rows)
         if q is None:
@@ -696,6 +725,68 @@ def cmd_drift(args) -> int:
     return 0 if doc["ok"] else 3
 
 
+def cmd_capture(args) -> int:
+    from . import workload as wl_lib
+
+    try:
+        doc = wl_lib.capture(args.run_dir, align=not args.no_align)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"dtx-obs capture: {e}", file=sys.stderr)
+        return 2
+    if args.out:
+        wl_lib.write_workload(doc, args.out)
+        print(f"dtx-obs capture: {doc['workload_id']} "
+              f"({doc['n_requests']} requests over "
+              f"{doc['duration_s']:g}s) -> {args.out}")
+    else:
+        print(json.dumps(doc, indent=None if args.compact else 1,
+                         sort_keys=True))
+    return 0
+
+
+def cmd_capacity(args) -> int:
+    from . import capacity as cap_lib
+    from . import workload as wl_lib
+
+    try:
+        doc = wl_lib.load_workload(args.workload)
+    except (OSError, ValueError) as e:
+        print(f"dtx-obs capacity: {e}", file=sys.stderr)
+        return 2
+    util = (args.utilization if args.utilization is not None
+            else cap_lib.UTILIZATION_TARGET)
+    tol = (args.tolerance if args.tolerance is not None
+           else cap_lib.DEFAULT_TOLERANCE)
+    try:
+        fc = cap_lib.forecast(
+            doc, service_tok_s=args.service_tok_s,
+            utilization_target=util)
+    except ValueError as e:
+        print(f"dtx-obs capacity: {e}", file=sys.stderr)
+        return 2
+    out = dict(fc)
+    rc = 0
+    if args.measured_qps is not None:
+        # the validation loop: a measured saturation knee (replaying
+        # the same workload at increasing --replay_speed) against the
+        # closed-form forecast — exit 3 on the drift convention when
+        # measurement falls short beyond tolerance
+        out["verdict"] = cap_lib.verdict(
+            fc["sustainable_qps"], args.measured_qps,
+            tolerance=tol)
+        if not out["verdict"]["ok"]:
+            rc = 3
+    print(json.dumps(out, indent=None if args.compact else 1,
+                     sort_keys=True))
+    if rc:
+        v = out["verdict"]
+        print(f"dtx-obs capacity: measured {v['measured_qps']:g} qps "
+              f"falls short of forecast {v['forecast_qps']:g} qps "
+              f"beyond tolerance {v['tolerance']:.0%} "
+              f"(rel_err {v['rel_err']:.1%})", file=sys.stderr)
+    return rc
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dtx-obs",
@@ -741,6 +832,10 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--rid", type=int, default=None,
                    help="only span rows about this request id "
                         "(directly or as a batch member)")
+    t.add_argument("--workload", default="",
+                   metavar="WID",
+                   help="only span rows a replay stamped with "
+                        "replay_of WID (dtx-serve --replay)")
     t.add_argument("--trace", default="",
                    metavar="ID",
                    help="only span rows stamped with this trace id")
@@ -862,6 +957,10 @@ def build_parser() -> argparse.ArgumentParser:
     ex.add_argument("--trace", default="",
                     metavar="ID",
                     help="only requests stamped with this trace id")
+    ex.add_argument("--workload", default="",
+                    metavar="WID",
+                    help="only requests a replay stamped with "
+                         "replay_of WID (dtx-serve --replay)")
     ex.add_argument("--fleet", action="store_true",
                     help="queueing analytics (arrival rate, service "
                          "time by bucket, Little's-law check) "
@@ -892,6 +991,45 @@ def build_parser() -> argparse.ArgumentParser:
                          "forms")
     dr.add_argument("--compact", action="store_true")
     dr.set_defaults(fn=cmd_drift)
+
+    ca = sub.add_parser("capture",
+                        help="distill a run's span stream into a "
+                             "portable WORKLOAD document — the input "
+                             "to dtx-serve --replay and capacity")
+    ca.add_argument("run_dir",
+                    help="run dir (or fleet parent of run dirs)")
+    ca.add_argument("-o", "--out", default="",
+                    help="write the workload json here instead of "
+                         "stdout")
+    ca.add_argument("--no-align", action="store_true",
+                    help="skip cross-source clock alignment")
+    ca.add_argument("--compact", action="store_true")
+    ca.set_defaults(fn=cmd_capture)
+
+    cp = sub.add_parser("capacity",
+                        help="closed-form capacity forecast off a "
+                             "captured workload; exit 3 when a "
+                             "--measured-qps knee falls short of "
+                             "forecast beyond tolerance")
+    cp.add_argument("workload", help="a dtx-obs capture json")
+    cp.add_argument("--service-tok-s", type=float, required=True,
+                    dest="service_tok_s",
+                    help="one replica's decode budget in generated "
+                         "tokens/s (a measured unloaded replay rate, "
+                         "or the obs/capacity.py roofline on TPU)")
+    cp.add_argument("--utilization", type=float,
+                    default=None,
+                    help="target utilization the forecast plans to "
+                         "(default 0.8)")
+    cp.add_argument("--measured-qps", type=float, default=None,
+                    dest="measured_qps",
+                    help="the measured saturation knee (replaying at "
+                         "increasing --replay_speed); joins a "
+                         "verdict and arms exit 3")
+    cp.add_argument("--tolerance", type=float, default=None,
+                    help="verdict tolerance (default 0.25)")
+    cp.add_argument("--compact", action="store_true")
+    cp.set_defaults(fn=cmd_capacity)
     return p
 
 

@@ -146,6 +146,18 @@ GATE_METRICS: Dict[str, tuple] = {
     # and re-prefill baked in — the wide 25% A/B default)
     "fleet_completed_frac": ("higher", 0.01),
     "fleet_failover_p99_ms": ("lower", 0.25),
+    # the workload-replay keys (ISSUE 19): bench_workload_replay
+    # captures a seeded run and replays it twice through the real
+    # engine.  replay_determinism_frac is the fraction of requests
+    # whose typed terminal + token content matched pairwise across
+    # the two replays — deterministic by construction (seeded keys,
+    # greedy decode), so 1.0 with the tight 1% gate: any dip means
+    # replay lost its determinism; capacity_forecast_rel_err is the
+    # closed-form sustainable-QPS forecast (obs/capacity.py) against
+    # the measured saturation knee from replaying at increasing
+    # speed — a model-vs-measurement gap, gated at the wide 25%
+    "replay_determinism_frac": ("higher", 0.01),
+    "capacity_forecast_rel_err": ("lower", 0.25),
 }
 
 
@@ -300,6 +312,15 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
         put("fleet_failover_p99_ms",
             doc.get("fleet_failover_p99_ms"))
         return out
+    # bench workload-replay row — keyed on workload_replay_requests,
+    # a row-only key (the final summary carries both gate keys too
+    # and must fall through to its own branch — the serving lesson)
+    if "workload_replay_requests" in doc:
+        put("replay_determinism_frac",
+            doc.get("replay_determinism_frac"))
+        put("capacity_forecast_rel_err",
+            doc.get("capacity_forecast_rel_err"))
+        return out
     if "wall_clock_20ep_s" in doc:              # bench per-config row
         put("wall_s", doc.get("wall_clock_20ep_s"))
         put("examples_per_sec", doc.get("examples_per_sec"))
@@ -353,7 +374,11 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, float]:
                   # the fleet-failover keys (ISSUE 18): analytic
                   # fleet completed fraction + measured failover p99
                   "fleet_completed_frac",
-                  "fleet_failover_p99_ms"):
+                  "fleet_failover_p99_ms",
+                  # the workload-replay keys (ISSUE 19): two-replay
+                  # determinism + capacity forecast vs measured knee
+                  "replay_determinism_frac",
+                  "capacity_forecast_rel_err"):
             put(k, doc.get(k))
         return out
     # last resort: any directly-named gate metrics

@@ -64,8 +64,15 @@ _NUM = (int, float)
 # FLEET_REPORT's "failover" section (per-trace hop chains: every
 # intermediate hop a typed "failed", the last hop the fleet
 # terminal, intermediates excluded from the federated SLO so a
-# failed-over request counts once).
-SCHEMA_VERSION = 9
+# failed-over request counts once);
+# v10 = workload capture/replay: the WORKLOAD document (obs/workload.py
+# distills a span dir into a portable request schedule — arrival
+# offsets, token counts, deadlines, prompt fingerprints), the
+# "fingerprint" submit-span payload (chained prompt-block hashes
+# preserving shared-prefix structure, the prefix-cache input) and the
+# "replay_of" stamp (every row a serving/replay.py run writes names
+# the source workload id, so waterfalls compare A/B across replays).
+SCHEMA_VERSION = 10
 
 
 # field -> allowed types; a tuple including type(None) marks nullable
@@ -250,6 +257,13 @@ SPAN_FIELDS = {
     # fleet serving (v9): the router's route/failover narration names
     # the replica a request was placed on
     "replica": (str,),
+    # workload capture/replay (v10): fingerprint is the chained
+    # prompt-block hash list riding submit (optional — pure-scheduler
+    # streams omit it); replay_of stamps every row a replay run writes
+    # with the source workload id (recorder-level, so the whole
+    # stream is attributable to its workload for A/B waterfalls)
+    "fingerprint": (list,),
+    "replay_of": (str,),
 }
 
 SPAN_REQUIRED = {
@@ -325,8 +339,10 @@ def validate_span_row(row: Dict[str, Any], where: str = "row") -> List[str]:
                 errs.append(f"{where}: unknown phase "
                             f"{row['phase']!r} (known: "
                             f"{sorted(PHASE_SCOPES)})")
-    # the optional trace-context payload (v7) is typed whenever present
-    for f in ("trace_id", "parent_id", "source"):
+    # the optional trace-context payload (v7) and the capture/replay
+    # payloads (v10) are typed whenever present
+    for f in ("trace_id", "parent_id", "source", "fingerprint",
+              "replay_of"):
         if f in row:
             errs += _check(row, {f: SPAN_FIELDS[f]}, where)
     return errs
@@ -623,6 +639,91 @@ def validate_drift_report(doc: Dict[str, Any],
         errs += _check(d, {"metric": (str,), "first_offending": (str,),
                            "shift_frac": _NUM}, f"{where}.drifts[{i}]")
     return errs
+
+
+# The portable workload document obs/workload.py distills from a span
+# dir (dtx-obs capture emits it; serving/replay.py consumes it): the
+# request schedule of a recorded run, re-playable against any engine
+# or fleet.  "requests" entries are WORKLOAD_REQUEST-shaped; arrivals
+# are OFFSETS from the run's first submit (seconds), deadlines are
+# RELATIVE milliseconds (a replay must not inherit the recording's
+# wall clock); "fingerprint" is the chained prompt-block hash list
+# (same prefix ⇔ same leading hashes — the shared-prefix structure
+# ROADMAP item 1's prefix cache keys on); "workload_id" is a content
+# hash over the request schedule, so two captures of identical
+# traffic collide and a replay stream's replay_of stamp is stable.
+WORKLOAD = {
+    "v": (int,),
+    "kind": (str,),          # "workload"
+    "workload_id": (str,),
+    "source": (str,),
+    "generated_t": _NUM,
+    "n_requests": (int,),
+    "duration_s": _NUM,
+    "requests": (list,),
+}
+
+WORKLOAD_REQUEST = {
+    "rid": (int,),
+    "arrival_s": _NUM,
+    "prompt_len": (int,),
+    "max_new_tokens": (int,),
+    "output_tokens": (int, type(None)),
+    "deadline_ms": _NUM + (type(None),),
+    "trace_id": (str, type(None)),
+    "terminal": (str, type(None)),
+    "fingerprint": (list,),
+}
+
+
+def validate_workload(doc: Dict[str, Any],
+                      where: str = "workload") -> List[str]:
+    """Validate a captured workload document (top-level contract +
+    every request entry's shape + the schedule invariants a replay
+    relies on: rids dense from 0 in arrival order, offsets
+    non-negative and non-decreasing)."""
+    if not isinstance(doc, dict):
+        return [f"{where}: not an object"]
+    verrs = _version_errs(doc, "v", where)
+    if verrs:
+        return verrs
+    errs = _check(doc, WORKLOAD, where)
+    if doc.get("kind") != "workload":
+        errs.append(f"{where}: kind is {doc.get('kind')!r}, expected "
+                    f"'workload'")
+    reqs = doc.get("requests")
+    if isinstance(reqs, list):
+        if isinstance(doc.get("n_requests"), int) \
+                and doc["n_requests"] != len(reqs):
+            errs.append(f"{where}: n_requests {doc['n_requests']} != "
+                        f"len(requests) {len(reqs)}")
+        prev = 0.0
+        for i, req in enumerate(reqs):
+            w = f"{where}.requests[{i}]"
+            sub = _check(req, WORKLOAD_REQUEST, w)
+            errs += sub
+            if sub or not isinstance(req, dict):
+                continue
+            if req["rid"] != i:
+                errs.append(f"{w}: rid {req['rid']} != index {i} "
+                            f"(rids are dense in arrival order)")
+            if req["arrival_s"] < prev:
+                errs.append(f"{w}: arrival_s {req['arrival_s']} "
+                            f"decreases (schedule must be sorted)")
+            prev = float(req["arrival_s"])
+            if req["prompt_len"] < 1 or req["max_new_tokens"] < 1:
+                errs.append(f"{w}: prompt_len/max_new_tokens must be "
+                            f">= 1")
+    return errs
+
+
+def validate_workload_file(path: str) -> List[str]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable ({e})"]
+    return validate_workload(doc, where=path)
 
 
 def _check(doc: Dict[str, Any], spec: Dict[str, tuple],

@@ -172,10 +172,15 @@ class SpanRecorder:
 
     def __init__(self, logs_path: str, process_index: int = 0,
                  ring: int = RING_CAPACITY, rotate_bytes: int = 0,
-                 keep: int = 3):
+                 keep: int = 3,
+                 extra: Optional[Dict[str, Any]] = None):
         import threading
 
         os.makedirs(logs_path, exist_ok=True)
+        # constant fields stamped onto EVERY emitted row (event fields
+        # win on collision): serving/replay.py attributes a whole
+        # replay stream to its workload with extra={"replay_of": id}
+        self.extra = dict(extra or {})
         self.process_index = int(process_index)
         self.rotate_bytes = int(rotate_bytes)
         self.keep = max(1, int(keep))
@@ -196,7 +201,7 @@ class SpanRecorder:
                              f"one of {SPAN_EVENTS}")
         row = {"kind": "span", "v": SCHEMA_VERSION, "t": time.time(),
                "proc": self.process_index, "event": event,
-               **_jsonable(fields)}
+               **self.extra, **_jsonable(fields)}
         with self._ring_lock:
             self.ring.append(row)
         if self._f is None:
@@ -374,6 +379,9 @@ def reconstruct(
             r["parent_id"] = row["parent_id"]
         if "source" not in r and isinstance(row.get("source"), str):
             r["source"] = row["source"]
+        if "replay_of" not in r and isinstance(row.get("replay_of"),
+                                               str):
+            r["replay_of"] = row["replay_of"]
         if event in MILESTONES:
             key = f"{event}_t"
             if key in r:
@@ -386,6 +394,9 @@ def reconstruct(
             r["arrival"] = row.get("arrival")
             if row.get("deadline") is not None:
                 r["deadline"] = row.get("deadline")
+            if row.get("fingerprint") is not None:
+                # the v10 prompt-block hashes workload capture reads
+                r["fingerprint"] = row.get("fingerprint")
         elif event == "blocked":
             reason = str(row.get("reason"))
             r["blocked"][reason] = r["blocked"].get(reason, 0) + 1

@@ -1,0 +1,303 @@
+"""Workload capture: span streams -> a portable, replayable schedule.
+
+Every observability layer before this module is read-only after the
+fact: spans narrate what happened, the collector joins it, waterfalls
+and queueing explain it.  This module closes the loop — it distills
+any span dir (a single engine run or a v9 fleet run) into the
+WORKLOAD document (obs/schema.py, v10): per-request arrival offsets,
+prompt/output token counts, deadlines, trace ids and a prompt-content
+fingerprint.  ``serving/replay.py`` feeds that document back through
+the real engine (or the scheduler-only fast path) deterministically,
+so a production incident becomes a reproducible benchmark and
+``obs/capacity.py`` can forecast from recorded traffic shapes.
+
+Fingerprints, not tokens: the span stream never carries prompt
+content (and a portable workload should not either).  The engine
+hashes each FINGERPRINT_BLOCK-token block of the prompt CHAINED on
+the previous block's hash (``prompt_fingerprint``), so two prompts
+share a fingerprint prefix exactly when they share a token prefix —
+the shared-prefix group structure ROADMAP item 1's prefix cache
+keys on survives the round trip.  ``synth_prompt`` regenerates a
+deterministic stand-in prompt from the fingerprint (same hash ->
+same block), so replayed traffic preserves lengths AND sharing
+without ever storing user content.
+
+Clock discipline: arrival offsets come from the submit span's
+``arrival`` field (the engine's monotonic clock — exact within a
+source) calibrated across fleet sources by the collector's
+skew-aligned wall timestamps; deadlines are stored RELATIVE
+(milliseconds from arrival), so a replay never inherits the
+recording's wall clock.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+from . import collector as collector_lib
+from .schema import SCHEMA_VERSION, validate_workload
+from .spans import reconstruct
+
+# prompt tokens per fingerprint block: matches the default KV page
+# size, so one fingerprint entry corresponds to one shareable page
+FINGERPRINT_BLOCK = 16
+
+# hex digits per fingerprint entry (48 bits — collision-safe for any
+# plausible prefix-group population, small enough to ship thousands)
+_FP_HEX = 12
+
+
+def prompt_fingerprint(tokens: Iterable[int],
+                       block: int = FINGERPRINT_BLOCK) -> List[str]:
+    """Chained per-block prompt hash: entry ``i`` digests block ``i``'s
+    tokens AND entry ``i-1``, so fingerprints share a PREFIX exactly
+    when the prompts share a token prefix (equal later blocks after a
+    divergence do not collide back together)."""
+    toks = [int(t) for t in tokens]
+    if block < 1:
+        raise ValueError(f"block={block} must be >= 1")
+    out: List[str] = []
+    prev = b""
+    for i in range(0, len(toks), block):
+        h = hashlib.sha1()
+        h.update(prev)
+        h.update(",".join(str(t) for t in toks[i:i + block]).encode())
+        digest = h.hexdigest()[:_FP_HEX]
+        out.append(digest)
+        prev = digest.encode()
+    return out
+
+
+def synth_prompt(prompt_len: int, fingerprint: Optional[List[str]],
+                 vocab_size: int, seed: int = 0,
+                 rid: int = 0) -> List[int]:
+    """A deterministic stand-in prompt for one workload entry: each
+    fingerprint entry seeds its block's tokens, so equal fingerprint
+    prefixes regenerate equal token prefixes (sharing preserved) and
+    two replays of the same workload submit identical prompts.  A
+    missing fingerprint (pure-scheduler captures) degrades to a
+    (seed, rid)-keyed stream — still replay-deterministic, just
+    without cross-request sharing.  Tokens land in [1, vocab_size)."""
+    if prompt_len < 1:
+        raise ValueError(f"prompt_len={prompt_len} must be >= 1")
+    if vocab_size < 2:
+        raise ValueError(f"vocab_size={vocab_size} must be >= 2")
+    fps = [str(f) for f in (fingerprint or [])]
+    tokens: List[int] = []
+    for b in range(0, prompt_len, FINGERPRINT_BLOCK):
+        i = b // FINGERPRINT_BLOCK
+        if i < len(fps) and fps[i]:
+            key = int(fps[i][:_FP_HEX], 16)
+        else:
+            h = hashlib.sha1(f"{seed}:{rid}:{i}".encode()).hexdigest()
+            key = int(h[:_FP_HEX], 16)
+        rng = random.Random(key)
+        n = min(FINGERPRINT_BLOCK, prompt_len - b)
+        tokens.extend(1 + rng.randrange(vocab_size - 1)
+                      for _ in range(n))
+    return tokens
+
+
+def workload_id(requests: List[Dict[str, Any]]) -> str:
+    """Content hash over the request SCHEDULE (arrivals, shapes,
+    deadlines, fingerprints — not trace ids or outcomes), so two
+    captures of identical traffic collide and the replay stream's
+    ``replay_of`` stamp is stable across re-captures."""
+    canon = [[round(float(r["arrival_s"]), 6), int(r["prompt_len"]),
+              int(r["max_new_tokens"]),
+              (round(float(r["deadline_ms"]), 3)
+               if r.get("deadline_ms") is not None else None),
+              list(r.get("fingerprint") or [])]
+             for r in requests]
+    h = hashlib.sha1(json.dumps(canon,
+                                separators=(",", ":")).encode())
+    return f"wl-{h.hexdigest()[:12]}"
+
+
+def _finish(requests: List[Dict[str, Any]], source: str,
+            t: Optional[float] = None) -> Dict[str, Any]:
+    """Assemble + self-validate the WORKLOAD document from raw request
+    entries (sorted, rids renumbered dense in arrival order)."""
+    requests = sorted(requests,
+                      key=lambda r: (float(r["arrival_s"]),
+                                     int(r.get("rid", 0))))
+    base = min((float(r["arrival_s"]) for r in requests),
+               default=0.0)
+    for i, r in enumerate(requests):
+        r["rid"] = i
+        r["arrival_s"] = round(float(r["arrival_s"]) - base, 6)
+    doc = {
+        "v": SCHEMA_VERSION,
+        "kind": "workload",
+        "workload_id": workload_id(requests),
+        "source": source,
+        "generated_t": time.time() if t is None else t,
+        "n_requests": len(requests),
+        "duration_s": (round(float(requests[-1]["arrival_s"]), 6)
+                       if requests else 0.0),
+        "requests": requests,
+    }
+    errs = validate_workload(doc)
+    if errs:
+        raise ValueError(f"capture produced an invalid workload: "
+                         f"{errs[:5]}")
+    return doc
+
+
+def capture(run_dir: str, align: bool = True) -> Dict[str, Any]:
+    """Distill one run dir's span streams into a WORKLOAD document.
+
+    Accepts a single-engine run dir or a fleet layout (a parent whose
+    children are ``replica<i>``/``router`` run dirs — the collector's
+    discovery).  Failover chains are joined by trace_id: the chain's
+    FIRST hop contributes the arrival/prompt shape (the client's
+    request, submitted once) and the chain's terminal hop the
+    outcome, so a failed-over request captures as ONE entry.  Shed
+    and router-narration records are skipped — a workload is the
+    ACCEPTED schedule.  Raises ValueError when the streams hold no
+    replayable request."""
+    res = collector_lib.collect([run_dir], align=align)
+    recs = reconstruct(res["rows"])
+    lifecycles = [r for r in recs.values()
+                  if r.get("submit_t") is not None
+                  and not r.get("narration")]
+    if not lifecycles:
+        raise ValueError(f"no accepted request lifecycles under "
+                         f"{run_dir!r}")
+    # failover join: one entry per trace chain (untraced records are
+    # their own chain)
+    chains: Dict[Any, List[Dict[str, Any]]] = {}
+    for i, r in enumerate(sorted(lifecycles,
+                                 key=lambda r: r["submit_t"])):
+        key = r.get("trace_id") or ("", r.get("source"), r["proc"],
+                                    r["rid"], i)
+        chains.setdefault(key, []).append(r)
+    # per-source arrival calibration: the engine's monotonic
+    # ``arrival`` field is exact WITHIN a source; across sources the
+    # collector's skew-aligned submit_t wall clock places each
+    # source's earliest submit on the fleet axis
+    per_src: Dict[str, List[Dict[str, Any]]] = {}
+    for chain in chains.values():
+        first = chain[0]
+        per_src.setdefault(str(first.get("source") or ""),
+                           []).append(first)
+    src_offset: Dict[str, float] = {}
+    global_t0 = min(r["submit_t"] for r in lifecycles)
+    for src, firsts in per_src.items():
+        if all(r.get("arrival") is not None for r in firsts):
+            src_offset[src] = (min(r["submit_t"] for r in firsts)
+                               - global_t0
+                               - min(float(r["arrival"])
+                                     for r in firsts))
+        else:
+            src_offset[src] = None  # fall back to wall submit_t
+    requests: List[Dict[str, Any]] = []
+    for chain in chains.values():
+        first = chain[0]
+        last = chain[-1]
+        terminal = next((r["terminal"] for r in chain
+                         if r.get("terminal")
+                         and r["terminal"] != "failed"),
+                        last.get("terminal"))
+        done = next((r for r in reversed(chain)
+                     if r.get("generated") is not None), last)
+        src = str(first.get("source") or "")
+        off = src_offset[src]
+        if off is not None and first.get("arrival") is not None:
+            arrival_s = float(first["arrival"]) + off
+        else:
+            arrival_s = float(first["submit_t"]) - global_t0
+        deadline_ms = None
+        if first.get("deadline") is not None \
+                and first.get("arrival") is not None:
+            deadline_ms = max(
+                0.0, round((float(first["deadline"])
+                            - float(first["arrival"])) * 1e3, 3))
+        if not first.get("prompt_len") \
+                or not first.get("max_new_tokens"):
+            continue
+        requests.append({
+            "rid": 0,  # renumbered by _finish
+            "arrival_s": arrival_s,
+            "prompt_len": int(first["prompt_len"]),
+            "max_new_tokens": int(first["max_new_tokens"]),
+            "output_tokens": (int(done["generated"])
+                              if done.get("generated") is not None
+                              else None),
+            "deadline_ms": deadline_ms,
+            "trace_id": first.get("trace_id"),
+            "terminal": terminal,
+            "fingerprint": list(first.get("fingerprint") or []),
+        })
+    if not requests:
+        raise ValueError(f"no replayable requests under {run_dir!r}")
+    return _finish(requests, source=run_dir)
+
+
+def synthetic_workload(n: int, seed: int = 0, qps: float = 50.0,
+                       mean_prompt: int = 24, mean_new: int = 12,
+                       vocab_size: int = 64,
+                       shared_prefix_frac: float = 0.5,
+                       prefix_len: int = FINGERPRINT_BLOCK,
+                       deadline_ms: Optional[float] = None
+                       ) -> Dict[str, Any]:
+    """A seeded synthetic WORKLOAD (the bench's analytic input and the
+    round-trip tests' fixture): Poisson-ish arrivals at ``qps``,
+    geometric-ish lengths around the means, and a
+    ``shared_prefix_frac`` fraction of requests opening with the SAME
+    ``prefix_len``-token system prompt — the prefix-group structure a
+    capture must preserve."""
+    if n < 1:
+        raise ValueError(f"n={n} must be >= 1")
+    rng = random.Random(seed)
+    prefix = [1 + rng.randrange(vocab_size - 1)
+              for _ in range(prefix_len)]
+    t = 0.0
+    requests: List[Dict[str, Any]] = []
+    for i in range(n):
+        t += rng.expovariate(qps)
+        p = max(1, min(4 * mean_prompt,
+                       int(rng.expovariate(1.0 / mean_prompt)) + 1))
+        m = max(1, min(4 * mean_new,
+                       int(rng.expovariate(1.0 / mean_new)) + 1))
+        if rng.random() < shared_prefix_frac:
+            body = [1 + rng.randrange(vocab_size - 1)
+                    for _ in range(max(1, p))]
+            tokens = prefix + body
+        else:
+            tokens = [1 + rng.randrange(vocab_size - 1)
+                      for _ in range(p)]
+        requests.append({
+            "rid": i,
+            "arrival_s": round(t, 6),
+            "prompt_len": len(tokens),
+            "max_new_tokens": m,
+            "output_tokens": m,
+            "deadline_ms": deadline_ms,
+            "trace_id": None,
+            "terminal": None,
+            "fingerprint": prompt_fingerprint(tokens),
+        })
+    return _finish(requests, source=f"synthetic:seed={seed}", t=0.0)
+
+
+def load_workload(path: str) -> Dict[str, Any]:
+    """Read + validate a workload file; raises ValueError on schema
+    drift (the replay driver and the CLI both refuse bad input loudly
+    instead of replaying garbage)."""
+    with open(path) as f:
+        doc = json.load(f)
+    errs = validate_workload(doc, where=path)
+    if errs:
+        raise ValueError("; ".join(errs[:5]))
+    return doc
+
+
+def write_workload(doc: Dict[str, Any], path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")

@@ -35,12 +35,20 @@ span streams land under ``<logs>/replica<i>`` and the router's
 route/failover narration under ``<logs>/router`` so ``dtx-obs
 fleet`` joins the whole story.  SIGTERM drains: stop admitting,
 finish in-flight, typed-shed the queue.
+
+Replay mode: ``--replay workload.json`` (a ``dtx-obs capture``
+WORKLOAD) feeds the recorded request schedule back through the
+engine — or the ``--replicas N`` fleet — at the recorded arrival
+offsets (``--replay_speed`` compresses time) and prints the replay
+report instead of serving HTTP; every span the run writes carries
+``replay_of: <workload_id>`` (serving/replay.py).
 """
 
 from __future__ import annotations
 
 import re
 import sys
+import time
 from typing import Optional, Sequence
 
 
@@ -202,11 +210,95 @@ def _main_fleet(cfg, spec, params, slos, brownout) -> int:
     return 0
 
 
+def _main_replay(cfg, spec, params, slos, brownout) -> int:
+    """``--replay workload.json``: instead of serving HTTP, feed the
+    captured WORKLOAD (dtx-obs capture) back through the engine — or
+    the ``--replicas N`` router fleet — at the recorded (or
+    ``--replay_speed``-scaled) arrival offsets and print the replay
+    report (serving/replay.py).  With ``--trace_spans`` every emitted
+    row carries ``replay_of: <workload_id>``, so ``dtx-obs tail
+    --workload`` isolates this run's waterfalls.  Exit 0 when every
+    request reached a typed terminal, 1 when any wedged."""
+    import json
+    import os
+
+    from ..obs.workload import load_workload
+    from . import replay as replay_lib
+    from .engine import DecodeEngine
+
+    try:
+        doc = load_workload(cfg.replay)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"dtx-serve: --replay: {e}", file=sys.stderr)
+        return 2
+    wid = doc["workload_id"]
+    recorders = []
+
+    def _recorder(sub=""):
+        if not cfg.trace_spans:
+            return None
+        rec = replay_lib.replay_recorder(
+            os.path.join(cfg.logs_path, sub) if sub else cfg.logs_path,
+            wid,
+            rotate_bytes=int(cfg.span_rotate_mb * 1024 * 1024),
+            keep=cfg.span_keep)
+        recorders.append(rec)
+        return rec
+
+    engines = []
+    for i in range(cfg.replicas):
+        engines.append(DecodeEngine(
+            spec, params, page_size=cfg.decode_page_size,
+            num_pages=cfg.decode_pages,
+            max_batch=cfg.decode_max_batch,
+            seed=cfg.seed, kv_quant=cfg.kv_quant,
+            recorder=_recorder(f"replica{i}" if cfg.replicas > 1
+                               else ""),
+            max_queue=cfg.max_queue, deadline_ms=cfg.deadline_ms,
+            engine_retries=cfg.engine_retries, brownout=brownout,
+            slos=slos))
+        engines[-1].start()
+    if cfg.replicas > 1:
+        from .health import parse_breaker
+        from .router import Router
+
+        target = Router(engines, fleet_retries=cfg.fleet_retries,
+                        breaker=parse_breaker(cfg.breaker or "on"),
+                        recorder=_recorder("router"))
+    else:
+        target = engines[0]
+    print(f"dtx-serve: replaying {wid} ({doc['n_requests']} requests "
+          f"over {doc['duration_s']:g}s recorded) at "
+          f"x{cfg.replay_speed:g}"
+          + (f" across {cfg.replicas} replicas"
+             if cfg.replicas > 1 else ""), file=sys.stderr)
+    try:
+        report = replay_lib.replay_engine(
+            target, doc, vocab_size=cfg.vocab_size,
+            speed=cfg.replay_speed, seed=cfg.seed)
+    finally:
+        # let each engine reach its final tick boundary before stop()
+        # so the last retire span lands (the result() that unblocked
+        # the replay returns one plan_tick before the retire row)
+        deadline = time.monotonic() + 10.0
+        for e in engines:
+            while time.monotonic() < deadline:
+                if not e.sched.live and not e.sched.waiting:
+                    time.sleep(0.05)
+                    break
+                time.sleep(0.02)
+            e.stop()
+        for rec in recorders:
+            rec.close()
+    print(json.dumps(report, indent=1, sort_keys=True))
+    return 0 if not report["terminals"].get("wedged") else 1
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     from .. import config as config_lib
 
     cfg = config_lib.parse_config(argv)
-    if cfg.serve_port <= 0:
+    if cfg.serve_port <= 0 and not cfg.replay:
         print("dtx-serve: --serve_port is required (> 0)",
               file=sys.stderr)
         return 2
@@ -239,12 +331,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if cfg.checkpoint_dir:
         params, path = _params_from_checkpoint(
             cfg.checkpoint_dir, tfm.param_shapes(spec))
-        print(f"dtx-serve: params restored from {path}")
+        # stderr so --replay's stdout is exactly the report JSON
+        print(f"dtx-serve: params restored from {path}",
+              file=sys.stderr)
         params = {k: jax.numpy.asarray(v) for k, v in params.items()}
     else:
         print("dtx-serve: no --checkpoint_dir — serving a seeded "
-              "random init (demo mode)")
+              "random init (demo mode)", file=sys.stderr)
         params = tfm.init(jax.random.PRNGKey(cfg.seed), spec)
+
+    if cfg.replay:
+        return _main_replay(cfg, spec, params, slos, brownout)
 
     if cfg.replicas > 1:
         return _main_fleet(cfg, spec, params, slos, brownout)

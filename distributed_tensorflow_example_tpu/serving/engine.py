@@ -245,7 +245,8 @@ class DecodeEngine:
                temperature: float = 0.0,
                deadline_ms: Optional[float] = None,
                traceparent: Optional[str] = None,
-               attempts: int = 0) -> int:
+               attempts: int = 0,
+               fingerprint: Optional[list] = None) -> int:
         """Queue a request (``prompt``: iterable of int token ids);
         returns its rid.  Thread-safe; the background loop (or the
         next ``step()``) picks it up.  ``deadline_ms`` bounds the
@@ -317,9 +318,20 @@ class DecodeEngine:
             # the scheduler may reject (page need > pool): allocate the
             # rid only on acceptance so requests_total counts accepted
             # requests, not attempts
+            # the prompt-block fingerprint (v10) rides the submit span
+            # so workload capture preserves shared-prefix structure
+            # without storing content; a replay passes the RECORDED
+            # fingerprint through verbatim (its stand-in tokens would
+            # hash differently), keeping capture→replay→capture
+            # idempotent
+            if fingerprint is None and self.recorder is not None:
+                from ..obs.workload import prompt_fingerprint
+
+                fingerprint = prompt_fingerprint(prompt)
             self.sched.submit(rid, len(prompt), int(max_new_tokens),
                               arrival=now, deadline=deadline,
-                              trace_id=trace_id, parent_id=parent_id)
+                              trace_id=trace_id, parent_id=parent_id,
+                              fingerprint=fingerprint)
             if attempts:
                 # a failed-over request arrives mid-ledger: the seq
                 # carries the cumulative count (requeue/failed spans

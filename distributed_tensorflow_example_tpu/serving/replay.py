@@ -1,0 +1,273 @@
+"""Deterministic open-loop replay of a captured workload.
+
+The write-back half of observability (ISSUE 19): ``obs/workload.py``
+distills a span dir into a WORKLOAD document; this module feeds that
+document back through the serving stack at the recorded (or
+``--speed``-scaled) arrival offsets, so a production traffic shape
+becomes a reproducible benchmark.  Two paths share one schedule:
+
+- **scheduler-only fast path** (``replay_sim``): pure Python through
+  the REAL ``ContinuousScheduler.simulate`` (reused, not forked) on
+  the ticks-as-seconds clock — one tick boundary per workload second
+  at speed 1, so arrival offsets and relative deadlines round-trip
+  through capture unchanged.  No jax, no wall clock: the
+  capture→replay→capture idempotence property is provable in tier-1
+  on any backend;
+- **real-engine path** (``replay_engine``): an open-loop driver over
+  a live ``DecodeEngine`` (or the r18 router fleet — anything with
+  ``submit``/``result``).  Prompts are regenerated from the recorded
+  fingerprints (``obs/workload.synth_prompt``: same hash -> same
+  block, so shared prefixes stay shared and two replays submit
+  IDENTICAL prompts), submits fire at ``arrival_s / speed`` on an
+  injectable clock (the serving/faults.py discipline: tests drive
+  virtual time, production sleeps), and relative deadlines scale
+  with speed.  With greedy decode (the default) the engine's seeded
+  keys make two replays of one workload produce identical typed
+  terminals, token counts and span shapes — timestamps aside —
+  which ``identity()`` verifies and ``bench_workload_replay`` gates.
+
+Span attribution: build the engine's recorder with
+``replay_recorder(...)`` and every row the replay writes carries
+``replay_of: <workload_id>`` (schema v10), so ``dtx-obs tail/explain
+--workload`` can compare waterfalls A/B across replays.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..obs import spans as spans_lib
+from ..obs.workload import synth_prompt
+from . import scheduler as sched_lib
+from .admission import ShedError
+
+# how long ``replay_engine`` waits on each straggler result after the
+# last submit before declaring the replay wedged
+RESULT_TIMEOUT_S = 120.0
+
+# polling granularity of the open-loop wait (real clock only; a
+# virtual clock's sleep() advances time instead)
+_WAIT_SLICE_S = 0.02
+
+
+def replay_recorder(logs_path: str, workload_id: str,
+                    process_index: int = 0,
+                    **kw) -> spans_lib.SpanRecorder:
+    """A SpanRecorder whose every row is stamped ``replay_of`` — give
+    this to the engine/router under replay so the whole stream is
+    attributable to its source workload."""
+    return spans_lib.SpanRecorder(
+        logs_path, process_index=process_index,
+        extra={"replay_of": str(workload_id)}, **kw)
+
+
+def _schedule(doc: Dict[str, Any], speed: float) -> List[Dict[str, Any]]:
+    if speed <= 0:
+        raise ValueError(f"speed={speed} must be > 0")
+    return sorted(doc["requests"], key=lambda r: (float(r["arrival_s"]),
+                                                  int(r["rid"])))
+
+
+def replay_sim(doc: Dict[str, Any], num_pages: int = 65,
+               page_size: int = 16, max_batch: int = 8,
+               speed: float = 1.0,
+               recorder=None) -> Dict[str, Any]:
+    """Scheduler-only replay: the workload schedule through the real
+    ``ContinuousScheduler`` + ``simulate`` on the ticks-as-seconds
+    clock (arrival tick = ``arrival_s / speed``; a relative deadline
+    becomes an absolute tick the same way).  Deterministic by
+    construction — same workload, same pool shape => identical
+    SimResult — and with a recorder attached the emitted span stream
+    re-captures to the SAME workload (fingerprints pass through
+    verbatim), which is the round-trip property the tests pin."""
+    sched = sched_lib.ContinuousScheduler(
+        num_pages=num_pages, page_size=page_size, max_batch=max_batch,
+        recorder=recorder)
+    requests = []
+    for r in _schedule(doc, speed):
+        arrival = float(r["arrival_s"]) / speed
+        if r.get("deadline_ms") is not None:
+            deadline = arrival + float(r["deadline_ms"]) / 1e3 / speed
+            requests.append((int(r["rid"]), int(r["prompt_len"]),
+                             int(r["max_new_tokens"]), arrival,
+                             deadline))
+        else:
+            requests.append((int(r["rid"]), int(r["prompt_len"]),
+                             int(r["max_new_tokens"]), arrival))
+    # fingerprints ride the submit spans verbatim (content-free
+    # idempotence): simulate() calls scheduler.submit(*req), which
+    # takes fingerprint as its trailing keyword — append it only when
+    # the entry recorded one
+    with_fp = []
+    by_rid = {int(r["rid"]): r for r in doc["requests"]}
+    for req in requests:
+        fp = by_rid[req[0]].get("fingerprint") or None
+        if fp and len(req) == 4:
+            req = req + (None,)       # explicit no-deadline slot
+        with_fp.append(req + (None, None, fp) if fp else req)
+    sim = sched_lib.simulate(sched, with_fp)
+    per_request = []
+    terminals: Dict[str, int] = {}
+    for r in doc["requests"]:
+        rid = int(r["rid"])
+        if rid in sim.finish_ticks:
+            term, toks = "result", int(r["max_new_tokens"])
+        else:
+            term, toks = "timeout", None
+        terminals[term] = terminals.get(term, 0) + 1
+        per_request.append({"rid": rid, "terminal": term,
+                            "tokens": toks, "token_sig": None,
+                            "latency": sim.latency_ticks.get(rid)})
+    return {
+        "kind": "replay_report",
+        "mode": "sim",
+        "workload_id": doc["workload_id"],
+        "speed": float(speed),
+        "n_requests": int(doc["n_requests"]),
+        "terminals": terminals,
+        "completed": terminals.get("result", 0),
+        "decode_ticks": sim.decode_ticks,
+        "total_ticks": sim.total_ticks,
+        "occupancy": round(sim.occupancy, 6),
+        "shapes": [list(s) for s in sim.shapes],
+        "per_request": per_request,
+    }
+
+
+def _submit(target, prompt: List[int], max_new: int,
+            temperature: float, deadline_ms: Optional[float],
+            fingerprint: Optional[List[str]]) -> int:
+    """Submit to an engine OR a router: the engine takes the recorded
+    fingerprint through; the router's surface doesn't (its replicas'
+    engines re-derive one from the regenerated prompt)."""
+    kw: Dict[str, Any] = {"temperature": temperature}
+    if deadline_ms is not None:
+        kw["deadline_ms"] = deadline_ms
+    try:
+        return target.submit(prompt, max_new, fingerprint=fingerprint,
+                             **kw)
+    except TypeError:
+        return target.submit(prompt, max_new, **kw)
+
+
+def replay_engine(target, doc: Dict[str, Any], vocab_size: int,
+                  speed: float = 1.0, temperature: float = 0.0,
+                  seed: int = 0,
+                  clock: Callable[[], float] = time.monotonic,
+                  sleep: Callable[[float], None] = time.sleep,
+                  result_timeout_s: float = RESULT_TIMEOUT_S
+                  ) -> Dict[str, Any]:
+    """Open-loop replay through a live engine/router ``target`` (its
+    background loop must be running).  Submits fire at
+    ``arrival_s / speed`` on the injectable ``clock`` (virtual-time
+    tests pass a fake clock whose ``sleep`` advances it — the
+    serving/faults.py discipline); deadlines scale by ``1/speed``.
+    Returns the replay report: typed-terminal multiset, per-request
+    token counts + content signatures, wall/throughput accounting."""
+    entries = _schedule(doc, speed)
+    start = clock()
+    rids: Dict[int, int] = {}
+    shed: Dict[int, str] = {}
+    for r in entries:
+        due = float(r["arrival_s"]) / speed
+        while True:
+            now = clock() - start
+            if now >= due:
+                break
+            sleep(min(due - now, _WAIT_SLICE_S))
+        prompt = synth_prompt(int(r["prompt_len"]),
+                              r.get("fingerprint"), vocab_size,
+                              seed=seed, rid=int(r["rid"]))
+        deadline_ms = (float(r["deadline_ms"]) / speed
+                       if r.get("deadline_ms") is not None else None)
+        try:
+            rids[int(r["rid"])] = _submit(
+                target, prompt, int(r["max_new_tokens"]), temperature,
+                deadline_ms, r.get("fingerprint") or None)
+        except ShedError as e:
+            shed[int(r["rid"])] = str(e)
+    per_request = []
+    terminals: Dict[str, int] = {}
+    tokens_total = 0
+    for r in doc["requests"]:
+        rid = int(r["rid"])
+        if rid in shed:
+            entry = {"rid": rid, "terminal": "shed", "tokens": None,
+                     "token_sig": None}
+        else:
+            res = target.result(rids[rid], timeout=result_timeout_s)
+            if res is None:
+                entry = {"rid": rid, "terminal": "wedged",
+                         "tokens": None, "token_sig": None}
+            else:
+                status = res.get("status")
+                term = {"result": "result", "timeout": "timeout",
+                        "shed": "shed"}.get(status, "failed")
+                toks = res.get("tokens")
+                sig = None
+                if toks is not None:
+                    sig = hashlib.sha1(
+                        ",".join(str(t) for t in toks).encode()
+                    ).hexdigest()[:16]
+                    tokens_total += len(toks)
+                entry = {"rid": rid, "terminal": term,
+                         "tokens": (len(toks) if toks is not None
+                                    else None),
+                         "token_sig": sig}
+        terminals[entry["terminal"]] = \
+            terminals.get(entry["terminal"], 0) + 1
+        per_request.append(entry)
+    wall_s = max(clock() - start, 1e-9)
+    dur = max(float(doc.get("duration_s") or 0.0) / speed, 1e-9)
+    return {
+        "kind": "replay_report",
+        "mode": "engine",
+        "workload_id": doc["workload_id"],
+        "speed": float(speed),
+        "n_requests": int(doc["n_requests"]),
+        "terminals": terminals,
+        "completed": terminals.get("result", 0),
+        "tokens_total": tokens_total,
+        "wall_s": round(wall_s, 6),
+        "qps_offered": round(doc["n_requests"] / dur, 6),
+        "qps_completed": round(terminals.get("result", 0) / wall_s, 6),
+        "per_request": per_request,
+    }
+
+
+def identity(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+    """The determinism verdict over two replay reports of the SAME
+    workload: per-request typed terminals and token content must
+    match pairwise.  ``determinism_frac`` is the matching fraction —
+    the ``replay_determinism_frac`` gate metric; ``identical`` also
+    requires the terminal MULTISETS to agree (a swap that preserves
+    counts per-request would already fail pairwise, so this is the
+    belt to that suspender)."""
+    if a.get("workload_id") != b.get("workload_id"):
+        raise ValueError(
+            f"replay reports of different workloads: "
+            f"{a.get('workload_id')} vs {b.get('workload_id')}")
+    pa = {r["rid"]: r for r in a.get("per_request", [])}
+    pb = {r["rid"]: r for r in b.get("per_request", [])}
+    rids = sorted(set(pa) | set(pb))
+    mismatches = []
+    matched = 0
+    for rid in rids:
+        ra, rb = pa.get(rid), pb.get(rid)
+        if (ra is not None and rb is not None
+                and ra["terminal"] == rb["terminal"]
+                and ra.get("tokens") == rb.get("tokens")
+                and ra.get("token_sig") == rb.get("token_sig")):
+            matched += 1
+        else:
+            mismatches.append({"rid": rid, "a": ra, "b": rb})
+    frac = matched / max(len(rids), 1)
+    return {
+        "identical": (not mismatches
+                      and a.get("terminals") == b.get("terminals")),
+        "determinism_frac": round(frac, 6),
+        "n_requests": len(rids),
+        "mismatches": mismatches[:10],
+    }

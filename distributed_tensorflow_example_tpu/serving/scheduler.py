@@ -237,7 +237,8 @@ class ContinuousScheduler:
                arrival: float = 0.0,
                deadline: Optional[float] = None,
                trace_id: Optional[str] = None,
-               parent_id: Optional[str] = None) -> None:
+               parent_id: Optional[str] = None,
+               fingerprint: Optional[List[str]] = None) -> None:
         if prompt_len < 1 or max_new_tokens < 1:
             raise ValueError("prompt_len and max_new_tokens must be "
                              ">= 1")
@@ -259,6 +260,10 @@ class ContinuousScheduler:
             extra["trace_id"] = str(trace_id)
         if parent_id is not None:
             extra["parent_id"] = str(parent_id)
+        if fingerprint:
+            # prompt-block hashes (v10): workload capture reads these
+            # off the submit span — the scheduler stays content-free
+            extra["fingerprint"] = [str(f) for f in fingerprint]
         self._emit("submit", rid=rid, prompt_len=int(prompt_len),
                    max_new_tokens=int(max_new_tokens),
                    arrival=float(arrival), **extra)

@@ -1,0 +1,83 @@
+#!/usr/bin/env bash
+# Workload time machine — the ISSUE 19 loop, runnable standalone.
+# Captures a WORKLOAD from a span run dir (or fabricates a synthetic
+# one when no dir is given), replays it TWICE through the real decode
+# engine via `dtx-serve --replay`, asserts the two replay reports are
+# identical (serving/replay.identity — typed terminals + token
+# counts), then folds the capacity verdict over the measured replay
+# throughput with `dtx-obs capacity` (exit 3 = measured short of the
+# closed-form forecast). Usage:
+#
+#   scripts/replay.sh [RUN_DIR] [SPEED]
+#
+# RUN_DIR: a span dir to capture (default: synthesize a workload).
+# SPEED:   replay time compression (default 25 — CI-friendly).
+cd "$(dirname "$0")/.." || exit 1
+set -o pipefail
+
+RUN_DIR="${1:-}"
+SPEED="${2:-25}"
+WORK="$(mktemp -d /tmp/dtx_replay.XXXXXX)" || exit 1
+WL="$WORK/workload.json"
+
+if [ -n "$RUN_DIR" ]; then
+  echo "replay: capturing $RUN_DIR -> $WL"
+  env JAX_PLATFORMS=cpu python -m distributed_tensorflow_example_tpu.obs.cli \
+      capture "$RUN_DIR" -o "$WL" || exit $?
+else
+  echo "replay: no run dir given — synthesizing a workload"
+  env JAX_PLATFORMS=cpu python - "$WL" <<'EOF' || exit $?
+import sys
+from distributed_tensorflow_example_tpu.obs import workload as wl
+doc = wl.synthetic_workload(8, seed=0, qps=4.0, mean_prompt=12,
+                            mean_new=6, vocab_size=64)
+wl.write_workload(doc, sys.argv[1])
+print("replay: synthesized", doc["workload_id"])
+EOF
+fi
+
+replay_once() {  # $1 = output report path, $2 = logs subdir
+  env JAX_PLATFORMS=cpu python -m distributed_tensorflow_example_tpu.serving.cli \
+      --model=transformer --objective=lm --seq_len=128 --vocab_size=64 \
+      --d_model=64 --n_heads=4 --num_blocks=2 --d_ff=128 --causal \
+      --decode_pages=65 --decode_page_size=16 --decode_max_batch=4 \
+      --seed=0 --logs_path="$WORK/$2" --trace_spans \
+      --replay "$WL" --replay_speed "$SPEED" > "$1"
+}
+
+echo "replay: run 1/2 (speed=$SPEED)"
+replay_once "$WORK/rep_a.json" runA || exit $?
+echo "replay: run 2/2 (speed=$SPEED)"
+replay_once "$WORK/rep_b.json" runB || exit $?
+
+env JAX_PLATFORMS=cpu python - "$WORK" "$WL" <<'EOF' || exit $?
+import json, sys
+from distributed_tensorflow_example_tpu.serving import replay as rp
+from distributed_tensorflow_example_tpu.obs import collector
+work, wlpath = sys.argv[1], sys.argv[2]
+a = json.load(open(work + "/rep_a.json"))
+b = json.load(open(work + "/rep_b.json"))
+ident = rp.identity(a, b)
+print("replay: identity", json.dumps(ident, sort_keys=True))
+if not ident["identical"]:
+    sys.exit(1)
+for sub in ("runA", "runB"):
+    fr = collector.fleet_report([work + "/" + sub])
+    if not fr["exactly_once"]:
+        print("replay: exactly-once violated in", sub, file=sys.stderr)
+        sys.exit(1)
+print("replay: exactly-once holds for both runs")
+# Measured throughput off run A feeds the capacity verdict.
+tok_s = a["tokens_total"] / a["wall_s"] if a.get("wall_s") else 0.0
+json.dump({"service_tok_s": tok_s, "measured_qps": a.get("qps_completed", 0.0)},
+          open(work + "/measured.json", "w"))
+EOF
+
+MEAS="$WORK/measured.json"
+TOK_S=$(python -c "import json,sys; print(json.load(open('$MEAS'))['service_tok_s'])")
+QPS=$(python -c "import json,sys; print(json.load(open('$MEAS'))['measured_qps'])")
+echo "replay: capacity verdict (service_tok_s=$TOK_S measured_qps=$QPS)"
+env JAX_PLATFORMS=cpu python -m distributed_tensorflow_example_tpu.obs.cli \
+    capacity "$WL" --service-tok-s "$TOK_S" --utilization 1.0 \
+    --measured-qps "$QPS" --compact || exit $?
+echo "replay: OK"

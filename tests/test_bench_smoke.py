@@ -144,6 +144,22 @@ def _stub_rows(monkeypatch):
                           "terminates_typed": True,
                           "fleet_failover_p99_ms": 3264.91,
                           "fleet_beats_routerless": True})
+    # the workload-replay row (r19) runs on EVERY backend: the
+    # two-replay determinism fraction + the capacity forecast gap are
+    # the gated evidence and must reach the final line gate-named
+    monkeypatch.setattr(
+        bench, "bench_workload_replay",
+        lambda *a, **kw: {"config": "workload_replay",
+                          "workload_replay_requests": 16,
+                          "workload_id": "wl-stubstubstub",
+                          "replay_identical": True,
+                          "replay_determinism_frac": 1.0,
+                          "capacity_forecast_qps": 0.34,
+                          "capacity_measured_qps": 0.3402,
+                          "capacity_forecast_rel_err": 0.000588,
+                          "capacity_knee_speed": 8.0,
+                          "capacity_required_replicas": 3,
+                          "terminates_typed": True})
     # the span-overhead row (r16) runs on EVERY backend: the
     # interleaved spans-on/off ratio is the gated evidence that
     # tracing is effectively free and must reach the final line
@@ -287,6 +303,12 @@ def test_bench_main_cpu_stubbed(monkeypatch, capsys):
     assert final["fleet_completed_frac"] == 0.916667
     assert final["fleet_failover_p99_ms"] == 3264.91
     assert final["fleet_beats_routerless"] is True
+    # the r19 workload-replay carriage (every backend): two-replay
+    # determinism + the capacity forecast gap, gate-named, plus the
+    # identity verdict bit
+    assert final["replay_determinism_frac"] == 1.0
+    assert final["capacity_forecast_rel_err"] == 0.000588
+    assert final["replay_identical"] is True
     assert final["serving_continuous_beats_static"] is True
     # the r10 multi-site carriage (every backend): the analytic H=8
     # comm bytes/token + reductions + the measured final-cost A/B

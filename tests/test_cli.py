@@ -156,6 +156,20 @@ def test_failopen_serving_validation_matrix():
     bad("fleet_retries", fleet_retries=-1)
     bad("breaker", breaker="bogus=1")
     bad("breaker", breaker="failures=x")
+    # r19 replay knobs: speed must be positive (1.0 = recorded pace)
+    ok(replay="/tmp/wl.json", replay_speed=4.0)
+    bad("replay_speed", replay_speed=0.0)
+    bad("replay_speed", replay_speed=-2.0)
+
+
+def test_replay_serving_flags():
+    """r19 replay knobs parse onto their Config fields; --replay lifts
+    the serve_port requirement (dtx-serve runs open-loop, no HTTP)."""
+    cfg = parse_config(["--replay=/tmp/wl.json", "--replay_speed=8"])
+    assert cfg.replay == "/tmp/wl.json"
+    assert cfg.replay_speed == 8.0
+    d = parse_config([])
+    assert d.replay == "" and d.replay_speed == 1.0
 
 
 def test_fleet_serving_flags():

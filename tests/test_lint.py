@@ -221,6 +221,45 @@ def test_schema_drift_gate_metrics(tmp_path):
     assert "'gone_ms'" in found[0].msg
 
 
+def test_schema_version_bump_undocumented(tmp_path):
+    # ISSUE 19 co-touch contract: a SCHEMA_VERSION bump whose tag
+    # appears neither in the schema's own history comment, nor in
+    # docs/observability.md, nor in the CONTRACT_WRITERS module (the
+    # real rules_contracts.py narrates v10, not v3) fires all three
+    # sides
+    files = {
+        "obs/schema.py": """
+            SCHEMA_VERSION = 3
+            METRICS_COMMON = {"v": (int,)}
+        """,
+    }
+    root_files = {"docs/observability.md": "# obs\n\nnothing versioned\n"}
+    found, _ = lint(tmp_path, files, root_files, rules=["schema-drift"])
+    msgs = [f.msg for f in found]
+    assert any("history comment never mentions v3" in m for m in msgs)
+    assert any("docs/observability.md never mentions v3" in m
+               for m in msgs)
+    assert any("CONTRACT_WRITERS was never revisited for v3" in m
+               for m in msgs)
+
+
+def test_schema_version_bump_documented(tmp_path):
+    # the good side: history comment + observability.md both narrate
+    # the tag (and the real rules_contracts.py already mentions v10)
+    files = {
+        "obs/schema.py": """
+            SCHEMA_VERSION = 10
+            # v10 = WORKLOAD capture/replay documents, fingerprint +
+            # replay_of span fields
+            METRICS_COMMON = {"v": (int,)}
+        """,
+    }
+    root_files = {
+        "docs/observability.md": "# obs\n\nschema v10 adds workloads\n"}
+    found, _ = lint(tmp_path, files, root_files, rules=["schema-drift"])
+    assert found == []
+
+
 # ---------------------------------------------------------------- rule 4
 
 VJP_BAD = """

@@ -540,7 +540,7 @@ def test_phase_span_contract_v7():
         PHASE_SCOPES,
     )
 
-    assert schema_lib.SCHEMA_VERSION == 9  # v9: route/failover/fleet join
+    assert schema_lib.SCHEMA_VERSION == 10  # v10: workload capture/replay
     assert "phase" in SPAN_EVENTS
     assert PHASE_SCOPES == ("round", "outer_sync", "ckpt")
     tid = "ab" * 16

@@ -1,0 +1,375 @@
+"""Workload time machine (ISSUE 19): capture -> replay -> capacity.
+
+Three coupled invariants under test:
+
+- **Capture** is content-free but structure-preserving: the chained
+  prompt fingerprints keep shared-prefix group structure (two prompts
+  sharing a prefix share the leading digests) without retaining any
+  prompt text, and ``synth_prompt`` deterministically regenerates
+  replayable tokens from them.
+- **Replay** is deterministic end to end: the scheduler-only
+  ``replay_sim`` round-trips (replaying a workload under a recorder
+  and re-capturing the emitted span stream yields the SAME workload
+  id), and two seeded replays through the REAL decode engine produce
+  identical typed terminals + token content with the collector's
+  exactly-once join holding — the acceptance invariant.
+- **Capacity** is closed-form exact: a hand-built workload reproduces
+  ``sustainable_qps`` to float precision, and the ``dtx-obs
+  capacity`` exit codes (0 clean / 2 bad input / 3 measured short of
+  forecast) are pinned.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_tensorflow_example_tpu.obs import capacity as cap_lib
+from distributed_tensorflow_example_tpu.obs import cli as obs_cli
+from distributed_tensorflow_example_tpu.obs import schema as schema_lib
+from distributed_tensorflow_example_tpu.obs import workload as wl
+from distributed_tensorflow_example_tpu.serving import replay as rp
+
+
+# ---------------------------------------------------------------- fingerprints
+
+
+def test_fingerprint_preserves_prefix_group_structure():
+    base = list(range(1, 40))
+    fork = base[:wl.FINGERPRINT_BLOCK] + [63] * 20
+    fa = wl.prompt_fingerprint(base)
+    fb = wl.prompt_fingerprint(fork)
+    # shared 16-token prefix => shared leading digest; divergent tails
+    # diverge from the second block on
+    assert fa[0] == fb[0]
+    assert fa[1] != fb[1]
+    # the chain means a changed FIRST token rewrites EVERY digest
+    fc = wl.prompt_fingerprint([2] + base[1:])
+    assert all(x != y for x, y in zip(fa, fc))
+    # block math: ceil(len / block) digests
+    assert len(fa) == (len(base) + wl.FINGERPRINT_BLOCK - 1) \
+        // wl.FINGERPRINT_BLOCK
+
+
+def test_synth_prompt_deterministic_and_prefix_shared():
+    fp = wl.prompt_fingerprint(list(range(1, 40)))
+    a = wl.synth_prompt(39, fp, vocab_size=64)
+    b = wl.synth_prompt(39, fp, vocab_size=64)
+    assert a == b
+    assert len(a) == 39
+    assert all(1 <= t < 64 for t in a)
+    # two requests whose fingerprints share a leading digest get
+    # token-identical leading blocks (the prefix-cache-relevant
+    # structure survives regeneration)
+    fp2 = list(fp)
+    fp2[-1] = "0" * len(fp2[-1])
+    c = wl.synth_prompt(39, fp2, vocab_size=64)
+    assert a[:wl.FINGERPRINT_BLOCK] == c[:wl.FINGERPRINT_BLOCK]
+    assert a != c
+    # no fingerprint at all still yields a deterministic seeded prompt
+    d = wl.synth_prompt(7, None, vocab_size=64, seed=1, rid=3)
+    assert d == wl.synth_prompt(7, None, vocab_size=64, seed=1, rid=3)
+    assert d != wl.synth_prompt(7, None, vocab_size=64, seed=1, rid=4)
+
+
+# ---------------------------------------------------------------- contract
+
+
+def test_synthetic_workload_validates_and_is_seeded():
+    doc = wl.synthetic_workload(12, seed=0, qps=4.0)
+    assert schema_lib.validate_workload(doc) == []
+    assert doc["n_requests"] == 12
+    assert len(doc["requests"]) == 12
+    # arrival offsets are base-min normalized and sorted
+    offs = [r["arrival_s"] for r in doc["requests"]]
+    assert offs[0] == 0.0 and offs == sorted(offs)
+    # seeded: same seed reproduces the id, another seed moves it
+    assert wl.synthetic_workload(12, seed=0, qps=4.0)["workload_id"] \
+        == doc["workload_id"]
+    assert wl.synthetic_workload(12, seed=1, qps=4.0)["workload_id"] \
+        != doc["workload_id"]
+
+
+def test_synthetic_workload_shared_prefix_groups():
+    doc = wl.synthetic_workload(10, seed=0, shared_prefix_frac=1.0,
+                                prefix_len=wl.FINGERPRINT_BLOCK)
+    heads = {r["fingerprint"][0] for r in doc["requests"]}
+    assert len(heads) == 1  # every request opens with the SAME prefix
+    doc2 = wl.synthetic_workload(10, seed=0, shared_prefix_frac=0.0)
+    heads2 = {r["fingerprint"][0] for r in doc2["requests"]}
+    assert len(heads2) > 1
+
+
+def test_validate_workload_rejects_malformed():
+    assert schema_lib.validate_workload({}) != []
+    doc = wl.synthetic_workload(3, seed=0)
+    bad = json.loads(json.dumps(doc))
+    bad["requests"][1]["arrival_s"] = "soon"
+    assert schema_lib.validate_workload(bad) != []
+    bad2 = json.loads(json.dumps(doc))
+    del bad2["requests"][0]["max_new_tokens"]
+    assert schema_lib.validate_workload(bad2) != []
+    bad3 = json.loads(json.dumps(doc))
+    bad3["kind"] = "snapshot"
+    assert schema_lib.validate_workload(bad3) != []
+
+
+def test_write_load_roundtrip(tmp_path):
+    doc = wl.synthetic_workload(5, seed=2)
+    p = str(tmp_path / "w.json")
+    wl.write_workload(doc, p)
+    assert wl.load_workload(p) == doc
+    # dtx-obs validate understands the workload kind
+    assert obs_cli.main(["validate", p]) == 0
+
+
+# ---------------------------------------------------------------- replay (sim)
+
+
+def test_replay_sim_deterministic_and_identity():
+    doc = wl.synthetic_workload(8, seed=0, qps=0.5, mean_prompt=16,
+                                mean_new=8)
+    a = rp.replay_sim(doc, num_pages=33, page_size=8, max_batch=4)
+    b = rp.replay_sim(doc, num_pages=33, page_size=8, max_batch=4)
+    ident = rp.identity(a, b)
+    assert ident["identical"] is True
+    assert ident["determinism_frac"] == 1.0
+    assert ident["n_requests"] == 8
+    assert a["completed"] == 8 and a["terminals"] == {"result": 8}
+
+
+def test_identity_flags_a_divergent_request():
+    doc = wl.synthetic_workload(8, seed=0, qps=0.5)
+    a = rp.replay_sim(doc, num_pages=33, page_size=8, max_batch=4)
+    b = json.loads(json.dumps(a))
+    b["per_request"][3]["tokens"] = (b["per_request"][3]["tokens"] or 0) + 1
+    ident = rp.identity(a, b)
+    assert ident["identical"] is False
+    assert ident["determinism_frac"] == pytest.approx(7 / 8)
+    assert ident["mismatches"][0]["rid"] == a["per_request"][3]["rid"]
+    with pytest.raises(ValueError):
+        rp.identity(a, {"workload_id": "wl-other", "per_request": []})
+
+
+def test_replay_sim_recapture_roundtrips_to_same_workload(tmp_path):
+    """THE idempotence hook: replaying a workload under a recorder and
+    re-capturing the emitted span stream yields the SAME workload id
+    (fingerprints pass through verbatim; arrival offsets survive the
+    ticks-as-seconds clock at speed 1)."""
+    doc = wl.synthetic_workload(6, seed=3, qps=0.5, mean_prompt=16,
+                                mean_new=6)
+    d = str(tmp_path / "sim")
+    rec = rp.replay_recorder(d, doc["workload_id"])
+    rp.replay_sim(doc, num_pages=33, page_size=8, max_batch=4,
+                  recorder=rec)
+    rec.close()
+    doc2 = wl.capture(d)
+    assert doc2["workload_id"] == doc["workload_id"]
+    assert doc2["n_requests"] == doc["n_requests"]
+    for r, r2 in zip(doc["requests"], doc2["requests"]):
+        assert r2["prompt_len"] == r["prompt_len"]
+        assert r2["max_new_tokens"] == r["max_new_tokens"]
+        assert r2["fingerprint"] == r["fingerprint"]
+    # every replayed span self-labels with its source workload
+    from distributed_tensorflow_example_tpu.obs import spans as spans_lib
+    rows = spans_lib.load_spans(d)
+    assert rows and all(
+        row.get("replay_of") == doc["workload_id"] for row in rows)
+
+
+# ---------------------------------------------------------------- capacity
+
+
+def _flat_workload(n, arrival_gap_s, max_new):
+    reqs = [{"rid": i, "arrival_s": i * arrival_gap_s, "prompt_len": 8,
+             "max_new_tokens": max_new} for i in range(n)]
+    return {"workload_id": "wl-fixture", "n_requests": n,
+            "duration_s": (n - 1) * arrival_gap_s or 1.0,
+            "requests": reqs}
+
+
+def test_forecast_closed_form_exact():
+    # 4 requests over 2 s => offered 2 QPS; 10 new tokens each at
+    # 100 tok/s => sustainable 10 QPS at util 1.0 — exact by hand
+    doc = _flat_workload(4, arrival_gap_s=2 / 3, max_new=10)
+    doc["duration_s"] = 2.0
+    fc = cap_lib.forecast(doc, 100.0, utilization_target=1.0)
+    assert fc["sustainable_qps"] == 10.0
+    assert fc["offered_qps"] == 2.0
+    assert fc["mean_new_tokens"] == 10.0
+    assert fc["required_replicas"] == 1
+    assert fc["utilization"] == pytest.approx(0.2)
+    # halve the budget: sustainable halves, replicas re-ceil
+    fc2 = cap_lib.forecast(doc, 100.0, utilization_target=0.5)
+    assert fc2["sustainable_qps"] == 5.0
+    assert fc2["required_replicas"] == 1
+    fc3 = cap_lib.forecast(doc, 12.0, utilization_target=0.5)
+    assert fc3["sustainable_qps"] == 0.6
+    assert fc3["required_replicas"] == 4  # ceil(2 / 0.6)
+    with pytest.raises(ValueError):
+        cap_lib.forecast(doc, 0.0)
+    with pytest.raises(ValueError):
+        cap_lib.forecast(doc, 10.0, utilization_target=1.5)
+
+
+def test_measured_knee_prefers_sustained_points():
+    points = [
+        {"speed": 1, "qps_offered": 1.0, "qps_completed": 1.0,
+         "n_requests": 8, "completed": 8},
+        {"speed": 2, "qps_offered": 2.0, "qps_completed": 2.0,
+         "n_requests": 8, "completed": 8},
+        {"speed": 4, "qps_offered": 4.0, "qps_completed": 2.5,
+         "n_requests": 8, "completed": 8},
+    ]
+    knee = cap_lib.measured_knee(points)
+    assert knee["measured_qps"] == 2.5
+    assert knee["knee_speed"] == 4.0
+    assert knee["saturated"] is False
+    # past the knee requests start dropping: the unsustained point is
+    # excluded from the measurement but flips the saturated bit
+    points.append({"speed": 8, "qps_offered": 8.0, "qps_completed": 3.0,
+                   "n_requests": 8, "completed": 7})
+    knee2 = cap_lib.measured_knee(points)
+    assert knee2["measured_qps"] == 2.5
+    assert knee2["saturated"] is True
+    with pytest.raises(ValueError):
+        cap_lib.measured_knee([])
+
+
+def test_verdict_shortfall_vs_headroom():
+    ok = cap_lib.verdict(10.0, 9.0)
+    assert ok["ok"] is True and ok["rel_err"] == pytest.approx(0.1)
+    short = cap_lib.verdict(10.0, 7.0)      # 30% short > 25% tolerance
+    assert short["ok"] is False
+    # beating the forecast is headroom (ok), but still counts toward
+    # rel_err — a wildly conservative model drifts the gate
+    head = cap_lib.verdict(10.0, 14.0)
+    assert head["ok"] is True and head["rel_err"] == pytest.approx(0.4)
+    with pytest.raises(ValueError):
+        cap_lib.verdict(0.0, 1.0)
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def test_cli_capture_and_capacity_exit_codes(tmp_path, capsys):
+    doc = wl.synthetic_workload(6, seed=0, qps=0.5)
+    d = str(tmp_path / "run")
+    rec = rp.replay_recorder(d, doc["workload_id"])
+    rp.replay_sim(doc, num_pages=33, page_size=8, max_batch=4,
+                  recorder=rec)
+    rec.close()
+
+    out = str(tmp_path / "cap.json")
+    assert obs_cli.main(["capture", d, "-o", out]) == 0
+    captured = wl.load_workload(out)
+    assert captured["workload_id"] == doc["workload_id"]
+    # bad input: 2
+    assert obs_cli.main(["capture", str(tmp_path / "nope"), "-o",
+                         str(tmp_path / "x.json")]) == 2
+    capsys.readouterr()
+
+    # capacity: 0 clean / 2 bad input / 3 measured short of forecast
+    assert obs_cli.main(["capacity", out, "--service-tok-s", "100"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["kind"] == "capacity"
+    assert rep["workload_id"] == doc["workload_id"]
+    assert obs_cli.main(["capacity", str(tmp_path / "nope.json"),
+                         "--service-tok-s", "100"]) == 2
+    assert obs_cli.main(["capacity", out, "--service-tok-s", "0"]) == 2
+    capsys.readouterr()
+    fc = cap_lib.forecast(captured, 100.0)
+    low = fc["sustainable_qps"] * (1 - cap_lib.DEFAULT_TOLERANCE) - 0.01
+    assert obs_cli.main(["capacity", out, "--service-tok-s", "100",
+                         "--measured-qps", str(low)]) == 3
+    assert obs_cli.main(["capacity", out, "--service-tok-s", "100",
+                         "--measured-qps",
+                         str(fc["sustainable_qps"])]) == 0
+    capsys.readouterr()
+
+
+# --- the real decode engine (CPU jax; serving imports fine even where
+# the training stack's jax API is too new for the container) ---------------
+
+
+def test_engine_two_replay_identity_and_exactly_once(tmp_path):
+    """The acceptance invariant: capture a seeded source run off its
+    span stream, replay it TWICE through fresh seeded engines, and the
+    two replays agree on every typed terminal and every token count —
+    with the collector's exactly-once join holding over each replay's
+    self-labeled (replay_of) span dir."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from distributed_tensorflow_example_tpu.models import (
+        transformer as tfm)
+    from distributed_tensorflow_example_tpu.obs import (
+        collector as collector_lib)
+    from distributed_tensorflow_example_tpu.obs.spans import SpanRecorder
+    from distributed_tensorflow_example_tpu.serving.engine import (
+        DecodeEngine)
+
+    spec = tfm.TransformerSpec(
+        input_size=64, num_classes=10, seq_len=64, d_model=32,
+        n_heads=2, num_blocks=2, d_ff=64, objective="lm",
+        vocab_size=64, causal=True, compute_dtype=jnp.bfloat16)
+    params = tfm.init(jax.random.PRNGKey(0), spec)
+
+    def settle(eng):
+        import time
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10.0:
+            if not eng.sched.live and not eng.sched.waiting:
+                time.sleep(0.05)
+                break
+            time.sleep(0.02)
+
+    # ---- seeded source run
+    src = str(tmp_path / "src")
+    rec = SpanRecorder(src)
+    eng = DecodeEngine(spec, params, page_size=8, max_batch=4, seed=0,
+                       recorder=rec)
+    eng.start()
+    rng = np.random.RandomState(0)
+    rids = [eng.submit(rng.randint(1, 64, size=int(n)).tolist(),
+                       int(m))
+            for n, m in [(5, 4), (9, 3), (7, 5), (12, 4)]]
+    results = [eng.result(r, timeout=120.0) for r in rids]
+    settle(eng)
+    eng.stop()
+    rec.close()
+    assert all(r is not None for r in results)
+
+    doc = wl.capture(src)
+    assert schema_lib.validate_workload(doc) == []
+    assert doc["n_requests"] == 4
+
+    # ---- two replays through FRESH engines
+    reports = []
+    for i in range(2):
+        d = str(tmp_path / f"replay{i}")
+        rrec = rp.replay_recorder(d, doc["workload_id"])
+        e2 = DecodeEngine(spec, params, page_size=8, max_batch=4,
+                          seed=0, recorder=rrec)
+        e2.start()
+        try:
+            reports.append(rp.replay_engine(
+                e2, doc, vocab_size=64, speed=25.0))
+        finally:
+            settle(e2)
+            e2.stop()
+            rrec.close()
+        fr = collector_lib.fleet_report([d])
+        assert fr["exactly_once"] is True
+
+    ident = rp.identity(reports[0], reports[1])
+    assert ident["identical"] is True
+    assert ident["determinism_frac"] == 1.0
+    assert reports[0]["completed"] == 4
+    # token content actually decoded (not just counted): token_sig is
+    # present and equal per request
+    sigs = {r["rid"]: r["token_sig"] for r in reports[0]["per_request"]}
+    assert all(s for s in sigs.values())
+    for r in reports[1]["per_request"]:
+        assert sigs[r["rid"]] == r["token_sig"]
